@@ -1,0 +1,25 @@
+"""cxxnet_tpu — a TPU-native deep learning framework with the capabilities of cxxnet.
+
+A ground-up JAX/XLA re-design of the 2014 dmlc cxxnet framework
+(reference: /root/reference). The reference's mechanism stack —
+mshadow expression templates, per-GPU host threads, async parameter-server
+push/pull — is replaced wholesale by the TPU-idiomatic equivalents:
+
+  * layers are pure ``init``/``apply`` functions over jax arrays
+  * the net is a functional DAG interpreter differentiated by ``jax.grad``
+  * the whole train step (fwd + bwd + optimizer) is one jit-compiled
+    program over a ``jax.sharding.Mesh``; gradient synchronisation is an
+    XLA all-reduce over the ICI mesh axis instead of PS push/pull
+  * the input pipeline is a host-side iterator chain feeding device batches
+
+The user-visible API surface — the ``k = v`` config dialect, the
+``netconfig`` graph language, layer/updater/iterator names and the CLI
+tasks — matches the reference so existing configs run with ``dev = tpu``.
+"""
+
+__version__ = "0.1.0"
+
+from . import config
+from . import graph
+
+__all__ = ["config", "graph", "__version__"]

@@ -1,0 +1,92 @@
+"""Config-dialect parser tests (semantics of reference src/utils/config.h)."""
+import pytest
+
+from cxxnet_tpu import config
+
+
+def test_basic_pairs():
+    entries = config.parse_string("a = 1\nb=2\nc =3\n")
+    assert entries == [("a", "1"), ("b", "2"), ("c", "3")]
+
+
+def test_comments_skipped():
+    text = "# leading comment\na = 1 # trailing\n# full line\nb = 2\n"
+    assert config.parse_string(text) == [("a", "1"), ("b", "2")]
+
+
+def test_quoted_string_value():
+    text = 'path_img = "./data/train images.gz"\n'
+    assert config.parse_string(text) == [("path_img", "./data/train images.gz")]
+
+
+def test_quoted_string_with_escape():
+    text = r'v = "a\"b"' + "\n"
+    assert config.parse_string(text) == [("v", 'a"b')]
+
+
+def test_multiline_quoted_string():
+    text = "v = 'line1\nline2'\nw = 3\n"
+    assert config.parse_string(text) == [("v", "line1\nline2"), ("w", "3")]
+
+
+def test_unterminated_string_raises():
+    with pytest.raises(config.ConfigError):
+        config.parse_string('v = "abc\n')
+
+
+def test_malformed_entry_stops_parsing():
+    # the reference's Next() silently stops at the first malformed triple;
+    # we match that (plus a warning) so reference-accepted files behave
+    # identically
+    with pytest.warns(UserWarning):
+        assert config.parse_string("a = 1\nb = = c\nd = 2\n") == [("a", "1")]
+    with pytest.warns(UserWarning):
+        assert config.parse_string("= 1\na = 2") == []
+
+
+def test_newline_breaks_entry():
+    # NAME = VALUE must sit on one line (reference GetNextToken new_line
+    # flag); an entry broken across lines terminates parsing
+    with pytest.warns(UserWarning):
+        assert config.parse_string("a =\n1\nb = 2\n") == []
+    with pytest.warns(UserWarning):
+        assert config.parse_string("a\n= 1\n") == []
+
+
+def test_multiline_quoted_value_ok_on_same_line_start():
+    # quoted values may contain newlines without breaking the triple
+    assert config.parse_string("v = 'x\ny'\nw = 1\n") == [("v", "x\ny"), ("w", "1")]
+
+
+def test_glued_equals():
+    assert config.parse_string("a=1") == [("a", "1")]
+    assert config.parse_string("a =1") == [("a", "1")]
+    assert config.parse_string("a= 1") == [("a", "1")]
+
+
+def test_order_preserved():
+    text = "z = 1\na = 2\nz = 3\n"
+    assert config.parse_string(text) == [("z", "1"), ("a", "2"), ("z", "3")]
+
+
+def test_bracketed_keys():
+    text = "layer[0->1] = fullc:fc1\nmetric[label] = error\n"
+    assert config.parse_string(text) == [
+        ("layer[0->1]", "fullc:fc1"), ("metric[label]", "error")]
+
+
+def test_cli_overrides():
+    out = config.parse_cli_overrides(["eta=0.05", "task=pred", "noequals"])
+    assert out == [("eta", "0.05"), ("task", "pred")]
+
+
+def test_reference_mnist_conf_shape():
+    """The in-tree reference MNIST config must parse with expected keys."""
+    entries = config.parse_file("/root/reference/example/MNIST/MNIST.conf")
+    keys = [k for k, _ in entries]
+    assert keys.count("iter") == 4  # two iterators, two "iter = end"
+    d = dict(entries)
+    assert d["netconfig"] == "end"  # last wins
+    assert d["input_shape"] == "1,1,784"
+    assert d["batch_size"] == "100"
+    assert d["metric[label]"] == "error"

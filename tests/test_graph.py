@@ -1,0 +1,253 @@
+"""NetConfig DAG builder tests (semantics of reference src/nnet/nnet_config.h)."""
+import pytest
+
+from cxxnet_tpu import config
+from cxxnet_tpu.graph import GraphConfigError, NetConfig
+
+
+def build(text):
+    net = NetConfig()
+    net.configure(config.parse_string(text))
+    return net
+
+
+MLP = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+  init_sigma = 0.01
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,784
+batch_size = 100
+eta = 0.1
+"""
+
+
+def test_mlp_structure():
+    net = build(MLP)
+    assert net.node_names == ["in", "fc1", "sg1", "fc2"]
+    assert [l.type for l in net.layers] == ["fullc", "sigmoid", "fullc", "softmax"]
+    # softmax is a self-loop on the top node
+    assert net.layers[3].nindex_in == net.layers[3].nindex_out == [3]
+    # wiring
+    assert net.layers[0].nindex_in == [0] and net.layers[0].nindex_out == [1]
+    assert net.layers[2].nindex_in == [2] and net.layers[2].nindex_out == [3]
+    assert net.input_shape == (1, 1, 784)
+
+
+def test_layer_cfg_buckets():
+    net = build(MLP)
+    assert ("nhidden", "100") in net.layercfg[0]
+    assert ("init_sigma", "0.01") in net.layercfg[0]
+    assert ("nhidden", "10") in net.layercfg[2]
+    assert net.layercfg[1] == []
+    # globals land in defcfg, not in layer buckets
+    assert ("eta", "0.1") in net.defcfg
+    assert ("batch_size", "100") in net.defcfg
+    # effective cfg = defaults then layer bucket (later wins downstream)
+    eff = net.effective_layer_cfg(0)
+    assert eff.index(("eta", "0.1")) < eff.index(("nhidden", "100"))
+
+
+def test_numeric_node_names():
+    net = build("""
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+layer[1->2] = max_pooling
+  kernel_size = 2
+layer[2->3] = flatten
+layer[3->3] = dropout
+layer[3->4] = fullc:fc1
+  nhidden = 10
+layer[4->4] = softmax
+netconfig=end
+""")
+    assert net.node_names == ["in", "1", "2", "3", "4"]
+    assert net.layers[3].nindex_in == [3] == net.layers[3].nindex_out
+
+
+def test_plus_zero_tag_ignored():
+    # the reference only honors a tag on the literal "+1:" form; layer[+0:x]
+    # stays a self-loop with the tag ignored (nnet_config.h:309-324)
+    net = build("""
+netconfig=start
+layer[+1:h] = fullc
+  nhidden = 4
+layer[+0:ignored] = sigmoid
+netconfig=end
+""")
+    assert net.layers[1].nindex_in == net.layers[1].nindex_out == [1]
+    assert "ignored" not in net.node_name_map
+
+
+def test_extra_data_after_layers_raises():
+    with pytest.raises(GraphConfigError):
+        build("""
+netconfig=start
+layer[+1] = fullc
+  nhidden = 4
+netconfig=end
+extra_data_num = 1
+""")
+
+
+def test_reconfigure_no_duplication():
+    text = """
+extra_data_num = 1
+extra_data_shape[1] = 1,1,10
+label_vec[0,2) = xy
+netconfig=start
+layer[0->9] = flatten
+netconfig=end
+"""
+    net = build(text)
+    net.configure(config.parse_string(text))
+    assert net.extra_shape == [1, 1, 10]
+    assert net.label_range == [(0, 1), (0, 2)]
+    assert net.label_name_map == {"label": 0, "xy": 1}
+
+
+def test_anonymous_plus_one_node():
+    net = build("""
+netconfig=start
+layer[+1] = fullc
+  nhidden = 4
+layer[+1] = fullc
+  nhidden = 2
+netconfig=end
+""")
+    assert net.node_names == ["in", "!node-after-0", "!node-after-1"]
+
+
+def test_undefined_input_node_raises():
+    with pytest.raises(GraphConfigError):
+        build("netconfig=start\nlayer[bogus->out] = fullc\nnetconfig=end\n")
+
+
+def test_shared_layer():
+    net = build("""
+netconfig=start
+layer[0->1] = fullc:w1
+  nhidden = 8
+layer[1->2] = sigmoid
+layer[2->3] = share[w1]
+netconfig=end
+""")
+    assert net.layers[2].type == "share"
+    assert net.layers[2].primary_layer_index == 0
+    assert net.resolve_primary(2) == 0
+    # shared layer inherits primary's bucket
+    assert ("nhidden", "8") in net.effective_layer_cfg(2)
+
+
+def test_shared_layer_param_raises():
+    with pytest.raises(GraphConfigError):
+        build("""
+netconfig=start
+layer[0->1] = fullc:w1
+  nhidden = 8
+layer[1->2] = share[w1]
+  nhidden = 9
+netconfig=end
+""")
+
+
+def test_shared_layer_unknown_tag_raises():
+    with pytest.raises(GraphConfigError):
+        build("netconfig=start\nlayer[0->1] = share[nope]\nnetconfig=end\n")
+
+
+def test_multi_input_concat():
+    net = build("""
+netconfig=start
+layer[0->a] = conv:c1
+  kernel_size = 1
+  nchannel = 4
+layer[0->b] = conv:c2
+  kernel_size = 1
+  nchannel = 4
+layer[a,b->cat] = ch_concat
+netconfig=end
+""")
+    assert net.layers[2].nindex_in == [1, 2]
+    assert net.layers[2].nindex_out == [3]
+    # multi-output layer invalidates the +N shorthand top node
+    with pytest.raises(GraphConfigError):
+        build("""
+netconfig=start
+layer[0->a,b] = split
+layer[+1] = sigmoid
+netconfig=end
+""")
+
+
+def test_label_vec_ranges():
+    net = build("label_vec[0,2) = xy\nlabel_vec[2,3) = z\n")
+    assert net.label_name_map == {"label": 0, "xy": 1, "z": 2}
+    assert net.label_range == [(0, 1), (0, 2), (2, 3)]
+
+
+def test_extra_data_nodes():
+    net = build("""
+extra_data_num = 2
+extra_data_shape[1] = 1,1,10
+extra_data_shape[2] = 1,1,20
+netconfig=start
+layer[0->3] = flatten
+netconfig=end
+""")
+    assert net.node_names[:3] == ["in", "in_1", "in_2"]
+    assert net.extra_data_num == 2
+    assert net.extra_shape == [1, 1, 10, 1, 1, 20]
+
+
+def test_pairtest_parsing():
+    net = build("""
+netconfig=start
+layer[0->1] = pairtest-conv-conv:pt
+  kernel_size = 3
+  nchannel = 2
+netconfig=end
+""")
+    assert net.layers[0].type == "pairtest"
+    assert net.layers[0].pair == ("conv", "conv")
+
+
+def test_reconfigure_checks_structure():
+    net = build(MLP)
+    # reconfiguring with identical structure is fine, buckets refresh
+    net.configure(config.parse_string(MLP))
+    assert net.num_layers == 4
+    # mismatched structure raises
+    with pytest.raises(GraphConfigError):
+        net.configure(config.parse_string("""
+netconfig=start
+layer[+1:zz] = fullc:other
+  nhidden = 3
+netconfig=end
+"""))
+
+
+def test_structure_roundtrip():
+    net = build(MLP)
+    state = net.structure_state()
+    net2 = NetConfig.from_structure_state(state)
+    assert net2.node_names == net.node_names
+    assert net2.layer_name_map == net.layer_name_map
+    for a, b in zip(net.layers, net2.layers):
+        assert a.same_structure(b)
+
+
+def test_reference_mnist_conv_conf():
+    entries = config.parse_file("/root/reference/example/MNIST/MNIST_CONV.conf")
+    net = NetConfig()
+    net.configure(entries)
+    assert [l.type for l in net.layers] == [
+        "conv", "max_pooling", "flatten", "dropout", "fullc", "sigmoid",
+        "fullc", "softmax"]
+    assert net.input_shape == (1, 28, 28)
