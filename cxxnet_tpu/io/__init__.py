@@ -1,0 +1,320 @@
+"""Data pipeline: composable iterator chain (reference: src/io/data.h:19-188).
+
+The reference iterator protocol (SetParam/Init/BeforeFirst/Next/Value)
+is kept verbatim because configs name iterators and their params. Base
+iterators produce whole ``DataBatch``es; wrapper iterators (threadbuffer)
+add host-side prefetch so the accelerator never waits on IO — the TPU
+equivalent of the reference's double-buffered reader threads
+(src/utils/thread_buffer.h:22).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+ConfigEntry = Tuple[str, str]
+
+
+@dataclass
+class DataBatch:
+    """One dense batch (reference: src/io/data.h:79-150).
+
+    data: (batch, channel, height, width) float32
+    label: (batch, label_width) float32
+    num_batch_padd: trailing instances that are padding (visible in
+    Predict output trimming, reference cxxnet_main.cpp:275-279)
+    """
+    data: np.ndarray
+    label: np.ndarray
+    num_batch_padd: int = 0
+    extra_data: List[np.ndarray] = field(default_factory=list)
+    inst_index: Optional[np.ndarray] = None
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+
+class DataIterator:
+    """Iterator protocol (reference: src/io/data.h:19-38)."""
+
+    def set_param(self, name: str, val: str) -> None:
+        pass
+
+    def init(self) -> None:
+        pass
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def next(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def value(self) -> DataBatch:
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.before_first()
+        while self.next():
+            yield self.value
+
+
+class ArrayIterator(DataIterator):
+    """Serve an in-memory (n, c, h, w) array + labels as DataBatches with
+    the reference's tail semantics (iter_mnist-inl.hpp:14-158): with
+    round_batch the tail wraps to the head and reports num_batch_padd;
+    otherwise the tail partial batch is dropped (reference MNIST drops to
+    full batches via Next loop)."""
+
+    def __init__(self, data: np.ndarray, label: np.ndarray,
+                 batch_size: int, shuffle: bool = False,
+                 round_batch: bool = True, seed: int = 0) -> None:
+        self.data = data
+        self.label = label if label.ndim == 2 else label[:, None]
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.round_batch = round_batch
+        self.rng = np.random.RandomState(seed)
+        self.order = np.arange(data.shape[0])
+        self._pos = 0
+        self._batch: Optional[DataBatch] = None
+
+    def before_first(self) -> None:
+        self._pos = 0
+        if self.shuffle:
+            self.rng.shuffle(self.order)
+
+    def next(self) -> bool:
+        n = self.data.shape[0]
+        bs = self.batch_size
+        if self._pos + bs <= n:
+            idx = self.order[self._pos:self._pos + bs]
+            self._batch = DataBatch(self.data[idx], self.label[idx],
+                                    num_batch_padd=0, inst_index=idx)
+            self._pos += bs
+            return True
+        remain = n - self._pos
+        if remain > 0 and self.round_batch:
+            # wrap around to the head (cycling if batch > dataset),
+            # mark padding count
+            reps = -(-(bs - remain) // n)  # ceil
+            head = np.tile(self.order, reps)[: bs - remain]
+            idx = np.concatenate([self.order[self._pos:], head])
+            self._batch = DataBatch(self.data[idx], self.label[idx],
+                                    num_batch_padd=bs - remain,
+                                    inst_index=idx)
+            self._pos = n
+            return True
+        return False
+
+    @property
+    def value(self) -> DataBatch:
+        return self._batch
+
+
+class SyntheticIterator(ArrayIterator):
+    """Deterministic synthetic classification data (no reference analogue;
+    used where the reference examples assume downloaded MNIST files).
+
+    Labels are a simple linear rule of the inputs so small nets can
+    actually learn them — convergence smoke tests rely on this.
+    """
+
+    def __init__(self) -> None:
+        self.shape = (1, 1, 16)
+        self.nclass = 4
+        self.ninst = 512
+        self.batch_size_cfg = 64
+        self.shuffle_cfg = False
+        self.seed = 0
+        self.round_batch_cfg = True
+        self.label_width = 1
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "shape":
+            self.shape = tuple(int(x) for x in val.split(","))
+        elif name == "input_shape":
+            self.shape = tuple(int(x) for x in val.split(","))
+        elif name == "nclass":
+            self.nclass = int(val)
+        elif name == "ninst":
+            self.ninst = int(val)
+        elif name == "batch_size":
+            self.batch_size_cfg = int(val)
+        elif name == "shuffle":
+            self.shuffle_cfg = bool(int(val))
+        elif name == "seed":
+            self.seed = int(val)
+        elif name == "round_batch":
+            self.round_batch_cfg = bool(int(val))
+        elif name == "label_width":
+            self.label_width = int(val)
+
+    def init(self) -> None:
+        rng = np.random.RandomState(self.seed + 42)
+        c, h, w = self.shape
+        x = rng.randn(self.ninst, c, h, w).astype(np.float32)
+        proj = rng.randn(c * h * w, self.nclass).astype(np.float32)
+        logits = x.reshape(self.ninst, -1) @ proj
+        y = logits.argmax(axis=1).astype(np.float32)
+        label = np.tile(y[:, None], (1, self.label_width))
+        super().__init__(x, label, self.batch_size_cfg,
+                         shuffle=self.shuffle_cfg,
+                         round_batch=self.round_batch_cfg, seed=self.seed)
+
+
+class MNISTIterator(ArrayIterator):
+    """MNIST idx-format reader (reference: src/io/iter_mnist-inl.hpp:14-158):
+    gz (or raw) idx files, optional shuffle, flat (1,1,784) or 2D
+    (1,28,28) shape via input_flat."""
+
+    def __init__(self) -> None:
+        self.path_img = ""
+        self.path_label = ""
+        self.input_flat = 1
+        self.shuffle_cfg = False
+        self.batch_size_cfg = 100
+        self.seed = 0
+        self.round_batch_cfg = True
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "path_img":
+            self.path_img = val
+        elif name == "path_label":
+            self.path_label = val
+        elif name == "input_flat":
+            self.input_flat = int(val)
+        elif name == "shuffle":
+            self.shuffle_cfg = bool(int(val))
+        elif name == "batch_size":
+            self.batch_size_cfg = int(val)
+        elif name == "seed":
+            self.seed = int(val)
+        elif name == "round_batch":
+            self.round_batch_cfg = bool(int(val))
+
+    @staticmethod
+    def _read_idx(path: str) -> np.ndarray:
+        import gzip
+        import struct
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            raw = f.read()
+        magic, = struct.unpack(">i", raw[:4])
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "i" * ndim, raw[4:4 + 4 * ndim])
+        data = np.frombuffer(raw, np.uint8, offset=4 + 4 * ndim)
+        return data.reshape(dims)
+
+    def init(self) -> None:
+        img = self._read_idx(self.path_img).astype(np.float32) / 256.0
+        lab = self._read_idx(self.path_label).astype(np.float32)
+        n = img.shape[0]
+        if self.input_flat:
+            img = img.reshape(n, 1, 1, -1)
+        else:
+            img = img.reshape(n, 1, img.shape[1], img.shape[2])
+        super().__init__(img, lab[:, None], self.batch_size_cfg,
+                         shuffle=self.shuffle_cfg,
+                         round_batch=self.round_batch_cfg, seed=self.seed)
+
+
+class ThreadBufferIterator(DataIterator):
+    """Background-thread batch prefetch (reference:
+    src/io/iter_batch_proc-inl.hpp:136-226, utils/thread_buffer.h:22):
+    a bounded queue keeps ``buffer_size`` batches ready ahead of the
+    consumer so host IO overlaps device compute."""
+
+    def __init__(self, base: DataIterator, buffer_size: int = 2) -> None:
+        self.base = base
+        self.buffer_size = buffer_size
+        self._queue = None
+        self._thread = None
+        self._batch: Optional[DataBatch] = None
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "buffer_size":
+            self.buffer_size = int(val)
+        else:
+            self.base.set_param(name, val)
+
+    def init(self) -> None:
+        self.base.init()
+
+    def _producer(self, queue) -> None:
+        self.base.before_first()
+        while self.base.next():
+            queue.put(self.base.value)
+        queue.put(None)
+
+    def before_first(self) -> None:
+        import queue as queue_mod
+        import threading
+        if self._thread is not None:
+            # drain the previous producer so it can exit
+            while self._queue.get() is not None:
+                pass
+            self._thread.join()
+        self._queue = queue_mod.Queue(maxsize=self.buffer_size)
+        self._thread = threading.Thread(
+            target=self._producer, args=(self._queue,), daemon=True)
+        self._thread.start()
+
+    def next(self) -> bool:
+        if self._queue is None:
+            self.before_first()
+        item = self._queue.get()
+        if item is None:
+            self._thread.join()
+            self._thread = None
+            self._queue = None
+            return False
+        self._batch = item
+        return True
+
+    @property
+    def value(self) -> DataBatch:
+        return self._batch
+
+
+def create_iterator(cfg: Sequence[ConfigEntry]) -> DataIterator:
+    """Factory chaining iterators in config order
+    (reference: src/io/data.cpp:24-75)."""
+    chain: List[DataIterator] = []
+    params: List[ConfigEntry] = []
+    base: Optional[DataIterator] = None
+    for name, val in cfg:
+        if name == "iter":
+            if val == "mnist":
+                base = MNISTIterator()
+                chain.append(base)
+            elif val == "synth":
+                base = SyntheticIterator()
+                chain.append(base)
+            elif val == "threadbuffer":
+                if base is None:
+                    raise ValueError("threadbuffer needs a base iterator")
+                base = ThreadBufferIterator(base)
+                chain[-1] = base
+            elif val == "end":
+                pass
+            else:
+                # imgbin/img/imgbinx arrive with the image pipeline module
+                from . import image as image_io
+                base = image_io.create_base_iterator(val)
+                if base is None:
+                    raise ValueError("unknown iterator type %s" % val)
+                chain.append(base)
+        else:
+            params.append((name, val))
+    if base is None:
+        raise ValueError("config does not declare an iterator")
+    for it in chain:
+        for k, v in params:
+            it.set_param(k, v)
+    base.init()
+    return base

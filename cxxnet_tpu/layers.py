@@ -1,0 +1,947 @@
+"""Layer library: every cxxnet layer as a pure ``init``/``apply`` function.
+
+Design. The reference's ``ILayer`` (reference: src/layer/layer.h:162-279)
+is an imperative fwd/bwd pair mutating device nodes in place, with
+gradients accumulated by hand. Here each layer is a *pure function
+module*:
+
+  * ``infer_shape(in_shapes) -> out_shapes``   (mirrors InitConnection)
+  * ``init_params(rng) -> dict[str, jnp.ndarray]``  (mirrors InitModel)
+  * ``apply(params, inputs, ctx) -> outputs``   (mirrors Forward)
+
+Backprop is *derived*, not written: the graph interpreter (model.py)
+differentiates the composed forward with ``jax.grad``. Loss layers add a
+scalar term to ``ctx.losses`` whose gradient w.r.t. their input equals the
+reference's hand-set gradient, including the
+``grad_scale/(batch_size*update_period)`` scaling
+(reference: src/layer/loss/loss_layer_base-inl.hpp:62).
+
+Node layout matches the reference (reference: src/layer/layer.h:31-46):
+4D ``(batch, channel, height, width)``; flat vectors are
+``(batch, 1, 1, n)``. The "mat view" is the reshape to ``(batch, n)``.
+
+Every shape is static, control flow is trace-friendly, and the matmuls /
+convs sit directly on the MXU via ``jnp.dot`` / ``lax.conv_general_dilated``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Shape4 = Tuple[int, int, int, int]
+Params = Dict[str, jnp.ndarray]
+
+_REGISTRY: Dict[str, Callable[..., "Layer"]] = {}
+
+
+def register(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        cls.type_name = name
+        return cls
+    return deco
+
+
+def create_layer(type_name: str, cfg: Sequence[Tuple[str, str]],
+                 label_name_map: Optional[Dict[str, int]] = None) -> "Layer":
+    """Factory mirroring CreateLayer_ (reference: src/layer/layer_impl-inl.hpp:37-79)."""
+    if type_name not in _REGISTRY:
+        raise ValueError('unknown layer type: "%s"' % type_name)
+    layer = _REGISTRY[type_name]()
+    layer.label_name_map = label_name_map or {"label": 0}
+    for k, v in cfg:
+        layer.set_param(k, v)
+    return layer
+
+
+# ----------------------------------------------------------------------
+@dataclass
+class LayerParam:
+    """Common hyper-parameters (reference: src/layer/param.h:15-111)."""
+    num_hidden: int = 0
+    init_sigma: float = 0.01
+    init_uniform: float = -1.0
+    init_bias: float = 0.0
+    num_channel: int = 0
+    random_type: int = 0        # 0 gaussian, 1 uniform/xavier, 2 kaiming
+    num_group: int = 1
+    kernel_height: int = 0
+    kernel_width: int = 0
+    stride: int = 1
+    pad_y: int = 0
+    pad_x: int = 0
+    no_bias: int = 0
+    silent: int = 0
+    num_input_channel: int = 0
+    num_input_node: int = 0
+
+    def set_param(self, name: str, val: str) -> bool:
+        ok = True
+        if name == "init_sigma":
+            self.init_sigma = float(val)
+        elif name == "init_uniform":
+            self.init_uniform = float(val)
+        elif name == "init_bias":
+            self.init_bias = float(val)
+        elif name == "random_type":
+            if val == "gaussian":
+                self.random_type = 0
+            elif val in ("uniform", "xavier"):
+                self.random_type = 1
+            elif val == "kaiming":
+                self.random_type = 2
+            else:
+                raise ValueError("invalid random_type %s" % val)
+        elif name == "nhidden":
+            self.num_hidden = int(val)
+        elif name == "nchannel":
+            self.num_channel = int(val)
+        elif name == "ngroup":
+            self.num_group = int(val)
+        elif name == "kernel_size":
+            self.kernel_height = self.kernel_width = int(val)
+        elif name == "kernel_height":
+            self.kernel_height = int(val)
+        elif name == "kernel_width":
+            self.kernel_width = int(val)
+        elif name == "stride":
+            self.stride = int(val)
+        elif name == "pad":
+            self.pad_y = self.pad_x = int(val)
+        elif name == "pad_y":
+            self.pad_y = int(val)
+        elif name == "pad_x":
+            self.pad_x = int(val)
+        elif name == "no_bias":
+            self.no_bias = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        else:
+            ok = False
+        return ok
+
+    def rand_init_weight(self, rng, shape, in_num: int, out_num: int):
+        """Weight init (reference: src/layer/param.h:113-138)."""
+        if self.random_type == 0:
+            return jax.random.normal(rng, shape, jnp.float32) * self.init_sigma
+        if self.random_type == 1:
+            a = math.sqrt(3.0 / (in_num + out_num))
+            if self.init_uniform > 0:
+                a = self.init_uniform
+            return jax.random.uniform(rng, shape, jnp.float32, -a, a)
+        if self.random_type == 2:
+            if self.num_hidden > 0:
+                sigma = math.sqrt(2.0 / self.num_hidden)
+            else:
+                sigma = math.sqrt(
+                    2.0 / (self.num_channel * self.kernel_width
+                           * self.kernel_height))
+            return jax.random.normal(rng, shape, jnp.float32) * sigma
+        raise ValueError("unsupported random_type %d" % self.random_type)
+
+
+@dataclass
+class ApplyContext:
+    """Per-step context threaded through layer application.
+
+    Replaces the reference's LabelInfo + global SetParam broadcast
+    (reference: src/layer/layer.h:96-121, loss_layer_base-inl.hpp:22-27).
+    """
+    train: bool = False
+    rng: Optional[jnp.ndarray] = None         # folded per layer by the model
+    labels: Optional[List[jnp.ndarray]] = None  # one (batch, w) per label field
+    batch_size: int = 1                        # GLOBAL batch size
+    update_period: int = 1
+    epoch: jnp.ndarray = 0                     # update counter (may be traced)
+    losses: List[jnp.ndarray] = field(default_factory=list)
+    compute_dtype: jnp.dtype = jnp.float32
+
+
+def _mat(x: jnp.ndarray) -> jnp.ndarray:
+    """Flat 2D view of a node (reference: layer.h:48-50 FlatTo2D)."""
+    return x.reshape(x.shape[0], -1)
+
+
+def _is_mat(shape: Shape4) -> bool:
+    return shape[1] == 1 and shape[2] == 1
+
+
+class Layer:
+    """Base class; one instance per connection, holding static config only."""
+    type_name = "?"
+    has_params = False
+    is_loss = False
+
+    def __init__(self) -> None:
+        self.param = LayerParam()
+        self.label_name_map: Dict[str, int] = {"label": 0}
+        self.in_shapes: List[Shape4] = []
+        self.out_shapes: List[Shape4] = []
+
+    # -- config ---------------------------------------------------------
+    def set_param(self, name: str, val: str) -> None:
+        self.param.set_param(name, val)
+
+    # -- structure ------------------------------------------------------
+    def infer_shape(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        self._check_arity(in_shapes, 1, 1)
+        out = self._infer(in_shapes)
+        self.in_shapes = list(in_shapes)
+        self.out_shapes = out
+        return out
+
+    def _infer(self, in_shapes: List[Shape4]) -> List[Shape4]:
+        return [in_shapes[0]]
+
+    def _check_arity(self, in_shapes, nin, nout) -> None:
+        if nin is not None and len(in_shapes) != nin:
+            raise ValueError("%s: layer only supports %d input(s)"
+                             % (self.type_name, nin))
+
+    # -- params ---------------------------------------------------------
+    def init_params(self, rng) -> Params:
+        return {}
+
+    # -- compute --------------------------------------------------------
+    def apply(self, params: Params, inputs: List[jnp.ndarray],
+              ctx: ApplyContext) -> List[jnp.ndarray]:
+        raise NotImplementedError
+
+
+# ======================================================================
+# dense / structural layers
+# ======================================================================
+@register("fullc")
+class FullConnectLayer(Layer):
+    """out = in . W^T + bias (reference: src/layer/fullc_layer-inl.hpp:100-117).
+
+    Weight stored as (nhidden, ninput) exactly like the reference wmat_.
+    """
+    has_params = True
+
+    def _infer(self, in_shapes):
+        (n, c, h, w) = in_shapes[0]
+        if not _is_mat(in_shapes[0]):
+            raise ValueError("FullcLayer: input needs to be a matrix")
+        if self.param.num_hidden <= 0:
+            raise ValueError("FullcLayer: must set nhidden correctly")
+        if self.param.num_input_node == 0:
+            self.param.num_input_node = w
+        elif self.param.num_input_node != w:
+            raise ValueError("FullcLayer: input hidden nodes inconsistent")
+        return [(n, 1, 1, self.param.num_hidden)]
+
+    def init_params(self, rng) -> Params:
+        nh, ni = self.param.num_hidden, self.param.num_input_node
+        wmat = self.param.rand_init_weight(rng, (nh, ni), ni, nh)
+        p = {"wmat": wmat}
+        if self.param.no_bias == 0:
+            p["bias"] = jnp.full((nh,), self.param.init_bias, jnp.float32)
+        return p
+
+    def apply(self, params, inputs, ctx):
+        x = _mat(inputs[0])
+        w = params["wmat"].astype(ctx.compute_dtype)
+        out = jnp.dot(x.astype(ctx.compute_dtype), w.T,
+                      preferred_element_type=jnp.float32)
+        if self.param.no_bias == 0:
+            out = out + params["bias"]
+        n = inputs[0].shape[0]
+        return [out.reshape(n, 1, 1, self.param.num_hidden)]
+
+
+@register("flatten")
+class FlattenLayer(Layer):
+    """(b,c,h,w) -> (b,1,1,c*h*w) (reference: src/layer/flatten_layer-inl.hpp:14-29)."""
+
+    def _infer(self, in_shapes):
+        n, c, h, w = in_shapes[0]
+        return [(n, 1, 1, c * h * w)]
+
+    def apply(self, params, inputs, ctx):
+        n = inputs[0].shape[0]
+        return [inputs[0].reshape(n, 1, 1, -1)]
+
+
+@register("bias")
+class BiasLayer(Layer):
+    """Self-loop additive bias for flat nodes
+    (reference: src/layer/bias_layer-inl.hpp:14-86)."""
+    has_params = True
+
+    def _infer(self, in_shapes):
+        if not _is_mat(in_shapes[0]):
+            raise ValueError("BiasLayer only works on flat nodes")
+        if self.param.num_input_node == 0:
+            self.param.num_input_node = in_shapes[0][3]
+        elif self.param.num_input_node != in_shapes[0][3]:
+            raise ValueError("BiasLayer: input hidden nodes inconsistent")
+        return [in_shapes[0]]
+
+    def init_params(self, rng) -> Params:
+        return {"bias": jnp.full((self.param.num_input_node,),
+                                 self.param.init_bias, jnp.float32)}
+
+    def apply(self, params, inputs, ctx):
+        return [inputs[0] + params["bias"].reshape(1, 1, 1, -1)]
+
+
+@register("split")
+class SplitLayer(Layer):
+    """1 -> N copy; gradient is the sum (derived automatically)
+    (reference: src/layer/split_layer-inl.hpp:12-47)."""
+
+    n_out = 1
+
+    def infer_shape(self, in_shapes):
+        out = [in_shapes[0]] * self.n_out
+        self.in_shapes = list(in_shapes)
+        self.out_shapes = out
+        return out
+
+    def apply(self, params, inputs, ctx):
+        return [inputs[0]] * self.n_out
+
+
+class _ConcatBase(Layer):
+    """N -> 1 concat along an axis (reference: src/layer/concat_layer-inl.hpp:12-82)."""
+    axis = 3
+
+    def infer_shape(self, in_shapes):
+        if len(in_shapes) < 2 or len(in_shapes) > 4:
+            raise ValueError("Concat layer supports 2-4 inputs")
+        base = list(in_shapes[0])
+        total = 0
+        for s in in_shapes:
+            total += s[self.axis]
+            for j in range(4):
+                if j != self.axis and s[j] != base[j]:
+                    raise ValueError("Concat shape doesn't match")
+        base[self.axis] = total
+        out = [tuple(base)]
+        self.in_shapes = list(in_shapes)
+        self.out_shapes = out
+        return out
+
+    def apply(self, params, inputs, ctx):
+        return [jnp.concatenate(inputs, axis=self.axis)]
+
+
+@register("concat")
+class ConcatLayer(_ConcatBase):
+    axis = 3
+
+
+@register("ch_concat")
+class ChConcatLayer(_ConcatBase):
+    axis = 1
+
+
+# ======================================================================
+# activations
+# ======================================================================
+class _ActivationLayer(Layer):
+    """Elementwise activation (reference: src/layer/activation_layer-inl.hpp:12-44).
+
+    The reference computes the backward pass from the *activated* value;
+    jax.grad derives the identical expression from this forward.
+    """
+    fn: Callable[[jnp.ndarray], jnp.ndarray] = staticmethod(lambda x: x)
+
+    def apply(self, params, inputs, ctx):
+        return [self.fn(inputs[0])]
+
+
+@register("relu")
+class ReluLayer(_ActivationLayer):
+    fn = staticmethod(lambda x: jnp.maximum(x, 0.0))
+
+
+@register("sigmoid")
+class SigmoidLayer(_ActivationLayer):
+    fn = staticmethod(jax.nn.sigmoid)
+
+
+@register("tanh")
+class TanhLayer(_ActivationLayer):
+    fn = staticmethod(jnp.tanh)
+
+
+@register("softplus")
+class SoftplusLayer(_ActivationLayer):
+    # enum exists in the reference (layer.h:290) but no factory case; we
+    # provide the real op
+    fn = staticmethod(jax.nn.softplus)
+
+
+@register("xelu")
+class XeluLayer(Layer):
+    """Leaky relu with divisor b: x>0 ? x : x/b
+    (reference: src/layer/xelu_layer-inl.hpp:15-60, op.h xelu)."""
+
+    def __init__(self):
+        super().__init__()
+        self.b = 5.0
+
+    def set_param(self, name, val):
+        if name == "b":
+            self.b = float(val)
+        else:
+            super().set_param(name, val)
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        return [jnp.where(x > 0, x, x / self.b)]
+
+
+@register("insanity")
+class InsanityLayer(Layer):
+    """Randomized leaky relu (RReLU): slope divisor ~ U[lb, ub] at train,
+    (lb+ub)/2 at eval (reference: src/layer/insanity_layer-inl.hpp:14-106).
+
+    The reference anneals lb/ub toward their midpoint by a per-forward-call
+    step counter between calm_start and calm_end; here the annealing step is
+    ctx.epoch (the update counter), which is the same scale for
+    update_period=1.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self.lb = 5.0
+        self.ub = 10.0
+        self.calm_start = 0
+        self.calm_end = 0
+
+    def set_param(self, name, val):
+        if name == "lb":
+            self.lb = float(val)
+        elif name == "ub":
+            self.ub = float(val)
+        elif name == "calm_start":
+            self.calm_start = int(val)
+        elif name == "calm_end":
+            self.calm_end = int(val)
+        else:
+            super().set_param(name, val)
+
+    def _bounds(self, ctx):
+        lb = jnp.asarray(self.lb, jnp.float32)
+        ub = jnp.asarray(self.ub, jnp.float32)
+        if self.calm_end > self.calm_start:
+            delta = (self.ub - self.lb) / 2.0 / (self.calm_end - self.calm_start)
+            step = jnp.clip(ctx.epoch - self.calm_start, 0,
+                            self.calm_end - self.calm_start)
+            lb = lb + delta * step
+            ub = ub - delta * step
+        return lb, ub
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        lb, ub = self._bounds(ctx)
+        if ctx.train:
+            mask = jax.random.uniform(ctx.rng, x.shape) * (ub - lb) + lb
+        else:
+            mask = (lb + ub) / 2.0
+        return [jnp.where(x > 0, x, x / mask)]
+
+
+@register("prelu")
+class PReluLayer(Layer):
+    """Learnable per-channel slope, stored under the "bias" tag like the
+    reference (reference: src/layer/prelu_layer-inl.hpp:48-177).
+
+    Forward: mask = clip(slope * noise, 0, 1); out = x>0 ? x : x*mask.
+    The slope gradient in the reference is d(out)/d(slope) = min(x,0)*gout
+    (prelu_grad) — jax.grad of this forward yields min(x,0)*noise*gout
+    which coincides for random=0 (noise==1), the default.
+    """
+    has_params = True
+
+    def __init__(self):
+        super().__init__()
+        self.init_slope = 0.25
+        self.init_random = 0
+        self.random = 0.0
+        self.channel = 0
+
+    def set_param(self, name, val):
+        if name == "init_slope":
+            self.init_slope = float(val)
+        elif name == "random_slope":
+            self.init_random = int(val)
+        elif name == "random":
+            self.random = float(val)
+        else:
+            super().set_param(name, val)
+
+    def _infer(self, in_shapes):
+        s = in_shapes[0]
+        self.channel = s[3] if s[1] == 1 else s[1]
+        self.bcast_axis = 3 if s[1] == 1 else 1
+        return [s]
+
+    def init_params(self, rng) -> Params:
+        if self.init_random:
+            slope = jax.random.uniform(rng, (self.channel,)) * self.init_slope
+        else:
+            slope = jnp.full((self.channel,), self.init_slope, jnp.float32)
+        return {"bias": slope}
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        shape = [1, 1, 1, 1]
+        shape[self.bcast_axis] = self.channel
+        mask = params["bias"].reshape(shape)
+        if ctx.train and self.random > 0:
+            noise = (1 + jax.random.uniform(ctx.rng, x.shape)
+                     * self.random * 2.0 - self.random)
+            mask = mask * noise
+        mask = jnp.clip(mask, 0.0, 1.0)
+        return [jnp.where(x > 0, x, x * mask)]
+
+
+@register("dropout")
+class DropoutLayer(Layer):
+    """Self-loop dropout (reference: src/layer/dropout_layer-inl.hpp:12-70):
+    mask = (u < pkeep)/pkeep applied at train time only."""
+
+    def __init__(self):
+        super().__init__()
+        self.threshold = 0.0
+
+    def set_param(self, name, val):
+        if name == "threshold":
+            self.threshold = float(val)
+        else:
+            super().set_param(name, val)
+
+    def _infer(self, in_shapes):
+        if not (0.0 <= self.threshold < 1.0):
+            raise ValueError("DropoutLayer: invalid threshold")
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        if not ctx.train or self.threshold == 0.0:
+            return [x]
+        pkeep = 1.0 - self.threshold
+        mask = (jax.random.uniform(ctx.rng, x.shape) < pkeep) / pkeep
+        return [x * mask.astype(x.dtype)]
+
+
+# ======================================================================
+# conv stack
+# ======================================================================
+@register("conv")
+class ConvolutionLayer(Layer):
+    """Grouped 2D convolution.
+
+    The reference lowers conv to im2col + GEMM with a workspace budget
+    (reference: src/layer/convolution_layer-inl.hpp:79-152); on TPU the
+    entire loop collapses into one ``lax.conv_general_dilated`` that XLA
+    tiles onto the MXU, with ``feature_group_count`` covering ngroup.
+    Output shape formula matches InitNode
+    (convolution_layer-inl.hpp:174-177): (h + 2p - k)//s + 1.
+
+    Weights are stored reference-style as
+    ``(ngroup, nchannel/ngroup, cin/ngroup*kh*kw)`` so checkpoints and the
+    visitor API line up; the kernel is reshaped for XLA at apply time
+    (free at compile time).
+    """
+    has_params = True
+
+    def _infer(self, in_shapes):
+        p = self.param
+        n, c, h, w = in_shapes[0]
+        if c % p.num_group != 0:
+            raise ValueError("input channels must divide group size")
+        if p.num_channel % p.num_group != 0:
+            raise ValueError("output channels must divide group size")
+        if p.num_channel <= 0:
+            raise ValueError("must set nchannel correctly")
+        if p.kernel_height <= 0 or p.kernel_width <= 0:
+            raise ValueError("must set kernel_size correctly")
+        if p.kernel_width > w or p.kernel_height > h:
+            raise ValueError("kernel size exceeds input")
+        if p.num_input_channel == 0:
+            p.num_input_channel = c
+        elif p.num_input_channel != c:
+            raise ValueError("Conv: number of input channels inconsistent")
+        oh = (h + 2 * p.pad_y - p.kernel_height) // p.stride + 1
+        ow = (w + 2 * p.pad_x - p.kernel_width) // p.stride + 1
+        return [(n, p.num_channel, oh, ow)]
+
+    def init_params(self, rng) -> Params:
+        p = self.param
+        g = p.num_group
+        co_g = p.num_channel // g
+        ci_g = p.num_input_channel // g
+        kshape = (g, co_g, ci_g * p.kernel_height * p.kernel_width)
+        # fan numbers as the reference passes them: in=size(2), out=size(1)
+        wmat = p.rand_init_weight(rng, kshape, kshape[2], kshape[1])
+        out = {"wmat": wmat}
+        if p.no_bias == 0:
+            out["bias"] = jnp.full((p.num_channel,), p.init_bias, jnp.float32)
+        return out
+
+    def apply(self, params, inputs, ctx):
+        p = self.param
+        x = inputs[0].astype(ctx.compute_dtype)
+        g = p.num_group
+        co_g = p.num_channel // g
+        ci_g = p.num_input_channel // g
+        # (g, co/g, ci/g*kh*kw) -> OIHW (co, ci/g, kh, kw)
+        kernel = params["wmat"].reshape(
+            g * co_g, ci_g, p.kernel_height, p.kernel_width)
+        out = lax.conv_general_dilated(
+            x, kernel.astype(ctx.compute_dtype),
+            window_strides=(p.stride, p.stride),
+            padding=[(p.pad_y, p.pad_y), (p.pad_x, p.pad_x)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=g,
+            preferred_element_type=jnp.float32)
+        if p.no_bias == 0:
+            out = out + params["bias"].reshape(1, -1, 1, 1)
+        return [out]
+
+
+class _PoolingLayer(Layer):
+    """Spatial pooling with the reference's edge semantics
+    (reference: src/layer/pooling_layer-inl.hpp:17-118).
+
+    The reference output size min(h-k+s-1, h-1)//s + 1 permits partial
+    windows at the bottom/right edge; we reproduce that by explicit
+    asymmetric padding into ``lax.reduce_window`` with the reducer's
+    identity element. avg pooling divides by k*k regardless of clipping,
+    exactly like the reference's * (1/(ksize_y*ksize_x)).
+    """
+    reducer = "max"
+    pre_relu = False  # relu_max_pooling fuses a relu before pooling
+
+    def _infer(self, in_shapes):
+        p = self.param
+        n, c, h, w = in_shapes[0]
+        if p.kernel_height <= 0 or p.kernel_width <= 0:
+            raise ValueError("must set kernel_size correctly")
+        if p.kernel_width > w or p.kernel_height > h:
+            raise ValueError("kernel size exceeds input")
+        oh = min(h - p.kernel_height + p.stride - 1, h - 1) // p.stride + 1
+        ow = min(w - p.kernel_width + p.stride - 1, w - 1) // p.stride + 1
+        self._pad = ((oh - 1) * p.stride + p.kernel_height - h,
+                     (ow - 1) * p.stride + p.kernel_width - w)
+        return [(n, c, oh, ow)]
+
+    def apply(self, params, inputs, ctx):
+        p = self.param
+        x = inputs[0]
+        if self.pre_relu:
+            x = jnp.maximum(x, 0.0)
+        pad_h, pad_w = self._pad
+        dims = (1, 1, p.kernel_height, p.kernel_width)
+        strides = (1, 1, p.stride, p.stride)
+        padding = ((0, 0), (0, 0), (0, pad_h), (0, pad_w))
+        if self.reducer == "max":
+            init = -jnp.inf
+            out = lax.reduce_window(x, init, lax.max, dims, strides, padding)
+        else:
+            out = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+            if self.reducer == "avg":
+                out = out * (1.0 / (p.kernel_height * p.kernel_width))
+        return [out]
+
+
+@register("max_pooling")
+class MaxPoolingLayer(_PoolingLayer):
+    reducer = "max"
+
+
+@register("sum_pooling")
+class SumPoolingLayer(_PoolingLayer):
+    reducer = "sum"
+
+
+@register("avg_pooling")
+class AvgPoolingLayer(_PoolingLayer):
+    reducer = "avg"
+
+
+@register("relu_max_pooling")
+class ReluMaxPoolingLayer(_PoolingLayer):
+    """Fused relu + max pooling (reference: src/layer/layer_impl-inl.hpp:55-56;
+    note the reference's template args leave this combination broken — we
+    implement the intended fusion)."""
+    reducer = "max"
+    pre_relu = True
+
+
+@register("insanity_max_pooling")
+class InsanityPoolingLayer(_PoolingLayer):
+    """Stochastic pooling (reference: src/layer/insanity_pooling_layer-inl.hpp:223).
+
+    At train time samples a window element with probability proportional
+    to its (relu'd) activation; at eval computes the activation-weighted
+    average — the standard Zeiler&Fergus stochastic pooling the reference's
+    custom InsanityPoolingExp expression implements.
+    """
+    reducer = "max"
+
+    def apply(self, params, inputs, ctx):
+        p = self.param
+        x = jnp.maximum(inputs[0], 0.0)
+        n, c, h, w = x.shape
+        kh, kw = p.kernel_height, p.kernel_width
+        pad_h, pad_w = self._pad
+        oh, ow = self.out_shapes[0][2], self.out_shapes[0][3]
+        xp = jnp.pad(x, ((0, 0), (0, 0), (0, pad_h), (0, pad_w)))
+        # gather all windows: (n, c, oh, ow, kh*kw)
+        patches = jnp.stack([
+            lax.slice(xp, (0, 0, dy, dx),
+                      (n, c, dy + (oh - 1) * p.stride + 1,
+                       dx + (ow - 1) * p.stride + 1),
+                      (1, 1, p.stride, p.stride))
+            for dy in range(kh) for dx in range(kw)], axis=-1)
+        probs = patches / jnp.maximum(
+            patches.sum(axis=-1, keepdims=True), 1e-12)
+        if ctx.train:
+            idx = jax.random.categorical(
+                ctx.rng, jnp.log(jnp.maximum(probs, 1e-12)), axis=-1)
+            out = jnp.take_along_axis(
+                patches, idx[..., None], axis=-1)[..., 0]
+        else:
+            out = (patches * probs).sum(axis=-1)
+        return [out]
+
+
+@register("lrn")
+class LRNLayer(Layer):
+    """AlexNet-style cross-channel local response normalization
+    (reference: src/layer/lrn_layer-inl.hpp:12-93):
+    out = in * (knorm + alpha/n * chpool_sum(in^2, n))^-beta.
+    The backward pass is derived by jax.grad (the reference hand-derives
+    the identical expression)."""
+
+    def __init__(self):
+        super().__init__()
+        self.nsize = 3
+        self.alpha = 0.0
+        self.beta = 0.0
+        self.knorm = 1.0
+
+    def set_param(self, name, val):
+        if name == "local_size":
+            self.nsize = int(val)
+        elif name == "alpha":
+            self.alpha = float(val)
+        elif name == "beta":
+            self.beta = float(val)
+        elif name == "knorm":
+            self.knorm = float(val)
+        else:
+            super().set_param(name, val)
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        salpha = self.alpha / self.nsize
+        # centered cross-channel window of nsize, zero-padded (chpool<sum>)
+        lo = self.nsize // 2
+        hi = self.nsize - 1 - lo
+        sq = jnp.square(x)
+        norm = lax.reduce_window(
+            sq, 0.0, lax.add, (1, self.nsize, 1, 1), (1, 1, 1, 1),
+            ((0, 0), (lo, hi), (0, 0), (0, 0)))
+        norm = norm * salpha + self.knorm
+        return [x * jnp.power(norm, -self.beta)]
+
+
+@register("batch_norm")
+class BatchNormLayer(Layer):
+    """Batch normalization (reference: src/layer/batch_norm_layer-inl.hpp:14-201).
+
+    Faithful to the reference's (nonstandard) eval semantics: *batch*
+    statistics are used in both train and eval mode — there are no running
+    averages in the reference model format. Channel axis is 1 for conv
+    nodes and 3 for flat nodes, like the reference's size(1)==1 dispatch.
+    """
+    has_params = True
+
+    def __init__(self):
+        super().__init__()
+        self.init_slope = 1.0
+        self.init_bias = 0.0
+        self.eps = 1e-10
+
+    def set_param(self, name, val):
+        if name == "init_slope":
+            self.init_slope = float(val)
+        elif name == "init_bias":
+            self.init_bias = float(val)
+        elif name == "eps":
+            self.eps = float(val)
+        else:
+            super().set_param(name, val)
+
+    def _infer(self, in_shapes):
+        s = in_shapes[0]
+        self.channel = s[3] if s[1] == 1 else s[1]
+        self.axis = 3 if s[1] == 1 else 1
+        return [s]
+
+    def init_params(self, rng) -> Params:
+        return {"wmat": jnp.full((self.channel,), self.init_slope, jnp.float32),
+                "bias": jnp.full((self.channel,), self.init_bias, jnp.float32)}
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        axes = tuple(i for i in range(4) if i != self.axis)
+        shape = [1, 1, 1, 1]
+        shape[self.axis] = self.channel
+        mean = x.mean(axis=axes)
+        var = jnp.square(x - mean.reshape(shape)).mean(axis=axes)
+        xhat = (x - mean.reshape(shape)) / jnp.sqrt(
+            var.reshape(shape) + self.eps)
+        return [xhat * params["wmat"].reshape(shape)
+                + params["bias"].reshape(shape)]
+
+
+@register("fixconn")
+class FixConnectLayer(Layer):
+    """Fixed (non-learned) sparse connection loaded from a text file
+    (reference: src/layer/fixconn_layer-inl.hpp:14-96). The weight matrix
+    is a constant: it is excluded from the optimizer by having no params;
+    the matrix is baked into the layer at config time."""
+
+    def __init__(self):
+        super().__init__()
+        self.weight_file = ""
+        self.num_hidden = 0
+        self._wmat = None
+
+    def set_param(self, name, val):
+        if name == "weight_file":
+            self.weight_file = val
+        elif name == "nhidden":
+            self.num_hidden = int(val)
+        else:
+            super().set_param(name, val)
+
+    def _infer(self, in_shapes):
+        n, c, h, w = in_shapes[0]
+        if not _is_mat(in_shapes[0]):
+            raise ValueError("FixConnectLayer: input needs to be a matrix")
+        if self.num_hidden <= 0:
+            raise ValueError("FixConnectLayer: must set nhidden")
+        import numpy as np
+        wmat = np.zeros((self.num_hidden, w), np.float32)
+        if self.weight_file:
+            with open(self.weight_file) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) == 3:
+                        i, j, v = int(parts[0]), int(parts[1]), float(parts[2])
+                        wmat[i, j] = v
+        self._wmat = jnp.asarray(wmat)
+        return [(n, 1, 1, self.num_hidden)]
+
+    def apply(self, params, inputs, ctx):
+        x = _mat(inputs[0])
+        out = jnp.dot(x, lax.stop_gradient(self._wmat).T)
+        n = inputs[0].shape[0]
+        return [out.reshape(n, 1, 1, self.num_hidden)]
+
+
+# ======================================================================
+# loss layers (self-loop)
+# ======================================================================
+class _LossLayer(Layer):
+    """Self-loop loss (reference: src/layer/loss/loss_layer_base-inl.hpp:11-133).
+
+    Forward transforms the node (softmax/sigmoid/identity) so that eval
+    and Predict see scores. The scalar added to ctx.losses is chosen so
+    jax.grad reproduces the reference gradient
+    (p - y) * grad_scale / (batch_size * update_period) at this node's
+    *input* — i.e. loss = grad_scale * L(input, y) / (batch*period).
+    """
+    is_loss = True
+
+    def __init__(self):
+        super().__init__()
+        self.target = "label"
+        self.grad_scale = 1.0
+
+    def set_param(self, name, val):
+        if name == "target":
+            self.target = val
+        elif name == "grad_scale":
+            self.grad_scale = float(val)
+        else:
+            super().set_param(name, val)
+
+    def _infer(self, in_shapes):
+        if self.target not in self.label_name_map:
+            raise ValueError("LossLayer: unknown target=%s" % self.target)
+        self.target_index = self.label_name_map[self.target]
+        return [in_shapes[0]]
+
+    def _scale(self, ctx: ApplyContext):
+        return self.grad_scale / (ctx.batch_size * ctx.update_period)
+
+    def _label(self, ctx: ApplyContext):
+        return ctx.labels[self.target_index]
+
+    def apply(self, params, inputs, ctx):
+        raise NotImplementedError
+
+
+@register("softmax")
+class SoftmaxLayer(_LossLayer):
+    """Softmax + cross entropy (reference: src/layer/loss/softmax_layer-inl.hpp:12-36).
+
+    Node value becomes softmax probabilities; loss term is
+    scale * sum_i -log p_i[y_i] whose input-gradient is scale*(p - onehot),
+    the reference's p[y] -= 1 rescaled.
+    """
+
+    def apply(self, params, inputs, ctx):
+        logits = _mat(inputs[0])
+        probs = jax.nn.softmax(logits, axis=-1)
+        if ctx.labels is not None:
+            y = self._label(ctx)[:, 0].astype(jnp.int32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ce = -jnp.take_along_axis(logp, y[:, None], axis=1).sum()
+            ctx.losses.append(ce * self._scale(ctx))
+        return [probs.reshape(inputs[0].shape)]
+
+
+@register("l2_loss")
+class L2LossLayer(_LossLayer):
+    """L2 loss (reference: src/layer/loss/l2_loss_layer-inl.hpp:12-37):
+    identity forward, gradient pred - label."""
+
+    def apply(self, params, inputs, ctx):
+        pred = _mat(inputs[0])
+        if ctx.labels is not None:
+            y = self._label(ctx)
+            l2 = 0.5 * jnp.square(pred - y).sum()
+            ctx.losses.append(l2 * self._scale(ctx))
+        return [inputs[0]]
+
+
+@register("multi_logistic")
+class MultiLogisticLayer(_LossLayer):
+    """Elementwise sigmoid + BCE
+    (reference: src/layer/loss/multi_logistic_layer-inl.hpp:12-38)."""
+
+    def apply(self, params, inputs, ctx):
+        logits = _mat(inputs[0])
+        probs = jax.nn.sigmoid(logits)
+        if ctx.labels is not None:
+            y = self._label(ctx)
+            bce = jnp.sum(jnp.logaddexp(0.0, logits) - logits * y)
+            ctx.losses.append(bce * self._scale(ctx))
+        return [probs.reshape(inputs[0].shape)]

@@ -1,0 +1,155 @@
+"""Functional network: the netconfig DAG as one pure forward function.
+
+The reference walks ``connections`` mutating device ``Node`` buffers and
+hand-chains backprop (reference: src/nnet/neural_net-inl.hpp:107-153).
+Here the DAG is *interpreted into a pure function* ``apply(params, ...)``
+whose gradient is taken by ``jax.grad`` — the whole fwd+bwd+update compiles
+into a single XLA program.
+
+Semantics preserved from the reference:
+  * connection order = config order; a node's value is whatever the last
+    connection wrote to it (self-loop layers update in place)
+  * loss layers transform their node (softmax probs visible to eval) and
+    contribute  grad_scale * L / (batch_size * update_period)  to the
+    scalar loss (loss_layer_base-inl.hpp:62)
+  * shared layers reuse the primary connection's parameters
+    (nnet_config.h:57-59, neural_net-inl.hpp:238-244)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+from .graph import NetConfig, SHARED_LAYER
+
+ConfigEntry = Tuple[str, str]
+
+
+class Network:
+    """Static model structure + pure init/apply.
+
+    Mirrors NeuralNet (reference: src/nnet/neural_net-inl.hpp:23-302) minus
+    device plumbing: no streams, no per-device threads — XLA owns scheduling.
+    """
+
+    def __init__(self, net_cfg: NetConfig, batch_size: int,
+                 update_period: int = 1,
+                 compute_dtype: str = "float32") -> None:
+        self.cfg = net_cfg
+        self.batch_size = batch_size
+        self.update_period = update_period
+        self.compute_dtype = jnp.dtype(compute_dtype)
+        self.modules: List[L.Layer] = []
+        self.node_shapes: List[Optional[Tuple[int, ...]]] = (
+            [None] * net_cfg.num_nodes)
+
+        c, h, w = net_cfg.input_shape
+        self.node_shapes[0] = (batch_size, c, h, w)
+        for i in range(net_cfg.extra_data_num):
+            ec, eh, ew = net_cfg.extra_shape[3 * i: 3 * i + 3]
+            self.node_shapes[i + 1] = (batch_size, ec, eh, ew)
+
+        # build modules + infer shapes in connection order
+        for li, info in enumerate(net_cfg.layers):
+            type_name = info.type
+            if type_name == SHARED_LAYER:
+                type_name = net_cfg.layers[info.primary_layer_index].type
+            if type_name == "pairtest":
+                raise NotImplementedError(
+                    "pairtest is handled by testing.pairtest, not in-net")
+            mod = L.create_layer(
+                type_name, net_cfg.effective_layer_cfg(li),
+                net_cfg.label_name_map)
+            if isinstance(mod, L.SplitLayer):
+                mod.n_out = len(info.nindex_out)
+            in_shapes = []
+            for ni in info.nindex_in:
+                if self.node_shapes[ni] is None:
+                    raise ValueError(
+                        "node %s used before it is produced"
+                        % net_cfg.node_names[ni])
+                in_shapes.append(self.node_shapes[ni])
+            out_shapes = mod.infer_shape(in_shapes)
+            if len(out_shapes) != len(info.nindex_out):
+                raise ValueError("layer %d produced %d outputs, expected %d"
+                                 % (li, len(out_shapes), len(info.nindex_out)))
+            for no, shp in zip(info.nindex_out, out_shapes):
+                if self.node_shapes[no] is not None and \
+                        self.node_shapes[no] != shp and no not in info.nindex_in:
+                    raise ValueError(
+                        "conflicting shapes for node %s: %s vs %s"
+                        % (net_cfg.node_names[no], self.node_shapes[no], shp))
+                self.node_shapes[no] = shp
+            self.modules.append(mod)
+
+    # ------------------------------------------------------------------
+    def init_params(self, rng) -> List[Optional[dict]]:
+        """Per-layer parameter dicts; shared layers hold None and read the
+        primary's slot (reference: neural_net-inl.hpp:216-250 InitModel)."""
+        params: List[Optional[dict]] = []
+        for li, (info, mod) in enumerate(zip(self.cfg.layers, self.modules)):
+            if info.type == SHARED_LAYER or not mod.has_params:
+                params.append(None)
+            else:
+                params.append(mod.init_params(jax.random.fold_in(rng, li)))
+        return params
+
+    def _layer_params(self, params, li: int):
+        info = self.cfg.layers[li]
+        if info.type == SHARED_LAYER:
+            return params[info.primary_layer_index]
+        return params[li]
+
+    # ------------------------------------------------------------------
+    def apply(self, params, data: jnp.ndarray,
+              extra_data: Sequence[jnp.ndarray] = (),
+              labels: Optional[List[jnp.ndarray]] = None,
+              train: bool = False,
+              rng: Optional[jnp.ndarray] = None,
+              epoch=0) -> Tuple[Dict[int, jnp.ndarray], jnp.ndarray]:
+        """Run the DAG; returns ({node_index: value}, scalar_loss).
+
+        ``labels`` is the list of label-field arrays in label_range order
+        (reference GetLabelInfo, nnet_impl-inl.hpp:271-285).
+        """
+        ctx = L.ApplyContext(
+            train=train, rng=rng, labels=labels,
+            batch_size=self.batch_size, update_period=self.update_period,
+            epoch=epoch, compute_dtype=self.compute_dtype)
+        values: Dict[int, jnp.ndarray] = {0: data}
+        for i, x in enumerate(extra_data):
+            values[i + 1] = x
+        for li, (info, mod) in enumerate(zip(self.cfg.layers, self.modules)):
+            layer_ctx = ctx
+            if rng is not None:
+                layer_ctx = dataclasses.replace(
+                    ctx, rng=jax.random.fold_in(rng, li))
+            inputs = [values[ni] for ni in info.nindex_in]
+            outputs = mod.apply(self._layer_params(params, li),
+                                inputs, layer_ctx)
+            for no, v in zip(info.nindex_out, outputs):
+                values[no] = v
+        if ctx.losses:
+            loss = sum(ctx.losses[1:], ctx.losses[0])
+        else:
+            loss = jnp.zeros((), jnp.float32)
+        return values, loss
+
+    # ------------------------------------------------------------------
+    def loss_fn(self, params, data, labels, rng, epoch,
+                extra_data=()) -> jnp.ndarray:
+        """Scalar training loss — the jax.grad entry point."""
+        _, loss = self.apply(params, data, extra_data=extra_data,
+                             labels=labels, train=True, rng=rng, epoch=epoch)
+        return loss
+
+    @property
+    def out_node(self) -> int:
+        """Default eval/predict node = last node (reference
+        nnet_impl-inl.hpp:190 nodes.back())."""
+        return self.cfg.num_nodes - 1

@@ -1,0 +1,315 @@
+"""Trainer: the INetTrainer surface over one jit-compiled sharded step.
+
+The reference CXXNetThreadTrainer (reference: src/nnet/nnet_impl-inl.hpp:16-455)
+splits each batch over per-device worker threads and syncs grads through a
+parameter server. Here there is exactly one program: a jitted
+fwd+bwd+update step over a device mesh; the batch is sharded on the data
+axis, parameters are replicated, and XLA emits the ICI all-reduce.
+``update_period`` gradient accumulation is preserved
+(nnet_impl-inl.hpp:149-150,181-184): the step accumulates into a grad
+buffer and applies the updaters every k-th call.
+"""
+
+from __future__ import annotations
+
+import sys
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import parallel
+from .graph import NetConfig
+from .io import DataBatch, DataIterator
+from .metrics import MetricSet
+from .model import Network
+from .updater import NetUpdater
+
+ConfigEntry = Tuple[str, str]
+
+
+class Trainer:
+    """Config-driven trainer; mirrors the INetTrainer contract
+    (reference: src/nnet/nnet.h:18-92)."""
+
+    def __init__(self) -> None:
+        self.cfg: List[ConfigEntry] = []
+        self.batch_size = 100
+        self.update_period = 1
+        self.eval_train = 1
+        self.seed = 0
+        self.silent = 0
+        self.dev = "tpu"
+        self.compute_dtype = "float32"
+        self.epoch_counter = 0
+        self.sample_counter = 0
+        self.round = 0
+        self.metric = MetricSet()
+        self.train_metric = MetricSet()
+        self.eval_nodes: List[Tuple[str, int]] = []
+        self.net_cfg: Optional[NetConfig] = None
+        self.net: Optional[Network] = None
+        self.params = None
+        self.opt_state = None
+        self.grad_accum = None
+        self._step_count = 0
+
+    # ------------------------------------------------------------------
+    def set_param(self, name: str, val: str) -> None:
+        """Config broadcast (reference: nnet_impl-inl.hpp:31-69)."""
+        if val == "default":
+            return
+        if name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "update_period":
+            self.update_period = int(val)
+        elif name == "eval_train":
+            self.eval_train = int(val)
+        elif name == "seed":
+            self.seed = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "dev":
+            self.dev = val
+        elif name == "dtype":
+            self.compute_dtype = val
+        if name.startswith("metric"):
+            import re
+            m = re.match(r"metric\[([^,\]]+),([^\]]+)\]", name)
+            if m:
+                self.metric.add_metric(val, m.group(1))
+                self.train_metric.add_metric(val, m.group(1))
+                self.eval_nodes.append((m.group(2), 0))
+            else:
+                m2 = re.match(r"metric\[([^,\]]+)\]", name)
+                field = m2.group(1) if m2 else "label"
+                self.metric.add_metric(val, field)
+                self.train_metric.add_metric(val, field)
+                self.eval_nodes.append(("", -1))
+        self.cfg.append((name, val))
+
+    # ------------------------------------------------------------------
+    def init_model(self) -> None:
+        """Parse structure, init params, build jitted steps
+        (reference: nnet_impl-inl.hpp:70-81,339-390)."""
+        self.net_cfg = NetConfig()
+        self.net_cfg.configure(self.cfg)
+        self._build_network()
+        rng = jax.random.PRNGKey(self.seed)
+        params = self.net.init_params(rng)
+        opt = NetUpdater(self.net)
+        opt_state = opt.init_state(params)
+        self._finish_init(params, opt, opt_state)
+
+    def _build_network(self) -> None:
+        self.net = Network(self.net_cfg, self.batch_size,
+                           update_period=self.update_period,
+                           compute_dtype=self.compute_dtype)
+        # device mesh (replaces InitParamServer + per-device threads)
+        devices = parallel.select_devices(self.dev)
+        ndev = parallel.fit_devices_to_batch(len(devices), self.batch_size)
+        if ndev != len(devices) and self.silent == 0:
+            print("Warning: using %d of %d devices to split batch_size=%d"
+                  % (ndev, len(devices), self.batch_size))
+        self.mesh = parallel.make_mesh(devices[:ndev])
+        self.n_devices = ndev
+        # resolve eval node requests (reference nnet_impl-inl.hpp:363-374)
+        self.eval_req: List[int] = []
+        for name, kind in self.eval_nodes:
+            if kind < 0:
+                self.eval_req.append(self.net.out_node)
+            else:
+                if name not in self.net_cfg.node_name_map:
+                    raise ValueError("Cannot find node name: %s" % name)
+                self.eval_req.append(self.net_cfg.node_name_map[name])
+        if not self.eval_req:
+            self.eval_req = [self.net.out_node]
+
+    def _finish_init(self, params, opt, opt_state) -> None:
+        self.opt = opt
+        rep = parallel.replicated(self.mesh)
+        dsh = parallel.batch_sharding(self.mesh)
+        self.params = jax.device_put(params, rep)
+        self.opt_state = jax.device_put(opt_state, rep)
+        if self.update_period > 1:
+            zeros = jax.tree.map(jnp.zeros_like, _strip_nones(self.params))
+            self.grad_accum = jax.device_put(zeros, rep)
+        self._rng = jax.random.PRNGKey(self.seed * 2243 + 7)
+
+        net, opt_ = self.net, self.opt
+        eval_req = tuple(self.eval_req)
+
+        def fwd_bwd(params, data, labels, rng, epoch):
+            def loss_fn(p):
+                values, loss = net.apply(
+                    p, data, labels=labels, train=True, rng=rng, epoch=epoch)
+                return loss, tuple(values[i] for i in eval_req)
+            (loss, evals), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return loss, evals, grads
+
+        def train_step(params, opt_state, data, labels, rng, epoch):
+            loss, evals, grads = fwd_bwd(params, data, labels, rng, epoch)
+            grads = _strip_nones(grads)
+            params2, opt2 = opt_.apply(params, grads, opt_state, epoch)
+            return params2, opt2, loss, evals
+
+        def accum_step(grad_accum, params, data, labels, rng, epoch):
+            loss, evals, grads = fwd_bwd(params, data, labels, rng, epoch)
+            grads = _strip_nones(grads)
+            acc = jax.tree.map(jnp.add, grad_accum, grads)
+            return acc, loss, evals
+
+        def apply_accum(params, opt_state, grad_accum, epoch):
+            params2, opt2 = opt_.apply(params, grad_accum, opt_state, epoch)
+            zeros = jax.tree.map(jnp.zeros_like, grad_accum)
+            return params2, opt2, zeros
+
+        def forward_step(params, data, node_ids):
+            values, _ = net.apply(params, data, train=False)
+            return tuple(values[i] for i in node_ids)
+
+        self._train_step = jax.jit(
+            train_step, donate_argnums=(0, 1),
+            in_shardings=(rep, rep, dsh, dsh, rep, rep))
+        self._accum_step = jax.jit(
+            accum_step, donate_argnums=(0,),
+            in_shardings=(rep, rep, dsh, dsh, rep, rep))
+        self._apply_accum = jax.jit(
+            apply_accum, donate_argnums=(0, 1, 2),
+            in_shardings=(rep, rep, rep, rep))
+        self._forward = jax.jit(
+            forward_step, in_shardings=(rep, dsh),
+            static_argnums=(2,))
+
+    # ------------------------------------------------------------------
+    def _label_fields(self, batch: DataBatch) -> List[jnp.ndarray]:
+        """Slice label matrix into fields (reference GetLabelInfo,
+        nnet_impl-inl.hpp:271-285)."""
+        out = []
+        for (a, b) in self.net_cfg.label_range:
+            out.append(jnp.asarray(batch.label[:, a:b], jnp.float32))
+        return out
+
+    def _label_dict(self, batch: DataBatch,
+                    skip_pad: bool = False) -> Dict[str, np.ndarray]:
+        n = batch.batch_size - (batch.num_batch_padd if skip_pad else 0)
+        out = {}
+        for fname, idx in self.net_cfg.label_name_map.items():
+            a, b = self.net_cfg.label_range[idx]
+            out[fname] = np.asarray(batch.label[:n, a:b])
+        return out
+
+    def start_round(self, round_: int) -> None:
+        self.round = round_
+
+    # ------------------------------------------------------------------
+    def update(self, batch: DataBatch) -> None:
+        """One minibatch of training (reference: nnet_impl-inl.hpp:141-185)."""
+        data = jnp.asarray(batch.data, jnp.float32)
+        labels = self._label_fields(batch)
+        self._step_count += 1
+        rng = jax.random.fold_in(self._rng, self._step_count)
+        # traced scalar: changing epoch must not recompile the step
+        epoch = jnp.asarray(self.epoch_counter, jnp.float32)
+        if self.update_period == 1:
+            self.params, self.opt_state, loss, evals = self._train_step(
+                self.params, self.opt_state, data, labels, rng, epoch)
+        else:
+            self.grad_accum, loss, evals = self._accum_step(
+                self.grad_accum, self.params, data, labels, rng, epoch)
+            if (self.sample_counter + 1) % self.update_period == 0:
+                self.params, self.opt_state, self.grad_accum = \
+                    self._apply_accum(self.params, self.opt_state,
+                                      self.grad_accum, epoch)
+        if self.eval_train != 0 and self.train_metric.evals:
+            scores = [np.asarray(e).reshape(e.shape[0], -1) for e in evals]
+            self.train_metric.add_eval(scores, self._label_dict(batch))
+        self.sample_counter += 1
+        if self.sample_counter >= self.update_period:
+            self.sample_counter = 0
+            self.epoch_counter += 1
+
+    # ------------------------------------------------------------------
+    def forward_nodes(self, batch: DataBatch,
+                      node_ids: Sequence[int]) -> List[np.ndarray]:
+        data = jnp.asarray(batch.data, jnp.float32)
+        values = self._forward(self.params, data, tuple(node_ids))
+        return [np.asarray(v) for v in values]
+
+    def predict(self, batch: DataBatch) -> np.ndarray:
+        """Argmax (or raw scalar) of the final node
+        (reference: nnet_impl-inl.hpp:186-199,286-299)."""
+        out = self.forward_nodes(batch, [self.net.out_node])[0]
+        mat = out.reshape(out.shape[0], -1)
+        if mat.shape[1] != 1:
+            return mat.argmax(axis=1).astype(np.float32)
+        return mat[:, 0]
+
+    def extract_feature(self, batch: DataBatch, node_name: str) -> np.ndarray:
+        """Copy out a named node or top[-k]
+        (reference: nnet_impl-inl.hpp:200-223)."""
+        import re
+        m = re.match(r"top\[-(\d+)\]", node_name)
+        if m:
+            offset = int(m.group(1))
+            nnode = self.net_cfg.num_nodes
+            if not (1 <= offset <= nnode):
+                raise ValueError("ExtractFeature: offset out of range")
+            node_id = nnode - offset
+        else:
+            if node_name not in self.net_cfg.node_name_map:
+                raise ValueError(
+                    "ExtractFeature: cannot find node name: %s" % node_name)
+            node_id = self.net_cfg.node_name_map[node_name]
+        return self.forward_nodes(batch, [node_id])[0]
+
+    # ------------------------------------------------------------------
+    def evaluate(self, iter_eval: Optional[DataIterator],
+                 data_name: str) -> str:
+        """Round-end metric report (reference: nnet_impl-inl.hpp:224-245)."""
+        ret = ""
+        if self.eval_train != 0 and self.train_metric.evals:
+            ret += self.train_metric.print("train")
+            self.train_metric.clear()
+        if iter_eval is None:
+            return ret
+        if not self.metric.evals:
+            return ret
+        self.metric.clear()
+        iter_eval.before_first()
+        while iter_eval.next():
+            batch = iter_eval.value
+            outs = self.forward_nodes(batch, self.eval_req)
+            n = batch.batch_size - batch.num_batch_padd
+            scores = [o[:n].reshape(n, -1) for o in outs]
+            self.metric.add_eval(scores, self._label_dict(batch, skip_pad=True))
+        ret += self.metric.print(data_name)
+        return ret
+
+    # ------------------------------------------------------------------
+    # weight access (reference: nnet_impl-inl.hpp:246-268 + visitor.h)
+    def get_weight(self, layer_name: str, tag: str) -> np.ndarray:
+        idx = self.net_cfg.get_layer_index(layer_name)
+        if self.params[idx] is None or tag not in self.params[idx]:
+            raise ValueError("layer %s has no %s" % (layer_name, tag))
+        w = np.asarray(self.params[idx][tag])
+        return w.reshape(w.shape[0], -1) if w.ndim > 1 else w.reshape(1, -1)
+
+    def set_weight(self, weight: np.ndarray, layer_name: str,
+                   tag: str) -> None:
+        idx = self.net_cfg.get_layer_index(layer_name)
+        if self.params[idx] is None or tag not in self.params[idx]:
+            raise ValueError("layer %s has no %s" % (layer_name, tag))
+        cur = self.params[idx][tag]
+        arr = jnp.asarray(weight, jnp.float32).reshape(cur.shape)
+        params = list(self.params)
+        params[idx] = dict(params[idx], **{tag: arr})
+        self.params = jax.device_put(params, parallel.replicated(self.mesh))
+
+
+def _strip_nones(tree):
+    """Replace per-layer None slots with empty dicts so tree ops line up."""
+    return [({} if t is None else t) for t in tree]

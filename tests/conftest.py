@@ -2,11 +2,12 @@
 
 Multi-chip TPU hardware is not available in CI; all sharding tests run on
 XLA's host platform with 8 virtual devices, exactly as the driver's
-multichip dry-run does.
+multichip dry-run does. JAX_PLATFORMS is *forced* to cpu (the container
+environment pins it to the axon TPU backend, which tests must not touch).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

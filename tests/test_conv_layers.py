@@ -1,0 +1,143 @@
+"""Conv-stack numerics: differential tests against torch (cpu), the modern
+equivalent of the reference's pairtest master/slave comparisons."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu import layers as L
+
+torch = pytest.importorskip("torch")
+
+
+def mk(shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def make_layer(name, cfg, in_shapes, seed=0):
+    lay = L.create_layer(name, cfg)
+    lay.infer_shape(in_shapes)
+    params = lay.init_params(jax.random.PRNGKey(seed))
+    return lay, params
+
+
+def ctx(**kw):
+    return L.ApplyContext(**kw)
+
+
+@pytest.mark.parametrize("ngroup,pad,stride", [(1, 0, 1), (1, 2, 2), (2, 1, 2)])
+def test_conv_vs_torch(ngroup, pad, stride):
+    cin, cout, k = 4, 6, 3
+    lay, params = make_layer("conv", [
+        ("kernel_size", str(k)), ("stride", str(stride)), ("pad", str(pad)),
+        ("nchannel", str(cout)), ("ngroup", str(ngroup)),
+        ("init_bias", "0.3")], [(2, cin, 8, 8)])
+    x = mk((2, cin, 8, 8))
+    (out,) = lay.apply(params, [jnp.asarray(x)], ctx())
+
+    w = np.asarray(params["wmat"]).reshape(cout, cin // ngroup, k, k)
+    tout = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(w),
+        torch.tensor(np.asarray(params["bias"])),
+        stride=stride, padding=pad, groups=ngroup)
+    assert tuple(out.shape) == tuple(tout.shape) == tuple(lay.out_shapes[0])
+    np.testing.assert_allclose(out, tout.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_conv_gradients_vs_torch():
+    lay, params = make_layer("conv", [
+        ("kernel_size", "3"), ("stride", "1"), ("pad", "1"),
+        ("nchannel", "5")], [(2, 3, 6, 6)])
+    x = mk((2, 3, 6, 6))
+
+    def f(p, xx):
+        (out,) = lay.apply(p, [xx], ctx())
+        return (out * out).sum() * 0.5
+
+    gp, gx = jax.grad(f, argnums=(0, 1))(params, jnp.asarray(x))
+
+    tx = torch.tensor(x, requires_grad=True)
+    tw = torch.tensor(np.asarray(params["wmat"]).reshape(5, 3, 3, 3),
+                      requires_grad=True)
+    tb = torch.tensor(np.asarray(params["bias"]), requires_grad=True)
+    tout = torch.nn.functional.conv2d(tx, tw, tb, stride=1, padding=1)
+    ((tout * tout).sum() * 0.5).backward()
+    np.testing.assert_allclose(np.asarray(gp["wmat"]).reshape(5, 3, 3, 3),
+                               tw.grad.numpy(), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gp["bias"], tb.grad.numpy(), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(gx, tx.grad.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_max_pooling_partial_window():
+    """Reference pooling allows partial windows at the edge:
+    oh = min(h-k+s-1, h-1)//s + 1 (pooling_layer-inl.hpp:102-105).
+    For h=14,k=3,s=2 that is 7 (valid pooling would give 6)."""
+    lay, _ = make_layer("max_pooling", [("kernel_size", "3"), ("stride", "2")],
+                        [(1, 1, 14, 14)])
+    assert lay.out_shapes == [(1, 1, 7, 7)]
+    x = mk((1, 1, 14, 14))
+    (out,) = lay.apply({}, [jnp.asarray(x)], ctx())
+    # last output pools the partial 2x2 window at the bottom-right corner
+    np.testing.assert_allclose(out[0, 0, 6, 6], x[0, 0, 12:, 12:].max())
+    np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 0, :3, :3].max())
+
+
+def test_max_pooling_vs_torch_exact_fit():
+    lay, _ = make_layer("max_pooling", [("kernel_size", "2"), ("stride", "2")],
+                        [(2, 3, 8, 8)])
+    x = mk((2, 3, 8, 8))
+    (out,) = lay.apply({}, [jnp.asarray(x)], ctx())
+    tout = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2)
+    np.testing.assert_allclose(out, tout.numpy(), rtol=1e-6)
+
+
+def test_avg_pooling_divides_by_full_kernel():
+    """avg pooling scales by 1/k^2 even for clipped windows
+    (pooling_layer-inl.hpp:44-46)."""
+    lay, _ = make_layer("avg_pooling", [("kernel_size", "3"), ("stride", "2")],
+                        [(1, 1, 6, 6)])
+    x = np.ones((1, 1, 6, 6), np.float32)
+    (out,) = lay.apply({}, [jnp.asarray(x)], ctx())
+    # reference formula: min(6-3+1, 5)//2 + 1 = 3 (valid pooling would be 2)
+    assert lay.out_shapes == [(1, 1, 3, 3)]
+    np.testing.assert_allclose(out[0, 0, 0, 0], 1.0)   # full window
+    np.testing.assert_allclose(out[0, 0, 2, 2], 4.0 / 9.0)  # 2x2 clipped
+
+
+def test_lrn_vs_torch():
+    nsize, alpha, beta, knorm = 5, 0.001, 0.75, 1.0
+    lay, _ = make_layer("lrn", [("local_size", str(nsize)),
+                                ("alpha", str(alpha)), ("beta", str(beta)),
+                                ("knorm", str(knorm))], [(2, 8, 4, 4)])
+    x = mk((2, 8, 4, 4))
+    (out,) = lay.apply({}, [jnp.asarray(x)], ctx())
+    tout = torch.nn.functional.local_response_norm(
+        torch.tensor(x), nsize, alpha=alpha, beta=beta, k=knorm)
+    np.testing.assert_allclose(out, tout.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_relu_max_pooling_fused():
+    lay, _ = make_layer("relu_max_pooling",
+                        [("kernel_size", "2"), ("stride", "2")],
+                        [(1, 1, 4, 4)])
+    x = -np.abs(mk((1, 1, 4, 4)))  # all negative -> relu zeroes everything
+    (out,) = lay.apply({}, [jnp.asarray(x)], ctx())
+    np.testing.assert_allclose(out, np.zeros((1, 1, 2, 2)))
+
+
+def test_insanity_pooling_eval_weighted_avg():
+    lay, _ = make_layer("insanity_max_pooling",
+                        [("kernel_size", "2"), ("stride", "2")],
+                        [(1, 1, 4, 4)])
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0, 0, 0] = 3.0
+    x[0, 0, 0, 1] = 1.0
+    (out,) = lay.apply({}, [jnp.asarray(x)], ctx(train=False))
+    # weighted average: (3*3 + 1*1)/4 = 2.5
+    np.testing.assert_allclose(out[0, 0, 0, 0], 2.5, rtol=1e-5)
+    # train: sampled value is one of the window entries
+    (out_t,) = lay.apply({}, [jnp.asarray(x)],
+                         ctx(train=True, rng=jax.random.PRNGKey(0)))
+    assert float(out_t[0, 0, 0, 0]) in (3.0, 1.0, 0.0)

@@ -1,0 +1,111 @@
+"""Updater math + schedule tests (reference: src/updater/*)."""
+import numpy as np
+import jax.numpy as jnp
+
+from cxxnet_tpu.updater import (UpdaterHyperParams, SGDUpdater, NAGUpdater,
+                                AdamUpdater, create_tensor_updater)
+
+
+def test_sgd_matches_reference_formula():
+    """m = mom*m - lr*(g + wd*w); w += m (sgd_updater-inl.hpp:73-84)."""
+    hp = UpdaterHyperParams(base_lr=0.1, momentum=0.9, wd=0.01)
+    upd = SGDUpdater(hp)
+    w = jnp.asarray([1.0, -2.0])
+    g = jnp.asarray([0.5, 0.25])
+    st = upd.init_state(w)
+    w1, st1 = upd.update(st, w, g, 0)
+    m_expect = -0.1 * (np.asarray(g) + 0.01 * np.asarray(w))
+    np.testing.assert_allclose(w1, np.asarray(w) + m_expect, rtol=1e-6)
+    w2, st2 = upd.update(st1, w1, g, 1)
+    m2 = 0.9 * m_expect - 0.1 * (np.asarray(g) + 0.01 * np.asarray(w1))
+    np.testing.assert_allclose(w2, np.asarray(w1) + m2, rtol=1e-6)
+
+
+def test_sgd_clip_and_nan_guard():
+    hp = UpdaterHyperParams(base_lr=1.0, momentum=0.0, clip_gradient=0.5)
+    upd = SGDUpdater(hp)
+    w = jnp.zeros(3)
+    g = jnp.asarray([10.0, -10.0, float("nan")])
+    w1, _ = upd.update(upd.init_state(w), w, g, 0)
+    np.testing.assert_allclose(w1, [-0.5, 0.5, 0.0])
+
+
+def test_nag_matches_reference_formula():
+    """w += (1+mom)*m - mom*old_m (nag_updater-inl.hpp:64-71)."""
+    hp = UpdaterHyperParams(base_lr=0.1, momentum=0.9, wd=0.0)
+    upd = NAGUpdater(hp)
+    w = jnp.asarray([1.0])
+    g = jnp.asarray([1.0])
+    st = upd.init_state(w)
+    w1, st1 = upd.update(st, w, g, 0)
+    # old_m=0, m = -0.1 -> w += 1.9*(-0.1) - 0.9*0 = -0.19
+    np.testing.assert_allclose(w1, [1.0 - 0.19], rtol=1e-6)
+
+
+def test_adam_matches_reference_formula():
+    """Reference adam (adam_updater-inl.hpp:66-76) with decay-style betas."""
+    hp = UpdaterHyperParams(base_lr=0.001, wd=0.0)
+    upd = AdamUpdater(hp)
+    w = jnp.asarray([1.0])
+    g = jnp.asarray([2.0])
+    w1, st = upd.update(upd.init_state(w), w, g, 0)
+    # epoch 0: fix1 = 1-(0.9)^1 = 0.1; fix2 = 1-(0.999)^1 = 0.001
+    # lr_t = 0.001*sqrt(0.001)/0.1
+    lr_t = 0.001 * np.sqrt(0.001) / 0.1
+    m1 = 0.1 * 2.0
+    m2 = 0.001 * 4.0
+    np.testing.assert_allclose(
+        w1, [1.0 - lr_t * (m1 / (np.sqrt(m2) + 1e-8))], rtol=1e-5)
+
+
+def test_lr_schedules():
+    hp = UpdaterHyperParams(base_lr=1.0)
+    hp.set_param("lr:schedule", "expdecay")
+    hp.set_param("lr:gamma", "0.5")
+    hp.set_param("lr:step", "10")
+    lr, _ = hp.schedule(10)
+    np.testing.assert_allclose(lr, 0.5, rtol=1e-6)
+    lr, _ = hp.schedule(20)
+    np.testing.assert_allclose(lr, 0.25, rtol=1e-6)
+
+    hp2 = UpdaterHyperParams(base_lr=1.0)
+    hp2.set_param("eta:schedule", "factor")
+    hp2.set_param("eta:factor", "0.1")
+    hp2.set_param("eta:step", "5")
+    np.testing.assert_allclose(hp2.schedule(4)[0], 1.0)
+    np.testing.assert_allclose(hp2.schedule(5)[0], 0.1, rtol=1e-6)
+    np.testing.assert_allclose(hp2.schedule(10)[0], 0.01, rtol=1e-5)
+
+    hp3 = UpdaterHyperParams(base_lr=1.0)
+    hp3.set_param("lr:schedule", "polydecay")
+    hp3.set_param("lr:gamma", "1.0")
+    hp3.set_param("lr:alpha", "1.0")
+    hp3.set_param("lr:step", "1")
+    np.testing.assert_allclose(hp3.schedule(3)[0], 0.25, rtol=1e-6)
+
+
+def test_lr_minimum_floor():
+    hp = UpdaterHyperParams(base_lr=1.0)
+    hp.set_param("lr:schedule", "expdecay")
+    hp.set_param("lr:gamma", "1e-8")
+    hp.set_param("lr:step", "1")
+    np.testing.assert_allclose(hp.schedule(3)[0], 1e-5, rtol=1e-5)
+
+
+def test_tag_scoped_params():
+    """wmat:lr applies only to the wmat updater; later entries win
+    (reference param.h:100-117)."""
+    cfgs = [[("eta", "0.1"), ("wd", "0.001"),
+             ("wmat:lr", "0.5"), ("bias:wd", "0.0")]]
+    w_upd = create_tensor_updater("sgd", "wmat", cfgs)
+    b_upd = create_tensor_updater("sgd", "bias", cfgs)
+    assert w_upd.hp.base_lr == 0.5
+    assert w_upd.hp.wd == 0.001
+    assert b_upd.hp.base_lr == 0.1
+    assert b_upd.hp.wd == 0.0
+
+
+def test_layer_cfg_overrides_global():
+    cfgs = [[("eta", "0.1")], [("eta", "0.9")]]
+    upd = create_tensor_updater("sgd", "wmat", cfgs)
+    assert upd.hp.base_lr == 0.9
