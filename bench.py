@@ -1,0 +1,65 @@
+"""Benchmark: AlexNet training throughput (images/sec) on one chip.
+
+The reference's headline benchmark is ImageNet AlexNet images/sec
+(BASELINE.md): the reference publishes no absolute number, so the
+baseline is the commonly reported single-K40 AlexNet fwd+bwd throughput
+of the 2014-15 CUDA frameworks (~250 images/sec at batch 256, e.g. the
+public convnet-benchmarks tables for Caffe-era code on Kepler).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+# K40-era AlexNet fwd+bwd throughput (external published baseline)
+BASELINE_IMAGES_PER_SEC = 250.0
+
+BATCH = 256
+WARMUP = 3
+ITERS = 10
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    import __graft_entry__ as ge
+    from cxxnet_tpu.io import DataBatch
+
+    platform = jax.devices()[0].platform
+    # bfloat16 compute on TPU (MXU-native), float32 elsewhere
+    dtype = "bfloat16" if platform == "tpu" else "float32"
+    tr = ge._build_trainer(batch_size=BATCH, nclass=1000, dev=platform,
+                           dtype=dtype, eval_train=0)
+
+    rs = np.random.RandomState(0)
+    batch = DataBatch(
+        data=rs.randn(BATCH, 3, 227, 227).astype(np.float32),
+        label=rs.randint(0, 1000, size=(BATCH, 1)).astype(np.float32))
+
+    for _ in range(WARMUP):
+        tr.update(batch)
+    jax.block_until_ready(tr.params)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        tr.update(batch)
+    jax.block_until_ready(tr.params)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "alexnet_train_images_per_sec",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""Entry point: ``python -m cxxnet_tpu config.conf [k=v ...]`` — the
+equivalent of the reference's ``bin/cxxnet`` binary
+(reference: src/cxxnet_main.cpp:475-478)."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,114 @@
+"""Checkpointing: model save/load/continue/finetune.
+
+The reference model format is ``[net_type][NetConfig][epoch][weight blob]``
+written every ``save_model`` rounds to ``model_dir/%04d.model``
+(reference: src/cxxnet_main.cpp:173-182, nnet_impl-inl.hpp:82-100).
+We keep the *UX* — numbered .model files, scan-directory resume, name-based
+finetune copy — with a robust container: a single .model file holding a
+JSON structure header plus npz weight arrays. Unlike the reference
+(which drops momentum on resume, SURVEY.md §5), optimizer state is saved
+and restored by default.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import zipfile
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .graph import NetConfig
+
+MAGIC = "cxxnet_tpu.model.v1"
+
+
+def _collect_arrays(params, prefix: str) -> dict:
+    out = {}
+    for li, p in enumerate(params):
+        if not p:
+            continue
+        if isinstance(p, dict):
+            for tag, v in p.items():
+                if isinstance(v, dict):  # optimizer slots
+                    for slot, w in v.items():
+                        out["%s%d:%s:%s" % (prefix, li, tag, slot)] = \
+                            np.asarray(w)
+                else:
+                    out["%s%d:%s" % (prefix, li, tag)] = np.asarray(v)
+    return out
+
+
+def save_model(path: str, net_cfg: NetConfig, epoch_counter: int,
+               params, opt_state=None, net_type: int = 0) -> None:
+    """Write one .model file (structure + epoch + weights [+opt state])."""
+    header = {
+        "magic": MAGIC,
+        "net_type": net_type,
+        "epoch_counter": int(epoch_counter),
+        "structure": net_cfg.structure_state(),
+        "has_opt_state": opt_state is not None,
+    }
+    arrays = _collect_arrays(params, "L")
+    if opt_state is not None:
+        arrays.update(_collect_arrays(opt_state, "O"))
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    tmp = path + ".tmp"
+    with zipfile.ZipFile(tmp, "w") as z:
+        z.writestr("header.json", json.dumps(header))
+        z.writestr("arrays.npz", buf.getvalue())
+    os.replace(tmp, path)
+
+
+def load_model(path: str):
+    """Read a .model file -> (net_cfg, epoch, params, opt_state, net_type).
+
+    params/opt_state are lists indexed by layer with dict leaves, matching
+    Network.init_params layout; slots missing from the file are None.
+    """
+    with zipfile.ZipFile(path, "r") as z:
+        header = json.loads(z.read("header.json"))
+        if header.get("magic") != MAGIC:
+            raise ValueError("%s: not a cxxnet_tpu model file" % path)
+        npz = np.load(io.BytesIO(z.read("arrays.npz")))
+        arrays = {k: npz[k] for k in npz.files}
+    net_cfg = NetConfig.from_structure_state(header["structure"])
+    nlayers = net_cfg.num_layers
+    params: List[Optional[dict]] = [None] * nlayers
+    opt_state: List[Optional[dict]] = [None] * nlayers
+    for key, arr in arrays.items():
+        m = re.match(r"L(\d+):([^:]+)$", key)
+        if m:
+            li = int(m.group(1))
+            params[li] = params[li] or {}
+            params[li][m.group(2)] = arr
+            continue
+        m = re.match(r"O(\d+):([^:]+):([^:]+)$", key)
+        if m:
+            li = int(m.group(1))
+            opt_state[li] = opt_state[li] or {}
+            opt_state[li].setdefault(m.group(2), {})[m.group(3)] = arr
+    if not header.get("has_opt_state"):
+        opt_state = None
+    return (net_cfg, header["epoch_counter"], params, opt_state,
+            header.get("net_type", 0))
+
+
+def model_path(model_dir: str, counter: int) -> str:
+    return os.path.join(model_dir, "%04d.model" % counter)
+
+
+def find_latest_model(model_dir: str,
+                      start_counter: int = 0) -> Optional[Tuple[str, int]]:
+    """Scan model_dir/%04d.model upward from start_counter for the last
+    existing file (reference SyncLastestModel, cxxnet_main.cpp:135-157)."""
+    last = None
+    c = start_counter
+    while os.path.exists(model_path(model_dir, c)):
+        last = (model_path(model_dir, c), c)
+        c += 1
+    return last

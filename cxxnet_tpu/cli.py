@@ -1,0 +1,288 @@
+"""CLI task driver: ``python -m cxxnet_tpu config.conf [k=v ...]``.
+
+Mirrors the reference's CXXNetLearnTask (reference: src/cxxnet_main.cpp:16-471):
+the same argv contract (config file + k=v overrides), the same tasks
+(train / finetune / pred / extract), continue-training via model-dir scan,
+save_model cadence, ``test_io`` pipeline dry-run, per-round eval lines on
+stderr and progress lines on stdout.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from . import checkpoint, config
+from .io import DataIterator, create_iterator
+from .trainer import Trainer
+
+ConfigEntry = Tuple[str, str]
+
+
+class LearnTask:
+    def __init__(self) -> None:
+        self.cfg: List[ConfigEntry] = []
+        self.task = "train"
+        self.net_type = 0
+        self.trainer: Optional[Trainer] = None
+        self.itr_train: Optional[DataIterator] = None
+        self.itr_pred: Optional[DataIterator] = None
+        self.itr_evals: List[DataIterator] = []
+        self.eval_names: List[str] = []
+        self.model_dir = "models"
+        self.num_round = 10
+        self.max_round = 1 << 31
+        self.test_io = 0
+        self.silent = 0
+        self.start_counter = 0
+        self.continue_training = 0
+        self.save_period = 1
+        self.model_in = "NULL"
+        self.name_pred = "pred.txt"
+        self.print_step = 100
+        self.extract_node_name = ""
+        self.output_format = 1
+
+    # ------------------------------------------------------------------
+    def set_param(self, name: str, val: str) -> None:
+        """Reference: cxxnet_main.cpp:83-105."""
+        if val == "default":
+            return
+        if name == "net_type":
+            self.net_type = int(val)
+        elif name == "print_step":
+            self.print_step = int(val)
+        elif name == "continue":
+            self.continue_training = int(val)
+        elif name == "save_model":
+            self.save_period = int(val)
+        elif name == "start_counter":
+            self.start_counter = int(val)
+        elif name == "model_in":
+            self.model_in = val
+        elif name == "model_dir":
+            self.model_dir = val
+        elif name == "num_round":
+            self.num_round = int(val)
+        elif name == "max_round":
+            self.max_round = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "task":
+            self.task = val
+        elif name == "test_io":
+            self.test_io = int(val)
+        elif name == "extract_node_name":
+            self.extract_node_name = val
+        elif name == "output_format":
+            self.output_format = 1 if val == "txt" else 0
+        self.cfg.append((name, val))
+
+    # ------------------------------------------------------------------
+    def run(self, argv: List[str]) -> int:
+        if len(argv) < 1:
+            print("Usage: <config>")
+            return 0
+        for name, val in config.parse_file(argv[0]):
+            self.set_param(name, val)
+        for name, val in config.parse_cli_overrides(argv[1:]):
+            self.set_param(name, val)
+        self.init()
+        if not self.silent:
+            print("initializing end, start working")
+        if self.task in ("train", "finetune"):
+            self.task_train()
+        elif self.task == "pred":
+            self.task_predict()
+        elif self.task == "extract":
+            self.task_extract()
+        return 0
+
+    # ------------------------------------------------------------------
+    def _create_trainer(self) -> Trainer:
+        tr = Trainer()
+        for k, v in self.cfg:
+            tr.set_param(k, v)
+        return tr
+
+    def init(self) -> None:
+        """Reference: cxxnet_main.cpp:108-133."""
+        if self.task == "train" and self.continue_training:
+            found = checkpoint.find_latest_model(
+                self.model_dir, self.start_counter)
+            if found is None:
+                raise RuntimeError(
+                    "Init: cannot find models for continue training; "
+                    "specify model_in instead")
+            path, counter = found
+            print("Init: Continue training from round %d" % counter)
+            self.trainer = self._create_trainer()
+            self.trainer.load_model(path)
+            self.start_counter = counter + 1
+            self.create_iterators()
+            return
+        self.continue_training = 0
+        if self.model_in == "NULL":
+            if self.task != "train":
+                raise RuntimeError("must specify model_in if not training")
+            self.trainer = self._create_trainer()
+            self.trainer.init_model()
+        else:
+            self.trainer = self._create_trainer()
+            if self.task == "finetune":
+                self.trainer.copy_model_from(self.model_in)
+            else:
+                self.trainer.load_model(self.model_in)
+                base = os.path.basename(self.model_in).split(".")[0]
+                if base.isdigit():
+                    self.start_counter = int(base)
+                self.start_counter += 1
+        self.create_iterators()
+
+    def create_iterators(self) -> None:
+        """Order-sensitive iterator sections (reference:
+        cxxnet_main.cpp:214-264): data/eval/pred ... iter=end."""
+        flag = 0
+        evname = ""
+        itcfg: List[ConfigEntry] = []
+        for name, val in self.cfg:
+            if name == "data":
+                flag = 1
+                continue
+            if name == "eval":
+                evname = val
+                flag = 2
+                continue
+            if name == "pred":
+                flag = 3
+                self.name_pred = val
+                continue
+            if name == "iter" and val == "end":
+                if flag == 1 and self.task != "pred":
+                    assert self.itr_train is None, "can only have one data"
+                    self.itr_train = create_iterator(itcfg)
+                elif flag == 2 and self.task != "pred":
+                    self.itr_evals.append(create_iterator(itcfg))
+                    self.eval_names.append(evname)
+                elif flag == 3 and self.task in ("pred", "extract"):
+                    assert self.itr_pred is None, "can only have one pred"
+                    self.itr_pred = create_iterator(itcfg)
+                flag = 0
+                itcfg = []
+                continue
+            if flag != 0:
+                itcfg.append((name, val))
+
+    # ------------------------------------------------------------------
+    def save_model_file(self) -> None:
+        """Reference: cxxnet_main.cpp:173-182 (cadence check + %04d name)."""
+        counter = self.start_counter
+        self.start_counter += 1
+        # the reference checks the *incremented* counter against the period
+        if self.save_period == 0 or self.start_counter % self.save_period != 0:
+            return
+        os.makedirs(self.model_dir, exist_ok=True)
+        self.trainer.save_model(checkpoint.model_path(self.model_dir, counter))
+
+    def task_train(self) -> None:
+        """Reference: cxxnet_main.cpp:344-412."""
+        start = time.time()
+        if self.continue_training == 0 and self.model_in == "NULL":
+            self.save_model_file()
+        else:
+            for itr, name in zip(self.itr_evals, self.eval_names):
+                sys.stderr.write(self.trainer.evaluate(itr, name))
+            sys.stderr.write("\n")
+            sys.stderr.flush()
+        if self.itr_train is None:
+            return
+        if self.test_io:
+            print("start I/O test")
+        cc = self.max_round
+        while self.start_counter <= self.num_round and cc > 0:
+            cc -= 1
+            if not self.silent:
+                print("update round %d" % (self.start_counter - 1), end="")
+                sys.stdout.flush()
+            sample_counter = 0
+            self.trainer.start_round(self.start_counter)
+            self.itr_train.before_first()
+            while self.itr_train.next():
+                if self.test_io == 0:
+                    self.trainer.update(self.itr_train.value)
+                sample_counter += 1
+                if sample_counter % self.print_step == 0 and not self.silent:
+                    elapsed = int(time.time() - start)
+                    print("\r%80s\r" % "", end="")
+                    print("round %8d:[%8d] %d sec elapsed"
+                          % (self.start_counter - 1, sample_counter, elapsed),
+                          end="")
+                    sys.stdout.flush()
+            if self.test_io == 0:
+                sys.stderr.write("[%d]" % self.start_counter)
+                if not self.itr_evals:
+                    sys.stderr.write(self.trainer.evaluate(None, "train"))
+                for itr, name in zip(self.itr_evals, self.eval_names):
+                    sys.stderr.write(self.trainer.evaluate(itr, name))
+                sys.stderr.write("\n")
+                sys.stderr.flush()
+            self.save_model_file()
+        if not self.silent:
+            print("\nupdating end, %d sec in all" % int(time.time() - start))
+
+    # ------------------------------------------------------------------
+    def task_predict(self) -> None:
+        """Reference: cxxnet_main.cpp:266-283."""
+        assert self.itr_pred is not None, \
+            "must specify a pred iterator to generate predictions"
+        print("start predicting...")
+        with open(self.name_pred, "w") as fo:
+            self.itr_pred.before_first()
+            while self.itr_pred.next():
+                batch = self.itr_pred.value
+                preds = self.trainer.predict(batch)
+                sz = batch.batch_size - batch.num_batch_padd
+                for j in range(sz):
+                    fo.write("%g\n" % preds[j])
+        print("finished prediction, write into %s" % self.name_pred)
+
+    def task_extract(self) -> None:
+        """Reference: cxxnet_main.cpp:284-343."""
+        assert self.itr_pred is not None, \
+            "must specify a pred iterator for feature extraction"
+        if not self.extract_node_name:
+            raise RuntimeError(
+                "extract node name must be specified in task extract")
+        print("start predicting...")
+        nrow = 0
+        dshape = None
+        mode = "w" if self.output_format else "wb"
+        with open(self.name_pred, mode) as fo:
+            self.itr_pred.before_first()
+            while self.itr_pred.next():
+                batch = self.itr_pred.value
+                feat = self.trainer.extract_feature(
+                    batch, self.extract_node_name)
+                sz = batch.batch_size - batch.num_batch_padd
+                nrow += sz
+                for j in range(sz):
+                    row = feat[j].reshape(-1)
+                    if self.output_format:
+                        fo.write(" ".join("%g" % v for v in row) + " \n")
+                    else:
+                        row.astype(np.float32).tofile(fo)
+                if sz:
+                    dshape = feat[0].shape
+        with open(self.name_pred + ".meta", "w") as fm:
+            fm.write("%d,%d,%d,%d\n" % ((nrow,) + tuple(dshape)))
+        print("finished prediction, write into %s" % self.name_pred)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    return LearnTask().run(argv)

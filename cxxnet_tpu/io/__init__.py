@@ -157,8 +157,10 @@ class SyntheticIterator(ArrayIterator):
     def init(self) -> None:
         rng = np.random.RandomState(self.seed + 42)
         c, h, w = self.shape
-        x = rng.randn(self.ninst, c, h, w).astype(np.float32)
+        # the labeling rule is drawn FIRST so train/eval iterators with
+        # different ninst share the same ground-truth function
         proj = rng.randn(c * h * w, self.nclass).astype(np.float32)
+        x = rng.randn(self.ninst, c, h, w).astype(np.float32)
         logits = x.reshape(self.ninst, -1) @ proj
         y = logits.argmax(axis=1).astype(np.float32)
         label = np.tile(y[:, None], (1, self.label_width))

@@ -1,0 +1,220 @@
+"""Model zoo: netconfig recipes for the reference's example model families.
+
+Each function returns a config *string* in the cxxnet dialect — the same
+text a user would put in a .conf file — so the zoo exercises exactly the
+public surface (reference examples: example/MNIST/MNIST.conf,
+example/MNIST/MNIST_CONV.conf, example/ImageNet/ImageNet.conf,
+example/kaggle_bowl/bowl.conf).
+"""
+
+from __future__ import annotations
+
+
+def mnist_mlp(nhidden: int = 100, nclass: int = 10) -> str:
+    """2-layer MLP with sigmoid + softmax (MNIST.conf recipe)."""
+    return f"""
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = {nhidden}
+  init_sigma = 0.01
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = {nclass}
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,784
+"""
+
+
+def mnist_conv(nclass: int = 10) -> str:
+    """LeNet-ish conv net (MNIST_CONV.conf recipe)."""
+    return f"""
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+  pad = 1
+  stride = 2
+  nchannel = 32
+  random_type = xavier
+layer[1->2] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[2->3] = flatten
+layer[3->3] = dropout
+  threshold = 0.5
+layer[3->4] = fullc:fc1
+  nhidden = 100
+  init_sigma = 0.01
+layer[4->5] = sigmoid:se1
+layer[5->6] = fullc:fc2
+  nhidden = {nclass}
+  init_sigma = 0.01
+layer[6->6] = softmax
+netconfig=end
+input_shape = 1,28,28
+"""
+
+
+def alexnet(nclass: int = 1000) -> str:
+    """AlexNet with grouped convs, LRN and dropout — the reference's
+    flagship ImageNet recipe (example/ImageNet/ImageNet.conf structure:
+    5 convs (groups on 2/4/5), 3 maxpools, 2 LRNs, 2 dropout fullc)."""
+    return f"""
+netconfig=start
+layer[0->1] = conv:conv1
+  kernel_size = 11
+  stride = 4
+  nchannel = 96
+layer[1->2] = relu
+layer[2->3] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[3->4] = lrn
+  local_size = 5
+  alpha = 0.001
+  beta = 0.75
+  knorm = 1
+layer[4->5] = conv:conv2
+  ngroup = 2
+  kernel_size = 5
+  pad = 2
+  nchannel = 256
+layer[5->6] = relu
+layer[6->7] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[7->8] = lrn
+  local_size = 5
+  alpha = 0.001
+  beta = 0.75
+  knorm = 1
+layer[8->9] = conv:conv3
+  kernel_size = 3
+  pad = 1
+  nchannel = 384
+layer[9->10] = relu
+layer[10->11] = conv:conv4
+  ngroup = 2
+  kernel_size = 3
+  pad = 1
+  nchannel = 384
+layer[11->12] = relu
+layer[12->13] = conv:conv5
+  ngroup = 2
+  kernel_size = 3
+  pad = 1
+  nchannel = 256
+  init_bias = 1.0
+layer[13->14] = relu
+layer[14->15] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[15->16] = flatten
+layer[16->17] = fullc:fc6
+  nhidden = 4096
+  init_sigma = 0.005
+  init_bias = 1.0
+layer[17->18] = relu
+layer[18->18] = dropout
+  threshold = 0.5
+layer[18->19] = fullc:fc7
+  nhidden = 4096
+  init_sigma = 0.005
+  init_bias = 1.0
+layer[19->20] = relu
+layer[20->20] = dropout
+  threshold = 0.5
+layer[20->21] = fullc:fc8
+  nhidden = {nclass}
+layer[21->21] = softmax
+netconfig=end
+input_shape = 3,227,227
+"""
+
+
+def bowl_net(nclass: int = 121) -> str:
+    """Plankton convnet (kaggle_bowl/bowl.conf recipe)."""
+    return f"""
+netconfig=start
+layer[0->1] = conv:c1
+  kernel_size = 4
+  stride = 1
+  pad = 2
+  nchannel = 48
+layer[1->2] = relu
+layer[2->3] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[3->4] = conv:c2
+  kernel_size = 3
+  stride = 1
+  pad = 1
+  nchannel = 96
+layer[4->5] = relu
+layer[5->6] = conv:c3
+  kernel_size = 3
+  stride = 1
+  pad = 1
+  nchannel = 96
+layer[6->7] = relu
+layer[7->8] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[8->9] = conv:c4
+  kernel_size = 2
+  stride = 1
+  nchannel = 128
+layer[9->10] = relu
+layer[10->11] = conv:c5
+  kernel_size = 3
+  stride = 1
+  nchannel = 128
+layer[11->12] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[12->13] = flatten
+layer[13->14] = fullc:fc1
+  nhidden = 256
+layer[14->14] = dropout
+  threshold = 0.5
+layer[14->15] = fullc:fc2
+  nhidden = {nclass}
+layer[15->15] = softmax
+netconfig=end
+input_shape = 3,40,40
+"""
+
+
+def inception_block_demo(nclass: int = 10) -> str:
+    """GoogLeNet-style inception block using split + ch_concat — exercises
+    the multi-input/multi-output graph machinery (BASELINE.md config #4)."""
+    return f"""
+netconfig=start
+layer[0->1] = conv:stem
+  kernel_size = 3
+  pad = 1
+  stride = 2
+  nchannel = 16
+layer[1->2] = relu
+layer[2->b1,b2,b3] = split
+layer[b1->c1] = conv:i1x1
+  kernel_size = 1
+  nchannel = 8
+layer[b2->c2] = conv:i3x3
+  kernel_size = 3
+  pad = 1
+  nchannel = 16
+layer[b3->c3] = conv:i5x5
+  kernel_size = 5
+  pad = 2
+  nchannel = 8
+layer[c1,c2,c3->cat] = ch_concat
+layer[cat->r] = relu
+layer[r->f] = flatten
+layer[f->out] = fullc:head
+  nhidden = {nclass}
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,32,32
+"""

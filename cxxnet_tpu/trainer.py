@@ -310,6 +310,58 @@ class Trainer:
         self.params = jax.device_put(params, parallel.replicated(self.mesh))
 
 
+    # ------------------------------------------------------------------
+    # checkpointing (reference: nnet_impl-inl.hpp:82-134, SURVEY.md §3.3)
+    def save_model(self, path: str) -> None:
+        from . import checkpoint
+        checkpoint.save_model(
+            path, self.net_cfg, self.epoch_counter,
+            jax.device_get(self.params), jax.device_get(self.opt_state))
+
+    def load_model(self, path: str) -> None:
+        """Restore structure + epoch + weights (+ optimizer state, which
+        the reference loses on resume — SURVEY.md §5)."""
+        from . import checkpoint
+        net_cfg, epoch, params, opt_state, _ = checkpoint.load_model(path)
+        self.net_cfg = net_cfg
+        # refresh training-param buckets + verify declared structure
+        self.net_cfg.configure(self.cfg)
+        self.epoch_counter = epoch
+        self._build_network()
+        params = jax.tree.map(jnp.asarray, params)
+        opt = NetUpdater(self.net)
+        if opt_state is None:
+            opt_state = opt.init_state(params)
+        else:
+            opt_state = jax.tree.map(jnp.asarray, opt_state)
+        self._finish_init(params, opt, opt_state)
+
+    def copy_model_from(self, path: str) -> None:
+        """Finetune: fresh init, then copy params of layers whose names
+        match the old net (reference: nnet_impl-inl.hpp:101-134)."""
+        from . import checkpoint
+        self.init_model()
+        old_cfg, _, old_params, _, _ = checkpoint.load_model(path)
+        params = list(self.params)
+        for i, old in enumerate(old_cfg.layers):
+            if not old.name or old_params[i] is None:
+                continue
+            j = self.net_cfg.layer_name_map.get(old.name)
+            if j is None:
+                continue
+            if self.silent == 0:
+                print("Copying layer %s" % old.name)
+            cur = dict(params[j] or {})
+            for tag, arr in old_params[i].items():
+                if tag in cur and tuple(cur[tag].shape) != tuple(arr.shape):
+                    raise ValueError(
+                        "finetune: layer %s %s shape mismatch %s vs %s"
+                        % (old.name, tag, cur[tag].shape, arr.shape))
+                cur[tag] = jnp.asarray(arr)
+            params[j] = cur
+        self.params = jax.device_put(params, parallel.replicated(self.mesh))
+
+
 def _strip_nones(tree):
     """Replace per-layer None slots with empty dicts so tree ops line up."""
     return [({} if t is None else t) for t in tree]
