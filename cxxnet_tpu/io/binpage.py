@@ -1,0 +1,134 @@
+"""BinaryPage packfile format — bit-compatible with the reference.
+
+Layout (reference: src/utils/io.h:254-326): a packfile is a sequence of
+fixed 64MB pages. Each page is an int32 array ``data`` of kPageSize
+elements where
+
+  * ``data[0]``   = number of objects n
+  * ``data[1]``   = 0
+  * ``data[r+2]`` = cumulative end-offset (bytes) of object r
+  * object r's bytes live at ``[PAGE_BYTES - data[r+2],
+    PAGE_BYTES - data[r+1])`` — packed backward from the page end
+
+so existing .bin files written by the reference's im2bin tool load here
+unchanged, and files written here load in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+K_PAGE_SIZE = 64 << 18                 # ints per page (io.h:259)
+PAGE_BYTES = K_PAGE_SIZE * 4           # 64 MB
+
+
+class BinaryPage:
+    """One in-memory page."""
+
+    def __init__(self, raw: Optional[bytes] = None) -> None:
+        if raw is None:
+            self.data = np.zeros(K_PAGE_SIZE, dtype="<i4")
+        else:
+            if len(raw) != PAGE_BYTES:
+                raise ValueError("BinaryPage: truncated page")
+            self.data = np.frombuffer(bytearray(raw), dtype="<i4")
+
+    @property
+    def size(self) -> int:
+        return int(self.data[0])
+
+    def _free_bytes(self) -> int:
+        return (K_PAGE_SIZE - (self.size + 2)) * 4 - int(self.data[self.size + 1])
+
+    def push(self, obj: bytes) -> bool:
+        """Append one object; False if the page is full (io.h:297-305)."""
+        if self._free_bytes() < len(obj) + 4:
+            return False
+        n = self.size
+        end = int(self.data[n + 1]) + len(obj)
+        self.data[n + 2] = end
+        view = self.data.view(np.uint8)
+        view[PAGE_BYTES - end: PAGE_BYTES - end + len(obj)] = \
+            np.frombuffer(obj, np.uint8)
+        self.data[0] = n + 1
+        return True
+
+    def __getitem__(self, r: int) -> bytes:
+        if r >= self.size:
+            raise IndexError("BinaryPage index exceeds bound")
+        start = int(self.data[r + 1])
+        end = int(self.data[r + 2])
+        view = self.data.view(np.uint8)
+        return bytes(view[PAGE_BYTES - end: PAGE_BYTES - start])
+
+    def tobytes(self) -> bytes:
+        return self.data.tobytes()
+
+    def clear(self) -> None:
+        self.data[:] = 0
+
+
+class BinaryPageWriter:
+    """Stream objects into a packfile (the im2bin path,
+    reference: tools/im2bin.cpp)."""
+
+    def __init__(self, path: str) -> None:
+        self.f = open(path, "wb")
+        self.page = BinaryPage()
+
+    def push(self, obj: bytes) -> None:
+        if not self.page.push(obj):
+            self.f.write(self.page.tobytes())
+            self.page.clear()
+            if not self.page.push(obj):
+                raise ValueError(
+                    "object of %d bytes exceeds page capacity" % len(obj))
+
+    def close(self) -> None:
+        if self.page.size > 0:
+            self.f.write(self.page.tobytes())
+            self.page.clear()
+        self.f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def iter_packfile(path: str) -> Iterator[bytes]:
+    """Yield every object in a packfile, in order."""
+    with open(path, "rb") as f:
+        while True:
+            raw = f.read(PAGE_BYTES)
+            if len(raw) < PAGE_BYTES:
+                break
+            page = BinaryPage(raw)
+            for r in range(page.size):
+                yield page[r]
+
+
+def pack_images(lst_path: str, root_dir: str, out_path: str,
+                silent: bool = False) -> int:
+    """im2bin: pack the image files named by a .lst into a packfile
+    (reference: tools/im2bin.cpp). Returns the number of images packed."""
+    count = 0
+    with BinaryPageWriter(out_path) as w:
+        with open(lst_path) as f:
+            for line in f:
+                parts = line.strip().split("\t")
+                if len(parts) < 3:
+                    continue
+                fname = parts[-1]
+                with open(os.path.join(root_dir, fname), "rb") as img:
+                    w.push(img.read())
+                count += 1
+                if not silent and count % 1000 == 0:
+                    print("\r%8d images packed" % count, end="", flush=True)
+    if not silent:
+        print("\r%8d images packed into %s" % (count, out_path))
+    return count

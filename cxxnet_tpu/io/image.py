@@ -1,0 +1,616 @@
+"""Image data pipeline: .lst/img/imgbin readers, augmentation, batching.
+
+The reference pipeline (reference: src/io/data.cpp:24-75) chains
+instance iterators (img / imgbin) through augmentation
+(iter_augment_proc-inl.hpp) into a batch adapter
+(iter_batch_proc-inl.hpp). The chain shape and every config knob are
+preserved; decode runs on worker threads (the TPU host-side equivalent of
+the reference's prefetch threads).
+
+Channel convention: instance tensors are (3, h, w) float32 in R,G,B
+order. (The reference is internally inconsistent here: its augmenter
+emits RGB planes while the mean_value path labels plane 0 "b" —
+iter_augment_proc-inl.hpp:65-67,126 vs image_augmenter-inl.hpp:147-151;
+we resolve to RGB and map mean_value=b,g,r onto the right planes.)
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import DataBatch, DataIterator
+from .binpage import iter_packfile
+
+ConfigEntry = Tuple[str, str]
+
+
+@dataclass
+class DataInst:
+    """One instance (reference: src/io/data.h:41-56)."""
+    index: int
+    label: np.ndarray          # (label_width,)
+    data: np.ndarray           # (c, h, w) float32, RGB
+
+
+class InstIterator:
+    """Instance-level iterator protocol."""
+
+    def set_param(self, name: str, val: str) -> None:
+        pass
+
+    def init(self) -> None:
+        pass
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def next(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def value(self) -> DataInst:
+        raise NotImplementedError
+
+
+def _decode_image(buf: bytes) -> np.ndarray:
+    """JPEG/PNG bytes -> (3, h, w) float32 RGB in [0, 255]."""
+    import cv2
+    arr = np.frombuffer(buf, np.uint8)
+    bgr = cv2.imdecode(arr, cv2.IMREAD_COLOR)
+    if bgr is None:
+        raise ValueError("cannot decode image (%d bytes)" % len(buf))
+    return bgr[:, :, ::-1].astype(np.float32).transpose(2, 0, 1)
+
+
+def _load_image(path: str) -> np.ndarray:
+    import cv2
+    bgr = cv2.imread(path, cv2.IMREAD_COLOR)
+    if bgr is None:
+        raise ValueError("cannot read image %s" % path)
+    return bgr[:, :, ::-1].astype(np.float32).transpose(2, 0, 1)
+
+
+def _parse_lst(path: str, label_width: int):
+    """.lst line = index \\t label... \\t filename
+    (reference: iter_img-inl.hpp, doc/io.md)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 2 + label_width:
+                parts = line.split()
+            if len(parts) < 2 + label_width:
+                continue
+            idx = int(parts[0])
+            label = np.asarray([float(x) for x in parts[1:1 + label_width]],
+                               np.float32)
+            out.append((idx, label, parts[-1]))
+    return out
+
+
+class ImageListIterator(InstIterator):
+    """``iter = img``: .lst + per-file imread, order-shuffle
+    (reference: src/io/iter_img-inl.hpp:16-137)."""
+
+    def __init__(self) -> None:
+        self.image_list = ""
+        self.image_root = ""
+        self.label_width = 1
+        self.shuffle = False
+        self.seed = 0
+        self.silent = 0
+        self._items = []
+        self._order = None
+        self._pos = 0
+        self._value: Optional[DataInst] = None
+
+    def set_param(self, name, val):
+        if name == "image_list":
+            self.image_list = val
+        elif name == "image_root":
+            self.image_root = val
+        elif name == "label_width":
+            self.label_width = int(val)
+        elif name == "shuffle":
+            self.shuffle = bool(int(val))
+        elif name in ("seed_data", "seed"):
+            self.seed = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+
+    def init(self):
+        self._items = _parse_lst(self.image_list, self.label_width)
+        self._order = np.arange(len(self._items))
+        self._rng = np.random.RandomState(self.seed)
+        if self.silent == 0:
+            print("ImageIterator:image_list=%s, %d images"
+                  % (self.image_list, len(self._items)))
+
+    def before_first(self):
+        self._pos = 0
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+
+    def next(self):
+        if self._pos >= len(self._items):
+            return False
+        idx, label, fname = self._items[self._order[self._pos]]
+        self._pos += 1
+        path = os.path.join(self.image_root, fname) if self.image_root \
+            else fname
+        self._value = DataInst(idx, label, _load_image(path))
+        return True
+
+    @property
+    def value(self):
+        return self._value
+
+
+class ImageBinIterator(InstIterator):
+    """``iter = imgbin``: .lst + BinaryPage packfile(s), with the
+    multi-part ``image_conf_prefix``/``image_conf_ids`` scheme and
+    per-worker shard assignment for distributed training
+    (reference: src/io/iter_thread_imbin-inl.hpp:16-285). Page reading +
+    decode happen on a prefetch thread via ThreadBufferIterator wrapping
+    at the batch level."""
+
+    def __init__(self) -> None:
+        self.path_imglst: List[str] = []
+        self.path_imgbin: List[str] = []
+        self.img_conf_prefix = ""
+        self.img_conf_ids = ""
+        self.dist_num_worker = 0
+        self.dist_worker_rank = 0
+        self.label_width = 1
+        self.silent = 0
+        self._part = 0
+        self._lst = []
+        self._pos = 0
+        self._objs = None
+        self._value: Optional[DataInst] = None
+
+    def set_param(self, name, val):
+        if name == "image_list":
+            self.path_imglst.append(val)
+        elif name == "image_bin":
+            self.path_imgbin.append(val)
+        elif name == "image_conf_prefix":
+            self.img_conf_prefix = val
+        elif name == "image_conf_ids":
+            self.img_conf_ids = val
+        elif name == "dist_num_worker":
+            self.dist_num_worker = int(val)
+        elif name == "dist_worker_rank":
+            self.dist_worker_rank = int(val)
+        elif name == "label_width":
+            self.label_width = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+
+    def _parse_image_conf(self):
+        """Multi-part spec: prefix with %d + id list "a-b" or "a,b,c";
+        parts are assigned round-robin to workers by rank
+        (reference: iter_thread_imbin-inl.hpp:199-219; rank from env
+        PS_RANK if unset, :190-194)."""
+        if not self.img_conf_prefix:
+            return
+        ids: List[int] = []
+        spec = self.img_conf_ids
+        if "-" in spec:
+            a, b = spec.split("-")
+            ids = list(range(int(a), int(b) + 1))
+        elif spec:
+            ids = [int(t) for t in spec.split(",")]
+        if self.dist_num_worker == 0 and os.environ.get("PS_RANK"):
+            self.dist_worker_rank = int(os.environ["PS_RANK"])
+            self.dist_num_worker = int(os.environ.get("PS_NUM_WORKER", "1"))
+        nw = max(self.dist_num_worker, 1)
+        my = [i for k, i in enumerate(ids) if k % nw == self.dist_worker_rank]
+        if not my and ids:
+            my = [ids[self.dist_worker_rank % len(ids)]]
+        for i in my:
+            self.path_imglst.append((self.img_conf_prefix % i) + ".lst")
+            self.path_imgbin.append((self.img_conf_prefix % i) + ".bin")
+
+    def init(self):
+        self._parse_image_conf()
+        if len(self.path_imglst) != len(self.path_imgbin):
+            raise ValueError("List/Bin number not consistent")
+        if not self.path_imglst:
+            raise ValueError("imgbin: no image_list/image_bin configured")
+        if self.silent == 0:
+            print("ImageBinIterator: %d part(s), list=%s"
+                  % (len(self.path_imglst), ",".join(self.path_imglst)))
+
+    def before_first(self):
+        self._part = 0
+        self._open_part(0)
+
+    def _open_part(self, k):
+        self._lst = _parse_lst(self.path_imglst[k], self.label_width)
+        self._objs = iter_packfile(self.path_imgbin[k])
+        self._pos = 0
+
+    def next(self):
+        while True:
+            if self._pos < len(self._lst):
+                idx, label, _ = self._lst[self._pos]
+                self._pos += 1
+                buf = next(self._objs)
+                self._value = DataInst(idx, label, _decode_image(buf))
+                return True
+            if self._part + 1 >= len(self.path_imglst):
+                return False
+            self._part += 1
+            self._open_part(self._part)
+
+    @property
+    def value(self):
+        return self._value
+
+
+class AugmentIterator(InstIterator):
+    """Per-instance augmentation (reference: src/io/iter_augment_proc-inl.hpp:21-248):
+    affine warp (rotate/shear/scale/aspect), crop (random / fixed-start /
+    center), mirror, mean image (computed+cached) or mean_value subtract,
+    contrast/illumination jitter, final scale."""
+
+    def __init__(self, base: InstIterator) -> None:
+        self.base = base
+        self.shape = (1, 1, 1)       # input_shape (c, h, w)
+        self.rand_crop = 0
+        self.crop_y_start = -1
+        self.crop_x_start = -1
+        self.scale = 1.0
+        self.silent = 0
+        self.name_meanimg = ""
+        self.mean_rgb = None          # (r, g, b) or None
+        self.mirror = 0
+        self.rand_mirror = 0
+        self.max_random_contrast = 0.0
+        self.max_random_illumination = 0.0
+        # affine params (reference image_augmenter-inl.hpp:39-76)
+        self.max_rotate_angle = 0.0
+        self.max_shear_ratio = 0.0
+        self.max_aspect_ratio = 0.0
+        self.min_random_scale = 1.0
+        self.max_random_scale = 1.0
+        self.min_img_size = 0.0
+        self.max_img_size = 1e10
+        self.fill_value = 255
+        self.rotate = -1
+        self.rotate_list: List[int] = []
+        self.seed = 0
+        self._meanimg = None
+        self._value: Optional[DataInst] = None
+
+    def set_param(self, name, val):
+        self.base.set_param(name, val)
+        if name == "input_shape":
+            self.shape = tuple(int(x) for x in val.split(","))
+        elif name == "seed_data":
+            self.seed = int(val)
+        elif name == "rand_crop":
+            self.rand_crop = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "divideby":
+            self.scale = 1.0 / float(val)
+        elif name == "scale":
+            self.scale = float(val)
+        elif name == "image_mean":
+            self.name_meanimg = val
+        elif name == "crop_y_start":
+            self.crop_y_start = int(val)
+        elif name == "crop_x_start":
+            self.crop_x_start = int(val)
+        elif name == "rand_mirror":
+            self.rand_mirror = int(val)
+        elif name == "mirror":
+            self.mirror = int(val)
+        elif name == "max_random_contrast":
+            self.max_random_contrast = float(val)
+        elif name == "max_random_illumination":
+            self.max_random_illumination = float(val)
+        elif name == "mean_value":
+            b, g, r = (float(x) for x in val.split(","))
+            self.mean_rgb = (r, g, b)
+        elif name == "max_rotate_angle":
+            self.max_rotate_angle = float(val)
+        elif name == "max_shear_ratio":
+            self.max_shear_ratio = float(val)
+        elif name == "max_aspect_ratio":
+            self.max_aspect_ratio = float(val)
+        elif name == "min_random_scale":
+            self.min_random_scale = float(val)
+        elif name == "max_random_scale":
+            self.max_random_scale = float(val)
+        elif name == "min_img_size":
+            self.min_img_size = float(val)
+        elif name == "max_img_size":
+            self.max_img_size = float(val)
+        elif name == "fill_value":
+            self.fill_value = int(val)
+        elif name == "rotate":
+            self.rotate = int(val)
+        elif name == "rotate_list":
+            self.rotate_list = [int(t) for t in val.split(",") if t]
+
+    # ------------------------------------------------------------------
+    def init(self):
+        self.base.init()
+        self._rng = np.random.RandomState(self.seed)
+        if self.name_meanimg:
+            if os.path.exists(self.name_meanimg):
+                if self.silent == 0:
+                    print("loading mean image from %s" % self.name_meanimg)
+                self._meanimg = _load_mean(self.name_meanimg)
+            else:
+                self._create_mean_img()
+
+    def before_first(self):
+        self.base.before_first()
+
+    def _needs_affine(self) -> bool:
+        return (self.max_rotate_angle > 0 or self.max_shear_ratio > 0
+                or self.rotate > 0 or len(self.rotate_list) > 0)
+
+    def _affine(self, data: np.ndarray) -> np.ndarray:
+        """Single warpAffine combining rotation/shear/scale/aspect
+        (reference: image_augmenter-inl.hpp:76-121)."""
+        import cv2
+        rng = self._rng
+        s = rng.rand() * self.max_shear_ratio * 2 - self.max_shear_ratio
+        angle = 0
+        if self.max_rotate_angle > 0:
+            angle = rng.randint(0, int(self.max_rotate_angle * 2) + 1) \
+                - self.max_rotate_angle
+        if self.rotate > 0:
+            angle = self.rotate
+        if self.rotate_list:
+            angle = self.rotate_list[rng.randint(0, len(self.rotate_list))]
+        a = math.cos(angle / 180.0 * math.pi)
+        b = math.sin(angle / 180.0 * math.pi)
+        scale = rng.rand() * (self.max_random_scale
+                              - self.min_random_scale) + self.min_random_scale
+        ratio = rng.rand() * self.max_aspect_ratio * 2 \
+            - self.max_aspect_ratio + 1
+        hs = 2 * scale / (1 + ratio)
+        ws = ratio * hs
+        h, w = data.shape[1], data.shape[2]
+        new_w = max(self.min_img_size, min(self.max_img_size, scale * w))
+        new_h = max(self.min_img_size, min(self.max_img_size, scale * h))
+        M = np.zeros((2, 3), np.float32)
+        M[0, 0] = hs * a - s * b * ws
+        M[1, 0] = -b * ws
+        M[0, 1] = hs * b + s * a * ws
+        M[1, 1] = a * ws
+        M[0, 2] = (new_w - (M[0, 0] * w + M[0, 1] * h)) / 2
+        M[1, 2] = (new_h - (M[1, 0] * w + M[1, 1] * h)) / 2
+        bgr = data[::-1].transpose(1, 2, 0)  # RGB planes -> HWC BGR
+        warped = cv2.warpAffine(
+            bgr, M, (int(new_w), int(new_h)), flags=cv2.INTER_CUBIC,
+            borderMode=cv2.BORDER_CONSTANT,
+            borderValue=(self.fill_value,) * 3)
+        return warped.transpose(2, 0, 1)[::-1]
+
+    def _process(self, d: DataInst) -> DataInst:
+        data = d.data
+        if self._needs_affine():
+            data = self._affine(data)
+        c, th, tw = self.shape
+        rng = self._rng
+        if th == 1:  # flat input: scale only (iter_augment_proc:108-110)
+            return DataInst(d.index, d.label,
+                            (data * self.scale).astype(np.float32))
+        if data.shape[1] < th or data.shape[2] < tw:
+            raise ValueError(
+                "Data size must be bigger than the input size to net.")
+        yy_max = data.shape[1] - th
+        xx_max = data.shape[2] - tw
+        if self.rand_crop != 0 and (yy_max != 0 or xx_max != 0):
+            yy = rng.randint(0, yy_max + 1)
+            xx = rng.randint(0, xx_max + 1)
+        else:
+            yy, xx = yy_max // 2, xx_max // 2
+        if data.shape[1] != th and self.crop_y_start != -1:
+            yy = self.crop_y_start
+        if data.shape[2] != tw and self.crop_x_start != -1:
+            xx = self.crop_x_start
+        contrast = 1.0
+        illumination = 0.0
+        if self.max_random_contrast > 0:
+            contrast = rng.rand() * self.max_random_contrast * 2 \
+                - self.max_random_contrast + 1
+        if self.max_random_illumination > 0:
+            illumination = rng.rand() * self.max_random_illumination * 2 \
+                - self.max_random_illumination
+        do_mirror = (self.rand_mirror != 0 and rng.rand() < 0.5) \
+            or self.mirror == 1
+
+        if self.mean_rgb is not None:
+            img = data - np.asarray(self.mean_rgb,
+                                    np.float32).reshape(3, 1, 1)
+            img = img * contrast + illumination
+            img = img[:, yy:yy + th, xx:xx + tw]
+        elif self._meanimg is not None:
+            if data.shape == self._meanimg.shape:
+                img = (data - self._meanimg) * contrast + illumination
+                img = img[:, yy:yy + th, xx:xx + tw]
+            else:
+                img = data[:, yy:yy + th, xx:xx + tw] - self._meanimg
+                img = img * contrast + illumination
+        else:
+            img = data[:, yy:yy + th, xx:xx + tw]
+        if do_mirror:
+            img = img[:, :, ::-1]
+        return DataInst(d.index, d.label,
+                        (img * self.scale).astype(np.float32))
+
+    def next(self):
+        if not self.base.next():
+            return False
+        self._value = self._process(self.base.value)
+        return True
+
+    @property
+    def value(self):
+        return self._value
+
+    def _create_mean_img(self):
+        """Compute the dataset mean and cache to file
+        (reference: iter_augment_proc-inl.hpp:171-198)."""
+        if self.silent == 0:
+            print("cannot find %s: create mean image, this will take "
+                  "some time..." % self.name_meanimg)
+        self.base.before_first()
+        acc = None
+        cnt = 0
+        c, th, tw = self.shape
+        while self.base.next():
+            d = self.base.value.data
+            img = d[:, :th, :tw] if (d.shape[1] >= th and d.shape[2] >= tw) \
+                else d
+            if acc is None:
+                acc = np.zeros((c, th, tw) if th > 1 else d.shape, np.float64)
+            if img.shape != acc.shape:
+                # center-crop to the accumulator shape
+                ys = (img.shape[1] - acc.shape[1]) // 2
+                xs = (img.shape[2] - acc.shape[2]) // 2
+                img = img[:, ys:ys + acc.shape[1], xs:xs + acc.shape[2]]
+            acc += img
+            cnt += 1
+        self._meanimg = (acc / max(cnt, 1)).astype(np.float32)
+        _save_mean(self.name_meanimg, self._meanimg)
+        if self.silent == 0:
+            print("save mean image to %s.." % self.name_meanimg)
+        self.base.before_first()
+
+
+def _save_mean(path: str, img: np.ndarray) -> None:
+    """Mean-image file: mshadow SaveBinary layout — uint32 shape dims then
+    float32 data (reference mshadow tensor SaveBinary convention)."""
+    with open(path, "wb") as f:
+        np.asarray(img.shape, "<u4").tofile(f)
+        img.astype("<f4").tofile(f)
+
+
+def _load_mean(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        shape = np.fromfile(f, "<u4", 3)
+        data = np.fromfile(f, "<f4")
+    return data.reshape(tuple(int(x) for x in shape))
+
+
+class BatchAdaptIterator(DataIterator):
+    """DataInst -> DataBatch with tail semantics
+    (reference: src/io/iter_batch_proc-inl.hpp:16-133): round_batch wraps
+    the tail into the next epoch's head and reports num_batch_padd;
+    otherwise the tail is zero-padded. test_skipread re-serves one batch
+    to bound IO cost."""
+
+    def __init__(self, base: InstIterator) -> None:
+        self.base = base
+        self.batch_size = 0
+        self.shape = (1, 1, 1)
+        self.label_width = 1
+        self.round_batch = 0
+        self.test_skipread = 0
+        self.silent = 0
+        self._num_overflow = 0
+        self._head = 1
+        self._batch: Optional[DataBatch] = None
+
+    def set_param(self, name, val):
+        self.base.set_param(name, val)
+        if name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "input_shape":
+            self.shape = tuple(int(x) for x in val.split(","))
+        elif name == "label_width":
+            self.label_width = int(val)
+        elif name == "round_batch":
+            self.round_batch = int(val)
+        elif name == "test_skipread":
+            self.test_skipread = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+
+    def init(self):
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be set")
+        self.base.init()
+        c, h, w = self.shape
+        if h == 1 and c == 1:
+            self._dshape = (self.batch_size, 1, 1, w)
+        else:
+            self._dshape = (self.batch_size, c, h, w)
+
+    def before_first(self):
+        if self.round_batch == 0 or self._num_overflow == 0:
+            self.base.before_first()
+        else:
+            self._num_overflow = 0
+        self._head = 1
+
+    def _store(self, data, label, inst_index, top, d: DataInst):
+        label[top] = d.label
+        inst_index[top] = d.index
+        data[top] = d.data.reshape(self._dshape[1:])
+
+    def next(self):
+        if self.test_skipread != 0 and self._head == 0:
+            return True
+        self._head = 0
+        if self._num_overflow != 0:
+            return False
+        data = np.zeros(self._dshape, np.float32)
+        label = np.zeros((self.batch_size, self.label_width), np.float32)
+        inst_index = np.zeros(self.batch_size, np.int64)
+        top = 0
+        while self.base.next():
+            self._store(data, label, inst_index, top, self.base.value)
+            top += 1
+            if top >= self.batch_size:
+                self._batch = DataBatch(data, label, 0,
+                                        inst_index=inst_index)
+                return True
+        if top != 0:
+            if self.round_batch != 0:
+                self._num_overflow = 0
+                self.base.before_first()
+                while top < self.batch_size:
+                    if not self.base.next():
+                        raise ValueError(
+                            "number of input must be bigger than batch size")
+                    self._store(data, label, inst_index, top, self.base.value)
+                    top += 1
+                    self._num_overflow += 1
+                padd = self._num_overflow
+            else:
+                padd = self.batch_size - top
+            self._batch = DataBatch(data, label, padd, inst_index=inst_index)
+            return True
+        return False
+
+    @property
+    def value(self):
+        return self._batch
+
+
+def create_base_iterator(kind: str):
+    """Base instance iterators, wrapped augment+batch by the factory
+    (reference: src/io/data.cpp:35-64 wires img/imgbin through
+    AugmentIterator + BatchAdaptIterator)."""
+    if kind == "img":
+        inst = ImageListIterator()
+    elif kind in ("imgbin", "imgbinx"):
+        inst = ImageBinIterator()
+    else:
+        return None
+    return BatchAdaptIterator(AugmentIterator(inst))

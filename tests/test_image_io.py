@@ -1,0 +1,216 @@
+"""Image pipeline tests: BinaryPage format, img/imgbin iterators,
+augmentation, batch adapter (reference: src/io/*, src/utils/io.h:254-326)."""
+import os
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.io.binpage import (BinaryPage, BinaryPageWriter, PAGE_BYTES,
+                                   iter_packfile, pack_images)
+from cxxnet_tpu.io import image as img_io
+
+
+def test_binary_page_layout():
+    pg = BinaryPage()
+    assert pg.push(b"hello")
+    assert pg.push(b"world!!")
+    assert pg.size == 2
+    assert pg[0] == b"hello"
+    assert pg[1] == b"world!!"
+    # int header: [n, 0, end0, end1]
+    assert pg.data[0] == 2 and pg.data[1] == 0
+    assert pg.data[2] == 5 and pg.data[3] == 12
+    # objects packed backward from page end
+    raw = pg.tobytes()
+    assert raw[PAGE_BYTES - 5:] == b"hello"
+    assert raw[PAGE_BYTES - 12:PAGE_BYTES - 5] == b"world!!"
+
+
+def test_packfile_roundtrip(tmp_path):
+    objs = [os.urandom(np.random.randint(1, 5000)) for _ in range(50)]
+    p = str(tmp_path / "x.bin")
+    with BinaryPageWriter(p) as w:
+        for o in objs:
+            w.push(o)
+    assert os.path.getsize(p) % PAGE_BYTES == 0
+    got = list(iter_packfile(p))
+    assert got == objs
+
+
+def _make_dataset(tmp_path, n=12, size=24):
+    """Write n jpegs + .lst; returns (lst_path, root)."""
+    rs = np.random.RandomState(0)
+    root = tmp_path / "imgs"
+    root.mkdir(exist_ok=True)
+    lines = []
+    for i in range(n):
+        img = rs.randint(0, 255, size=(size, size, 3), dtype=np.uint8)
+        fname = "img%03d.png" % i  # png = lossless, exact round trip
+        cv2.imwrite(str(root / fname), img)
+        lines.append("%d\t%d\t%s" % (i, i % 3, fname))
+    lst = tmp_path / "data.lst"
+    lst.write_text("\n".join(lines) + "\n")
+    return str(lst), str(root)
+
+
+def test_img_iterator_batches(tmp_path):
+    lst, root = _make_dataset(tmp_path)
+    it = create_iterator([
+        ("iter", "img"),
+        ("image_list", lst), ("image_root", root),
+        ("input_shape", "3,24,24"), ("batch_size", "4"),
+        ("silent", "1"), ("iter", "end")])
+    batches = list(it)
+    assert len(batches) == 3
+    b = batches[0]
+    assert b.data.shape == (4, 3, 24, 24)
+    assert b.label.shape == (4, 1)
+    assert b.data.max() > 1.0  # raw pixel scale
+
+
+def test_imgbin_matches_img(tmp_path):
+    """imgbin pipeline must produce identical tensors to img for the same
+    data (pairtest-style differential check)."""
+    lst, root = _make_dataset(tmp_path)
+    binp = str(tmp_path / "data.bin")
+    n = pack_images(lst, root, binp, silent=True)
+    assert n == 12
+    common = [("input_shape", "3,24,24"), ("batch_size", "4"),
+              ("silent", "1"), ("iter", "end")]
+    it1 = create_iterator([("iter", "img"), ("image_list", lst),
+                           ("image_root", root)] + common)
+    it2 = create_iterator([("iter", "imgbin"), ("image_list", lst),
+                           ("image_bin", binp)] + common)
+    for b1, b2 in zip(it1, it2):
+        np.testing.assert_allclose(b1.data, b2.data)
+        np.testing.assert_allclose(b1.label, b2.label)
+
+
+def test_round_batch_tail(tmp_path):
+    lst, root = _make_dataset(tmp_path, n=10)
+    it = create_iterator([
+        ("iter", "img"), ("image_list", lst), ("image_root", root),
+        ("input_shape", "3,24,24"), ("batch_size", "4"),
+        ("round_batch", "1"), ("silent", "1"), ("iter", "end")])
+    it.before_first()
+    padds = []
+    while it.next():
+        padds.append(it.value.num_batch_padd)
+    assert padds == [0, 0, 2]
+    # next epoch: wrapped instances are consumed from the head
+    it.before_first()
+    count = 0
+    while it.next():
+        count += 1
+    assert count == 2  # 8 remaining insts / 4
+
+
+def test_augment_crop_mirror_scale(tmp_path):
+    lst, root = _make_dataset(tmp_path, size=28)
+    base = [("image_list", lst), ("image_root", root),
+            ("batch_size", "2"), ("silent", "1")]
+    # center crop 28 -> 24, divideby 255
+    it = create_iterator([("iter", "img")] + base + [
+        ("input_shape", "3,24,24"), ("divideby", "255"), ("iter", "end")])
+    it.before_first(); it.next()
+    assert it.value.data.shape == (2, 3, 24, 24)
+    assert it.value.data.max() <= 1.0
+    # fixed crop start
+    it2 = create_iterator([("iter", "img")] + base + [
+        ("input_shape", "3,24,24"), ("crop_y_start", "0"),
+        ("crop_x_start", "0"), ("iter", "end")])
+    it3 = create_iterator([("iter", "img")] + base + [
+        ("input_shape", "3,28,28"), ("iter", "end")])
+    it2.before_first(); it2.next()
+    it3.before_first(); it3.next()
+    np.testing.assert_allclose(it2.value.data,
+                               it3.value.data[:, :, :24, :24])
+    # deterministic mirror flips x axis
+    itm = create_iterator([("iter", "img")] + base + [
+        ("input_shape", "3,28,28"), ("mirror", "1"), ("iter", "end")])
+    itm.before_first(); itm.next()
+    np.testing.assert_allclose(itm.value.data,
+                               it3.value.data[:, :, :, ::-1])
+
+
+def test_mean_value_subtract(tmp_path):
+    lst, root = _make_dataset(tmp_path, size=24)
+    base = [("image_list", lst), ("image_root", root),
+            ("batch_size", "2"), ("silent", "1"),
+            ("input_shape", "3,24,24")]
+    it = create_iterator([("iter", "img")] + base + [("iter", "end")])
+    itm = create_iterator([("iter", "img")] + base + [
+        ("mean_value", "10,20,30"), ("iter", "end")])
+    it.before_first(); it.next()
+    itm.before_first(); itm.next()
+    # mean_value is b,g,r; our planes are r,g,b
+    expect = it.value.data - np.asarray([30, 20, 10],
+                                        np.float32).reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(itm.value.data, expect, atol=1e-4)
+
+
+def test_mean_image_create_and_cache(tmp_path, capsys):
+    lst, root = _make_dataset(tmp_path, size=24)
+    meanf = str(tmp_path / "mean.bin")
+    cfg = [("iter", "img"), ("image_list", lst), ("image_root", root),
+           ("batch_size", "2"), ("input_shape", "3,24,24"),
+           ("image_mean", meanf), ("iter", "end")]
+    it = create_iterator(cfg)
+    assert os.path.exists(meanf)
+    mean = img_io._load_mean(meanf)
+    assert mean.shape == (3, 24, 24)
+    # second init loads the cached file
+    it2 = create_iterator(cfg)
+    out = capsys.readouterr().out
+    assert "loading mean image" in out
+    it.before_first(); it.next()
+    it2.before_first(); it2.next()
+    np.testing.assert_allclose(it.value.data, it2.value.data)
+
+
+def test_affine_augmentation_runs(tmp_path):
+    lst, root = _make_dataset(tmp_path, size=32)
+    it = create_iterator([
+        ("iter", "img"), ("image_list", lst), ("image_root", root),
+        ("batch_size", "2"), ("input_shape", "3,24,24"),
+        ("max_rotate_angle", "15"), ("max_shear_ratio", "0.1"),
+        ("rand_crop", "1"), ("rand_mirror", "1"),
+        ("silent", "1"), ("iter", "end")])
+    it.before_first()
+    assert it.next()
+    assert it.value.data.shape == (2, 3, 24, 24)
+    assert np.isfinite(it.value.data).all()
+
+
+def test_threadbuffer_wraps_imgbin(tmp_path):
+    lst, root = _make_dataset(tmp_path)
+    binp = str(tmp_path / "d.bin")
+    pack_images(lst, root, binp, silent=True)
+    it = create_iterator([
+        ("iter", "imgbin"), ("image_list", lst), ("image_bin", binp),
+        ("iter", "threadbuffer"),
+        ("input_shape", "3,24,24"), ("batch_size", "4"),
+        ("silent", "1"), ("iter", "end")])
+    total = 0
+    for epoch in range(2):
+        it.before_first()
+        while it.next():
+            total += it.value.batch_size
+    assert total == 24
+
+
+def test_test_skipread(tmp_path):
+    """test_skipread re-serves one batch (reference iter_batch_proc:73-74)."""
+    lst, root = _make_dataset(tmp_path)
+    it = create_iterator([
+        ("iter", "img"), ("image_list", lst), ("image_root", root),
+        ("input_shape", "3,24,24"), ("batch_size", "4"),
+        ("test_skipread", "1"), ("silent", "1"), ("iter", "end")])
+    it.before_first()
+    n = 0
+    while it.next() and n < 20:
+        n += 1
+    assert n == 20  # never exhausts: same batch re-served
