@@ -91,6 +91,15 @@ class LearnTask:
             self.set_param(name, val)
         for name, val in config.parse_cli_overrides(argv[1:]):
             self.set_param(name, val)
+        # multi-host runtime (replaces the dist parameter server deployment)
+        d = dict(self.cfg)
+        if "dist_coordinator" in d:
+            from . import parallel
+            parallel.init_distributed(
+                d["dist_coordinator"],
+                int(d.get("dist_num_worker", "1")),
+                int(d.get("dist_worker_rank",
+                          os.environ.get("PS_RANK", "0"))))
         self.init()
         if not self.silent:
             print("initializing end, start working")

@@ -87,6 +87,63 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Initialize the multi-host JAX runtime over DCN.
+
+    This replaces the reference's distributed parameter-server deployment
+    (``bin/cxxnet.ps`` + mpi.conf launcher, reference: src/nnet/
+    nnet_ps_server.cpp, example/MNIST/mpi.conf): after initialization,
+    ``jax.devices()`` spans every host, the same jitted step runs as one
+    SPMD program, and gradient all-reduce rides ICI within a slice and
+    DCN across slices — no server processes, no push/pull.
+
+    Config keys: ``dist_coordinator`` (host:port), ``dist_num_worker``,
+    ``dist_worker_rank`` — or the standard JAX env autodetection when
+    called with no arguments.
+    """
+    import jax
+    kw = {}
+    if coordinator:
+        kw = dict(coordinator_address=coordinator,
+                  num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kw)
+
+
+def param_sharding(mesh: Mesh, layer_type: str, tag: str,
+                   shape: Tuple[int, ...]) -> NamedSharding:
+    """Tensor-parallel placement for one weight tensor.
+
+    On a 2D (data, model) mesh the big matmul weights shard over the
+    ``model`` axis — the output-feature dimension, so each device owns a
+    slice of the features and XLA all-gathers activations where needed
+    (Megatron-style column parallelism, expressed purely as sharding
+    annotations; the collectives are inserted by GSPMD over ICI):
+
+      * fullc wmat (nhidden, nin)        -> P('model', None)
+      * fullc/conv bias (nchannel,)      -> P('model')
+      * conv wmat (g, co/g, ci*kh*kw)    -> P(None, 'model', None)
+
+    On a 1D mesh everything is replicated (pure data parallelism).
+    """
+    if MODEL_AXIS not in mesh.shape:
+        return replicated(mesh)
+    n_model = mesh.shape[MODEL_AXIS]
+
+    def ok(dim):
+        return shape[dim] % n_model == 0
+
+    if layer_type in ("fullc", "fixconn") and tag == "wmat" and ok(0):
+        return NamedSharding(mesh, P(MODEL_AXIS, None))
+    if layer_type == "conv" and tag == "wmat" and len(shape) == 3 and ok(1):
+        return NamedSharding(mesh, P(None, MODEL_AXIS, None))
+    if tag == "bias" and len(shape) == 1 and ok(0) \
+            and layer_type in ("fullc", "conv"):
+        return NamedSharding(mesh, P(MODEL_AXIS))
+    return replicated(mesh)
+
+
 def fit_devices_to_batch(n_devices: int, batch_size: int) -> int:
     """Largest device count <= n_devices that divides batch_size (the
     reference instead pops devices until each holds >=1 row,

@@ -43,6 +43,7 @@ class Trainer:
         self.silent = 0
         self.dev = "tpu"
         self.compute_dtype = "float32"
+        self.model_parallel = 1
         self.epoch_counter = 0
         self.sample_counter = 0
         self.round = 0
@@ -75,6 +76,8 @@ class Trainer:
             self.dev = val
         elif name == "dtype":
             self.compute_dtype = val
+        elif name == "model_parallel":
+            self.model_parallel = int(val)
         if name.startswith("metric"):
             import re
             m = re.match(r"metric\[([^,\]]+),([^\]]+)\]", name)
@@ -109,11 +112,18 @@ class Trainer:
                            compute_dtype=self.compute_dtype)
         # device mesh (replaces InitParamServer + per-device threads)
         devices = parallel.select_devices(self.dev)
-        ndev = parallel.fit_devices_to_batch(len(devices), self.batch_size)
+        mp = self.model_parallel
+        if len(devices) % mp != 0:
+            raise ValueError(
+                "model_parallel=%d does not divide %d devices"
+                % (mp, len(devices)))
+        ndata = parallel.fit_devices_to_batch(
+            len(devices) // mp, self.batch_size)
+        ndev = ndata * mp
         if ndev != len(devices) and self.silent == 0:
             print("Warning: using %d of %d devices to split batch_size=%d"
                   % (ndev, len(devices), self.batch_size))
-        self.mesh = parallel.make_mesh(devices[:ndev])
+        self.mesh = parallel.make_mesh(devices[:ndev], model_parallel=mp)
         self.n_devices = ndev
         # resolve eval node requests (reference nnet_impl-inl.hpp:363-374)
         self.eval_req: List[int] = []
@@ -127,15 +137,41 @@ class Trainer:
         if not self.eval_req:
             self.eval_req = [self.net.out_node]
 
+    def _param_shardings(self, params):
+        """Per-tensor placement: replicated on a 1D mesh, tensor-parallel
+        over the model axis on a 2D mesh (parallel.param_sharding)."""
+        out = []
+        for li, p in enumerate(params):
+            if p is None:
+                out.append(None)
+                continue
+            ltype = self.net_cfg.layers[li].type
+            out.append({
+                tag: parallel.param_sharding(
+                    self.mesh, ltype, tag, tuple(np.shape(w)))
+                for tag, w in p.items()})
+        return out
+
     def _finish_init(self, params, opt, opt_state) -> None:
         self.opt = opt
         rep = parallel.replicated(self.mesh)
         dsh = parallel.batch_sharding(self.mesh)
-        self.params = jax.device_put(params, rep)
-        self.opt_state = jax.device_put(opt_state, rep)
+        psh = self._param_shardings(params)
+        # optimizer slots shard exactly like their weights
+        osh = []
+        for li, s in enumerate(opt_state):
+            if s is None:
+                osh.append(None)
+            else:
+                osh.append({tag: {slot: psh[li][tag] for slot in slots}
+                            for tag, slots in s.items()})
+        self.params = jax.device_put(params, psh)
+        self.opt_state = jax.device_put(opt_state, osh)
+        self._psh, self._osh = psh, osh
         if self.update_period > 1:
             zeros = jax.tree.map(jnp.zeros_like, _strip_nones(self.params))
-            self.grad_accum = jax.device_put(zeros, rep)
+            self.grad_accum = jax.device_put(
+                zeros, [s or {} for s in psh])
         self._rng = jax.random.PRNGKey(self.seed * 2243 + 7)
 
         net, opt_ = self.net, self.opt
@@ -171,17 +207,18 @@ class Trainer:
             values, _ = net.apply(params, data, train=False)
             return tuple(values[i] for i in node_ids)
 
+        gsh = [s or {} for s in psh]  # grad tree shardings (None -> {})
         self._train_step = jax.jit(
             train_step, donate_argnums=(0, 1),
-            in_shardings=(rep, rep, dsh, dsh, rep, rep))
+            in_shardings=(psh, osh, dsh, dsh, rep, rep))
         self._accum_step = jax.jit(
             accum_step, donate_argnums=(0,),
-            in_shardings=(rep, rep, dsh, dsh, rep, rep))
+            in_shardings=(gsh, psh, dsh, dsh, rep, rep))
         self._apply_accum = jax.jit(
             apply_accum, donate_argnums=(0, 1, 2),
-            in_shardings=(rep, rep, rep, rep))
+            in_shardings=(psh, osh, gsh, rep))
         self._forward = jax.jit(
-            forward_step, in_shardings=(rep, dsh),
+            forward_step, in_shardings=(psh, dsh),
             static_argnums=(2,))
 
     # ------------------------------------------------------------------
@@ -307,7 +344,7 @@ class Trainer:
         arr = jnp.asarray(weight, jnp.float32).reshape(cur.shape)
         params = list(self.params)
         params[idx] = dict(params[idx], **{tag: arr})
-        self.params = jax.device_put(params, parallel.replicated(self.mesh))
+        self.params = jax.device_put(params, self._psh)
 
 
     # ------------------------------------------------------------------
@@ -359,7 +396,7 @@ class Trainer:
                         % (old.name, tag, cur[tag].shape, arr.shape))
                 cur[tag] = jnp.asarray(arr)
             params[j] = cur
-        self.params = jax.device_put(params, parallel.replicated(self.mesh))
+        self.params = jax.device_put(params, self._psh)
 
 
 def _strip_nones(tree):

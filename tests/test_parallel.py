@@ -1,0 +1,129 @@
+"""Parallelism tests on the 8-device virtual mesh: data parallelism,
+tensor parallelism, and dp+tp equivalence (SURVEY.md §2.7)."""
+import numpy as np
+import pytest
+
+import jax
+
+from cxxnet_tpu import config, parallel
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.trainer import Trainer
+
+CONF = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 64
+  init_sigma = 0.1
+layer[+1:r1] = relu
+layer[r1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+dev = cpu
+eta = 0.3
+momentum = 0.9
+metric = error
+"""
+
+
+def make_trainer(**overrides):
+    tr = Trainer()
+    for k, v in config.parse_string(CONF):
+        tr.set_param(k, v)
+    for k, v in overrides.items():
+        tr.set_param(k, str(v))
+    tr.init_model()
+    return tr
+
+
+def make_synth(batch=64):
+    return create_iterator([
+        ("iter", "synth"), ("batch_size", str(batch)), ("shape", "1,1,16"),
+        ("nclass", "4"), ("ninst", "512"), ("shuffle", "1"), ("iter", "end")])
+
+
+def train_rounds(tr, itr, n):
+    errs = []
+    for r in range(n):
+        tr.start_round(r)
+        itr.before_first()
+        while itr.next():
+            tr.update(itr.value)
+        errs.append(float(tr.evaluate(itr, "t").split(":")[-1]))
+    return errs
+
+
+def test_device_config_parsing():
+    assert parallel.parse_device_config("tpu") == ("tpu", None)
+    assert parallel.parse_device_config("gpu:0-3") == ("gpu", [0, 1, 2, 3])
+    assert parallel.parse_device_config("tpu:0,2,5") == ("tpu", [0, 2, 5])
+    with pytest.raises(ValueError):
+        parallel.select_devices("cpu:17")
+
+
+def test_tensor_parallel_mesh():
+    tr = make_trainer(model_parallel=2)
+    assert dict(tr.mesh.shape) == {"data": 4, "model": 2}
+    # fc1 wmat (64,16) sharded over model axis on dim 0
+    sh = tr.params[0]["wmat"].sharding
+    assert sh.spec == parallel.P("model", None)
+    # softmax has no params; fc2 nhidden=4 shards 4%2==0 too
+    assert tr.params[2]["wmat"].sharding.spec == parallel.P("model", None)
+
+
+def test_dp_and_tp_trajectories_match():
+    """dp-only and dp+tp must compute the SAME math (sharding is layout,
+    not semantics): identical seeds give near-identical trajectories."""
+    t1 = make_trainer()
+    t2 = make_trainer(model_parallel=2)
+    i1, i2 = make_synth(), make_synth()
+    e1 = train_rounds(t1, i1, 3)
+    e2 = train_rounds(t2, i2, 3)
+    np.testing.assert_allclose(e1, e2, atol=0.02)
+    assert e1[-1] < 0.2 and e2[-1] < 0.2
+    # weights stay numerically close across layouts
+    w1 = t1.get_weight("fc2", "wmat")
+    w2 = t2.get_weight("fc2", "wmat")
+    np.testing.assert_allclose(w1, w2, atol=1e-3)
+
+
+def test_tp_conv_model():
+    """Conv net with model_parallel=2: conv wmat sharded on the
+    out-channel-per-group dim."""
+    text = """
+netconfig=start
+layer[0->1] = conv:c1
+  kernel_size = 3
+  nchannel = 16
+layer[1->2] = relu
+layer[2->3] = flatten
+layer[3->4] = fullc:f1
+  nhidden = 4
+layer[4->4] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 16
+dev = cpu
+model_parallel = 2
+eta = 0.1
+metric = error
+"""
+    tr = Trainer()
+    for k, v in config.parse_string(text):
+        tr.set_param(k, v)
+    tr.init_model()
+    assert tr.params[0]["wmat"].sharding.spec == \
+        parallel.P(None, "model", None)
+    it = create_iterator([
+        ("iter", "synth"), ("batch_size", "16"), ("shape", "3,8,8"),
+        ("nclass", "4"), ("ninst", "64"), ("iter", "end")])
+    errs = train_rounds(tr, it, 2)
+    assert np.isfinite(errs).all()
+
+
+def test_model_parallel_must_divide_devices():
+    with pytest.raises(ValueError):
+        make_trainer(model_parallel=3)
