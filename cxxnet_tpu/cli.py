@@ -97,7 +97,8 @@ class LearnTask:
             from . import parallel
             parallel.init_distributed(
                 d["dist_coordinator"],
-                int(d.get("dist_num_worker", "1")),
+                int(d.get("dist_num_worker",
+                          os.environ.get("PS_NUM_WORKER", "1"))),
                 int(d.get("dist_worker_rank",
                           os.environ.get("PS_RANK", "0"))))
         self.init()

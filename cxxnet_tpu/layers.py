@@ -247,9 +247,11 @@ class FullConnectLayer(Layer):
 
     def apply(self, params, inputs, ctx):
         x = _mat(inputs[0])
+        # bf16 operands, f32 result: the MXU accumulates f32 internally;
+        # avoiding preferred_element_type keeps the grad transposes
+        # same-dtype (their f32 accumulation is likewise implicit)
         w = params["wmat"].astype(ctx.compute_dtype)
-        out = jnp.dot(x.astype(ctx.compute_dtype), w.T,
-                      preferred_element_type=jnp.float32)
+        out = jnp.dot(x.astype(ctx.compute_dtype), w.T).astype(jnp.float32)
         if self.param.no_bias == 0:
             out = out + params["bias"]
         n = inputs[0].shape[0]
@@ -599,13 +601,15 @@ class ConvolutionLayer(Layer):
         # (g, co/g, ci/g*kh*kw) -> OIHW (co, ci/g, kh, kw)
         kernel = params["wmat"].reshape(
             g * co_g, ci_g, p.kernel_height, p.kernel_width)
+        # no preferred_element_type: with a f32 result dtype the rhs-grad
+        # transpose would convolve bf16 activations with a f32 cotangent,
+        # which lax rejects; bf16-in/bf16-out still accumulates f32 on MXU
         out = lax.conv_general_dilated(
             x, kernel.astype(ctx.compute_dtype),
             window_strides=(p.stride, p.stride),
             padding=[(p.pad_y, p.pad_y), (p.pad_x, p.pad_x)],
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            feature_group_count=g,
-            preferred_element_type=jnp.float32)
+            feature_group_count=g).astype(jnp.float32)
         if p.no_bias == 0:
             out = out + params["bias"].reshape(1, -1, 1, 1)
         return [out]

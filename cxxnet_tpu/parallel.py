@@ -134,7 +134,7 @@ def param_sharding(mesh: Mesh, layer_type: str, tag: str,
     def ok(dim):
         return shape[dim] % n_model == 0
 
-    if layer_type in ("fullc", "fixconn") and tag == "wmat" and ok(0):
+    if layer_type == "fullc" and tag == "wmat" and ok(0):
         return NamedSharding(mesh, P(MODEL_AXIS, None))
     if layer_type == "conv" and tag == "wmat" and len(shape) == 3 and ok(1):
         return NamedSharding(mesh, P(None, MODEL_AXIS, None))

@@ -107,7 +107,11 @@ class Trainer:
         self._finish_init(params, opt, opt_state)
 
     def _build_network(self) -> None:
-        self.net = Network(self.net_cfg, self.batch_size,
+        # batch_size is per-process, like the reference's per-worker batch
+        # in dist-PS mode (same config file on every worker); the jitted
+        # step sees the global batch
+        self.global_batch = self.batch_size * jax.process_count()
+        self.net = Network(self.net_cfg, self.global_batch,
                            update_period=self.update_period,
                            compute_dtype=self.compute_dtype)
         # device mesh (replaces InitParamServer + per-device threads)
@@ -117,12 +121,28 @@ class Trainer:
             raise ValueError(
                 "model_parallel=%d does not divide %d devices"
                 % (mp, len(devices)))
-        ndata = parallel.fit_devices_to_batch(
-            len(devices) // mp, self.batch_size)
-        ndev = ndata * mp
-        if ndev != len(devices) and self.silent == 0:
-            print("Warning: using %d of %d devices to split batch_size=%d"
-                  % (ndev, len(devices), self.batch_size))
+        if jax.process_count() > 1:
+            # trimming devices could orphan a whole process's chips;
+            # require an even split instead, with data shards aligned to
+            # process boundaries so each process feeds exactly its rows
+            dp = len(devices) // mp
+            if self.global_batch % dp != 0:
+                raise ValueError(
+                    "global batch %d not divisible over %d data-parallel "
+                    "devices" % (self.global_batch, dp))
+            if dp % jax.process_count() != 0:
+                raise ValueError(
+                    "data-parallel degree %d must be a multiple of the "
+                    "process count %d (shrink model_parallel)"
+                    % (dp, jax.process_count()))
+            ndev = len(devices)
+        else:
+            ndata = parallel.fit_devices_to_batch(
+                len(devices) // mp, self.global_batch)
+            ndev = ndata * mp
+            if ndev != len(devices) and self.silent == 0:
+                print("Warning: using %d of %d devices to split "
+                      "batch_size=%d" % (ndev, len(devices), self.batch_size))
         self.mesh = parallel.make_mesh(devices[:ndev], model_parallel=mp)
         self.n_devices = ndev
         # resolve eval node requests (reference nnet_impl-inl.hpp:363-374)
@@ -167,11 +187,11 @@ class Trainer:
                             for tag, slots in s.items()})
         self.params = jax.device_put(params, psh)
         self.opt_state = jax.device_put(opt_state, osh)
-        self._psh, self._osh = psh, osh
+        self._psh, self._dsh = psh, dsh
+        gsh = [s or {} for s in psh]  # grad tree shardings (None -> {})
         if self.update_period > 1:
             zeros = jax.tree.map(jnp.zeros_like, _strip_nones(self.params))
-            self.grad_accum = jax.device_put(
-                zeros, [s or {} for s in psh])
+            self.grad_accum = jax.device_put(zeros, gsh)
         self._rng = jax.random.PRNGKey(self.seed * 2243 + 7)
 
         net, opt_ = self.net, self.opt
@@ -207,7 +227,6 @@ class Trainer:
             values, _ = net.apply(params, data, train=False)
             return tuple(values[i] for i in node_ids)
 
-        gsh = [s or {} for s in psh]  # grad tree shardings (None -> {})
         self._train_step = jax.jit(
             train_step, donate_argnums=(0, 1),
             in_shardings=(psh, osh, dsh, dsh, rep, rep))
@@ -222,12 +241,43 @@ class Trainer:
             static_argnums=(2,))
 
     # ------------------------------------------------------------------
+    def _put_data(self, arr) -> jnp.ndarray:
+        """Host array -> device array under the batch sharding. Multi-host:
+        each process holds its local shard of the global batch, so assemble
+        a global jax.Array (the PS-era per-worker data sharding,
+        reference iter_thread_imbin-inl.hpp:199-219, maps to per-process
+        local data here)."""
+        arr = np.asarray(arr, np.float32)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(self._dsh, arr)
+        return jnp.asarray(arr)
+
+    def _fetch_local(self, x) -> np.ndarray:
+        """Device array -> host numpy. Multi-host: a batch-sharded output
+        spans non-addressable devices, so assemble this process's rows from
+        its addressable shards (they are exactly the rows this process fed
+        in via _put_data); metrics/predictions stay process-local, like the
+        reference's per-worker eval."""
+        if jax.process_count() > 1 and not x.is_fully_replicated:
+            shards = x.addressable_shards
+            r0 = min((s.index[0].start or 0) for s in shards)
+            r1 = max((s.index[0].stop if s.index[0].stop is not None
+                      else x.shape[0]) for s in shards)
+            out = np.zeros((r1 - r0,) + x.shape[1:], x.dtype)
+            for s in shards:
+                idx = (slice((s.index[0].start or 0) - r0,
+                             (s.index[0].stop or x.shape[0]) - r0),
+                       ) + tuple(s.index[1:])
+                out[idx] = np.asarray(s.data)
+            return out
+        return np.asarray(x)
+
     def _label_fields(self, batch: DataBatch) -> List[jnp.ndarray]:
         """Slice label matrix into fields (reference GetLabelInfo,
         nnet_impl-inl.hpp:271-285)."""
         out = []
         for (a, b) in self.net_cfg.label_range:
-            out.append(jnp.asarray(batch.label[:, a:b], jnp.float32))
+            out.append(self._put_data(batch.label[:, a:b]))
         return out
 
     def _label_dict(self, batch: DataBatch,
@@ -245,7 +295,7 @@ class Trainer:
     # ------------------------------------------------------------------
     def update(self, batch: DataBatch) -> None:
         """One minibatch of training (reference: nnet_impl-inl.hpp:141-185)."""
-        data = jnp.asarray(batch.data, jnp.float32)
+        data = self._put_data(batch.data)
         labels = self._label_fields(batch)
         self._step_count += 1
         rng = jax.random.fold_in(self._rng, self._step_count)
@@ -262,7 +312,8 @@ class Trainer:
                     self._apply_accum(self.params, self.opt_state,
                                       self.grad_accum, epoch)
         if self.eval_train != 0 and self.train_metric.evals:
-            scores = [np.asarray(e).reshape(e.shape[0], -1) for e in evals]
+            scores = [self._fetch_local(e) for e in evals]
+            scores = [e.reshape(e.shape[0], -1) for e in scores]
             self.train_metric.add_eval(scores, self._label_dict(batch))
         self.sample_counter += 1
         if self.sample_counter >= self.update_period:
@@ -272,9 +323,9 @@ class Trainer:
     # ------------------------------------------------------------------
     def forward_nodes(self, batch: DataBatch,
                       node_ids: Sequence[int]) -> List[np.ndarray]:
-        data = jnp.asarray(batch.data, jnp.float32)
+        data = self._put_data(batch.data)
         values = self._forward(self.params, data, tuple(node_ids))
-        return [np.asarray(v) for v in values]
+        return [self._fetch_local(v) for v in values]
 
     def predict(self, batch: DataBatch) -> np.ndarray:
         """Argmax (or raw scalar) of the final node
@@ -351,9 +402,20 @@ class Trainer:
     # checkpointing (reference: nnet_impl-inl.hpp:82-134, SURVEY.md §3.3)
     def save_model(self, path: str) -> None:
         from . import checkpoint
-        checkpoint.save_model(
-            path, self.net_cfg, self.epoch_counter,
-            jax.device_get(self.params), jax.device_get(self.opt_state))
+
+        def fetch_global(x):
+            """Full global value on this host — unlike _fetch_local, a
+            model-sharded weight whose shards live on other processes must
+            be all-gathered or the checkpoint would be silently truncated."""
+            if jax.process_count() == 1 or x.is_fully_replicated:
+                return np.asarray(x)
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+        def fetch(t):
+            return jax.tree.map(fetch_global, t)
+        checkpoint.save_model(path, self.net_cfg, self.epoch_counter,
+                              fetch(self.params), fetch(self.opt_state))
 
     def load_model(self, path: str) -> None:
         """Restore structure + epoch + weights (+ optimizer state, which
@@ -384,13 +446,17 @@ class Trainer:
             if not old.name or old_params[i] is None:
                 continue
             j = self.net_cfg.layer_name_map.get(old.name)
-            if j is None:
+            if j is None or params[j] is None:
                 continue
             if self.silent == 0:
                 print("Copying layer %s" % old.name)
-            cur = dict(params[j] or {})
+            cur = dict(params[j])
+            # only tags the fresh net also has: copying e.g. a bias into a
+            # no_bias layer would desync params from their shardings
             for tag, arr in old_params[i].items():
-                if tag in cur and tuple(cur[tag].shape) != tuple(arr.shape):
+                if tag not in cur:
+                    continue
+                if tuple(cur[tag].shape) != tuple(arr.shape):
                     raise ValueError(
                         "finetune: layer %s %s shape mismatch %s vs %s"
                         % (old.name, tag, cur[tag].shape, arr.shape))

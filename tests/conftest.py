@@ -12,3 +12,10 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The container's sitecustomize registers the axon TPU backend before any
+# conftest runs, so the env var alone is ignored; the config override is
+# authoritative as long as no backend has been initialised yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
