@@ -107,6 +107,18 @@ def parse_layer_type(val: str) -> Tuple[str, str, Optional[Tuple[str, str]], str
     return ltype, lname, pair, share_tag
 
 
+def _dedup_last(entries):
+    """Collapse repeated keys keeping the last occurrence, order-preserving.
+
+    ``label_vec[a,b)`` entries are keyed by (name, value): each declares a
+    distinct named label *field*, not a later-wins assignment."""
+    def key(name, val):
+        return (name, val) if name.startswith("label_vec[") else name
+    last = {key(k, v): i for i, (k, v) in enumerate(entries)}
+    return [kv for i, kv in enumerate(entries)
+            if last[key(kv[0], kv[1])] == i]
+
+
 class NetConfig:
     """Parsed network structure + configuration buckets.
 
@@ -172,8 +184,14 @@ class NetConfig:
         m = re.match(r"label_vec\[(\d+),(\d+)\)", name)
         if m:
             a, b = int(m.group(1)), int(m.group(2))
-            self.label_range.append((a, b))
-            self.label_name_map[val] = len(self.label_range) - 1
+            # idempotent so a checkpoint-restored base plus the same live
+            # config entry yields one field (later wins on the range)
+            idx = self.label_name_map.get(val)
+            if idx is not None and idx > 0:
+                self.label_range[idx] = (a, b)
+            else:
+                self.label_range.append((a, b))
+                self.label_name_map[val] = len(self.label_range) - 1
 
     def _parse_layer_decl(self, name: str, val: str,
                           top_node: int, cfg_layer_index: int) -> LayerInfo:
@@ -234,14 +252,25 @@ class NetConfig:
         training): layer declarations are then checked for consistency and
         only the config buckets are refreshed.
         """
+        # buckets restored from a checkpoint are the base; entries from the
+        # live config stream append after and win (later-wins semantics,
+        # reference nnet_config.h:255-287)
         self.defcfg = []
-        self.layercfg = [[] for _ in self.layers]
+        loaded = getattr(self, "_loaded_layercfg", None)
+        if loaded and len(loaded) == len(self.layers):
+            self.layercfg = [list(b) for b in loaded]
+        else:
+            self.layercfg = [[] for _ in self.layers]
         # label/extra declarations are re-interpreted from scratch on every
         # configure() call so re-configuring (continue training) does not
         # duplicate entries
         self.label_name_map = {"label": 0}
         self.label_range = [(0, 1)]
         self.extra_shape = []
+        # a checkpoint-restored global config base (updater/sync/label_vec/
+        # extra_data_*/hyperparams) is replayed through the same
+        # interpretation loop as the live stream, which runs after and wins
+        cfg = list(getattr(self, "_loaded_defcfg", []) or []) + list(cfg)
         if not self.node_names:
             self.node_names.append("in")
             self.node_name_map["in"] = 0
@@ -348,6 +377,15 @@ class NetConfig:
                 }
                 for l in self.layers
             ],
+            # config buckets: the reference re-derives layer hyperparams
+            # from loaded weight shapes (LoadNet ClearConfig,
+            # nnet_config.h:171-191); the functional build needs them at
+            # graph-build time, so they travel with the structure.
+            # Deduped keep-last so repeated save/resume cycles do not grow
+            # the buckets (set_param is assignment-based, later wins).
+            "layercfg": [[list(kv) for kv in _dedup_last(b)]
+                         for b in self.layercfg],
+            "defcfg": [list(kv) for kv in _dedup_last(self.defcfg)],
         }
 
     @classmethod
@@ -358,6 +396,7 @@ class NetConfig:
         net.extra_shape = list(state["extra_shape"])
         net.node_names = list(state["node_names"])
         net.node_name_map = {n: i for i, n in enumerate(net.node_names)}
+        buckets = state.get("layercfg") or [[] for _ in state["layers"]]
         for i, ls in enumerate(state["layers"]):
             info = LayerInfo(
                 type=ls["type"], name=ls["name"],
@@ -366,11 +405,14 @@ class NetConfig:
                 primary_layer_index=ls["primary_layer_index"],
                 pair=tuple(ls["pair"]) if ls.get("pair") else None)
             net.layers.append(info)
-            net.layercfg.append([])
+            net.layercfg.append([tuple(kv) for kv in buckets[i]])
             if info.name and info.type != SHARED_LAYER:
                 if info.name in net.layer_name_map:
                     raise GraphConfigError(
                         "duplicated layer name: %s" % info.name)
                 net.layer_name_map[info.name] = i
+        net.defcfg = [tuple(kv) for kv in state.get("defcfg", [])]
+        net._loaded_layercfg = [list(b) for b in net.layercfg]
+        net._loaded_defcfg = list(net.defcfg)
         net.init_end = True
         return net

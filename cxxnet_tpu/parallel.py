@@ -29,6 +29,29 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def force_host_cpu(n_devices: int = 8) -> None:
+    """Force the JAX host-CPU platform with ``n_devices`` virtual devices.
+
+    Used by the test suite and the driver's multichip dry-run to validate
+    mesh sharding without TPU hardware. Must be called before any JAX
+    backend is initialised; the env var alone is not enough on boxes whose
+    sitecustomize registers an accelerator plugin backend, so the config
+    update is applied too (and a too-late call that raises RuntimeError is
+    tolerated — the env vars still cover fresh subprocesses)."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d"
+            % n_devices).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialised by the caller
+
+
 def parse_device_config(val: str) -> Tuple[str, Optional[List[int]]]:
     """Parse ``dev = tpu`` / ``tpu:0-3`` / ``gpu:0,2`` / ``cpu`` into
     (platform, device_ids or None) — reference: nnet_impl-inl.hpp:32-51."""

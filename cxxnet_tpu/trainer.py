@@ -414,8 +414,14 @@ class Trainer:
 
         def fetch(t):
             return jax.tree.map(fetch_global, t)
-        checkpoint.save_model(path, self.net_cfg, self.epoch_counter,
-                              fetch(self.params), fetch(self.opt_state))
+        # every process joins the allgather collectives; only process 0
+        # writes (the path normally sits on a shared filesystem in a pod
+        # job — concurrent writers would corrupt the file)
+        params = fetch(self.params)
+        opt_state = fetch(self.opt_state)
+        if jax.process_index() == 0:
+            checkpoint.save_model(path, self.net_cfg, self.epoch_counter,
+                                  params, opt_state)
 
     def load_model(self, path: str) -> None:
         """Restore structure + epoch + weights (+ optimizer state, which

@@ -1,0 +1,225 @@
+"""Python user API mirroring the reference language wrapper.
+
+The reference exposes a C ABI (reference: wrapper/cxxnet_wrapper.h:29-120)
+with a ctypes binding (reference: wrapper/cxxnet.py:64,105,281) whose user
+surface is ``DataIter``, ``Net`` and ``train``.  Here the framework itself
+is Python/JAX, so the same surface binds directly to :class:`Trainer` and
+the io iterator chain — no FFI hop, same semantics:
+
+* ``DataIter(cfg)`` — config *string*; entries up to the first
+  ``iter = end`` build the iterator chain, entries after it are applied
+  as iterator params (reference: wrapper/cxxnet_wrapper.cpp:12-45).
+* ``Net(dev, cfg)`` — config string broadcast as ``SetParam`` pairs; the
+  ``dev`` argument overrides any ``dev`` in the config
+  (reference: wrapper/cxxnet_wrapper.cpp:79-90).
+* ``Net.update`` accepts the current batch of a ``DataIter`` or a raw
+  numpy (data, label) pair (reference: wrapper/cxxnet.py:152-180).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple, Union
+
+import numpy as np
+
+from . import config as _config
+from .io import DataBatch, create_iterator
+from .trainer import Trainer
+
+ConfigEntry = Tuple[str, str]
+
+
+class DataIter:
+    """Data iterator over a config string (reference: wrapper/cxxnet.py:64-103)."""
+
+    def __init__(self, cfg: str):
+        entries = _config.parse_string(cfg)
+        # Split at the first `iter = end`: the chain config vs trailing
+        # iterator params (reference: wrapper/cxxnet_wrapper.cpp:20-44).
+        # Our factory applies params before init, so defaults can simply
+        # be appended to the chain config.
+        itcfg: List[ConfigEntry] = []
+        defcfg: List[ConfigEntry] = []
+        flag = 1
+        for name, val in entries:
+            if name == "iter" and val == "end":
+                flag = 0
+                continue
+            (itcfg if flag else defcfg).append((name, val))
+        self._iter = create_iterator(itcfg + defcfg)
+        self.head = True
+        self.tail = False
+
+    def next(self) -> bool:
+        ret = self._iter.next()
+        self.head = False
+        self.tail = not ret
+        return ret
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+        self.head = True
+        self.tail = False
+
+    def check_valid(self) -> None:
+        if self.head:
+            raise RuntimeError(
+                "iterator was at head state, call next to get to valid state")
+        if self.tail:
+            raise RuntimeError("iterator reaches end")
+
+    @property
+    def value(self) -> DataBatch:
+        self.check_valid()
+        return self._iter.value
+
+    def get_data(self) -> np.ndarray:
+        """Current batch data, 4D (batch, channel, height, width)."""
+        return np.asarray(self.value.data, np.float32)
+
+    def get_label(self) -> np.ndarray:
+        """Current batch label, 2D (batch, label_width)."""
+        lab = np.asarray(self.value.label, np.float32)
+        return lab.reshape(lab.shape[0], -1)
+
+
+class Net:
+    """Neural net object (reference: wrapper/cxxnet.py:105-279)."""
+
+    def __init__(self, dev: str = "cpu", cfg: str = ""):
+        self._cfg: List[ConfigEntry] = []
+        self._net: Optional[Trainer] = None
+        self.net_type = 0
+        for name, val in _config.parse_string(cfg):
+            self.set_param(name, val)
+        if dev:
+            self.set_param("dev", dev)
+
+    # ------------------------------------------------------------------
+    def set_param(self, name, value) -> None:
+        name, value = str(name), str(value)
+        if name == "net_type":
+            self.net_type = int(value)
+        if self._net is not None:
+            self._net.set_param(name, value)
+        self._cfg.append((name, value))
+
+    def _create_net(self) -> Trainer:
+        net = Trainer()
+        for k, v in self._cfg:
+            net.set_param(k, v)
+        return net
+
+    def init_model(self) -> None:
+        self._net = self._create_net()
+        self._net.init_model()
+
+    def load_model(self, fname: str) -> None:
+        self._net = self._create_net()
+        self._net.load_model(fname)
+
+    def save_model(self, fname: str) -> None:
+        self._net.save_model(fname)
+
+    def start_round(self, round_counter: int) -> None:
+        self._net.start_round(round_counter)
+
+    # ------------------------------------------------------------------
+    def _as_batch(self, data: np.ndarray,
+                  label: Optional[np.ndarray] = None) -> DataBatch:
+        data = np.asarray(data, np.float32)
+        if data.ndim != 4:
+            raise ValueError("need 4 dimensional tensor "
+                             "(batch, channel, height, width)")
+        if label is not None:
+            label = np.asarray(label, np.float32)
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            if label.ndim != 2:
+                raise ValueError("label needs to be 1- or 2-dimensional")
+            if label.shape[0] != data.shape[0]:
+                raise ValueError("data/label size mismatch")
+        return DataBatch(data=data, label=label)
+
+    def update(self, data, label=None) -> None:
+        """Train on the iterator's current batch or a numpy batch
+        (reference: wrapper/cxxnet.py:152-180)."""
+        if isinstance(data, DataIter):
+            self._net.update(data.value)
+        elif isinstance(data, np.ndarray):
+            if label is None:
+                raise ValueError("Net.update: need label to use update")
+            self._net.update(self._as_batch(data, label))
+        else:
+            raise TypeError("update does not support type %s" % type(data))
+
+    def evaluate(self, data: DataIter, name: str) -> str:
+        """Run metrics over the whole iterator; returns the eval string
+        (reference: wrapper/cxxnet_wrapper.cpp Evaluate)."""
+        if not isinstance(data, DataIter):
+            raise TypeError("evaluate needs a DataIter")
+        return self._net.evaluate(data._iter, name)
+
+    def predict(self, data) -> np.ndarray:
+        """Predictions for the current batch (reference: wrapper/cxxnet.py:196)."""
+        if isinstance(data, DataIter):
+            batch = data.value
+        else:
+            batch = self._as_batch(data)
+        return self._net.predict(batch)
+
+    def extract(self, data, name: str) -> np.ndarray:
+        """Extract a named node (or ``top[-k]``) for the current batch."""
+        if isinstance(data, DataIter):
+            batch = data.value
+        else:
+            batch = self._as_batch(data)
+        return self._net.extract_feature(batch, name)
+
+    # ------------------------------------------------------------------
+    def set_weight(self, weight: np.ndarray, layer_name: str,
+                   tag: str) -> None:
+        if tag not in ("bias", "wmat"):
+            raise ValueError("tag must be bias or wmat")
+        self._net.set_weight(np.asarray(weight, np.float32), layer_name, tag)
+
+    def get_weight(self, layer_name: str, tag: str) -> Optional[np.ndarray]:
+        if tag not in ("bias", "wmat"):
+            raise ValueError("tag must be bias or wmat")
+        try:
+            return self._net.get_weight(layer_name, tag)
+        except ValueError:
+            return None
+
+
+def train(cfg: str, data, num_round: int,
+          param: Union[Dict[str, str], Iterable[Tuple[str, str]]],
+          eval_data: Optional[DataIter] = None,
+          label: Optional[np.ndarray] = None) -> Net:
+    """Config-driven training helper (reference: wrapper/cxxnet.py:281-312;
+    the reference defines two overloads — iterator-driven rounds and a
+    single numpy batch per round — merged here via the ``label`` kwarg)."""
+    import sys
+
+    net = Net(cfg=cfg)
+    if isinstance(param, dict):
+        param = param.items()
+    for k, v in param:
+        net.set_param(k, v)
+    net.init_model()
+    for r in range(num_round):
+        net.start_round(r)
+        if isinstance(data, DataIter):
+            data.before_first()
+            scounter = 0
+            while data.next():
+                net.update(data)
+                scounter += 1
+                if scounter % 100 == 0:
+                    print("[%d] %d batch passed" % (r, scounter))
+        else:
+            net.update(data=data, label=label)
+        if eval_data is not None:
+            seval = net.evaluate(eval_data, "eval")
+            sys.stderr.write(seval + "\n")
+    return net

@@ -2,20 +2,15 @@
 
 Multi-chip TPU hardware is not available in CI; all sharding tests run on
 XLA's host platform with 8 virtual devices, exactly as the driver's
-multichip dry-run does. JAX_PLATFORMS is *forced* to cpu (the container
-environment pins it to the axon TPU backend, which tests must not touch).
+multichip dry-run does (see cxxnet_tpu.parallel.force_host_cpu).
 """
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
 
-# The container's sitecustomize registers the axon TPU backend before any
-# conftest runs, so the env var alone is ignored; the config override is
-# authoritative as long as no backend has been initialised yet.
-import jax
+from cxxnet_tpu.parallel import force_host_cpu
 
-jax.config.update("jax_platforms", "cpu")
+force_host_cpu(8)

@@ -251,3 +251,72 @@ def test_reference_mnist_conv_conf():
         "conv", "max_pooling", "flatten", "dropout", "fullc", "sigmoid",
         "fullc", "softmax"]
     assert net.input_shape == (1, 28, 28)
+
+
+def test_layercfg_travels_with_structure():
+    """Layer hyperparams (incl. ones set via global defaults) must survive
+    a checkpoint structure roundtrip, and repeated save/configure/save
+    cycles must not grow the config buckets."""
+    net = build("nhidden = 64\n" + MLP)
+    state = net.structure_state()
+    net2 = NetConfig.from_structure_state(state)
+    # global default landed in defcfg and travelled
+    assert ("nhidden", "64") in net2.effective_layer_cfg(0)
+    # per-layer bucket travelled: fc1's nhidden=100 overrides the global
+    eff = dict(net2.effective_layer_cfg(0))
+    assert eff["nhidden"] == "100"
+    # resume cycle: configure again with the same stream, then re-save
+    net2.configure(config.parse_string("nhidden = 64\n" + MLP))
+    state2 = net2.structure_state()
+    net3 = NetConfig.from_structure_state(state2)
+    net3.configure(config.parse_string("nhidden = 64\n" + MLP))
+    state3 = net3.structure_state()
+    assert state3["layercfg"] == state2["layercfg"]
+    assert state3["defcfg"] == state2["defcfg"]
+
+
+def test_global_params_travel_with_structure():
+    """updater/sync/label_vec settings restored from a checkpoint must be
+    re-interpreted, not just stored (they live outside layercfg)."""
+    net = build(MLP + """
+updater = adam
+label_vec[0,2) = extra
+""")
+    assert net.updater_type == "adam"
+    assert net.label_name_map["extra"] == 1
+    state = net.structure_state()
+    net2 = NetConfig.from_structure_state(state)
+    # minimal-config resume: no updater/label_vec in the live stream
+    net2.configure(config.parse_string("dev = cpu"))
+    assert net2.updater_type == "adam"
+    assert net2.label_range == [(0, 1), (0, 2)]
+    assert net2.label_name_map["extra"] == 1
+    # full-config resume must not duplicate the label field
+    net2.configure(config.parse_string(MLP + "\nlabel_vec[0,2) = extra"))
+    assert net2.label_range == [(0, 1), (0, 2)]
+
+
+def test_label_vec_fields_not_collapsed_by_dedup():
+    """Two label_vec declarations with the same range but different field
+    names are distinct fields and must both survive a structure roundtrip."""
+    net = build(MLP + """
+label_vec[0,2) = a
+label_vec[0,2) = b
+""")
+    assert net.label_name_map == {"label": 0, "a": 1, "b": 2}
+    net2 = NetConfig.from_structure_state(net.structure_state())
+    net2.configure(config.parse_string("dev = cpu"))
+    assert net2.label_name_map == {"label": 0, "a": 1, "b": 2}
+    assert net2.label_range == [(0, 1), (0, 2), (0, 2)]
+
+
+def test_extra_data_shape_travels_with_structure():
+    net = build("""
+extra_data_num = 1
+extra_data_shape[0] = 1,1,3
+""" + MLP)
+    assert net.extra_shape == [1, 1, 3]
+    net2 = NetConfig.from_structure_state(net.structure_state())
+    net2.configure(config.parse_string("dev = cpu"))
+    assert net2.extra_data_num == 1
+    assert net2.extra_shape == [1, 1, 3]

@@ -1,0 +1,174 @@
+"""Python wrapper API parity tests, modeled on the reference's
+example/MNIST/mnist.py usage of wrapper/cxxnet.py (DataIter / Net / train)."""
+import numpy as np
+import pytest
+
+from cxxnet_tpu import wrapper
+
+DATA_CFG = """
+iter = synth
+    shape = 1,1,16
+    nclass = 4
+    ninst = 256
+    shuffle = 1
+iter = end
+input_shape = 1,1,16
+batch_size = 64
+"""
+
+EVAL_CFG = """
+iter = synth
+    shape = 1,1,16
+    nclass = 4
+    ninst = 128
+    seed = 0
+iter = end
+input_shape = 1,1,16
+batch_size = 64
+"""
+
+NET_CFG = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.1
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,16
+batch_size = 64
+
+random_type = gaussian
+"""
+
+PARAM = {
+    "eta": 0.3,
+    "dev": "cpu",
+    "momentum": 0.9,
+    "metric[label]": "error",
+}
+
+
+@pytest.fixture(scope="module")
+def trained():
+    data = wrapper.DataIter(DATA_CFG)
+    deval = wrapper.DataIter(EVAL_CFG)
+    net = wrapper.train(NET_CFG, data, 10, PARAM, eval_data=deval)
+    return net, data, deval
+
+
+def test_dataiter_protocol():
+    it = wrapper.DataIter(DATA_CFG)
+    with pytest.raises(RuntimeError):
+        it.check_valid()
+    assert it.next()
+    d, l = it.get_data(), it.get_label()
+    assert d.shape == (64, 1, 1, 16)
+    assert l.shape == (64, 1)
+    it.before_first()
+    assert it.head and not it.tail
+    n = sum(1 for _ in iter(it.next, False))
+    assert n == 4  # 256 / 64
+
+
+def test_predict_iter_vs_batch(trained):
+    net, data, _ = trained
+    data.before_first()
+    data.next()
+    pred = net.predict(data)
+    dbatch = data.get_data()
+    pred2 = net.predict(dbatch)
+    assert pred.shape == (64,)
+    np.testing.assert_allclose(pred, pred2)
+
+
+def test_extract_iter_vs_batch(trained):
+    net, data, _ = trained
+    data.before_first()
+    data.next()
+    a = net.extract(data, "sg1")
+    b = net.extract(data.get_data(), "sg1")
+    assert a.shape[0] == 64
+    np.testing.assert_allclose(a, b)
+
+
+def test_eval_error_low_after_training(trained):
+    net, _, deval = trained
+    deval.before_first()
+    werr, wcnt = 0, 0
+    while deval.next():
+        label = deval.get_label()
+        pred = net.predict(deval)
+        werr += np.sum(label[:, 0] != pred[:])
+        wcnt += len(label[:, 0])
+    assert wcnt == 128
+    assert float(werr) / wcnt < 0.3
+
+
+def test_evaluate_string(trained):
+    net, _, deval = trained
+    s = net.evaluate(deval, "eval")
+    assert "eval-error:" in s
+
+
+def test_weight_roundtrip_changes_predictions(trained):
+    net, data, deval = trained
+    weights = []
+    for layer in ["fc1", "fc2"]:
+        for tag in ["wmat", "bias"]:
+            w = net.get_weight(layer, tag)
+            assert w is not None
+            weights.append((layer, tag, w.copy()))
+    assert net.get_weight("sg1", "wmat") is None
+
+    def eval_err():
+        deval.before_first()
+        werr, wcnt = 0, 0
+        while deval.next():
+            label = deval.get_label()
+            pred = net.predict(deval)
+            werr += np.sum(label[:, 0] != pred[:])
+            wcnt += len(label[:, 0])
+        return float(werr) / wcnt
+
+    base = eval_err()
+    # clobber weights -> predictions degrade; restore -> exact comeback
+    for layer, tag, w in weights:
+        net.set_weight(np.zeros_like(w), layer, tag)
+    assert eval_err() >= base
+    for layer, tag, w in weights:
+        net.set_weight(w, layer, tag)
+    assert eval_err() == base
+
+
+def test_numpy_update_path(trained):
+    _, data, _ = trained
+    net = wrapper.Net(cfg=NET_CFG)
+    for k, v in PARAM.items():
+        net.set_param(k, v)
+    net.init_model()
+    data.before_first()
+    while data.next():
+        net.update(data.get_data(), data.get_label())
+    data.before_first()
+    data.next()
+    assert net.predict(data).shape == (64,)
+    with pytest.raises(ValueError):
+        net.update(data.get_data())  # missing label
+    with pytest.raises(TypeError):
+        net.update("nonsense")
+
+
+def test_save_load_model(trained, tmp_path):
+    net, data, _ = trained
+    path = str(tmp_path / "wrapped.model")
+    net.save_model(path)
+    net2 = wrapper.Net(cfg="dev = cpu\nbatch_size = 64")
+    net2.load_model(path)
+    data.before_first()
+    data.next()
+    np.testing.assert_allclose(net.predict(data), net2.predict(data))
