@@ -292,10 +292,21 @@ class NetConfig:
                             % (i + 1, i + 1))
                 self.extra_data_num = num
             if name.startswith("extra_data_shape["):
+                m = re.match(r"extra_data_shape\[(\d+)\]", name)
+                if not m:
+                    raise GraphConfigError("extra data shape config incorrect")
                 xyz = [int(t) for t in val.split(",")]
                 if len(xyz) != 3:
                     raise GraphConfigError("extra data shape config incorrect")
-                self.extra_shape.extend(xyz)
+                # slot-indexed assignment so a checkpoint-restored entry
+                # replayed before the same live entry stays idempotent and
+                # a changed live value wins; extra_data_shape[i] describes
+                # node in_i, so brackets are 1-based (0 tolerated as in_1)
+                slot = max(int(m.group(1)) - 1, 0)
+                need = 3 * (slot + 1)
+                if len(self.extra_shape) < need:
+                    self.extra_shape.extend([0] * (need - len(self.extra_shape)))
+                self.extra_shape[3 * slot: 3 * slot + 3] = xyz
             if not self.init_end and name == "input_shape":
                 dims = tuple(int(t) for t in val.split(","))
                 if len(dims) != 3:

@@ -86,7 +86,10 @@ class DataIter:
 class Net:
     """Neural net object (reference: wrapper/cxxnet.py:105-279)."""
 
-    def __init__(self, dev: str = "cpu", cfg: str = ""):
+    def __init__(self, dev: str = "", cfg: str = ""):
+        """``dev`` overrides any ``dev`` entry in the config when given.
+        (Deviation from the reference wrapper, whose default 'cpu' argument
+        silently overrode the config's device selection.)"""
         self._cfg: List[ConfigEntry] = []
         self._net: Optional[Trainer] = None
         self.net_type = 0
@@ -155,10 +158,16 @@ class Net:
 
     def evaluate(self, data: DataIter, name: str) -> str:
         """Run metrics over the whole iterator; returns the eval string
-        (reference: wrapper/cxxnet_wrapper.cpp Evaluate)."""
+        (reference: wrapper/cxxnet_wrapper.cpp Evaluate). The sweep
+        consumes the iterator: call ``before_first()`` to reposition."""
         if not isinstance(data, DataIter):
             raise TypeError("evaluate needs a DataIter")
-        return self._net.evaluate(data._iter, name)
+        ret = self._net.evaluate(data._iter, name)
+        # the sweep exhausted the underlying iterator; keep the wrapper's
+        # validity flags truthful so .value cannot return a stale batch
+        data.head = False
+        data.tail = True
+        return ret
 
     def predict(self, data) -> np.ndarray:
         """Predictions for the current batch (reference: wrapper/cxxnet.py:196)."""

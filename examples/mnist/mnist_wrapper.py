@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Python wrapper API walkthrough (the reference's example/MNIST/mnist.py
+workflow): build iterators and a net from config strings, train, predict
+both from an iterator and from a raw numpy batch, round-trip weights.
+
+Uses MNIST idx.gz files from ./data when present, else synthetic data so
+the example always runs.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import cxxnet_tpu.wrapper as cxxnet
+
+HAVE_MNIST = os.path.exists("./data/train-images-idx3-ubyte.gz")
+
+if HAVE_MNIST:
+    data = cxxnet.DataIter("""
+    iter = mnist
+        path_img = "./data/train-images-idx3-ubyte.gz"
+        path_label = "./data/train-labels-idx1-ubyte.gz"
+        shuffle = 1
+    iter = end
+    input_shape = 1,1,784
+    batch_size = 100
+    """)
+    deval = cxxnet.DataIter("""
+    iter = mnist
+        path_img = "./data/t10k-images-idx3-ubyte.gz"
+        path_label = "./data/t10k-labels-idx1-ubyte.gz"
+    iter = end
+    input_shape = 1,1,784
+    batch_size = 100
+    """)
+    nin, nclass = 784, 10
+else:
+    print("MNIST data not found in ./data — using synthetic data")
+    data = cxxnet.DataIter("""
+    iter = synth
+        shape = 1,1,64
+        nclass = 10
+        ninst = 4096
+        shuffle = 1
+    iter = end
+    batch_size = 100
+    """)
+    deval = cxxnet.DataIter("""
+    iter = synth
+        shape = 1,1,64
+        nclass = 10
+        ninst = 1024
+    iter = end
+    batch_size = 100
+    """)
+    nin, nclass = 64, 10
+
+cfg = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+  init_sigma = 0.01
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = %d
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,%d
+batch_size = 100
+
+random_type = gaussian
+""" % (nclass, nin)
+
+param = {"eta": 0.1, "dev": "cpu", "momentum": 0.9, "metric[label]": "error"}
+
+net = cxxnet.train(cfg, data, 3, param, eval_data=deval)
+
+# predictions agree between the iterator path and the raw-numpy path
+data.before_first()
+data.next()
+pred = net.predict(data)
+pred2 = net.predict(data.get_data())
+print("iter-vs-numpy predict diff:", np.abs(pred - pred2).sum())
+print("sg1 activations:", net.extract(data, "sg1").shape)
+
+# manual eval loop
+deval.before_first()
+werr = wcnt = 0
+while deval.next():
+    label = deval.get_label()
+    p = net.predict(deval)
+    werr += np.sum(label[:, 0] != p[:])
+    wcnt += len(label[:, 0])
+print("eval-error=%f" % (float(werr) / wcnt))
+
+# weight round-trip
+w = net.get_weight("fc1", "wmat")
+net.set_weight(w, "fc1", "wmat")
+print("weight round-trip ok:", w.shape)

@@ -313,10 +313,26 @@ label_vec[0,2) = b
 def test_extra_data_shape_travels_with_structure():
     net = build("""
 extra_data_num = 1
-extra_data_shape[0] = 1,1,3
+extra_data_shape[1] = 1,1,3
 """ + MLP)
     assert net.extra_shape == [1, 1, 3]
     net2 = NetConfig.from_structure_state(net.structure_state())
     net2.configure(config.parse_string("dev = cpu"))
     assert net2.extra_data_num == 1
     assert net2.extra_shape == [1, 1, 3]
+
+
+def test_extra_data_shape_full_config_resume_idempotent():
+    text = """
+extra_data_num = 1
+extra_data_shape[1] = 1,1,3
+""" + MLP
+    net = build(text)
+    net2 = NetConfig.from_structure_state(net.structure_state())
+    # full-config resume: replayed base + identical live entry -> one slot
+    net2.configure(config.parse_string(text))
+    assert net2.extra_shape == [1, 1, 3]
+    # a changed live value wins over the checkpoint's
+    net2.configure(config.parse_string(
+        "extra_data_num = 1\nextra_data_shape[1] = 1,1,5\n" + MLP))
+    assert net2.extra_shape == [1, 1, 5]

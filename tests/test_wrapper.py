@@ -172,3 +172,24 @@ def test_save_load_model(trained, tmp_path):
     data.before_first()
     data.next()
     np.testing.assert_allclose(net.predict(data), net2.predict(data))
+
+
+def test_config_dev_not_silently_overridden():
+    net = wrapper.Net(cfg=NET_CFG + "\ndev = cpu")
+    net.init_model()
+    assert net._net.dev == "cpu"
+    # explicit dev argument wins over the config entry
+    net2 = wrapper.Net(dev="cpu", cfg=NET_CFG + "\ndev = tpu")
+    assert ("dev", "cpu") == net2._cfg[-1]
+
+
+def test_evaluate_invalidates_iterator_position(trained):
+    net, data, deval = trained
+    data.before_first()
+    data.next()
+    net.evaluate(deval, "eval")
+    # deval was consumed by the sweep: .value must refuse, not serve stale
+    with pytest.raises(RuntimeError):
+        deval.check_valid()
+    deval.before_first()
+    assert deval.next()

@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""im2bin: pack images named by a .lst index into a BinaryPage packfile.
+
+Same CLI contract as the reference tool (reference: tools/im2bin.cpp):
+
+    python tools/im2bin.py <image.lst> <image_root> <output.bin>
+
+The .lst format is one ``index\\tlabel[\\tlabel...]\\tfilename`` line per
+image. The resulting .bin is bit-compatible with the reference's packfile
+format, so it also loads in the reference framework (and vice versa).
+
+If the native runtime extension is built (cxxnet_tpu._native), packing is
+delegated to it for speed.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv):
+    if len(argv) < 4:
+        print("Usage: <image.lst> <image_root> <output.bin>")
+        return 1
+    from cxxnet_tpu.io.binpage import pack_images
+    pack_images(argv[1], argv[2], argv[3])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

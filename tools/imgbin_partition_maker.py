@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Shard an image list into N part packfiles for distributed reading.
+
+The reference version (reference: tools/imgbin-partition-maker.py)
+generates a Makefile that invokes im2bin once per part; here the parts
+are written directly (optionally in parallel worker threads). The output
+naming matches what the ``imgbin`` iterator's multi-part options expect:
+
+    <prefix>_part-0.lst / <prefix>_part-0.bin ... up to nparts-1
+
+consumed via ``image_conf_prefix = <prefix>_part-%d.bin`` +
+``image_conf_ids = 0-<nparts-1>`` with per-worker shard assignment
+(reference: src/io/iter_thread_imbin-inl.hpp:199-219).
+"""
+import argparse
+import os
+import random
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Shard an image list into part packfiles")
+    ap.add_argument("--img_list", required=True,
+                    help="path to the list of all images")
+    ap.add_argument("--img_root", required=True,
+                    help="prefix path for the filenames in img_list")
+    ap.add_argument("--prefix", required=True,
+                    help="prefix of output part lists/bins")
+    ap.add_argument("--out", required=True, help="output directory")
+    ap.add_argument("--nparts", type=int, default=8,
+                    help="number of part files")
+    ap.add_argument("--shuffle", type=int, default=0,
+                    help="shuffle the list before sharding")
+    ap.add_argument("--seed", type=int, default=888)
+    ap.add_argument("--jobs", type=int, default=4,
+                    help="parallel packing workers")
+    args = ap.parse_args()
+
+    from cxxnet_tpu.io.binpage import pack_images
+
+    with open(args.img_list) as f:
+        lines = [ln for ln in f if ln.strip()]
+    if args.shuffle:
+        random.Random(args.seed).shuffle(lines)
+
+    os.makedirs(args.out, exist_ok=True)
+    base = os.path.join(args.out, args.prefix)
+
+    def write_part(p):
+        lst = "%s_part-%d.lst" % (base, p)
+        with open(lst, "w") as f:
+            f.writelines(lines[p::args.nparts])
+        pack_images(lst, args.img_root, "%s_part-%d.bin" % (base, p),
+                    silent=True)
+        return p
+
+    with ThreadPoolExecutor(max_workers=args.jobs) as ex:
+        for p in ex.map(write_part, range(args.nparts)):
+            print("part %d done" % p)
+    print("wrote %d parts under %s_part-*.{lst,bin}" % (args.nparts, base))
+    print("config: image_conf_prefix = %s_part-%%d.bin" % base)
+    print("        image_conf_ids = 0-%d" % (args.nparts - 1))
+
+
+if __name__ == "__main__":
+    main()
