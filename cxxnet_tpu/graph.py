@@ -279,6 +279,7 @@ class NetConfig:
         netcfg_mode = 0
         cfg_top_node = 0
         cfg_layer_index = 0
+        extra_by_bracket: Dict[int, List[int]] = {}
         for name, val in cfg:
             if name == "extra_data_num":
                 num = int(val)
@@ -298,15 +299,13 @@ class NetConfig:
                 xyz = [int(t) for t in val.split(",")]
                 if len(xyz) != 3:
                     raise GraphConfigError("extra data shape config incorrect")
-                # slot-indexed assignment so a checkpoint-restored entry
+                # keyed by bracket number so a checkpoint-restored entry
                 # replayed before the same live entry stays idempotent and
-                # a changed live value wins; extra_data_shape[i] describes
-                # node in_i, so brackets are 1-based (0 tolerated as in_1)
-                slot = max(int(m.group(1)) - 1, 0)
-                need = 3 * (slot + 1)
-                if len(self.extra_shape) < need:
-                    self.extra_shape.extend([0] * (need - len(self.extra_shape)))
-                self.extra_shape[3 * slot: 3 * slot + 3] = xyz
+                # a changed live value wins; materialised in sorted-bracket
+                # order below, which accepts 0-based and 1-based configs
+                # alike (the reference ignores the number entirely and
+                # appends in declaration order, nnet_config.h:236-245)
+                extra_by_bracket[int(m.group(1))] = xyz
             if not self.init_end and name == "input_shape":
                 dims = tuple(int(t) for t in val.split(","))
                 if len(dims) != 3:
@@ -349,6 +348,10 @@ class NetConfig:
                 self.layercfg[cfg_layer_index - 1].append((name, val))
             else:
                 self.defcfg.append((name, val))
+        if extra_by_bracket:
+            self.extra_shape = [
+                x for k in sorted(extra_by_bracket)
+                for x in extra_by_bracket[k]]
         if not self.init_end:
             self.init_end = True
 

@@ -283,40 +283,178 @@ class ThreadBufferIterator(DataIterator):
         return self._batch
 
 
+class MemBufferIterator(DataIterator):
+    """Pin the first ``max_nbatch`` batches of the base iterator in RAM and
+    serve only those (reference DenseBufferIterator,
+    src/io/iter_mem_buffer-inl.hpp:16-77). Used to bound IO cost or to
+    train on a fixed in-memory subset."""
+
+    def __init__(self, base: DataIterator) -> None:
+        self.base = base
+        self.max_nbatch = 100
+        self.silent = 0
+        self._buffer: List[DataBatch] = []
+        self._index = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        # max_nbatch is this wrapper's own knob; everything else (incl.
+        # silent, which both levels honor) flows down the chain
+        if name == "max_nbatch":
+            self.max_nbatch = int(val)
+            return
+        if name == "silent":
+            self.silent = int(val)
+        self.base.set_param(name, val)
+
+    def init(self) -> None:
+        self.base.init()
+        self.base.before_first()
+        while self.base.next():
+            b = self.base.value
+            # deep copy: base iterators are free to reuse their buffers
+            self._buffer.append(DataBatch(
+                data=np.array(b.data, np.float32),
+                label=np.array(b.label, np.float32),
+                num_batch_padd=b.num_batch_padd,
+                extra_data=[np.array(e) for e in b.extra_data],
+                inst_index=None if b.inst_index is None
+                else np.array(b.inst_index)))
+            if len(self._buffer) >= self.max_nbatch:
+                break
+        if self.silent == 0:
+            print("MemBufferIterator: load %d batches" % len(self._buffer))
+
+    def before_first(self) -> None:
+        self._index = 0
+
+    def next(self) -> bool:
+        if self._index < len(self._buffer):
+            self._index += 1
+            return True
+        return False
+
+    @property
+    def value(self) -> DataBatch:
+        assert self._index > 0, "Iterator.Value: at beginning of iterator"
+        return self._buffer[self._index - 1]
+
+
+class AttachTxtIterator(DataIterator):
+    """Attach per-instance dense vectors from a text file, keyed by
+    instance index, as ``DataBatch.extra_data`` (reference:
+    src/io/iter_attach_txt-inl.hpp:15-101). File format: first token is
+    the dimension d, then lines of ``instance_id v1 ... vd``. The vectors
+    feed the net's extra input nodes ``in_1...`` (extra_data_num,
+    reference nnet_config.h:223-235)."""
+
+    def __init__(self, base: DataIterator) -> None:
+        self.base = base
+        self.filename = ""
+        self._dim = 0
+        self._table: dict = {}
+        self._batch: Optional[DataBatch] = None
+
+    def set_param(self, name: str, val: str) -> None:
+        # filename is this wrapper's own knob: forwarding it would clobber
+        # an inner attachtxt's file in a chained-attachtxt stack
+        if name == "filename":
+            self.filename = val
+            return
+        self.base.set_param(name, val)
+
+    def init(self) -> None:
+        self.base.init()
+        if not self.filename:
+            raise ValueError("AttachTxt: must set filename")
+        with open(self.filename) as f:
+            toks = f.read().split()
+        if not toks:
+            raise ValueError("AttachTxt: first token must be the data dim")
+        self._dim = int(toks[0])
+        pos = 1
+        while pos < len(toks):
+            inst = int(toks[pos])
+            chunk = toks[pos + 1: pos + 1 + self._dim]
+            if len(chunk) != self._dim:
+                raise ValueError(
+                    "AttachTxt: data do not match dimension specified")
+            self._table[inst] = np.asarray([float(t) for t in chunk],
+                                           np.float32)
+            pos += 1 + self._dim
+
+    def before_first(self) -> None:
+        self.base.before_first()
+
+    def next(self) -> bool:
+        if not self.base.next():
+            return False
+        b = self.base.value
+        if b.inst_index is None:
+            raise ValueError("AttachTxt: base iterator provides no "
+                             "instance indices")
+        n = b.batch_size
+        extra = np.zeros((n, 1, 1, self._dim), np.float32)
+        for top in range(n):
+            vec = self._table.get(int(b.inst_index[top]))
+            if vec is not None:
+                extra[top, 0, 0, :] = vec
+        # append after any extras the base already carries so chained
+        # attachtxt iterators feed in_1, in_2, ... in chain order
+        self._batch = DataBatch(
+            data=b.data, label=b.label, num_batch_padd=b.num_batch_padd,
+            extra_data=list(b.extra_data) + [extra], inst_index=b.inst_index)
+        return True
+
+    @property
+    def value(self) -> DataBatch:
+        return self._batch
+
+
 def create_iterator(cfg: Sequence[ConfigEntry]) -> DataIterator:
     """Factory chaining iterators in config order
     (reference: src/io/data.cpp:24-75)."""
-    chain: List[DataIterator] = []
-    params: List[ConfigEntry] = []
     base: Optional[DataIterator] = None
+    pre_params: List[ConfigEntry] = []
     for name, val in cfg:
         if name == "iter":
             if val == "mnist":
                 base = MNISTIterator()
-                chain.append(base)
             elif val == "synth":
                 base = SyntheticIterator()
-                chain.append(base)
             elif val == "threadbuffer":
                 if base is None:
                     raise ValueError("threadbuffer needs a base iterator")
                 base = ThreadBufferIterator(base)
-                chain[-1] = base
+            elif val == "membuffer":
+                if base is None:
+                    raise ValueError("membuffer needs a base iterator")
+                base = MemBufferIterator(base)
+            elif val == "attachtxt":
+                if base is None:
+                    raise ValueError("attachtxt needs a base iterator")
+                base = AttachTxtIterator(base)
             elif val == "end":
-                pass
+                continue
             else:
                 # imgbin/img/imgbinx arrive with the image pipeline module
                 from . import image as image_io
                 base = image_io.create_base_iterator(val)
                 if base is None:
                     raise ValueError("unknown iterator type %s" % val)
-                chain.append(base)
+            for k, v in pre_params:
+                base.set_param(k, v)
+            pre_params = []
+        elif base is None:
+            # params written before the first iterator declaration apply
+            # once a base exists (the reference drops them; keeping them
+            # is kinder to hand-written configs)
+            pre_params.append((name, val))
         else:
-            params.append((name, val))
+            # positional semantics (reference data.cpp:68-71): a param
+            # applies to the chain as built so far; wrappers withhold
+            # their own knobs and forward the rest down
+            base.set_param(name, val)
     if base is None:
         raise ValueError("config does not declare an iterator")
-    for it in chain:
-        for k, v in params:
-            it.set_param(k, v)
     base.init()
     return base

@@ -197,23 +197,26 @@ class Trainer:
         net, opt_ = self.net, self.opt
         eval_req = tuple(self.eval_req)
 
-        def fwd_bwd(params, data, labels, rng, epoch):
+        def fwd_bwd(params, data, extras, labels, rng, epoch):
             def loss_fn(p):
                 values, loss = net.apply(
-                    p, data, labels=labels, train=True, rng=rng, epoch=epoch)
+                    p, data, extra_data=extras, labels=labels, train=True,
+                    rng=rng, epoch=epoch)
                 return loss, tuple(values[i] for i in eval_req)
             (loss, evals), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             return loss, evals, grads
 
-        def train_step(params, opt_state, data, labels, rng, epoch):
-            loss, evals, grads = fwd_bwd(params, data, labels, rng, epoch)
+        def train_step(params, opt_state, data, extras, labels, rng, epoch):
+            loss, evals, grads = fwd_bwd(params, data, extras, labels,
+                                         rng, epoch)
             grads = _strip_nones(grads)
             params2, opt2 = opt_.apply(params, grads, opt_state, epoch)
             return params2, opt2, loss, evals
 
-        def accum_step(grad_accum, params, data, labels, rng, epoch):
-            loss, evals, grads = fwd_bwd(params, data, labels, rng, epoch)
+        def accum_step(grad_accum, params, data, extras, labels, rng, epoch):
+            loss, evals, grads = fwd_bwd(params, data, extras, labels,
+                                         rng, epoch)
             grads = _strip_nones(grads)
             acc = jax.tree.map(jnp.add, grad_accum, grads)
             return acc, loss, evals
@@ -223,22 +226,23 @@ class Trainer:
             zeros = jax.tree.map(jnp.zeros_like, grad_accum)
             return params2, opt2, zeros
 
-        def forward_step(params, data, node_ids):
-            values, _ = net.apply(params, data, train=False)
+        def forward_step(params, data, extras, node_ids):
+            values, _ = net.apply(params, data, extra_data=extras,
+                                  train=False)
             return tuple(values[i] for i in node_ids)
 
         self._train_step = jax.jit(
             train_step, donate_argnums=(0, 1),
-            in_shardings=(psh, osh, dsh, dsh, rep, rep))
+            in_shardings=(psh, osh, dsh, dsh, dsh, rep, rep))
         self._accum_step = jax.jit(
             accum_step, donate_argnums=(0,),
-            in_shardings=(gsh, psh, dsh, dsh, rep, rep))
+            in_shardings=(gsh, psh, dsh, dsh, dsh, rep, rep))
         self._apply_accum = jax.jit(
             apply_accum, donate_argnums=(0, 1, 2),
             in_shardings=(psh, osh, gsh, rep))
         self._forward = jax.jit(
-            forward_step, in_shardings=(psh, dsh),
-            static_argnums=(2,))
+            forward_step, in_shardings=(psh, dsh, dsh),
+            static_argnums=(3,))
 
     # ------------------------------------------------------------------
     def _put_data(self, arr) -> jnp.ndarray:
@@ -272,6 +276,19 @@ class Trainer:
             return out
         return np.asarray(x)
 
+    def _extra_fields(self, batch: DataBatch) -> Tuple[jnp.ndarray, ...]:
+        """Extra input nodes in_1.. from batch.extra_data (reference
+        attachtxt + nnet_config extra_data_num, nnet_config.h:223-235)."""
+        n = self.net_cfg.extra_data_num
+        if n == 0:
+            return ()
+        if len(batch.extra_data) < n:
+            raise ValueError(
+                "net declares extra_data_num=%d but batch carries %d extra "
+                "arrays (chain an attachtxt iterator)"
+                % (n, len(batch.extra_data)))
+        return tuple(self._put_data(batch.extra_data[i]) for i in range(n))
+
     def _label_fields(self, batch: DataBatch) -> List[jnp.ndarray]:
         """Slice label matrix into fields (reference GetLabelInfo,
         nnet_impl-inl.hpp:271-285)."""
@@ -296,6 +313,7 @@ class Trainer:
     def update(self, batch: DataBatch) -> None:
         """One minibatch of training (reference: nnet_impl-inl.hpp:141-185)."""
         data = self._put_data(batch.data)
+        extras = self._extra_fields(batch)
         labels = self._label_fields(batch)
         self._step_count += 1
         rng = jax.random.fold_in(self._rng, self._step_count)
@@ -303,10 +321,10 @@ class Trainer:
         epoch = jnp.asarray(self.epoch_counter, jnp.float32)
         if self.update_period == 1:
             self.params, self.opt_state, loss, evals = self._train_step(
-                self.params, self.opt_state, data, labels, rng, epoch)
+                self.params, self.opt_state, data, extras, labels, rng, epoch)
         else:
             self.grad_accum, loss, evals = self._accum_step(
-                self.grad_accum, self.params, data, labels, rng, epoch)
+                self.grad_accum, self.params, data, extras, labels, rng, epoch)
             if (self.sample_counter + 1) % self.update_period == 0:
                 self.params, self.opt_state, self.grad_accum = \
                     self._apply_accum(self.params, self.opt_state,
@@ -324,7 +342,8 @@ class Trainer:
     def forward_nodes(self, batch: DataBatch,
                       node_ids: Sequence[int]) -> List[np.ndarray]:
         data = self._put_data(batch.data)
-        values = self._forward(self.params, data, tuple(node_ids))
+        extras = self._extra_fields(batch)
+        values = self._forward(self.params, data, extras, tuple(node_ids))
         return [self._fetch_local(v) for v in values]
 
     def predict(self, batch: DataBatch) -> np.ndarray:

@@ -336,3 +336,20 @@ extra_data_shape[1] = 1,1,3
     net2.configure(config.parse_string(
         "extra_data_num = 1\nextra_data_shape[1] = 1,1,5\n" + MLP))
     assert net2.extra_shape == [1, 1, 5]
+
+
+def test_extra_data_shape_zero_based_brackets():
+    """0-based bracket configs (accepted by the old append parser) keep
+    both slots; brackets are ordered, not clamped."""
+    net = build("""
+extra_data_num = 2
+extra_data_shape[0] = 1,1,3
+extra_data_shape[1] = 1,1,4
+""" + MLP)
+    assert net.extra_shape == [1, 1, 3, 1, 1, 4]
+    net2 = build("""
+extra_data_num = 2
+extra_data_shape[1] = 1,1,3
+extra_data_shape[2] = 1,1,4
+""" + MLP)
+    assert net2.extra_shape == [1, 1, 3, 1, 1, 4]
