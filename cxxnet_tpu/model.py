@@ -60,11 +60,18 @@ class Network:
             if type_name == SHARED_LAYER:
                 type_name = net_cfg.layers[info.primary_layer_index].type
             if type_name == "pairtest":
-                raise NotImplementedError(
-                    "pairtest is handled by testing.pairtest, not in-net")
-            mod = L.create_layer(
-                type_name, net_cfg.effective_layer_cfg(li),
-                net_cfg.label_name_map)
+                from . import pairtest
+                # a share[...] of a pairtest layer carries pair=None itself;
+                # the pair lives on the primary, like type_name and cfg
+                pair = (info.pair if info.type != SHARED_LAYER
+                        else net_cfg.layers[info.primary_layer_index].pair)
+                mod = pairtest.PairTestLayer(
+                    pair, net_cfg.effective_layer_cfg(li),
+                    net_cfg.label_name_map)
+            else:
+                mod = L.create_layer(
+                    type_name, net_cfg.effective_layer_cfg(li),
+                    net_cfg.label_name_map)
             if isinstance(mod, L.SplitLayer):
                 mod.n_out = len(info.nindex_out)
             in_shapes = []

@@ -1,0 +1,205 @@
+"""Differential testing: run two layer implementations side by side.
+
+The reference's PairTestLayer (reference: src/layer/pairtest_layer-inl.hpp:15-203)
+mirrors a master and a slave implementation of the same layer onto cloned
+nodes, synchronizes weights, and after every Forward/Backprop compares
+outputs, propagated gradients and weight gradients with relative absolute
+error sum|m-s|/sum|m|, printing divergences above 1e-5 (CmpResult,
+reference :171-196). Config syntax ``layer[..] = pairtest-master-slave``
+with ``master:``/``slave:`` prefixed params routed to one side
+(reference :127-135).
+
+Here the same capability splits into two pieces:
+
+* :func:`compare_layers` — the full harness: shared params, shared rng,
+  identical inputs; compares forward outputs AND gradients (via jax.vjp
+  with a fixed cotangent) for both implementations. This is how an XLA
+  path and a Pallas kernel path are validated against each other.
+* :class:`PairTestLayer` — the in-net layer (config-compatible): runs both
+  implementations on the same params inside the jitted step, returns the
+  master's output, and reports forward divergence through a host callback
+  (the reference's in-band printing).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import layers as L
+
+ConfigEntry = Tuple[str, str]
+
+REL_ERR_TOL = 1e-5
+
+
+def split_pair_cfg(cfg: Sequence[ConfigEntry]
+                   ) -> Tuple[List[ConfigEntry], List[ConfigEntry]]:
+    """Route config entries: unprefixed to both sides, ``master:``/``slave:``
+    prefixes to one (reference pairtest_layer-inl.hpp:127-135)."""
+    mcfg: List[ConfigEntry] = []
+    scfg: List[ConfigEntry] = []
+    for name, val in cfg:
+        if name.startswith("master:"):
+            mcfg.append((name[len("master:"):], val))
+        elif name.startswith("slave:"):
+            scfg.append((name[len("slave:"):], val))
+        else:
+            mcfg.append((name, val))
+            scfg.append((name, val))
+    return mcfg, scfg
+
+
+def rel_err(master, slave) -> jnp.ndarray:
+    """Relative absolute error sum|m-s| / sum|m| (reference CmpResult)."""
+    m = jnp.asarray(master, jnp.float32)
+    s = jnp.asarray(slave, jnp.float32)
+    return jnp.sum(jnp.abs(m - s)) / jnp.maximum(
+        jnp.sum(jnp.abs(m)), jnp.finfo(jnp.float32).tiny)
+
+
+def _tree_rel_errs(tag: str, tm, ts) -> List[Tuple[str, float]]:
+    lm = jax.tree.leaves(tm)
+    ls = jax.tree.leaves(ts)
+    if len(lm) != len(ls):
+        raise ValueError("%s: pytree structure mismatch" % tag)
+    return [("%s[%d]" % (tag, i), float(rel_err(a, b)))
+            for i, (a, b) in enumerate(zip(lm, ls))]
+
+
+def compare_layers(master_type: str, slave_type: str,
+                   cfg: Sequence[ConfigEntry],
+                   in_shapes: Sequence[Tuple[int, int, int, int]],
+                   *, train: bool = False, seed: int = 0,
+                   tol: float = REL_ERR_TOL) -> Dict[str, float]:
+    """Differential-test two layer types on identical params and inputs.
+
+    Returns {check_name: rel_err}; every entry must be <= tol for the pair
+    to be considered equivalent (helper :func:`assert_pair_ok`). Checks:
+    ``out[i]`` forward outputs, ``gin[i]`` propagated input gradients,
+    ``gw[j]`` parameter gradients — the same three comparisons the
+    reference makes around Forward/Backprop (pairtest_layer-inl.hpp:60-117).
+    """
+    mcfg, scfg = split_pair_cfg(cfg)
+    master = L.create_layer(master_type, mcfg)
+    slave = L.create_layer(slave_type, scfg)
+    out_m = master.infer_shape(list(in_shapes))
+    out_s = slave.infer_shape(list(in_shapes))
+    if out_m != out_s:
+        raise ValueError("pairtest: output shapes disagree: %s vs %s"
+                         % (out_m, out_s))
+
+    key = jax.random.PRNGKey(seed)
+    k_in, k_par, k_ctx, k_cot = jax.random.split(key, 4)
+    inputs = [jax.random.normal(jax.random.fold_in(k_in, i), shp, jnp.float32)
+              for i, shp in enumerate(in_shapes)]
+    params = master.init_params(k_par) if master.has_params else {}
+    if slave.has_params:
+        sparams = slave.init_params(k_par)
+        if jax.tree.structure(sparams) != jax.tree.structure(params) or \
+           [np.shape(x) for x in jax.tree.leaves(sparams)] != \
+           [np.shape(x) for x in jax.tree.leaves(params)]:
+            raise ValueError(
+                "pairtest: master and slave parameter layouts differ; "
+                "weights cannot be synced")
+    batch = in_shapes[0][0]
+    ctx = L.ApplyContext(train=train, rng=k_ctx, batch_size=batch)
+
+    def run(layer):
+        def f(p, xs):
+            return layer.apply(p, xs, ctx)
+        return f
+
+    cot = None
+    report: Dict[str, float] = {}
+    om, vjp_m = jax.vjp(run(master), params, inputs)
+    os_, vjp_s = jax.vjp(run(slave), params, inputs)
+    for i, (a, b) in enumerate(zip(om, os_)):
+        report["out[%d]" % i] = float(rel_err(a, b))
+    cot = [jax.random.normal(jax.random.fold_in(k_cot, i), o.shape, o.dtype)
+           for i, o in enumerate(om)]
+    gp_m, gi_m = vjp_m(cot)
+    gp_s, gi_s = vjp_s(cot)
+    for i, (a, b) in enumerate(zip(gi_m, gi_s)):
+        report["gin[%d]" % i] = float(rel_err(a, b))
+    report.update(_tree_rel_errs("gw", gp_m, gp_s))
+    return report
+
+
+def assert_pair_ok(report: Dict[str, float],
+                   tol: float = REL_ERR_TOL) -> None:
+    bad = {k: v for k, v in report.items()
+           if not (v <= tol) or np.isnan(v)}
+    if bad:
+        raise AssertionError("pairtest divergence: %s" % bad)
+
+
+# ----------------------------------------------------------------------
+# host-side divergence log for the in-net layer (tests read this)
+_divergence_log: List[Tuple[str, float]] = []
+
+
+def divergence_log() -> List[Tuple[str, float]]:
+    return _divergence_log
+
+
+def clear_divergence_log() -> None:
+    _divergence_log.clear()
+
+
+class PairTestLayer(L.Layer):
+    """In-net pairtest: both implementations run on the SAME parameters
+    inside the jitted step; the master's output is the layer's output and
+    forward divergence is reported through a host callback (the analogue
+    of the reference's in-band CmpResult printing). Gradient-level
+    comparison lives in :func:`compare_layers`."""
+
+    type_name = "pairtest"
+
+    def __init__(self, pair: Tuple[str, str], cfg: Sequence[ConfigEntry],
+                 label_name_map=None) -> None:
+        super().__init__()
+        mcfg, scfg = split_pair_cfg(cfg)
+        self.master = L.create_layer(pair[0], mcfg, label_name_map)
+        self.slave = L.create_layer(pair[1], scfg, label_name_map)
+        self.tag = "pairtest-%s-%s" % pair
+        self.has_params = self.master.has_params
+
+    def set_param(self, name: str, val: str) -> None:
+        pass  # routing happened in __init__ via the config bucket
+
+    def infer_shape(self, in_shapes):
+        out_m = self.master.infer_shape(list(in_shapes))
+        out_s = self.slave.infer_shape(list(in_shapes))
+        if out_m != out_s:
+            raise ValueError("%s: output shapes disagree: %s vs %s"
+                             % (self.tag, out_m, out_s))
+        self.in_shapes = list(in_shapes)
+        self.out_shapes = out_m
+        return out_m
+
+    def init_params(self, rng):
+        params = self.master.init_params(rng)
+        if self.slave.has_params:
+            sparams = self.slave.init_params(rng)
+            if jax.tree.structure(sparams) != jax.tree.structure(params):
+                raise ValueError(
+                    "%s: parameter layouts differ; cannot sync" % self.tag)
+        return params
+
+    def apply(self, params, inputs, ctx):
+        out_m = self.master.apply(params, inputs, ctx)
+        out_s = self.slave.apply(params, inputs, ctx)
+        tag = self.tag
+
+        def report(errs):
+            for i, e in enumerate(np.atleast_1d(np.asarray(errs))):
+                _divergence_log.append(("%s:out[%d]" % (tag, i), float(e)))
+                if not (e <= REL_ERR_TOL):
+                    print("%s:out[%d]: err=%g" % (tag, i, e))
+        errs = jnp.stack([rel_err(a, b) for a, b in zip(out_m, out_s)])
+        jax.debug.callback(report, errs)
+        return out_m
