@@ -736,6 +736,7 @@ class LRNLayer(Layer):
         self.alpha = 0.0
         self.beta = 0.0
         self.knorm = 1.0
+        self.use_pallas = -1  # -1 auto (TPU only), 0 never, 1 always
 
     def set_param(self, name, val):
         if name == "local_size":
@@ -746,11 +747,24 @@ class LRNLayer(Layer):
             self.beta = float(val)
         elif name == "knorm":
             self.knorm = float(val)
+        elif name == "use_pallas":
+            self.use_pallas = int(val)
         else:
             super().set_param(name, val)
 
+    def _want_pallas(self) -> bool:
+        if self.use_pallas == 0:
+            return False
+        if self.use_pallas == 1:
+            return True
+        return jax.default_backend() == "tpu"
+
     def apply(self, params, inputs, ctx):
         x = inputs[0]
+        if self._want_pallas():
+            from .ops import lrn_pallas
+            return [lrn_pallas(x, self.nsize, self.alpha, self.beta,
+                               self.knorm)]
         salpha = self.alpha / self.nsize
         # centered cross-channel window of nsize, zero-padded (chpool<sum>)
         lo = self.nsize // 2
@@ -761,6 +775,22 @@ class LRNLayer(Layer):
             ((0, 0), (lo, hi), (0, 0), (0, 0)))
         norm = norm * salpha + self.knorm
         return [x * jnp.power(norm, -self.beta)]
+
+
+@register("lrn_pallas")
+class LRNPallasLayer(LRNLayer):
+    """LRN forced onto the Pallas kernel path (interpreted off-TPU);
+    exists so ``pairtest-lrn-lrn_pallas`` differential-tests the kernel
+    against the XLA lowering."""
+
+    def __init__(self):
+        super().__init__()
+        self.use_pallas = 1
+
+    def set_param(self, name, val):
+        if name == "use_pallas":
+            return  # pinned: this type exists to force the kernel path
+        super().set_param(name, val)
 
 
 @register("batch_norm")

@@ -1,0 +1,5 @@
+"""Hand-written TPU kernels for ops where a fused Pallas implementation
+beats the composed XLA lowering. Validated against the XLA paths via the
+pairtest harness (cxxnet_tpu.pairtest)."""
+
+from .lrn import lrn as lrn_pallas  # noqa: F401

@@ -36,12 +36,20 @@ ConfigEntry = Tuple[str, str]
 REL_ERR_TOL = 1e-5
 
 
-def split_pair_cfg(cfg: Sequence[ConfigEntry]
+def split_pair_cfg(cfg: Sequence[ConfigEntry],
+                   master_type: str = "", slave_type: str = ""
                    ) -> Tuple[List[ConfigEntry], List[ConfigEntry]]:
     """Route config entries: unprefixed to both sides, ``master:``/``slave:``
-    prefixes to one (reference pairtest_layer-inl.hpp:127-135)."""
+    prefixes to one (reference pairtest_layer-inl.hpp:127-135).
+
+    When the pair is an XLA-vs-Pallas comparison (slave type is
+    ``<master>_pallas``), the master is pinned to the XLA path: on TPU the
+    base layer's auto mode would otherwise pick the Pallas kernel on both
+    sides and the differential test would be vacuous."""
     mcfg: List[ConfigEntry] = []
     scfg: List[ConfigEntry] = []
+    if slave_type and slave_type == master_type + "_pallas":
+        mcfg.append(("use_pallas", "0"))
     for name, val in cfg:
         if name.startswith("master:"):
             mcfg.append((name[len("master:"):], val))
@@ -73,17 +81,16 @@ def _tree_rel_errs(tag: str, tm, ts) -> List[Tuple[str, float]]:
 def compare_layers(master_type: str, slave_type: str,
                    cfg: Sequence[ConfigEntry],
                    in_shapes: Sequence[Tuple[int, int, int, int]],
-                   *, train: bool = False, seed: int = 0,
-                   tol: float = REL_ERR_TOL) -> Dict[str, float]:
+                   *, train: bool = False, seed: int = 0) -> Dict[str, float]:
     """Differential-test two layer types on identical params and inputs.
 
-    Returns {check_name: rel_err}; every entry must be <= tol for the pair
-    to be considered equivalent (helper :func:`assert_pair_ok`). Checks:
+    Returns {check_name: rel_err}; gate it with :func:`assert_pair_ok`
+    (tolerance lives there). Checks:
     ``out[i]`` forward outputs, ``gin[i]`` propagated input gradients,
     ``gw[j]`` parameter gradients — the same three comparisons the
     reference makes around Forward/Backprop (pairtest_layer-inl.hpp:60-117).
     """
-    mcfg, scfg = split_pair_cfg(cfg)
+    mcfg, scfg = split_pair_cfg(cfg, master_type, slave_type)
     master = L.create_layer(master_type, mcfg)
     slave = L.create_layer(slave_type, scfg)
     out_m = master.infer_shape(list(in_shapes))
@@ -113,7 +120,6 @@ def compare_layers(master_type: str, slave_type: str,
             return layer.apply(p, xs, ctx)
         return f
 
-    cot = None
     report: Dict[str, float] = {}
     om, vjp_m = jax.vjp(run(master), params, inputs)
     os_, vjp_s = jax.vjp(run(slave), params, inputs)
@@ -162,11 +168,16 @@ class PairTestLayer(L.Layer):
     def __init__(self, pair: Tuple[str, str], cfg: Sequence[ConfigEntry],
                  label_name_map=None) -> None:
         super().__init__()
-        mcfg, scfg = split_pair_cfg(cfg)
+        mcfg, scfg = split_pair_cfg(cfg, pair[0], pair[1])
         self.master = L.create_layer(pair[0], mcfg, label_name_map)
         self.slave = L.create_layer(pair[1], scfg, label_name_map)
         self.tag = "pairtest-%s-%s" % pair
+        if self.slave.has_params and not self.master.has_params:
+            raise ValueError(
+                "%s: slave has parameters but master has none; weights "
+                "cannot be synced" % self.tag)
         self.has_params = self.master.has_params
+        self.is_loss = self.master.is_loss
 
     def set_param(self, name: str, val: str) -> None:
         pass  # routing happened in __init__ via the config bucket
@@ -185,14 +196,20 @@ class PairTestLayer(L.Layer):
         params = self.master.init_params(rng)
         if self.slave.has_params:
             sparams = self.slave.init_params(rng)
-            if jax.tree.structure(sparams) != jax.tree.structure(params):
+            if jax.tree.structure(sparams) != jax.tree.structure(params) or \
+               [np.shape(x) for x in jax.tree.leaves(sparams)] != \
+               [np.shape(x) for x in jax.tree.leaves(params)]:
                 raise ValueError(
                     "%s: parameter layouts differ; cannot sync" % self.tag)
         return params
 
     def apply(self, params, inputs, ctx):
+        import dataclasses
         out_m = self.master.apply(params, inputs, ctx)
-        out_s = self.slave.apply(params, inputs, ctx)
+        # the slave runs on a scratch context: a pairtested loss layer must
+        # not append its loss twice (that would double the gradient)
+        out_s = self.slave.apply(params, inputs,
+                                 dataclasses.replace(ctx, losses=[]))
         tag = self.tag
 
         def report(errs):

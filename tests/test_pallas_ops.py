@@ -1,0 +1,81 @@
+"""Pallas kernel correctness vs the XLA lowerings (interpret mode on CPU;
+the same kernels compile on TPU — validated on-chip separately).
+
+The pairtest harness is the validation mechanism (SURVEY.md §4.1): the
+XLA layer is the master, the Pallas layer the slave."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu import pairtest
+from cxxnet_tpu.ops import lrn_pallas
+
+LRN_CFG = [("local_size", "5"), ("alpha", "0.001"), ("beta", "0.75"),
+           ("knorm", "1.0")]
+
+
+def test_lrn_pairtest_fwd_bwd():
+    rep = pairtest.compare_layers(
+        "lrn", "lrn_pallas", LRN_CFG, [(2, 16, 7, 9)], train=True)
+    pairtest.assert_pair_ok(rep)
+
+
+@pytest.mark.parametrize("nsize", [3, 4, 5])
+@pytest.mark.parametrize("beta", [0.75, 0.6])
+def test_lrn_grad_matches_autodiff(nsize, beta):
+    """custom_vjp backward vs jax.grad of the XLA forward, including even
+    windows (asymmetric pad -> flipped adjoint) and non-special betas."""
+    from jax import lax
+    alpha, knorm = 0.002, 1.5
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 8, 3, 5), jnp.float32)
+
+    def xla(x):
+        lo = nsize // 2
+        hi = nsize - 1 - lo
+        norm = lax.reduce_window(
+            jnp.square(x), 0.0, lax.add, (1, nsize, 1, 1), (1, 1, 1, 1),
+            ((0, 0), (lo, hi), (0, 0), (0, 0)))
+        return x * jnp.power(norm * (alpha / nsize) + knorm, -beta)
+
+    np.testing.assert_allclose(
+        np.asarray(lrn_pallas(x, nsize, alpha, beta, knorm)),
+        np.asarray(xla(x)), rtol=1e-5, atol=1e-6)
+    g_pallas = jax.grad(lambda x: jnp.sum(jnp.sin(
+        lrn_pallas(x, nsize, alpha, beta, knorm))))(x)
+    g_xla = jax.grad(lambda x: jnp.sum(jnp.sin(xla(x))))(x)
+    np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_xla),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_lrn_under_jit_and_value_and_grad():
+    x = jnp.asarray(np.random.RandomState(2).randn(4, 8, 4, 4), jnp.float32)
+
+    @jax.jit
+    def step(x):
+        return jax.value_and_grad(
+            lambda x: jnp.mean(lrn_pallas(x, 3, 0.01, 0.75, 1.0)))(x)
+    v, g = step(x)
+    assert np.isfinite(float(v))
+    assert g.shape == x.shape
+
+
+def test_lrn_bf16_preserves_dtype():
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 8, 4, 4),
+                    jnp.bfloat16)
+    out = lrn_pallas(x, 5, 0.001, 0.75, 1.0)
+    assert out.dtype == jnp.bfloat16
+    g = jax.grad(lambda x: jnp.sum(
+        lrn_pallas(x, 5, 0.001, 0.75, 1.0).astype(jnp.float32)))(x)
+    assert g.dtype == jnp.bfloat16
+
+
+def test_lrn_layer_use_pallas_flag():
+    from cxxnet_tpu import layers as L
+    lay = L.create_layer("lrn", LRN_CFG + [("use_pallas", "1")])
+    lay2 = L.create_layer("lrn", LRN_CFG + [("use_pallas", "0")])
+    x = jnp.asarray(np.random.RandomState(4).randn(2, 8, 4, 4), jnp.float32)
+    ctx = L.ApplyContext(train=True, batch_size=2)
+    np.testing.assert_allclose(
+        np.asarray(lay.apply({}, [x], ctx)[0]),
+        np.asarray(lay2.apply({}, [x], ctx)[0]), rtol=1e-6, atol=1e-7)
