@@ -115,9 +115,15 @@ def iter_packfile(path: str) -> Iterator[bytes]:
 def pack_images(lst_path: str, root_dir: str, out_path: str,
                 silent: bool = False) -> int:
     """im2bin: pack the image files named by a .lst into a packfile
-    (reference: tools/im2bin.cpp). Returns the number of images packed."""
+    (reference: tools/im2bin.cpp). Uses the native C++ packer when the
+    runtime library is available. Returns the number of images packed."""
+    from .. import native
+    if native.available():
+        writer = native.NativePacker(out_path)
+    else:
+        writer = BinaryPageWriter(out_path)
     count = 0
-    with BinaryPageWriter(out_path) as w:
+    with writer as w:
         with open(lst_path) as f:
             for line in f:
                 parts = line.strip().split("\t")

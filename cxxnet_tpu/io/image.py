@@ -152,12 +152,16 @@ class ImageListIterator(InstIterator):
 
 
 class ImageBinIterator(InstIterator):
-    """``iter = imgbin``: .lst + BinaryPage packfile(s), with the
-    multi-part ``image_conf_prefix``/``image_conf_ids`` scheme and
-    per-worker shard assignment for distributed training
-    (reference: src/io/iter_thread_imbin-inl.hpp:16-285). Page reading +
-    decode happen on a prefetch thread via ThreadBufferIterator wrapping
-    at the batch level."""
+    """``iter = imgbin`` / ``imgbinx``: .lst + BinaryPage packfile(s),
+    with the multi-part ``image_conf_prefix``/``image_conf_ids`` scheme
+    and per-worker shard assignment for distributed training
+    (reference: src/io/iter_thread_imbin-inl.hpp:16-285).
+
+    When the native runtime library is available, page reading and JPEG
+    decode run on C++ worker threads off the GIL (the reference keeps
+    this path in C++ too: src/io/iter_thread_imbin_x-inl.hpp's page
+    prefetch thread + OpenMP decode); ``decode_thread`` sets the worker
+    count, ``native_decode = 0`` forces the pure-Python path."""
 
     def __init__(self) -> None:
         self.path_imglst: List[str] = []
@@ -168,10 +172,12 @@ class ImageBinIterator(InstIterator):
         self.dist_worker_rank = 0
         self.label_width = 1
         self.silent = 0
-        self._part = 0
+        self.decode_thread = 4
+        self.native_decode = 1
         self._lst = []
         self._pos = 0
         self._objs = None
+        self._loader = None
         self._value: Optional[DataInst] = None
 
     def set_param(self, name, val):
@@ -191,6 +197,10 @@ class ImageBinIterator(InstIterator):
             self.label_width = int(val)
         elif name == "silent":
             self.silent = int(val)
+        elif name == "decode_thread":
+            self.decode_thread = int(val)
+        elif name == "native_decode":
+            self.native_decode = int(val)
 
     def _parse_image_conf(self):
         """Multi-part spec: prefix with %d + id list "a-b" or "a,b,c";
@@ -223,31 +233,52 @@ class ImageBinIterator(InstIterator):
             raise ValueError("List/Bin number not consistent")
         if not self.path_imglst:
             raise ValueError("imgbin: no image_list/image_bin configured")
+        # concatenated .lst entries, aligned with packfile object order
+        self._lst = []
+        for p in self.path_imglst:
+            self._lst.extend(_parse_lst(p, self.label_width))
+        if self.native_decode:
+            from .. import native
+            if native.available():
+                self._loader = native.NativeDecodeLoader(
+                    self.path_imgbin, nthread=self.decode_thread)
         if self.silent == 0:
-            print("ImageBinIterator: %d part(s), list=%s"
-                  % (len(self.path_imglst), ",".join(self.path_imglst)))
+            print("ImageBinIterator: %d part(s), %d images, list=%s%s"
+                  % (len(self.path_imglst), len(self._lst),
+                     ",".join(self.path_imglst),
+                     ", native decode x%d" % self.decode_thread
+                     if self._loader else ""))
 
     def before_first(self):
-        self._part = 0
-        self._open_part(0)
-
-    def _open_part(self, k):
-        self._lst = _parse_lst(self.path_imglst[k], self.label_width)
-        self._objs = iter_packfile(self.path_imgbin[k])
         self._pos = 0
+        if self._loader is not None:
+            self._loader.before_first()
+        else:
+            self._objs = self._iter_all_parts()
+
+    def _iter_all_parts(self):
+        for p in self.path_imgbin:
+            for obj in iter_packfile(p):
+                yield obj
 
     def next(self):
-        while True:
-            if self._pos < len(self._lst):
-                idx, label, _ = self._lst[self._pos]
-                self._pos += 1
-                buf = next(self._objs)
-                self._value = DataInst(idx, label, _decode_image(buf))
-                return True
-            if self._part + 1 >= len(self.path_imglst):
-                return False
-            self._part += 1
-            self._open_part(self._part)
+        if self._pos >= len(self._lst):
+            return False
+        idx, label, _ = self._lst[self._pos]
+        self._pos += 1
+        if self._loader is not None:
+            kind, val = self._loader.next()
+            if kind is None:
+                raise ValueError("packfile has fewer objects than .lst")
+            data = val if kind == "img" else _decode_image(val)
+        else:
+            try:
+                data = _decode_image(next(self._objs))
+            except StopIteration:
+                raise ValueError("packfile has fewer objects than .lst") \
+                    from None
+        self._value = DataInst(idx, label, data)
+        return True
 
     @property
     def value(self):

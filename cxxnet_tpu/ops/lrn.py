@@ -41,10 +41,12 @@ def _windowed_sum(t: jnp.ndarray, n_above: int, n_below: int) -> jnp.ndarray:
     nsize is small (3-5 in every known config)."""
     c = t.shape[0]
     acc = t
-    for d in range(1, n_above + 1):
+    # shifts of >= c rows contribute nothing (all zero-pad) — clamping also
+    # keeps the concatenated shape at (c, S) when the half-extent exceeds C
+    for d in range(1, min(n_above, c - 1) + 1):
         acc = acc + jnp.concatenate(
             [t[d:], jnp.zeros((d, t.shape[1]), t.dtype)], axis=0)
-    for d in range(1, n_below + 1):
+    for d in range(1, min(n_below, c - 1) + 1):
         acc = acc + jnp.concatenate(
             [jnp.zeros((d, t.shape[1]), t.dtype), t[:c - d]], axis=0)
     return acc
@@ -77,6 +79,12 @@ def _fwd_kernel(x_ref, out_ref, scale_ref, *, lo, hi, salpha, beta, knorm):
     out_ref[0] = (x * _neg_pow(s, beta)).astype(out_ref.dtype)
 
 
+def _fwd_only_kernel(x_ref, out_ref, *, lo, hi, salpha, beta, knorm):
+    x = x_ref[0].astype(jnp.float32)
+    s = knorm + salpha * _windowed_sum(x * x, hi, lo)
+    out_ref[0] = (x * _neg_pow(s, beta)).astype(out_ref.dtype)
+
+
 def _bwd_kernel(x_ref, scale_ref, g_ref, gx_ref, *, lo, hi, salpha, beta):
     x = x_ref[0].astype(jnp.float32)
     s = scale_ref[0]
@@ -91,9 +99,26 @@ def _bwd_kernel(x_ref, scale_ref, g_ref, gx_ref, *, lo, hi, salpha, beta):
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
 def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float,
         knorm: float) -> jnp.ndarray:
-    """Fused LRN over a (N, C, H, W) activation."""
-    out, _ = _lrn_fwd_impl(x, nsize, alpha, beta, knorm)
-    return out
+    """Fused LRN over a (N, C, H, W) activation.
+
+    The primal (inference) path uses a forward-only kernel that skips the
+    float32 normalizer output — the VJP path materialises it as the
+    residual for the hand-derived backward kernel."""
+    n, c, h, w = x.shape
+    s = h * w
+    lo = nsize // 2
+    hi = nsize - 1 - lo
+    blk = _specs(c, s)
+    out = pl.pallas_call(
+        partial(_fwd_only_kernel, lo=lo, hi=hi, salpha=alpha / nsize,
+                beta=beta, knorm=knorm),
+        grid=(n,),
+        in_specs=[blk],
+        out_specs=blk,
+        out_shape=jax.ShapeDtypeStruct((n, c, s), x.dtype),
+        interpret=_interpret(),
+    )(x.reshape(n, c, s))
+    return out.reshape(n, c, h, w)
 
 
 def _specs(c, s):

@@ -105,13 +105,7 @@ def compare_layers(master_type: str, slave_type: str,
               for i, shp in enumerate(in_shapes)]
     params = master.init_params(k_par) if master.has_params else {}
     if slave.has_params:
-        sparams = slave.init_params(k_par)
-        if jax.tree.structure(sparams) != jax.tree.structure(params) or \
-           [np.shape(x) for x in jax.tree.leaves(sparams)] != \
-           [np.shape(x) for x in jax.tree.leaves(params)]:
-            raise ValueError(
-                "pairtest: master and slave parameter layouts differ; "
-                "weights cannot be synced")
+        _check_param_layouts(params, slave.init_params(k_par), "pairtest")
     batch = in_shapes[0][0]
     ctx = L.ApplyContext(train=train, rng=k_ctx, batch_size=batch)
 
@@ -133,6 +127,18 @@ def compare_layers(master_type: str, slave_type: str,
         report["gin[%d]" % i] = float(rel_err(a, b))
     report.update(_tree_rel_errs("gw", gp_m, gp_s))
     return report
+
+
+def _check_param_layouts(params, sparams, tag: str) -> None:
+    """Master/slave weights are shared, so their trees must agree in
+    structure and leaf shapes (the reference syncs weights the same way,
+    pairtest_layer-inl.hpp:158-163)."""
+    if jax.tree.structure(sparams) != jax.tree.structure(params) or \
+       [np.shape(x) for x in jax.tree.leaves(sparams)] != \
+       [np.shape(x) for x in jax.tree.leaves(params)]:
+        raise ValueError(
+            "%s: master and slave parameter layouts differ; weights "
+            "cannot be synced" % tag)
 
 
 def assert_pair_ok(report: Dict[str, float],
@@ -195,12 +201,8 @@ class PairTestLayer(L.Layer):
     def init_params(self, rng):
         params = self.master.init_params(rng)
         if self.slave.has_params:
-            sparams = self.slave.init_params(rng)
-            if jax.tree.structure(sparams) != jax.tree.structure(params) or \
-               [np.shape(x) for x in jax.tree.leaves(sparams)] != \
-               [np.shape(x) for x in jax.tree.leaves(params)]:
-                raise ValueError(
-                    "%s: parameter layouts differ; cannot sync" % self.tag)
+            _check_param_layouts(params, self.slave.init_params(rng),
+                                 self.tag)
         return params
 
     def apply(self, params, inputs, ctx):

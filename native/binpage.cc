@@ -1,0 +1,193 @@
+// BinaryPage packfile reader/writer — bit-compatible with the reference
+// format (reference: src/utils/io.h:254-326) and with the Python
+// implementation in cxxnet_tpu/io/binpage.py:
+//   64MB pages of int32; data[0]=n objects, data[r+2]=cumulative end
+//   offset of object r, payload packed backward from the page end.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "native.h"
+
+namespace cxn {
+
+constexpr int64_t kPageSize = 64 << 18;           // ints per page
+constexpr int64_t kPageBytes = kPageSize * 4;     // 64 MB
+
+class BinaryPage {
+ public:
+  BinaryPage() : data_(kPageSize, 0) {}
+
+  int32_t size() const { return data_[0]; }
+
+  void Clear() { std::fill(data_.begin(), data_.end(), 0); }
+
+  bool Push(const uint8_t* obj, int64_t len) {
+    const int32_t n = size();
+    const int64_t used = data_[n + 1];
+    const int64_t free_bytes = (kPageSize - (n + 2)) * 4 - used;
+    if (free_bytes < len + 4) return false;
+    const int64_t end = used + len;
+    data_[n + 2] = static_cast<int32_t>(end);
+    uint8_t* base = reinterpret_cast<uint8_t*>(data_.data());
+    std::memcpy(base + kPageBytes - end, obj, len);
+    data_[0] = n + 1;
+    return true;
+  }
+
+  // Object r as (ptr, len) into the page buffer.
+  const uint8_t* Get(int r, int64_t* len) const {
+    const int64_t start = data_[r + 1];
+    const int64_t end = data_[r + 2];
+    *len = end - start;
+    return reinterpret_cast<const uint8_t*>(data_.data()) + kPageBytes - end;
+  }
+
+  uint8_t* Raw() { return reinterpret_cast<uint8_t*>(data_.data()); }
+  const uint8_t* Raw() const {
+    return reinterpret_cast<const uint8_t*>(data_.data());
+  }
+
+ private:
+  std::vector<int32_t> data_;
+};
+
+// Sequential reader over one or more packfiles.
+class PackfileReader {
+ public:
+  explicit PackfileReader(std::vector<std::string> paths)
+      : paths_(std::move(paths)) {}
+
+  ~PackfileReader() {
+    if (f_) std::fclose(f_);
+  }
+
+  void Reset() {
+    if (f_) std::fclose(f_);
+    f_ = nullptr;
+    file_idx_ = 0;
+    obj_idx_ = 0;
+    page_n_ = 0;
+  }
+
+  // Next object; returns false at end of all files.
+  bool Next(std::vector<uint8_t>* out) {
+    while (true) {
+      if (obj_idx_ < page_n_) {
+        int64_t len = 0;
+        const uint8_t* p = page_.Get(obj_idx_++, &len);
+        out->assign(p, p + len);
+        return true;
+      }
+      if (!LoadNextPage()) return false;
+    }
+  }
+
+ private:
+  bool LoadNextPage() {
+    while (true) {
+      if (!f_) {
+        if (file_idx_ >= paths_.size()) return false;
+        f_ = std::fopen(paths_[file_idx_].c_str(), "rb");
+        if (!f_) return false;
+      }
+      const size_t got = std::fread(page_.Raw(), 1, kPageBytes, f_);
+      if (got == static_cast<size_t>(kPageBytes)) {
+        page_n_ = page_.size();
+        obj_idx_ = 0;
+        if (page_n_ > 0) return true;
+        continue;  // empty page: keep reading
+      }
+      std::fclose(f_);
+      f_ = nullptr;
+      ++file_idx_;
+    }
+  }
+
+  std::vector<std::string> paths_;
+  std::FILE* f_ = nullptr;
+  size_t file_idx_ = 0;
+  BinaryPage page_;
+  int32_t page_n_ = 0;
+  int32_t obj_idx_ = 0;
+};
+
+PackfileReader* NewPackfileReader(const std::vector<std::string>& paths) {
+  return new PackfileReader(paths);
+}
+
+bool PackfileReaderNext(PackfileReader* r, std::vector<uint8_t>* out) {
+  return r->Next(out);
+}
+
+void PackfileReaderReset(PackfileReader* r) { r->Reset(); }
+
+void DeletePackfileReader(PackfileReader* r) { delete r; }
+
+}  // namespace cxn
+
+extern "C" {
+
+// ---- writer (the im2bin path, reference: tools/im2bin.cpp) ----
+
+struct CxnPacker {
+  std::FILE* f;
+  cxn::BinaryPage page;
+};
+
+void* cxn_packer_open(const char* path) {
+  std::FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  return new CxnPacker{f, {}};
+}
+
+int cxn_packer_push(void* h, const uint8_t* buf, int64_t len) {
+  CxnPacker* p = static_cast<CxnPacker*>(h);
+  if (p->page.Push(buf, len)) return 1;
+  if (std::fwrite(p->page.Raw(), 1, cxn::kPageBytes, p->f) !=
+      static_cast<size_t>(cxn::kPageBytes))
+    return 0;
+  p->page.Clear();
+  return p->page.Push(buf, len) ? 1 : 0;
+}
+
+int cxn_packer_close(void* h) {
+  CxnPacker* p = static_cast<CxnPacker*>(h);
+  int ok = 1;
+  if (p->page.size() > 0) {
+    ok = std::fwrite(p->page.Raw(), 1, cxn::kPageBytes, p->f) ==
+         static_cast<size_t>(cxn::kPageBytes);
+  }
+  std::fclose(p->f);
+  delete p;
+  return ok;
+}
+
+// ---- plain sequential reader (single-threaded; tests + fallback) ----
+
+void* cxn_reader_open(const char** paths, int npath) {
+  std::vector<std::string> v(paths, paths + npath);
+  return cxn::NewPackfileReader(v);
+}
+
+// Returns object length (>0), 0 at end. Buffer valid until next call.
+int64_t cxn_reader_next(void* h, const uint8_t** buf) {
+  auto* r = static_cast<cxn::PackfileReader*>(h);
+  static thread_local std::vector<uint8_t> scratch;
+  if (!r->Next(&scratch)) return 0;
+  *buf = scratch.data();
+  return static_cast<int64_t>(scratch.size());
+}
+
+void cxn_reader_reset(void* h) {
+  static_cast<cxn::PackfileReader*>(h)->Reset();
+}
+
+void cxn_reader_close(void* h) {
+  delete static_cast<cxn::PackfileReader*>(h);
+}
+
+}  // extern "C"

@@ -1,0 +1,156 @@
+// JPEG decode via libjpeg, emitting (3, h, w) float32 RGB planes —
+// the native decode path of the data loader (reference:
+// src/utils/decoder.h:21-60 uses the same libjpeg API for the imgbinx
+// iterator's parallel-decode variant).
+//
+// Greyscale JPEGs are broadcast to 3 channels, matching cv2.IMREAD_COLOR
+// behaviour in the Python fallback decoder (cxxnet_tpu/io/image.py).
+
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>  // jpeglib.h needs FILE declared first
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include <jpeglib.h>
+
+#include "native.h"
+
+namespace cxn {
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jmp;
+};
+
+void ErrorExit(j_common_ptr cinfo) {
+  ErrorMgr* err = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  std::longjmp(err->jmp, 1);
+}
+
+// Custom memory source manager: works on every libjpeg ABI (jpeg_mem_src
+// only exists on libjpeg>=8 / turbo).
+struct MemSrc {
+  jpeg_source_mgr pub;
+  const uint8_t* buf;
+  size_t len;
+};
+
+void InitSource(j_decompress_ptr) {}
+
+boolean FillInputBuffer(j_decompress_ptr cinfo) {
+  // Hitting this means truncated data; feed a fake EOI so libjpeg bails
+  // out gracefully instead of spinning.
+  static const JOCTET eoi[2] = {0xFF, JPEG_EOI};
+  cinfo->src->next_input_byte = eoi;
+  cinfo->src->bytes_in_buffer = 2;
+  return TRUE;
+}
+
+void SkipInputData(j_decompress_ptr cinfo, long n) {
+  jpeg_source_mgr* src = cinfo->src;
+  if (n <= 0) return;
+  if (static_cast<size_t>(n) > src->bytes_in_buffer) {
+    FillInputBuffer(cinfo);
+  } else {
+    src->next_input_byte += n;
+    src->bytes_in_buffer -= n;
+  }
+}
+
+void TermSource(j_decompress_ptr) {}
+
+void SetMemSrc(j_decompress_ptr cinfo, MemSrc* src, const uint8_t* buf,
+               size_t len) {
+  src->pub.init_source = InitSource;
+  src->pub.fill_input_buffer = FillInputBuffer;
+  src->pub.skip_input_data = SkipInputData;
+  src->pub.resync_to_restart = jpeg_resync_to_restart;
+  src->pub.term_source = TermSource;
+  src->pub.next_input_byte = buf;
+  src->pub.bytes_in_buffer = len;
+  src->buf = buf;
+  src->len = len;
+  cinfo->src = &src->pub;
+}
+
+}  // namespace
+
+bool IsJpeg(const uint8_t* buf, size_t len) {
+  return len > 3 && buf[0] == 0xFF && buf[1] == 0xD8;
+}
+
+// Decode JPEG bytes into out (resized to 3*h*w float32, plane-major RGB).
+bool DecodeJpeg(const uint8_t* buf, size_t len, std::vector<float>* out,
+                int* oc, int* oh, int* ow) {
+  if (!IsJpeg(buf, len)) return false;
+  jpeg_decompress_struct cinfo;
+  ErrorMgr err;
+  cinfo.err = jpeg_std_error(&err.pub);
+  err.pub.error_exit = ErrorExit;
+  if (setjmp(err.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  MemSrc src;
+  SetMemSrc(&cinfo, &src, buf, len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int w = cinfo.output_width;
+  const int h = cinfo.output_height;
+  const int nch = cinfo.output_components;  // 3 after JCS_RGB
+  std::vector<JSAMPLE> row(static_cast<size_t>(w) * nch);
+  out->resize(static_cast<size_t>(3) * h * w);
+  float* rp = out->data();
+  float* gp = rp + static_cast<size_t>(h) * w;
+  float* bp = gp + static_cast<size_t>(h) * w;
+  JSAMPROW rows[1] = {row.data()};
+  while (cinfo.output_scanline < cinfo.output_height) {
+    const int y = cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, rows, 1);
+    const JSAMPLE* p = row.data();
+    float* r = rp + static_cast<size_t>(y) * w;
+    float* g = gp + static_cast<size_t>(y) * w;
+    float* b = bp + static_cast<size_t>(y) * w;
+    if (nch >= 3) {
+      for (int x = 0; x < w; ++x) {
+        r[x] = p[x * nch];
+        g[x] = p[x * nch + 1];
+        b[x] = p[x * nch + 2];
+      }
+    } else {
+      for (int x = 0; x < w; ++x) r[x] = g[x] = b[x] = p[x];
+    }
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *oc = 3;
+  *oh = h;
+  *ow = w;
+  return true;
+}
+
+}  // namespace cxn
+
+extern "C" {
+
+// One-shot decode for tests / the img iterator. Returns 1 on success and
+// mallocs *out (caller frees with cxn_free).
+int cxn_decode_jpeg(const uint8_t* buf, int64_t len, float** out, int* c,
+                    int* h, int* w) {
+  std::vector<float> v;
+  if (!cxn::DecodeJpeg(buf, static_cast<size_t>(len), &v, c, h, w)) return 0;
+  *out = static_cast<float*>(std::malloc(v.size() * sizeof(float)));
+  if (!*out) return 0;
+  std::memcpy(*out, v.data(), v.size() * sizeof(float));
+  return 1;
+}
+
+void cxn_free(void* p) { std::free(p); }
+
+}  // extern "C"

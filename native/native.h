@@ -1,0 +1,28 @@
+// Internal interfaces of the native runtime library.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace cxn {
+
+bool IsJpeg(const uint8_t* buf, size_t len);
+
+// Decode JPEG bytes to (3, h, w) float32 RGB planes. Returns false on
+// non-JPEG / corrupt input.
+bool DecodeJpeg(const uint8_t* buf, size_t len, std::vector<float>* out,
+                int* c, int* h, int* w);
+
+class PackfileReader;
+
+// Owns the FILE handles for a list of packfiles; Next() yields objects
+// in file order.
+PackfileReader* NewPackfileReader(
+    const std::vector<std::string>& paths);
+bool PackfileReaderNext(PackfileReader* r, std::vector<uint8_t>* out);
+void PackfileReaderReset(PackfileReader* r);
+void DeletePackfileReader(PackfileReader* r);
+
+}  // namespace cxn

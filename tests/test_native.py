@@ -1,0 +1,151 @@
+"""Native C++ runtime tests: decoder parity with the Python/cv2 path,
+BinaryPage cross-implementation roundtrips, threaded ordered loader, and
+the imgbin iterator native-vs-Python differential (the pairtest
+discipline applied to the IO layer — reference validates layers this way
+via src/layer/pairtest_layer-inl.hpp; we apply it to IO too)."""
+import os
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from cxxnet_tpu import native
+from cxxnet_tpu.io import binpage, create_iterator
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable")
+
+
+def _jpeg(rs, h=32, w=40):
+    img = rs.randint(0, 255, size=(h, w, 3), dtype=np.uint8)
+    ok, enc = cv2.imencode(".jpg", img)
+    assert ok
+    return enc.tobytes()
+
+
+def test_decoder_matches_cv2():
+    rs = np.random.RandomState(0)
+    for shape in [(32, 40), (1, 1), (211, 13)]:
+        buf = _jpeg(rs, *shape)
+        a = native.decode_jpeg(buf)
+        bgr = cv2.imdecode(np.frombuffer(buf, np.uint8), cv2.IMREAD_COLOR)
+        ref = bgr[:, :, ::-1].astype(np.float32).transpose(2, 0, 1)
+        assert a.shape == ref.shape
+        assert np.abs(a - ref).max() == 0
+
+
+def test_decoder_greyscale_broadcasts():
+    rs = np.random.RandomState(1)
+    img = rs.randint(0, 255, size=(20, 30), dtype=np.uint8)
+    ok, enc = cv2.imencode(".jpg", img)
+    a = native.decode_jpeg(enc.tobytes())
+    assert a.shape == (3, 20, 30)
+    assert np.array_equal(a[0], a[1]) and np.array_equal(a[1], a[2])
+
+
+def test_decoder_rejects_non_jpeg():
+    assert native.decode_jpeg(b"definitely not a jpeg") is None
+    # PNG magic: not handled natively -> None (Python cv2 fallback used)
+    assert native.decode_jpeg(b"\x89PNG\r\n\x1a\n" + b"0" * 64) is None
+
+
+def test_binpage_native_write_python_read(tmp_path):
+    rs = np.random.RandomState(2)
+    objs = [rs.bytes(int(rs.randint(1, 100000))) for _ in range(100)]
+    p = str(tmp_path / "a.bin")
+    with native.NativePacker(p) as w:
+        for o in objs:
+            w.push(o)
+    assert os.path.getsize(p) % binpage.PAGE_BYTES == 0
+    assert list(binpage.iter_packfile(p)) == objs
+
+
+def test_binpage_python_write_native_read(tmp_path):
+    rs = np.random.RandomState(3)
+    objs = [rs.bytes(int(rs.randint(1, 100000))) for _ in range(100)]
+    p = str(tmp_path / "b.bin")
+    with binpage.BinaryPageWriter(p) as w:
+        for o in objs:
+            w.push(o)
+    assert list(native.iter_packfile_native([p])) == objs
+
+
+def test_native_reader_multifile(tmp_path):
+    rs = np.random.RandomState(4)
+    all_objs = []
+    paths = []
+    for k in range(3):
+        objs = [rs.bytes(int(rs.randint(1, 5000))) for _ in range(20)]
+        p = str(tmp_path / ("p%d.bin" % k))
+        with binpage.BinaryPageWriter(p) as w:
+            for o in objs:
+                w.push(o)
+        all_objs += objs
+        paths.append(p)
+    assert list(native.iter_packfile_native(paths)) == all_objs
+
+
+def test_threaded_loader_order_and_epochs(tmp_path):
+    rs = np.random.RandomState(5)
+    bufs = [_jpeg(rs, 16 + i % 7, 24) for i in range(60)]
+    p = str(tmp_path / "c.bin")
+    with native.NativePacker(p) as w:
+        for b in bufs:
+            w.push(b)
+        w.push(b"raw-object")  # non-JPEG falls back to raw bytes
+    ld = native.NativeDecodeLoader([p], nthread=4, capacity=8)
+    for _ in range(2):  # restartability (before_first each epoch)
+        ld.before_first()
+        n = 0
+        while True:
+            kind, val = ld.next()
+            if kind is None:
+                break
+            if n < 60:
+                assert kind == "img"
+                bgr = cv2.imdecode(np.frombuffer(bufs[n], np.uint8),
+                                   cv2.IMREAD_COLOR)
+                ref = bgr[:, :, ::-1].astype(np.float32).transpose(2, 0, 1)
+                assert np.abs(val - ref).max() == 0
+            else:
+                assert kind == "raw" and val == b"raw-object"
+            n += 1
+        assert n == 61
+    ld.close()
+
+
+def _make_imgbin(tmp_path, n=10):
+    rs = np.random.RandomState(6)
+    root = tmp_path / "imgs"
+    root.mkdir(exist_ok=True)
+    lines = []
+    for i in range(n):
+        img = rs.randint(0, 255, size=(24, 24, 3), dtype=np.uint8)
+        cv2.imwrite(str(root / ("%d.jpg" % i)), img)
+        lines.append("%d\t%d\t%d.jpg" % (i, i % 3, i))
+    lst = tmp_path / "data.lst"
+    lst.write_text("\n".join(lines) + "\n")
+    binpage.pack_images(str(lst), str(root), str(tmp_path / "data.bin"),
+                        silent=True)
+    return str(lst), str(tmp_path / "data.bin")
+
+
+def test_imgbin_iterator_native_matches_python(tmp_path):
+    lst, bin_path = _make_imgbin(tmp_path)
+    batches = {}
+    for nat in (0, 1):
+        it = create_iterator(
+            [("iter", "imgbin"), ("image_list", lst),
+             ("image_bin", bin_path), ("native_decode", str(nat)),
+             ("input_shape", "3,20,20"), ("batch_size", "5"),
+             ("silent", "1"), ("iter", "end")])
+        it.before_first()
+        out = []
+        while it.next():
+            out.append((it.value.data.copy(), it.value.label.copy()))
+        batches[nat] = out
+    assert len(batches[0]) == len(batches[1]) == 2
+    for (d0, l0), (d1, l1) in zip(batches[0], batches[1]):
+        assert np.array_equal(d0, d1)
+        assert np.array_equal(l0, l1)

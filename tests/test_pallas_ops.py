@@ -79,3 +79,22 @@ def test_lrn_layer_use_pallas_flag():
     np.testing.assert_allclose(
         np.asarray(lay.apply({}, [x], ctx)[0]),
         np.asarray(lay2.apply({}, [x], ctx)[0]), rtol=1e-6, atol=1e-7)
+
+
+def test_lrn_window_wider_than_channels():
+    """local_size half-extent > C must clamp, matching reduce_window
+    (regression: the unrolled shift produced a wrong-shaped tile)."""
+    import jax
+    import jax.numpy as jnp
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 3, 4, 5).astype(np.float32))
+    nsize, alpha, beta, knorm = 9, 0.001, 0.75, 1.0
+    got = np.asarray(lrn_pallas(x, nsize, alpha, beta, knorm))
+    # reference: full cross-channel sum (window covers all 3 channels)
+    s = knorm + (alpha / nsize) * np.asarray(
+        (x * x).sum(axis=1, keepdims=True))
+    want = np.asarray(x) * s ** (-beta)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # grad path too
+    g = jax.grad(lambda t: lrn_pallas(t, nsize, alpha, beta, knorm).sum())(x)
+    assert np.isfinite(np.asarray(g)).all()
