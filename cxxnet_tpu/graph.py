@@ -43,8 +43,9 @@ KNOWN_LAYER_TYPES = frozenset([
     "max_pooling", "sum_pooling", "avg_pooling", "lrn", "concat", "xelu",
     "split", "insanity", "insanity_max_pooling", "l2_loss",
     "multi_logistic", "ch_concat", "prelu", "batch_norm",
-    # TPU-native additions: forced-Pallas variants for differential testing
-    "lrn_pallas",
+    # TPU-native additions: forced-Pallas variants for differential testing,
+    # and the long-context attention layer (ring attention under seq_parallel)
+    "lrn_pallas", "attention",
 ])
 
 # self-loop loss layers (in == out node); see src/layer/loss/

@@ -161,6 +161,10 @@ class ApplyContext:
     epoch: jnp.ndarray = 0                     # update counter (may be traced)
     losses: List[jnp.ndarray] = field(default_factory=list)
     compute_dtype: jnp.dtype = jnp.float32
+    # sequence parallelism: when set, attention layers run ring attention
+    # sharded over this mesh axis (cxxnet_tpu/ops/ring_attention.py)
+    mesh: Optional[object] = None
+    seq_axis: Optional[str] = None
 
 
 def _mat(x: jnp.ndarray) -> jnp.ndarray:
@@ -930,6 +934,75 @@ class _LossLayer(Layer):
 
     def apply(self, params, inputs, ctx):
         raise NotImplementedError
+
+
+@register("attention")
+class AttentionLayer(Layer):
+    """Multi-head self-attention over a (batch, 1, seq, embed) node.
+
+    The reference has no sequence models (SURVEY.md §5), but long-context
+    is first-class here: node layout (b, 1, s, e) treats h as the sequence
+    axis and w as the embedding. Config keys: ``nhead`` (default 1),
+    ``causal`` (0/1). Parameters: ``wqkv`` (3e, e) and ``wo`` (e, e),
+    reference-style (out, in) row-major matrices.
+
+    When the trainer builds a mesh with a ``seq`` axis (``seq_parallel``
+    config), the score computation runs as ring attention sharded over
+    that axis (cxxnet_tpu/ops/ring_attention.py): K/V shards rotate via
+    ppermute while each chip holds only its local sequence block —
+    sequences longer than one chip's HBM train exactly.
+    """
+    has_params = True
+    param_tags = ("wqkv", "wo")  # tag-scoped hyperparams: wqkv:lr etc.
+
+    def __init__(self):
+        super().__init__()
+        self.nhead = 1
+        self.causal = 0
+
+    def set_param(self, name, val):
+        if name == "nhead":
+            self.nhead = int(val)
+        elif name == "causal":
+            self.causal = int(val)
+        else:
+            super().set_param(name, val)
+
+    def _infer(self, in_shapes):
+        n, c, s, e = in_shapes[0]
+        if c != 1:
+            raise ValueError("attention: input must be (batch,1,seq,embed)")
+        if e % self.nhead != 0:
+            raise ValueError("attention: embed %d not divisible by nhead %d"
+                             % (e, self.nhead))
+        return [(n, 1, s, e)]
+
+    def init_params(self, rng) -> Params:
+        e = self.in_shapes[0][3]
+        p = self.param
+        r1, r2 = jax.random.split(rng)
+        return {"wqkv": p.rand_init_weight(r1, (3 * e, e), e, 3 * e),
+                "wo": p.rand_init_weight(r2, (e, e), e, e)}
+
+    def apply(self, params, inputs, ctx):
+        from .ops import ring_attention as ra
+        b, _, s, e = inputs[0].shape
+        nh, d = self.nhead, e // self.nhead
+        dt = ctx.compute_dtype
+        x = inputs[0].reshape(b, s, e).astype(dt)
+        qkv = jnp.einsum("bse,fe->bsf", x, params["wqkv"].astype(dt))
+        qkv = qkv.reshape(b, s, 3, nh, d).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        mesh, axis = ctx.mesh, ctx.seq_axis
+        if mesh is not None and axis is not None \
+                and mesh.shape.get(axis, 1) > 1:
+            out = ra.sharded_attention(mesh, q, k, v, seq_axis=axis,
+                                       causal=bool(self.causal))
+        else:
+            out = ra.attention(q, k, v, causal=bool(self.causal))
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, e)
+        out = jnp.einsum("bse,fe->bsf", out, params["wo"].astype(dt))
+        return [out.reshape(b, 1, s, e).astype(jnp.float32)]
 
 
 @register("softmax")

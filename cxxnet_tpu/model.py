@@ -47,6 +47,8 @@ class Network:
         self.modules: List[L.Layer] = []
         self.node_shapes: List[Optional[Tuple[int, ...]]] = (
             [None] * net_cfg.num_nodes)
+        self.mesh = None       # set by the trainer for sequence parallelism
+        self.seq_axis: Optional[str] = None
 
         c, h, w = net_cfg.input_shape
         self.node_shapes[0] = (batch_size, c, h, w)
@@ -127,7 +129,8 @@ class Network:
         ctx = L.ApplyContext(
             train=train, rng=rng, labels=labels,
             batch_size=self.batch_size, update_period=self.update_period,
-            epoch=epoch, compute_dtype=self.compute_dtype)
+            epoch=epoch, compute_dtype=self.compute_dtype,
+            mesh=self.mesh, seq_axis=self.seq_axis)
         values: Dict[int, jnp.ndarray] = {0: data}
         for i, x in enumerate(extra_data):
             values[i + 1] = x

@@ -218,3 +218,29 @@ layer[+0] = softmax
 netconfig=end
 input_shape = 3,32,32
 """
+
+
+def seq_classifier(seq_len: int = 16, embed: int = 32, nhead: int = 4,
+                   nclass: int = 10, causal: int = 0) -> str:
+    """Attention-based sequence classifier (no reference equivalent —
+    cxxnet has no sequence models, SURVEY.md §5; this exercises the
+    long-context path: the attention layer runs ring attention when
+    ``seq_parallel`` shards the sequence over the mesh)."""
+    return f"""
+netconfig=start
+layer[0->1] = attention:att1
+  nhead = {nhead}
+  causal = {causal}
+  random_type = xavier
+layer[1->2] = attention:att2
+  nhead = {nhead}
+  causal = {causal}
+  random_type = xavier
+layer[2->3] = flatten
+layer[3->4] = fullc:fc1
+  nhidden = {nclass}
+  init_sigma = 0.01
+layer[4->4] = softmax
+netconfig=end
+input_shape = 1,{seq_len},{embed}
+"""

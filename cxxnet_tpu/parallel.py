@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
 
 
 def force_host_cpu(n_devices: int = 8) -> None:
@@ -89,21 +90,44 @@ def select_devices(dev: str) -> List[jax.Device]:
 
 
 def make_mesh(devices: Sequence[jax.Device],
-              model_parallel: int = 1) -> Mesh:
-    """1D data mesh, or 2D (data, model) when tensor parallelism is on."""
+              model_parallel: int = 1,
+              seq_parallel: int = 1) -> Mesh:
+    """Device mesh over (data[, model][, seq]) axes.
+
+    1D data mesh by default; a ``model`` axis when tensor parallelism is
+    on; a ``seq`` axis when sequence parallelism is on (ring attention
+    shards the sequence over it — cxxnet_tpu/ops/ring_attention.py)."""
     devs = np.asarray(devices)
+    inner = model_parallel * seq_parallel
+    if len(devs) % inner != 0:
+        raise ValueError(
+            "#devices %d not divisible by model_parallel*seq_parallel %d"
+            % (len(devs), inner))
+    axes = [DATA_AXIS]
+    shape = [len(devs) // inner]
     if model_parallel > 1:
-        if len(devs) % model_parallel != 0:
-            raise ValueError("#devices %d not divisible by model_parallel %d"
-                             % (len(devs), model_parallel))
-        devs = devs.reshape(len(devs) // model_parallel, model_parallel)
-        return Mesh(devs, (DATA_AXIS, MODEL_AXIS))
-    return Mesh(devs, (DATA_AXIS,))
+        axes.append(MODEL_AXIS)
+        shape.append(model_parallel)
+    if seq_parallel > 1:
+        axes.append(SEQ_AXIS)
+        shape.append(seq_parallel)
+    return Mesh(devs.reshape(shape), tuple(axes))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Batch axis sharded across the data axis of the mesh."""
     return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def input_sharding(mesh: Mesh, shape: Tuple[int, ...]) -> NamedSharding:
+    """Placement for the network's input node: batch over ``data``, and —
+    when the mesh has a ``seq`` axis and the node is sequence-shaped
+    (b, 1, s, e) with s divisible — the sequence dim over ``seq``, so
+    long-context activations never materialise unsharded."""
+    if SEQ_AXIS in mesh.shape and len(shape) == 4 and shape[1] == 1 \
+            and shape[2] % mesh.shape[SEQ_AXIS] == 0:
+        return NamedSharding(mesh, P(DATA_AXIS, None, SEQ_AXIS, None))
+    return batch_sharding(mesh)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -44,6 +44,7 @@ class Trainer:
         self.dev = "tpu"
         self.compute_dtype = "float32"
         self.model_parallel = 1
+        self.seq_parallel = 1
         self.epoch_counter = 0
         self.sample_counter = 0
         self.round = 0
@@ -78,6 +79,8 @@ class Trainer:
             self.compute_dtype = val
         elif name == "model_parallel":
             self.model_parallel = int(val)
+        elif name == "seq_parallel":
+            self.seq_parallel = int(val)
         if name.startswith("metric"):
             import re
             m = re.match(r"metric\[([^,\]]+),([^\]]+)\]", name)
@@ -117,15 +120,17 @@ class Trainer:
         # device mesh (replaces InitParamServer + per-device threads)
         devices = parallel.select_devices(self.dev)
         mp = self.model_parallel
-        if len(devices) % mp != 0:
+        sp = self.seq_parallel
+        inner = mp * sp
+        if len(devices) % inner != 0:
             raise ValueError(
-                "model_parallel=%d does not divide %d devices"
-                % (mp, len(devices)))
+                "model_parallel=%d * seq_parallel=%d does not divide %d "
+                "devices" % (mp, sp, len(devices)))
         if jax.process_count() > 1:
             # trimming devices could orphan a whole process's chips;
             # require an even split instead, with data shards aligned to
             # process boundaries so each process feeds exactly its rows
-            dp = len(devices) // mp
+            dp = len(devices) // inner
             if self.global_batch % dp != 0:
                 raise ValueError(
                     "global batch %d not divisible over %d data-parallel "
@@ -138,13 +143,17 @@ class Trainer:
             ndev = len(devices)
         else:
             ndata = parallel.fit_devices_to_batch(
-                len(devices) // mp, self.global_batch)
-            ndev = ndata * mp
+                len(devices) // inner, self.global_batch)
+            ndev = ndata * inner
             if ndev != len(devices) and self.silent == 0:
                 print("Warning: using %d of %d devices to split "
                       "batch_size=%d" % (ndev, len(devices), self.batch_size))
-        self.mesh = parallel.make_mesh(devices[:ndev], model_parallel=mp)
+        self.mesh = parallel.make_mesh(devices[:ndev], model_parallel=mp,
+                                       seq_parallel=sp)
         self.n_devices = ndev
+        if sp > 1:
+            self.net.mesh = self.mesh
+            self.net.seq_axis = parallel.SEQ_AXIS
         # resolve eval node requests (reference nnet_impl-inl.hpp:363-374)
         self.eval_req: List[int] = []
         for name, kind in self.eval_nodes:
@@ -176,6 +185,8 @@ class Trainer:
         self.opt = opt
         rep = parallel.replicated(self.mesh)
         dsh = parallel.batch_sharding(self.mesh)
+        # input node: additionally sharded over the seq axis when present
+        xsh = parallel.input_sharding(self.mesh, self.net.node_shapes[0])
         psh = self._param_shardings(params)
         # optimizer slots shard exactly like their weights
         osh = []
@@ -187,7 +198,7 @@ class Trainer:
                             for tag, slots in s.items()})
         self.params = jax.device_put(params, psh)
         self.opt_state = jax.device_put(opt_state, osh)
-        self._psh, self._dsh = psh, dsh
+        self._psh, self._dsh, self._xsh = psh, dsh, xsh
         gsh = [s or {} for s in psh]  # grad tree shardings (None -> {})
         if self.update_period > 1:
             zeros = jax.tree.map(jnp.zeros_like, _strip_nones(self.params))
@@ -231,21 +242,27 @@ class Trainer:
                                   train=False)
             return tuple(values[i] for i in node_ids)
 
+        # out_shardings pin params/opt-state to their declared placement:
+        # without them XLA's sharding propagation may reshard an output
+        # (e.g. over the seq axis), desyncing from in_shardings next step
         self._train_step = jax.jit(
             train_step, donate_argnums=(0, 1),
-            in_shardings=(psh, osh, dsh, dsh, dsh, rep, rep))
+            in_shardings=(psh, osh, xsh, dsh, dsh, rep, rep),
+            out_shardings=(psh, osh, None, None))
         self._accum_step = jax.jit(
             accum_step, donate_argnums=(0,),
-            in_shardings=(gsh, psh, dsh, dsh, dsh, rep, rep))
+            in_shardings=(gsh, psh, xsh, dsh, dsh, rep, rep),
+            out_shardings=(gsh, None, None))
         self._apply_accum = jax.jit(
             apply_accum, donate_argnums=(0, 1, 2),
-            in_shardings=(psh, osh, gsh, rep))
+            in_shardings=(psh, osh, gsh, rep),
+            out_shardings=(psh, osh, gsh))
         self._forward = jax.jit(
-            forward_step, in_shardings=(psh, dsh, dsh),
+            forward_step, in_shardings=(psh, xsh, dsh),
             static_argnums=(3,))
 
     # ------------------------------------------------------------------
-    def _put_data(self, arr) -> jnp.ndarray:
+    def _put_data(self, arr, sharding=None) -> jnp.ndarray:
         """Host array -> device array under the batch sharding. Multi-host:
         each process holds its local shard of the global batch, so assemble
         a global jax.Array (the PS-era per-worker data sharding,
@@ -253,7 +270,8 @@ class Trainer:
         local data here)."""
         arr = np.asarray(arr, np.float32)
         if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(self._dsh, arr)
+            return jax.make_array_from_process_local_data(
+                sharding or self._dsh, arr)
         return jnp.asarray(arr)
 
     def _fetch_local(self, x) -> np.ndarray:
@@ -312,7 +330,7 @@ class Trainer:
     # ------------------------------------------------------------------
     def update(self, batch: DataBatch) -> None:
         """One minibatch of training (reference: nnet_impl-inl.hpp:141-185)."""
-        data = self._put_data(batch.data)
+        data = self._put_data(batch.data, self._xsh)
         extras = self._extra_fields(batch)
         labels = self._label_fields(batch)
         self._step_count += 1
@@ -341,7 +359,7 @@ class Trainer:
     # ------------------------------------------------------------------
     def forward_nodes(self, batch: DataBatch,
                       node_ids: Sequence[int]) -> List[np.ndarray]:
-        data = self._put_data(batch.data)
+        data = self._put_data(batch.data, self._xsh)
         extras = self._extra_fields(batch)
         values = self._forward(self.params, data, extras, tuple(node_ids))
         return [self._fetch_local(v) for v in values]

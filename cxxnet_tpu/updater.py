@@ -242,9 +242,10 @@ class NetUpdater:
                 self.updaters.append(None)
                 continue
             layer_cfgs = (cfg.defcfg, cfg.layercfg[li])
+            tags = getattr(mod, "param_tags", ("wmat", "bias"))
             self.updaters.append({
                 tag: create_tensor_updater(kind, tag, layer_cfgs)
-                for tag in ("wmat", "bias")})
+                for tag in tags})
         self._kind = kind
 
     def init_state(self, params):
