@@ -37,10 +37,14 @@ def main() -> None:
     tr = ge._build_trainer(batch_size=BATCH, nclass=1000, dev=platform,
                            dtype=dtype, eval_train=0)
 
+    # raw uint8 pixels + deferred on-device normalization: exactly what the
+    # imgbin pipeline emits with on_device_norm=1 (JPEG decode -> uint8
+    # crop/mirror on host, (x-mean)*scale fused into the jitted step)
     rs = np.random.RandomState(0)
     batch = DataBatch(
-        data=rs.randn(BATCH, 3, 227, 227).astype(np.float32),
-        label=rs.randint(0, 1000, size=(BATCH, 1)).astype(np.float32))
+        data=rs.randint(0, 256, size=(BATCH, 3, 227, 227), dtype=np.uint8),
+        label=rs.randint(0, 1000, size=(BATCH, 1)).astype(np.float32),
+        norm=(np.full((3, 1, 1), 120.0, np.float32), 1.0))
 
     for _ in range(WARMUP):
         tr.update(batch)

@@ -18,6 +18,7 @@ import numpy as np
 
 from . import checkpoint, config
 from .io import DataIterator, create_iterator
+from .profiler import StepTimer, TraceSession, device_memory_summary
 from .trainer import Trainer
 
 ConfigEntry = Tuple[str, str]
@@ -46,6 +47,8 @@ class LearnTask:
         self.print_step = 100
         self.extract_node_name = ""
         self.output_format = 1
+        self.trace = TraceSession()
+        self.timer = StepTimer()
 
     # ------------------------------------------------------------------
     def set_param(self, name: str, val: str) -> None:
@@ -80,6 +83,7 @@ class LearnTask:
             self.extract_node_name = val
         elif name == "output_format":
             self.output_format = 1 if val == "txt" else 0
+        self.trace.set_param(name, val)
         self.cfg.append((name, val))
 
     # ------------------------------------------------------------------
@@ -220,10 +224,13 @@ class LearnTask:
                 sys.stdout.flush()
             sample_counter = 0
             self.trainer.start_round(self.start_counter)
+            self.timer.reset_clock()
             self.itr_train.before_first()
             while self.itr_train.next():
                 if self.test_io == 0:
-                    self.trainer.update(self.itr_train.value)
+                    with self.trace.step():
+                        self.trainer.update(self.itr_train.value)
+                    self.timer.tick()
                 sample_counter += 1
                 if sample_counter % self.print_step == 0 and not self.silent:
                     elapsed = int(time.time() - start)
@@ -240,7 +247,16 @@ class LearnTask:
                     sys.stderr.write(self.trainer.evaluate(itr, name))
                 sys.stderr.write("\n")
                 sys.stderr.flush()
+            if not self.silent:
+                print("\nround %d speed: %s" % (
+                    self.start_counter,
+                    self.timer.summary(self.trainer.batch_size)))
+                if self.trace.enabled:
+                    mem = device_memory_summary()
+                    if mem:
+                        print("device memory: %s" % mem)
             self.save_model_file()
+        self.trace.close()
         if not self.silent:
             print("\nupdating end, %d sec in all" % int(time.time() - start))
 

@@ -32,6 +32,12 @@ class DataBatch:
     num_batch_padd: int = 0
     extra_data: List[np.ndarray] = field(default_factory=list)
     inst_index: Optional[np.ndarray] = None
+    # deferred normalization (mean, scale): set when the augmenter runs
+    # with on_device_norm=1 — data is raw uint8 pixels and the trainer
+    # applies (x - mean) * scale inside the jitted step. Pixels then cross
+    # host->device as 1 byte instead of 4 (the TPU-native input path; the
+    # reference always normalizes on the host, iter_augment_proc-inl.hpp)
+    norm: Optional[Tuple[np.ndarray, float]] = None
 
     @property
     def batch_size(self) -> int:
@@ -312,13 +318,15 @@ class MemBufferIterator(DataIterator):
         while self.base.next():
             b = self.base.value
             # deep copy: base iterators are free to reuse their buffers
+            # (dtype preserved: uint8 raw-pixel batches stay uint8)
             self._buffer.append(DataBatch(
-                data=np.array(b.data, np.float32),
+                data=np.array(b.data),
                 label=np.array(b.label, np.float32),
                 num_batch_padd=b.num_batch_padd,
                 extra_data=[np.array(e) for e in b.extra_data],
                 inst_index=None if b.inst_index is None
-                else np.array(b.inst_index)))
+                else np.array(b.inst_index),
+                norm=b.norm))
             if len(self._buffer) >= self.max_nbatch:
                 break
         if self.silent == 0:
@@ -402,7 +410,8 @@ class AttachTxtIterator(DataIterator):
         # attachtxt iterators feed in_1, in_2, ... in chain order
         self._batch = DataBatch(
             data=b.data, label=b.label, num_batch_padd=b.num_batch_padd,
-            extra_data=list(b.extra_data) + [extra], inst_index=b.inst_index)
+            extra_data=list(b.extra_data) + [extra], inst_index=b.inst_index,
+            norm=b.norm)
         return True
 
     @property

@@ -57,6 +57,13 @@ class InstIterator:
         raise NotImplementedError
 
 
+def _to_u8(img: np.ndarray) -> np.ndarray:
+    """Round pixel data back to raw uint8 (deferred-norm path)."""
+    if img.dtype == np.uint8:
+        return img
+    return np.clip(np.rint(img), 0, 255).astype(np.uint8)
+
+
 def _decode_image(buf: bytes) -> np.ndarray:
     """JPEG/PNG bytes -> (3, h, w) float32 RGB in [0, 255]."""
     import cv2
@@ -289,7 +296,16 @@ class AugmentIterator(InstIterator):
     """Per-instance augmentation (reference: src/io/iter_augment_proc-inl.hpp:21-248):
     affine warp (rotate/shear/scale/aspect), crop (random / fixed-start /
     center), mirror, mean image (computed+cached) or mean_value subtract,
-    contrast/illumination jitter, final scale."""
+    contrast/illumination jitter, final scale.
+
+    ``on_device_norm = 1`` defers mean-subtract and scale to the device:
+    instances stay raw uint8 pixels (4x less host->device traffic) and the
+    batcher stamps ``DataBatch.norm`` so the trainer fuses
+    ``(x - mean) * scale`` into the jitted step. Geometric augmentation
+    (warp/crop/mirror) still happens here; contrast/illumination jitter is
+    folded into the pixels. Not exactly bitwise-identical to host
+    normalization (pixels are rounded back to uint8 after jitter), but
+    jitter-free pipelines match to float32 precision."""
 
     def __init__(self, base: InstIterator) -> None:
         self.base = base
@@ -317,6 +333,7 @@ class AugmentIterator(InstIterator):
         self.rotate = -1
         self.rotate_list: List[int] = []
         self.seed = 0
+        self.on_device_norm = 0
         self._meanimg = None
         self._value: Optional[DataInst] = None
 
@@ -371,6 +388,8 @@ class AugmentIterator(InstIterator):
             self.rotate = int(val)
         elif name == "rotate_list":
             self.rotate_list = [int(t) for t in val.split(",") if t]
+        elif name == "on_device_norm":
+            self.on_device_norm = int(val)
 
     # ------------------------------------------------------------------
     def init(self):
@@ -383,6 +402,34 @@ class AugmentIterator(InstIterator):
                 self._meanimg = _load_mean(self.name_meanimg)
             else:
                 self._create_mean_img()
+        if self.on_device_norm and self._meanimg is not None:
+            c, th, tw = self.shape
+            if th > 1 and self._meanimg.shape != (c, th, tw):
+                # host path subtracts the full-size mean *before* the random
+                # crop (iter_augment_proc-inl.hpp); a device-side mean can
+                # only match when it has the crop shape
+                if self.silent == 0:
+                    print("on_device_norm: mean image shape %s != input "
+                          "shape %s, normalizing on host instead"
+                          % (self._meanimg.shape, (c, th, tw)))
+                self.on_device_norm = 0
+
+    def _device_mean(self):
+        """Mean in instance layout for the deferred (on-device) path."""
+        if self.mean_rgb is not None:
+            return np.asarray(self.mean_rgb, np.float32).reshape(3, 1, 1)
+        if self._meanimg is not None:
+            return self._meanimg
+        return np.float32(0.0)
+
+    @property
+    def deferred_norm(self):
+        """(mean, scale) to apply on device, or None."""
+        if not self.on_device_norm:
+            return None
+        if self.shape[1] == 1:  # flat path is scale-only on the host too
+            return (np.float32(0.0), self.scale)
+        return (self._device_mean(), self.scale)
 
     def before_first(self):
         self.base.before_first()
@@ -437,6 +484,14 @@ class AugmentIterator(InstIterator):
         c, th, tw = self.shape
         rng = self._rng
         if th == 1:  # flat input: scale only (iter_augment_proc:108-110)
+            # defer only for genuinely-uint8 sources: quantizing arbitrary
+            # flat float features through _to_u8 would destroy them, and
+            # the host flat path applies no mean either (deferred_norm
+            # reports mean 0 for flat shapes)
+            if self.on_device_norm:
+                if data.dtype == np.uint8:
+                    return DataInst(d.index, d.label, data)
+                self.on_device_norm = 0  # sticky fallback for the run
             return DataInst(d.index, d.label,
                             (data * self.scale).astype(np.float32))
         if data.shape[1] < th or data.shape[2] < tw:
@@ -463,6 +518,17 @@ class AugmentIterator(InstIterator):
                 - self.max_random_illumination
         do_mirror = (self.rand_mirror != 0 and rng.rand() < 0.5) \
             or self.mirror == 1
+
+        if self.on_device_norm:
+            img = data[:, yy:yy + th, xx:xx + tw]
+            if contrast != 1.0 or illumination != 0.0:
+                # fold jitter into the pixels around the (deferred) mean so
+                # the device's (x - mean) * scale sees the jittered value
+                mean = self._device_mean()
+                img = mean + (img - mean) * contrast + illumination
+            if do_mirror:
+                img = img[:, :, ::-1]
+            return DataInst(d.index, d.label, _to_u8(img))
 
         if self.mean_rgb is not None:
             img = data - np.asarray(self.mean_rgb,
@@ -592,7 +658,11 @@ class BatchAdaptIterator(DataIterator):
     def _store(self, data, label, inst_index, top, d: DataInst):
         label[top] = d.label
         inst_index[top] = d.index
-        data[top] = d.data.reshape(self._dshape[1:])
+        if data[0] is None:
+            # allocate from the first instance's dtype: uint8 raw-pixel
+            # batches (deferred norm) stay uint8 end to end
+            data[0] = np.zeros(self._dshape, d.data.dtype)
+        data[0][top] = d.data.reshape(self._dshape[1:])
 
     def next(self):
         if self.test_skipread != 0 and self._head == 0:
@@ -600,7 +670,7 @@ class BatchAdaptIterator(DataIterator):
         self._head = 0
         if self._num_overflow != 0:
             return False
-        data = np.zeros(self._dshape, np.float32)
+        data = [None]  # boxed; allocated lazily by _store
         label = np.zeros((self.batch_size, self.label_width), np.float32)
         inst_index = np.zeros(self.batch_size, np.int64)
         top = 0
@@ -608,8 +678,11 @@ class BatchAdaptIterator(DataIterator):
             self._store(data, label, inst_index, top, self.base.value)
             top += 1
             if top >= self.batch_size:
-                self._batch = DataBatch(data, label, 0,
-                                        inst_index=inst_index)
+                # read deferred_norm AFTER processing: the augmenter may
+                # disable deferral when it first sees the real data
+                norm = getattr(self.base, "deferred_norm", None)
+                self._batch = DataBatch(data[0], label, 0,
+                                        inst_index=inst_index, norm=norm)
                 return True
         if top != 0:
             if self.round_batch != 0:
@@ -625,7 +698,9 @@ class BatchAdaptIterator(DataIterator):
                 padd = self._num_overflow
             else:
                 padd = self.batch_size - top
-            self._batch = DataBatch(data, label, padd, inst_index=inst_index)
+            norm = getattr(self.base, "deferred_norm", None)
+            self._batch = DataBatch(data[0], label, padd,
+                                    inst_index=inst_index, norm=norm)
             return True
         return False
 

@@ -49,6 +49,10 @@ class Network:
             [None] * net_cfg.num_nodes)
         self.mesh = None       # set by the trainer for sequence parallelism
         self.seq_axis: Optional[str] = None
+        # deferred input normalization (mean, scale): applied on-device to
+        # uint8 input batches so raw pixels cross host->device as 1 byte
+        # (set by the trainer from DataBatch.norm before the first trace)
+        self.input_norm: Optional[Tuple] = None
 
         c, h, w = net_cfg.input_shape
         self.node_shapes[0] = (batch_size, c, h, w)
@@ -131,6 +135,16 @@ class Network:
             batch_size=self.batch_size, update_period=self.update_period,
             epoch=epoch, compute_dtype=self.compute_dtype,
             mesh=self.mesh, seq_axis=self.seq_axis)
+        if data.dtype == jnp.uint8:
+            # raw-pixel feed: normalize on device, fused into the step
+            # (the reference normalizes on the host and ships float32,
+            # iter_augment_proc-inl.hpp:98-162 — 4x the PCIe/ICI bytes)
+            x = data.astype(self.compute_dtype)
+            if self.input_norm is not None:
+                mean, scale = self.input_norm
+                x = (x - jnp.asarray(mean, x.dtype)) * jnp.asarray(
+                    scale, x.dtype)
+            data = x
         values: Dict[int, jnp.ndarray] = {0: data}
         for i, x in enumerate(extra_data):
             values[i + 1] = x

@@ -110,6 +110,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         cols = kv_rank * s_local + jnp.arange(s_local)
         return rows[:, None] >= cols[None, :]
 
+    if n == 1:
+        acc, l, _ = _block_attend(q, k, v, scale, make_mask(my))
+        return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
     def hop(carry, _):
         kk, vv, rank, state = carry
         # issue next hop's permute before consuming kk/vv: the transfer
@@ -127,8 +131,13 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     k1 = jax.lax.ppermute(k, axis_name, perm)
     v1 = jax.lax.ppermute(v, axis_name, perm)
     state0 = _block_attend(q, k, v, scale, make_mask(my))
-    (_, _, _, (acc, l, _)), _ = jax.lax.scan(
-        hop, (k1, v1, (my + 1) % n, state0), None, length=n - 1)
+    # n-2 permuting hops in the scan; the last arriving shard is consumed
+    # outside it so exactly n-1 permutes are issued in total
+    (kk_l, vv_l, rank_l, state), _ = jax.lax.scan(
+        hop, (k1, v1, (my + 1) % n, state0), None, length=n - 2)
+    state = _merge(state, _block_attend(q, kk_l, vv_l, scale,
+                                        make_mask(rank_l)))
+    acc, l, _ = state
     out = acc / jnp.maximum(l, 1e-30)
     return out.astype(q.dtype)
 

@@ -1,0 +1,156 @@
+"""Profiling / tracing: per-step timing, throughput, XLA trace capture.
+
+The reference's observability is a wall-clock progress line every
+``print_step`` batches (reference: src/cxxnet_main.cpp:378-387 and the
+``GetTime`` helper, src/utils/timer.h:16-31) — no per-op timers, no trace
+files (SURVEY.md §5).  On TPU, profiler traces are table stakes: this
+module adds
+
+* ``StepTimer`` — rolling per-step wall time + images/sec, reported on
+  the progress line and per round;
+* ``TraceSession`` — config-gated ``jax.profiler`` trace capture
+  (``profile = 1``) writing a TensorBoard-loadable trace to
+  ``profile_dir`` between ``profile_start_batch`` and
+  ``profile_stop_batch`` of the first round, with each step wrapped in a
+  ``StepTraceAnnotation`` so the trace viewer groups ops by train step;
+* device-memory reporting (per-chip peak bytes) at round end.
+
+All of it is inert unless enabled, so the reference's stdout/stderr
+format is unchanged by default.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import List, Optional
+
+
+class StepTimer:
+    """Rolling wall-clock stats over train steps (host-side; includes
+    dispatch + any host blocking, which is what the user experiences)."""
+
+    def __init__(self, window: int = 50) -> None:
+        self.window = window
+        self._times: List[float] = []
+        self._last: Optional[float] = None
+        self.total_steps = 0
+        self.total_time = 0.0
+
+    def tick(self) -> None:
+        """Mark the end of one step."""
+        now = time.perf_counter()
+        if self._last is not None:
+            dt = now - self._last
+            self.total_time += dt
+            self._times.append(dt)
+            if len(self._times) > self.window:
+                self._times.pop(0)
+        self._last = now
+        self.total_steps += 1
+
+    def reset_clock(self) -> None:
+        """Forget the last timestamp (call across round boundaries so
+        eval/checkpoint time is not counted as a step)."""
+        self._last = None
+
+    @property
+    def mean_step_ms(self) -> float:
+        if not self._times:
+            return 0.0
+        return 1000.0 * sum(self._times) / len(self._times)
+
+    def images_per_sec(self, batch_size: int) -> float:
+        ms = self.mean_step_ms
+        return 0.0 if ms == 0 else batch_size * 1000.0 / ms
+
+    def summary(self, batch_size: int) -> str:
+        return "%.1f ms/step, %.1f images/sec" % (
+            self.mean_step_ms, self.images_per_sec(batch_size))
+
+
+def device_memory_summary() -> str:
+    """Per-device peak HBM usage, when the backend reports it."""
+    import jax
+
+    parts = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        peak = stats.get("peak_bytes_in_use")
+        limit = stats.get("bytes_limit")
+        if peak is None:
+            continue
+        if limit:
+            parts.append("%s: %.1f/%.1f MiB peak"
+                         % (str(d.id), peak / 2**20, limit / 2**20))
+        else:
+            parts.append("%s: %.1f MiB peak" % (str(d.id), peak / 2**20))
+    return "; ".join(parts)
+
+
+class TraceSession:
+    """Config-gated jax.profiler trace over a window of train steps.
+
+    Keys (global config, broadcast like every other param):
+      profile = 0|1            enable trace capture
+      profile_dir = <dir>      output directory (default "profile")
+      profile_start_batch = n  first batch (of round 0) inside the trace
+      profile_stop_batch = n   batch after which the trace is written
+    """
+
+    def __init__(self) -> None:
+        self.enabled = 0
+        self.dir = "profile"
+        self.start_batch = 2   # skip compile on step 0/1 by default
+        self.stop_batch = 12
+        self._active = False
+        self._done = False
+        self._step = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "profile":
+            self.enabled = int(val)
+        elif name == "profile_dir":
+            self.dir = val
+        elif name == "profile_start_batch":
+            self.start_batch = int(val)
+        elif name == "profile_stop_batch":
+            self.stop_batch = int(val)
+
+    # ------------------------------------------------------------------
+    def step(self):
+        """Context manager wrapping one train step: starts/stops the trace
+        at the configured batch indices and annotates the step."""
+        if not self.enabled or self._done:
+            self._step += 1
+            return contextlib.nullcontext()
+        import jax
+
+        if not self._active and self._step >= self.start_batch:
+            os.makedirs(self.dir, exist_ok=True)
+            jax.profiler.start_trace(self.dir)
+            self._active = True
+        if self._active and self._step >= self.stop_batch:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+        n = self._step
+        self._step += 1
+        if self._active:
+            return jax.profiler.StepTraceAnnotation("train", step_num=n)
+        return contextlib.nullcontext()
+
+    def close(self) -> None:
+        """Flush an open trace (end of training / interrupt)."""
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

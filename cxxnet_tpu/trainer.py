@@ -203,7 +203,15 @@ class Trainer:
         if self.update_period > 1:
             zeros = jax.tree.map(jnp.zeros_like, _strip_nones(self.params))
             self.grad_accum = jax.device_put(zeros, gsh)
-        self._rng = jax.random.PRNGKey(self.seed * 2243 + 7)
+        # rng + epoch live ON DEVICE and are carried (donated) through the
+        # step: a host-side fold_in / scalar upload would cost an extra
+        # dispatch round trip per step — expensive when the chip sits
+        # behind a network tunnel (and pointless on any transport)
+        self._rng = jax.device_put(
+            jax.random.PRNGKey(self.seed * 2243 + 7), rep)
+        # int32: float32 +1 would freeze at 2^24 updates
+        self._epoch_dev = jax.device_put(
+            jnp.asarray(self.epoch_counter, jnp.int32), rep)
 
         net, opt_ = self.net, self.opt
         eval_req = tuple(self.eval_req)
@@ -218,24 +226,26 @@ class Trainer:
                 loss_fn, has_aux=True)(params)
             return loss, evals, grads
 
-        def train_step(params, opt_state, data, extras, labels, rng, epoch):
+        def train_step(params, opt_state, rng, epoch, data, extras, labels):
+            use, nxt = jax.random.split(rng)
             loss, evals, grads = fwd_bwd(params, data, extras, labels,
-                                         rng, epoch)
+                                         use, epoch)
             grads = _strip_nones(grads)
             params2, opt2 = opt_.apply(params, grads, opt_state, epoch)
-            return params2, opt2, loss, evals
+            return params2, opt2, nxt, epoch + 1, loss, evals
 
-        def accum_step(grad_accum, params, data, extras, labels, rng, epoch):
+        def accum_step(grad_accum, rng, params, epoch, data, extras, labels):
+            use, nxt = jax.random.split(rng)
             loss, evals, grads = fwd_bwd(params, data, extras, labels,
-                                         rng, epoch)
+                                         use, epoch)
             grads = _strip_nones(grads)
             acc = jax.tree.map(jnp.add, grad_accum, grads)
-            return acc, loss, evals
+            return acc, nxt, loss, evals
 
         def apply_accum(params, opt_state, grad_accum, epoch):
             params2, opt2 = opt_.apply(params, grad_accum, opt_state, epoch)
             zeros = jax.tree.map(jnp.zeros_like, grad_accum)
-            return params2, opt2, zeros
+            return params2, opt2, zeros, epoch + 1
 
         def forward_step(params, data, extras, node_ids):
             values, _ = net.apply(params, data, extra_data=extras,
@@ -246,17 +256,17 @@ class Trainer:
         # without them XLA's sharding propagation may reshard an output
         # (e.g. over the seq axis), desyncing from in_shardings next step
         self._train_step = jax.jit(
-            train_step, donate_argnums=(0, 1),
-            in_shardings=(psh, osh, xsh, dsh, dsh, rep, rep),
-            out_shardings=(psh, osh, None, None))
+            train_step, donate_argnums=(0, 1, 2, 3),
+            in_shardings=(psh, osh, rep, rep, xsh, dsh, dsh),
+            out_shardings=(psh, osh, rep, rep, None, None))
         self._accum_step = jax.jit(
-            accum_step, donate_argnums=(0,),
-            in_shardings=(gsh, psh, xsh, dsh, dsh, rep, rep),
-            out_shardings=(gsh, None, None))
+            accum_step, donate_argnums=(0, 1),
+            in_shardings=(gsh, rep, psh, rep, xsh, dsh, dsh),
+            out_shardings=(gsh, rep, None, None))
         self._apply_accum = jax.jit(
-            apply_accum, donate_argnums=(0, 1, 2),
+            apply_accum, donate_argnums=(0, 1, 2, 3),
             in_shardings=(psh, osh, gsh, rep),
-            out_shardings=(psh, osh, gsh))
+            out_shardings=(psh, osh, gsh, rep))
         self._forward = jax.jit(
             forward_step, in_shardings=(psh, xsh, dsh),
             static_argnums=(3,))
@@ -268,7 +278,9 @@ class Trainer:
         a global jax.Array (the PS-era per-worker data sharding,
         reference iter_thread_imbin-inl.hpp:199-219, maps to per-process
         local data here)."""
-        arr = np.asarray(arr, np.float32)
+        arr = np.asarray(arr)
+        if arr.dtype != np.uint8:   # raw-pixel batches stay 1 byte/px
+            arr = np.asarray(arr, np.float32)
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(
                 sharding or self._dsh, arr)
@@ -294,26 +306,41 @@ class Trainer:
             return out
         return np.asarray(x)
 
-    def _extra_fields(self, batch: DataBatch) -> Tuple[jnp.ndarray, ...]:
-        """Extra input nodes in_1.. from batch.extra_data (reference
-        attachtxt + nnet_config extra_data_num, nnet_config.h:223-235)."""
+    def _host_fields(self, batch: DataBatch):
+        """Host-side batch decomposition shared by both ingest paths:
+        (data, extra input nodes in_1.., label fields). Extras per
+        attachtxt + extra_data_num (reference nnet_config.h:223-235);
+        label fields per GetLabelInfo (reference nnet_impl-inl.hpp:271-285)."""
         n = self.net_cfg.extra_data_num
-        if n == 0:
-            return ()
-        if len(batch.extra_data) < n:
+        if n and len(batch.extra_data) < n:
             raise ValueError(
                 "net declares extra_data_num=%d but batch carries %d extra "
                 "arrays (chain an attachtxt iterator)"
                 % (n, len(batch.extra_data)))
-        return tuple(self._put_data(batch.extra_data[i]) for i in range(n))
+        data = np.asarray(batch.data)
+        if data.dtype != np.uint8:   # raw-pixel batches stay 1 byte/px
+            data = np.asarray(data, np.float32)
+        extras = tuple(np.asarray(batch.extra_data[i], np.float32)
+                       for i in range(n))
+        labels = ([] if batch.label is None else
+                  [np.asarray(batch.label[:, a:b], np.float32)
+                   for (a, b) in self.net_cfg.label_range])
+        return data, extras, labels
 
-    def _label_fields(self, batch: DataBatch) -> List[jnp.ndarray]:
-        """Slice label matrix into fields (reference GetLabelInfo,
-        nnet_impl-inl.hpp:271-285)."""
-        out = []
-        for (a, b) in self.net_cfg.label_range:
-            out.append(self._put_data(batch.label[:, a:b]))
-        return out
+    def _put_batch(self, batch: DataBatch):
+        """Ship data + extra inputs + label fields in ONE batched
+        device_put: per-array puts each cost a dispatch round trip, which
+        dominates when the chip is remote (tunnel) and is wasted work
+        everywhere else."""
+        data, extras, labels = self._host_fields(batch)
+        if jax.process_count() > 1:
+            # multi-host assembly needs per-array process-local puts
+            return (self._put_data(data, self._xsh),
+                    tuple(self._put_data(e) for e in extras),
+                    [self._put_data(l) for l in labels])
+        shard = (self._xsh, tuple([self._dsh] * len(extras)),
+                 [self._dsh] * len(labels))
+        return jax.device_put((data, extras, labels), shard)
 
     def _label_dict(self, batch: DataBatch,
                     skip_pad: bool = False) -> Dict[str, np.ndarray]:
@@ -327,26 +354,48 @@ class Trainer:
     def start_round(self, round_: int) -> None:
         self.round = round_
 
+    def _maybe_set_norm(self, batch: DataBatch) -> None:
+        """Adopt the pipeline's deferred normalization (DataBatch.norm).
+        Must happen before the first trace of the step functions — jit
+        closes over net.input_norm as a compile-time constant, so every
+        iterator feeding this trainer must agree on (mean, scale)."""
+        if batch.norm is None:
+            return
+        mean, scale = batch.norm
+        mean = np.asarray(mean, np.float32)
+        if self.net.input_norm is None:
+            self.net.input_norm = (mean, float(scale))
+            return
+        cur_mean, cur_scale = self.net.input_norm
+        if cur_scale != float(scale) or cur_mean.shape != mean.shape \
+                or not np.allclose(cur_mean, mean):
+            raise ValueError(
+                "on_device_norm mismatch: this batch wants (mean %s, scale "
+                "%g) but the step was compiled with (mean %s, scale %g); "
+                "all iterators feeding one net must share the same "
+                "normalization" % (mean.reshape(-1)[:4], scale,
+                                   cur_mean.reshape(-1)[:4], cur_scale))
+
     # ------------------------------------------------------------------
     def update(self, batch: DataBatch) -> None:
         """One minibatch of training (reference: nnet_impl-inl.hpp:141-185)."""
-        data = self._put_data(batch.data, self._xsh)
-        extras = self._extra_fields(batch)
-        labels = self._label_fields(batch)
+        self._maybe_set_norm(batch)
+        data, extras, labels = self._put_batch(batch)
         self._step_count += 1
-        rng = jax.random.fold_in(self._rng, self._step_count)
-        # traced scalar: changing epoch must not recompile the step
-        epoch = jnp.asarray(self.epoch_counter, jnp.float32)
         if self.update_period == 1:
-            self.params, self.opt_state, loss, evals = self._train_step(
-                self.params, self.opt_state, data, extras, labels, rng, epoch)
+            (self.params, self.opt_state, self._rng, self._epoch_dev,
+             loss, evals) = self._train_step(
+                self.params, self.opt_state, self._rng, self._epoch_dev,
+                data, extras, labels)
         else:
-            self.grad_accum, loss, evals = self._accum_step(
-                self.grad_accum, self.params, data, extras, labels, rng, epoch)
+            self.grad_accum, self._rng, loss, evals = self._accum_step(
+                self.grad_accum, self._rng, self.params, self._epoch_dev,
+                data, extras, labels)
             if (self.sample_counter + 1) % self.update_period == 0:
-                self.params, self.opt_state, self.grad_accum = \
-                    self._apply_accum(self.params, self.opt_state,
-                                      self.grad_accum, epoch)
+                (self.params, self.opt_state, self.grad_accum,
+                 self._epoch_dev) = self._apply_accum(
+                    self.params, self.opt_state, self.grad_accum,
+                    self._epoch_dev)
         if self.eval_train != 0 and self.train_metric.evals:
             scores = [self._fetch_local(e) for e in evals]
             scores = [e.reshape(e.shape[0], -1) for e in scores]
@@ -359,8 +408,8 @@ class Trainer:
     # ------------------------------------------------------------------
     def forward_nodes(self, batch: DataBatch,
                       node_ids: Sequence[int]) -> List[np.ndarray]:
-        data = self._put_data(batch.data, self._xsh)
-        extras = self._extra_fields(batch)
+        self._maybe_set_norm(batch)
+        data, extras, _ = self._put_batch(batch)
         values = self._forward(self.params, data, extras, tuple(node_ids))
         return [self._fetch_local(v) for v in values]
 

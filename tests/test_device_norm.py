@@ -1,0 +1,157 @@
+"""Deferred (on-device) input normalization.
+
+With ``on_device_norm = 1`` the augmenter emits raw uint8 pixels and the
+trainer fuses ``(x - mean) * scale`` into the jitted step, so batches
+cross host->device at 1 byte/pixel — the TPU-native input path (the
+reference always normalizes on the host, iter_augment_proc-inl.hpp:98-162,
+and ships float32). These tests pin the numerics against the host path.
+"""
+import os
+
+import cv2
+import numpy as np
+
+from cxxnet_tpu import config
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.trainer import Trainer
+
+
+def _make_dataset(tmp_path, n=8, size=24):
+    rs = np.random.RandomState(7)
+    root = tmp_path / "imgs"
+    root.mkdir(exist_ok=True)
+    lines = []
+    for i in range(n):
+        img = rs.randint(0, 255, size=(size, size, 3), dtype=np.uint8)
+        fname = "img%03d.png" % i
+        cv2.imwrite(str(root / fname), img)
+        lines.append("%d\t%d\t%s" % (i, i % 3, fname))
+    lst = tmp_path / "data.lst"
+    lst.write_text("\n".join(lines) + "\n")
+    return str(lst), str(root)
+
+
+_NET = """
+netconfig=start
+layer[+1] = flatten:fl
+layer[+1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,24,24
+"""
+
+
+def _iter(lst, root, *extra):
+    return create_iterator(
+        [("iter", "img"), ("image_list", lst), ("image_root", root),
+         ("batch_size", "4"), ("silent", "1"), ("input_shape", "3,24,24")]
+        + list(extra) + [("iter", "end")])
+
+
+def test_uint8_batches_with_norm(tmp_path):
+    lst, root = _make_dataset(tmp_path)
+    it = _iter(lst, root, ("mean_value", "10,20,30"), ("scale", "0.0078125"),
+               ("on_device_norm", "1"))
+    it.before_first()
+    assert it.next()
+    b = it.value
+    assert b.data.dtype == np.uint8
+    assert b.norm is not None
+    mean, scale = b.norm
+    # mean_value is b,g,r; planes are r,g,b
+    np.testing.assert_allclose(mean.reshape(3), [30, 20, 10])
+    assert scale == 0.0078125
+
+
+def test_device_norm_matches_host_norm(tmp_path):
+    """(uint8 batch, norm) applied on device == host-normalized float batch."""
+    lst, root = _make_dataset(tmp_path)
+    host = _iter(lst, root, ("mean_value", "10,20,30"), ("scale", "0.0078125"))
+    dev = _iter(lst, root, ("mean_value", "10,20,30"), ("scale", "0.0078125"),
+                ("on_device_norm", "1"))
+    host.before_first(); host.next()
+    dev.before_first(); dev.next()
+    hb, db = host.value, dev.value
+
+    text = _NET
+
+    def build():
+        tr = Trainer()
+        for k, v in config.parse_string(text):
+            tr.set_param(k, v)
+        tr.set_param("batch_size", "4")
+        tr.set_param("dev", "cpu:0")
+        tr.set_param("seed", "3")
+        tr.init_model()
+        return tr
+
+    t1, t2 = build(), build()
+    p1 = t1.forward_nodes(hb, [t1.net.out_node])[0]
+    p2 = t2.forward_nodes(db, [t2.net.out_node])[0]
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-5)
+
+
+def test_device_norm_training_step(tmp_path):
+    """A full train step accepts uint8 batches (grad flows through the
+    on-device normalization)."""
+    lst, root = _make_dataset(tmp_path)
+    dev = _iter(lst, root, ("mean_value", "10,20,30"), ("scale", "0.0078125"),
+                ("on_device_norm", "1"))
+    text = _NET
+    tr = Trainer()
+    for k, v in config.parse_string(text):
+        tr.set_param(k, v)
+    tr.set_param("batch_size", "4")
+    tr.set_param("dev", "cpu:0")
+    tr.set_param("eta", "0.1")
+    tr.set_param("metric", "error")
+    tr.init_model()
+    dev.before_first()
+    before = None
+    for b in dev:
+        if before is None:
+            before = tr.get_weight("fc1", "wmat").copy()
+        tr.update(b)
+    after = tr.get_weight("fc1", "wmat")
+    assert np.abs(after - before).max() > 0  # weights moved
+
+
+def test_mean_image_crop_shape_deferred(tmp_path):
+    """meanimg with the crop shape defers cleanly; full-size meanimg falls
+    back to host normalization (random crop makes it undeferrable)."""
+    lst, root = _make_dataset(tmp_path, size=24)
+    mpath = str(tmp_path / "mean.bin")
+    it = _iter(lst, root, ("image_mean", mpath), ("on_device_norm", "1"))
+    it.before_first(); it.next()
+    b = it.value
+    assert b.norm is not None and b.data.dtype == np.uint8
+    mean, _ = b.norm
+    assert mean.shape == (3, 24, 24)
+
+    # a loaded full-size mean (28x28) with a smaller random crop cannot be
+    # deferred (the host path subtracts before cropping) -> host fallback
+    d2 = tmp_path / "d2"
+    d2.mkdir()
+    lst2, root2 = _make_dataset(d2, size=28)
+    from cxxnet_tpu.io.image import _save_mean
+    m2 = str(tmp_path / "mean2.bin")
+    _save_mean(m2, np.full((3, 28, 28), 5.0, np.float32))
+    it2 = create_iterator(
+        [("iter", "img"), ("image_list", lst2), ("image_root", root2),
+         ("batch_size", "4"), ("silent", "1"), ("input_shape", "3,24,24"),
+         ("rand_crop", "1"), ("image_mean", m2),
+         ("on_device_norm", "1"), ("iter", "end")])
+    it2.before_first(); it2.next()
+    assert it2.value.norm is None
+    assert it2.value.data.dtype == np.float32
+
+
+def test_contrast_jitter_folded_into_pixels(tmp_path):
+    lst, root = _make_dataset(tmp_path)
+    it = _iter(lst, root, ("mean_value", "10,20,30"),
+               ("max_random_contrast", "0.3"), ("on_device_norm", "1"))
+    it.before_first()
+    assert it.next()
+    assert it.value.data.dtype == np.uint8
