@@ -49,6 +49,9 @@ class LearnTask:
         self.output_format = 1
         self.trace = TraceSession()
         self.timer = StepTimer()
+        from concurrent.futures import ThreadPoolExecutor
+        self._stager = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="h2d-stage")
 
     # ------------------------------------------------------------------
     def set_param(self, name: str, val: str) -> None:
@@ -226,19 +229,49 @@ class LearnTask:
             self.trainer.start_round(self.start_counter)
             self.timer.reset_clock()
             self.itr_train.before_first()
-            while self.itr_train.next():
-                if self.test_io == 0:
+            # one-ahead device staging: batch k+1's host->device transfer
+            # is issued on a helper thread while batch k computes
+            pending = None
+            while True:
+                has_next = self.itr_train.next()
+                if self.test_io != 0:
+                    if not has_next:
+                        break
+                    sample_counter += 1
+                    if sample_counter % self.print_step == 0 \
+                            and not self.silent:
+                        elapsed = int(time.time() - start)
+                        print("\r%80s\r" % "", end="")
+                        print("round %8d:[%8d] %d sec elapsed"
+                              % (self.start_counter - 1, sample_counter,
+                                 elapsed), end="")
+                        sys.stdout.flush()
+                    continue
+                nxt = None
+                if has_next:
+                    nxt = self._stager.submit(self.trainer.stage,
+                                              self.itr_train.value)
+                if pending is not None:
+                    # dispatch is async: update() returns while the device
+                    # computes, so batch k+1's transfer (helper thread)
+                    # overlaps batch k's step
                     with self.trace.step():
-                        self.trainer.update(self.itr_train.value)
+                        self.trainer.update(pending)
                     self.timer.tick()
-                sample_counter += 1
-                if sample_counter % self.print_step == 0 and not self.silent:
-                    elapsed = int(time.time() - start)
-                    print("\r%80s\r" % "", end="")
-                    print("round %8d:[%8d] %d sec elapsed"
-                          % (self.start_counter - 1, sample_counter, elapsed),
-                          end="")
-                    sys.stdout.flush()
+                    sample_counter += 1
+                    if sample_counter % self.print_step == 0 \
+                            and not self.silent:
+                        elapsed = int(time.time() - start)
+                        print("\r%80s\r" % "", end="")
+                        print("round %8d:[%8d] %d sec elapsed"
+                              % (self.start_counter - 1, sample_counter,
+                                 elapsed), end="")
+                        sys.stdout.flush()
+                # resolve before touching the iterator again: next() may
+                # reuse the buffers the stager is still reading
+                pending = nxt.result() if nxt is not None else None
+                if not has_next:
+                    break
             if self.test_io == 0:
                 sys.stderr.write("[%d]" % self.start_counter)
                 if not self.itr_evals:

@@ -44,8 +44,11 @@ KNOWN_LAYER_TYPES = frozenset([
     "split", "insanity", "insanity_max_pooling", "l2_loss",
     "multi_logistic", "ch_concat", "prelu", "batch_norm",
     # TPU-native additions: forced-Pallas variants for differential testing,
-    # and the long-context attention layer (ring attention under seq_parallel)
-    "lrn_pallas", "attention",
+    # the long-context attention layer (ring/ulysses under seq_parallel),
+    # and mixture-of-experts fullc (expert parallelism over the model axis)
+    # and pipelined transformer stacks (depth-stacked params, scanned on
+    # one chip, pipelined over the pipe axis under pipeline_parallel)
+    "lrn_pallas", "attention", "moe_fullc", "transformer_stack",
 ])
 
 # self-loop loss layers (in == out node); see src/layer/loss/

@@ -262,6 +262,127 @@ class FullConnectLayer(Layer):
         return [out.reshape(n, 1, 1, self.param.num_hidden)]
 
 
+@register("moe_fullc")
+class MoEFullConnectLayer(Layer):
+    """Mixture-of-experts fullc with top-k token-choice routing.
+
+    No reference counterpart (cxxnet predates MoE; SURVEY.md §2.7 lists
+    expert parallelism as absent) — TPU-first capability. GShard-style
+    dense dispatch: a router picks top-``moe_topk`` experts per token,
+    tokens are scattered to per-expert capacity slots with one-hot
+    einsums (static shapes, MXU-friendly), each expert applies its own
+    (nhidden, nin) fullc, and combine weights gather the results.
+    Tokens over an expert's capacity are dropped (output 0 for that
+    expert's contribution), the standard GShard behavior.
+
+    Params: ``wmat`` (E, nhidden, nin), ``bias`` (E, nhidden), ``gate``
+    (E, nin). On a 2D (data, model) mesh the experts shard over the
+    ``model`` axis (expert parallelism): each device holds E/n experts
+    and GSPMD inserts the dispatch/combine all-to-alls.
+
+    Config: ``nexpert``, ``moe_topk`` (default 2), ``capacity_factor``
+    (default 1.25), ``moe_loss`` (aux load-balance loss weight,
+    default 0.01).
+    """
+    has_params = True
+    param_tags = ("wmat", "bias", "gate")
+
+    def __init__(self):
+        super().__init__()
+        self.nexpert = 0
+        self.topk = 2
+        self.capacity_factor = 1.25
+        self.moe_loss = 0.01
+
+    def set_param(self, name, val):
+        if name == "nexpert":
+            self.nexpert = int(val)
+        elif name == "moe_topk":
+            self.topk = int(val)
+        elif name == "capacity_factor":
+            self.capacity_factor = float(val)
+        elif name == "moe_loss":
+            self.moe_loss = float(val)
+        else:
+            super().set_param(name, val)
+
+    def _infer(self, in_shapes):
+        (n, c, h, w) = in_shapes[0]
+        if not _is_mat(in_shapes[0]):
+            raise ValueError("MoEFullcLayer: input needs to be a matrix")
+        if self.param.num_hidden <= 0 or self.nexpert <= 0:
+            raise ValueError("MoEFullcLayer: must set nhidden and nexpert")
+        if self.topk > self.nexpert:
+            raise ValueError("MoEFullcLayer: moe_topk > nexpert")
+        self.param.num_input_node = w
+        return [(n, 1, 1, self.param.num_hidden)]
+
+    def init_params(self, rng) -> Params:
+        nh, ni, e = self.param.num_hidden, self.param.num_input_node, \
+            self.nexpert
+        rw, rg = jax.random.split(rng)
+        return {
+            "wmat": self.param.rand_init_weight(rw, (e, nh, ni), ni, nh),
+            "bias": jnp.full((e, nh), self.param.init_bias, jnp.float32),
+            "gate": jax.random.normal(rg, (e, ni), jnp.float32)
+            * (ni ** -0.5)}
+
+    def _capacity(self, n_tokens: int) -> int:
+        c = int(math.ceil(self.topk * n_tokens / self.nexpert
+                          * self.capacity_factor))
+        return max(c, 1)
+
+    def apply(self, params, inputs, ctx):
+        x = _mat(inputs[0])                         # (B, ni)
+        dt = ctx.compute_dtype
+        B, E = x.shape[0], self.nexpert
+        C = self._capacity(B)
+        xc = x.astype(dt)
+
+        logits = jnp.dot(xc, params["gate"].astype(dt).T)  # (B, E)
+        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+        # iterative top-k selection (k small): one-hot choice per round,
+        # chosen experts masked out for the next round
+        masked = gates
+        dispatch = jnp.zeros((B, E, C), jnp.float32)
+        combine = jnp.zeros((B, E, C), jnp.float32)
+        # position counters per expert accumulate across rounds so that
+        # round-2 tokens take slots after round-1 tokens
+        base_count = jnp.zeros((E,), jnp.int32)
+        frac_routed = jnp.zeros((E,), jnp.float32)
+        for _ in range(self.topk):
+            idx = jnp.argmax(masked, axis=-1)               # (B,)
+            onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+            frac_routed = frac_routed + onehot.mean(axis=0)
+            # slot position of each token within its chosen expert
+            pos = jnp.cumsum(onehot, axis=0) - onehot + base_count
+            keep = (pos < C) * onehot                       # drop overflow
+            slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                                  dtype=jnp.float32) * keep[..., None]
+            gate_w = (gates * onehot).sum(-1, keepdims=True)  # (B, 1)
+            dispatch = dispatch + slot
+            combine = combine + slot * gate_w[..., None]
+            base_count = base_count + keep.sum(0).astype(jnp.int32)
+            masked = masked * (1.0 - onehot)
+
+        # aux load-balance loss (GShard eq.4): E * sum_e mean(gate_e) *
+        # mean(routed_e); scaled like other losses by grad_scale semantics
+        if ctx.train and self.moe_loss > 0.0:
+            aux = E * jnp.sum(gates.mean(axis=0)
+                              * frac_routed / self.topk)
+            ctx.losses.append(self.moe_loss * aux)
+
+        # scatter -> expert fullc -> gather (einsum dispatch, all static)
+        xin = jnp.einsum("bec,bi->eci", dispatch.astype(dt), xc)
+        h = jnp.einsum("eci,eoi->eco", xin, params["wmat"].astype(dt))
+        h = h + params["bias"][:, None, :].astype(dt)
+        out = jnp.einsum("bec,eco->bo", combine.astype(dt), h)
+        n = inputs[0].shape[0]
+        return [out.astype(jnp.float32).reshape(
+            n, 1, 1, self.param.num_hidden)]
+
+
 @register("flatten")
 class FlattenLayer(Layer):
     """(b,c,h,w) -> (b,1,1,c*h*w) (reference: src/layer/flatten_layer-inl.hpp:14-29)."""
@@ -947,10 +1068,17 @@ class AttentionLayer(Layer):
     reference-style (out, in) row-major matrices.
 
     When the trainer builds a mesh with a ``seq`` axis (``seq_parallel``
-    config), the score computation runs as ring attention sharded over
-    that axis (cxxnet_tpu/ops/ring_attention.py): K/V shards rotate via
-    ppermute while each chip holds only its local sequence block —
-    sequences longer than one chip's HBM train exactly.
+    config), the score computation is sharded over that axis by one of two
+    strategies selected with ``seq_algo``:
+
+      * ``ring`` (default) — ring attention: K/V shards rotate via
+        ppermute while each chip holds only its local sequence block
+        (cxxnet_tpu/ops/ring_attention.py); scales to sequences longer
+        than one chip's HBM.
+      * ``alltoall`` (a.k.a. ``ulysses``) — two lax.all_to_all collectives
+        re-partition seq-sharded tensors to head-sharded, full attention
+        runs locally per head group (cxxnet_tpu/ops/ulysses.py); needs
+        nhead divisible by the shard count.
     """
     has_params = True
     param_tags = ("wqkv", "wo")  # tag-scoped hyperparams: wqkv:lr etc.
@@ -959,12 +1087,17 @@ class AttentionLayer(Layer):
         super().__init__()
         self.nhead = 1
         self.causal = 0
+        self.seq_algo = "ring"
 
     def set_param(self, name, val):
         if name == "nhead":
             self.nhead = int(val)
         elif name == "causal":
             self.causal = int(val)
+        elif name == "seq_algo":
+            if val not in ("ring", "alltoall", "ulysses"):
+                raise ValueError("seq_algo must be ring|alltoall|ulysses")
+            self.seq_algo = val
         else:
             super().set_param(name, val)
 
@@ -996,13 +1129,135 @@ class AttentionLayer(Layer):
         mesh, axis = ctx.mesh, ctx.seq_axis
         if mesh is not None and axis is not None \
                 and mesh.shape.get(axis, 1) > 1:
-            out = ra.sharded_attention(mesh, q, k, v, seq_axis=axis,
-                                       causal=bool(self.causal))
+            if self.seq_algo in ("alltoall", "ulysses"):
+                from .ops import ulysses
+                out = ulysses.sharded_ulysses(mesh, q, k, v, seq_axis=axis,
+                                              causal=bool(self.causal))
+            else:
+                out = ra.sharded_attention(mesh, q, k, v, seq_axis=axis,
+                                           causal=bool(self.causal))
         else:
             out = ra.attention(q, k, v, causal=bool(self.causal))
         out = out.transpose(0, 2, 1, 3).reshape(b, s, e)
         out = jnp.einsum("bse,fe->bsf", out, params["wo"].astype(dt))
         return [out.reshape(b, 1, s, e).astype(jnp.float32)]
+
+
+@register("transformer_stack")
+class TransformerStackLayer(Layer):
+    """A stack of ``nlayer`` identical pre-norm transformer blocks with
+    parameters stacked on a leading depth dimension.
+
+    No reference counterpart (SURVEY.md §5: no sequence models). Depth as
+    a stacked axis is the TPU-native shape for deep stacks: one block is
+    traced once and either scanned over depth (single device — compile
+    time stays O(1) in depth) or pipelined over the mesh's ``pipe`` axis
+    (``pipeline_parallel`` config): each device owns nlayer/P consecutive
+    blocks and microbatches stream stage-to-stage via ppermute
+    (cxxnet_tpu/ops/pipeline.py).
+
+    Block: x += attn(rmsnorm(x)); x += mlp(rmsnorm(x)) with a ReLU MLP of
+    width ``nhidden_mlp`` (default 4*embed). Config: ``nlayer``,
+    ``nhead``, ``causal``, ``nhidden_mlp``, ``n_microbatch`` (pipeline
+    microbatches per local batch, default = pipe size).
+    """
+    has_params = True
+    param_tags = ("wqkv", "wo", "w1", "w2", "norm1", "norm2")
+
+    def __init__(self):
+        super().__init__()
+        self.nlayer = 1
+        self.nhead = 1
+        self.causal = 0
+        self.nhidden_mlp = 0
+        self.n_microbatch = 0
+
+    def set_param(self, name, val):
+        if name == "nlayer":
+            self.nlayer = int(val)
+        elif name == "nhead":
+            self.nhead = int(val)
+        elif name == "causal":
+            self.causal = int(val)
+        elif name == "nhidden_mlp":
+            self.nhidden_mlp = int(val)
+        elif name == "n_microbatch":
+            self.n_microbatch = int(val)
+        else:
+            super().set_param(name, val)
+
+    def _infer(self, in_shapes):
+        n, c, s, e = in_shapes[0]
+        if c != 1:
+            raise ValueError(
+                "transformer_stack: input must be (batch,1,seq,embed)")
+        if e % self.nhead != 0:
+            raise ValueError("transformer_stack: embed %d vs nhead %d"
+                             % (e, self.nhead))
+        if self.nhidden_mlp == 0:
+            self.nhidden_mlp = 4 * e
+        return [(n, 1, s, e)]
+
+    def init_params(self, rng) -> Params:
+        e, m, L = self.in_shapes[0][3], self.nhidden_mlp, self.nlayer
+        p = self.param
+        ks = jax.random.split(rng, 4)
+        return {
+            "wqkv": p.rand_init_weight(ks[0], (L, 3 * e, e), e, 3 * e),
+            "wo": p.rand_init_weight(ks[1], (L, e, e), e, e),
+            "w1": p.rand_init_weight(ks[2], (L, m, e), e, m),
+            "w2": p.rand_init_weight(ks[3], (L, e, m), m, e),
+            "norm1": jnp.ones((L, e), jnp.float32),
+            "norm2": jnp.ones((L, e), jnp.float32)}
+
+    def _block_fn(self, dt):
+        from .ops import ring_attention as ra
+        nh, causal = self.nhead, bool(self.causal)
+
+        def rmsnorm(x, g):
+            ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
+                          keepdims=True)
+            return (x.astype(jnp.float32) * jax.lax.rsqrt(ms + 1e-6)
+                    ).astype(dt) * g.astype(dt)
+
+        def block(lp, h):
+            b, s, e = h.shape
+            d = e // nh
+            x = rmsnorm(h, lp["norm1"])
+            qkv = jnp.einsum("bse,fe->bsf", x, lp["wqkv"].astype(dt))
+            qkv = qkv.reshape(b, s, 3, nh, d).transpose(2, 0, 3, 1, 4)
+            att = ra.attention(qkv[0], qkv[1], qkv[2], causal=causal)
+            att = att.transpose(0, 2, 1, 3).reshape(b, s, e)
+            h = h + jnp.einsum("bse,fe->bsf", att, lp["wo"].astype(dt))
+            x = rmsnorm(h, lp["norm2"])
+            x = jax.nn.relu(
+                jnp.einsum("bse,me->bsm", x, lp["w1"].astype(dt)))
+            h = h + jnp.einsum("bsm,em->bse", x, lp["w2"].astype(dt))
+            return h
+        return block
+
+    def apply(self, params, inputs, ctx):
+        b, _, s, e = inputs[0].shape
+        dt = ctx.compute_dtype
+        h = inputs[0].reshape(b, s, e).astype(dt)
+        block = self._block_fn(dt)
+        mesh = ctx.mesh
+        pipe = mesh.shape.get("pipe", 1) if mesh is not None else 1
+        if pipe > 1:
+            if self.nlayer % pipe != 0:
+                raise ValueError(
+                    "transformer_stack: nlayer %d not divisible by "
+                    "pipeline_parallel %d" % (self.nlayer, pipe))
+            from .ops import pipeline
+            nmb = self.n_microbatch or pipe
+            cast = {k: v.astype(dt) if v.ndim > 2 else v
+                    for k, v in params.items()}
+            h = pipeline.sharded_pipeline(mesh, block, cast, h, nmb)
+        else:
+            def body(hh, lp):
+                return block(lp, hh), None
+            h, _ = jax.lax.scan(body, h, params)
+        return [h.astype(jnp.float32).reshape(b, 1, s, e)]
 
 
 @register("softmax")

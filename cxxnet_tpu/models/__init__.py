@@ -220,6 +220,33 @@ input_shape = 3,32,32
 """
 
 
+def transformer_classifier(seq_len: int = 16, embed: int = 32,
+                           nlayer: int = 4, nhead: int = 4,
+                           nclass: int = 10, causal: int = 0,
+                           nhidden_mlp: int = 0) -> str:
+    """Deep transformer classifier on the depth-stacked
+    ``transformer_stack`` layer (no reference equivalent, SURVEY.md §5):
+    one block traced once, scanned over depth on a single chip or
+    pipelined over the mesh's ``pipe`` axis under ``pipeline_parallel``."""
+    mlp = nhidden_mlp or 4 * embed
+    return f"""
+netconfig=start
+layer[0->1] = transformer_stack:ts1
+  nlayer = {nlayer}
+  nhead = {nhead}
+  causal = {causal}
+  nhidden_mlp = {mlp}
+  random_type = xavier
+layer[1->2] = flatten
+layer[2->3] = fullc:fc1
+  nhidden = {nclass}
+  init_sigma = 0.01
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,{seq_len},{embed}
+"""
+
+
 def seq_classifier(seq_len: int = 16, embed: int = 32, nhead: int = 4,
                    nclass: int = 10, causal: int = 0) -> str:
     """Attention-based sequence classifier (no reference equivalent —

@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
 
 
 def force_host_cpu(n_devices: int = 8) -> None:
@@ -91,17 +92,19 @@ def select_devices(dev: str) -> List[jax.Device]:
 
 def make_mesh(devices: Sequence[jax.Device],
               model_parallel: int = 1,
-              seq_parallel: int = 1) -> Mesh:
-    """Device mesh over (data[, model][, seq]) axes.
+              seq_parallel: int = 1,
+              pipeline_parallel: int = 1) -> Mesh:
+    """Device mesh over (data[, model][, seq][, pipe]) axes.
 
-    1D data mesh by default; a ``model`` axis when tensor parallelism is
-    on; a ``seq`` axis when sequence parallelism is on (ring attention
-    shards the sequence over it — cxxnet_tpu/ops/ring_attention.py)."""
+    1D data mesh by default; a ``model`` axis for tensor/expert
+    parallelism; a ``seq`` axis for sequence parallelism (ring/ulysses
+    attention); a ``pipe`` axis for pipeline parallelism
+    (cxxnet_tpu/ops/pipeline.py)."""
     devs = np.asarray(devices)
-    inner = model_parallel * seq_parallel
+    inner = model_parallel * seq_parallel * pipeline_parallel
     if len(devs) % inner != 0:
         raise ValueError(
-            "#devices %d not divisible by model_parallel*seq_parallel %d"
+            "#devices %d not divisible by model*seq*pipe parallel %d"
             % (len(devs), inner))
     axes = [DATA_AXIS]
     shape = [len(devs) // inner]
@@ -111,6 +114,9 @@ def make_mesh(devices: Sequence[jax.Device],
     if seq_parallel > 1:
         axes.append(SEQ_AXIS)
         shape.append(seq_parallel)
+    if pipeline_parallel > 1:
+        axes.append(PIPE_AXIS)
+        shape.append(pipeline_parallel)
     return Mesh(devs.reshape(shape), tuple(axes))
 
 
@@ -174,6 +180,12 @@ def param_sharding(mesh: Mesh, layer_type: str, tag: str,
 
     On a 1D mesh everything is replicated (pure data parallelism).
     """
+    # pipeline parallelism: depth-stacked transformer params shard their
+    # layer dimension over the pipe axis — each stage owns L/P blocks
+    if layer_type == "transformer_stack" and PIPE_AXIS in mesh.shape \
+            and shape and shape[0] % mesh.shape[PIPE_AXIS] == 0:
+        return NamedSharding(mesh, P(*([PIPE_AXIS]
+                                       + [None] * (len(shape) - 1))))
     if MODEL_AXIS not in mesh.shape:
         return replicated(mesh)
     n_model = mesh.shape[MODEL_AXIS]
@@ -188,7 +200,39 @@ def param_sharding(mesh: Mesh, layer_type: str, tag: str,
     if tag == "bias" and len(shape) == 1 and ok(0) \
             and layer_type in ("fullc", "conv"):
         return NamedSharding(mesh, P(MODEL_AXIS))
+    # expert parallelism: MoE tensors all carry experts on dim 0 — each
+    # device owns E/n experts; GSPMD inserts the dispatch/combine
+    # all-to-alls around the per-expert matmuls
+    if layer_type == "moe_fullc" and ok(0):
+        return NamedSharding(mesh, P(*([MODEL_AXIS]
+                                       + [None] * (len(shape) - 1))))
     return replicated(mesh)
+
+
+def zero_sharding(mesh: Mesh, base: NamedSharding,
+                  shape: Tuple[int, ...]) -> NamedSharding:
+    """ZeRO-1 placement for one optimizer slot (momentum/adam moments).
+
+    The reference keeps a full optimizer state per weight on every worker
+    (and a second full copy on the PS server under update_on_server,
+    nnet_ps_server.cpp:116-129). Here slots shard over the ``data`` axis:
+    each data-parallel replica owns 1/n of the momentum, GSPMD turns the
+    gradient all-reduce + update into reduce-scatter / local update /
+    all-gather — the ZeRO-1 pattern, expressed purely as a sharding
+    annotation on the slot.
+
+    Extends the weight's own placement (tensor-parallel dims stay as they
+    are) by sharding the first free, divisible dimension over ``data``.
+    """
+    ndata = mesh.shape.get(DATA_AXIS, 1)
+    if ndata <= 1:
+        return base
+    spec = list(base.spec) + [None] * (len(shape) - len(base.spec))
+    for dim, (used, size) in enumerate(zip(spec, shape)):
+        if used is None and size % ndata == 0 and size > 0:
+            spec[dim] = DATA_AXIS
+            return NamedSharding(mesh, P(*spec))
+    return base
 
 
 def fit_devices_to_batch(n_devices: int, batch_size: int) -> int:

@@ -15,8 +15,10 @@ module adds
   ``StepTraceAnnotation`` so the trace viewer groups ops by train step;
 * device-memory reporting (per-chip peak bytes) at round end.
 
-All of it is inert unless enabled, so the reference's stdout/stderr
-format is unchanged by default.
+Trace capture and memory reporting are inert unless ``profile = 1``. The
+per-round speed summary prints whenever ``silent = 0`` (an addition to
+the reference's stdout; the compatibility surface — the stderr
+``name-metric:value`` eval lines and the model format — is unchanged).
 """
 
 from __future__ import annotations

@@ -30,6 +30,16 @@ from .updater import NetUpdater
 ConfigEntry = Tuple[str, str]
 
 
+class StagedBatch:
+    """A batch whose host->device transfer has been issued (Trainer.stage)."""
+
+    __slots__ = ("device", "host")
+
+    def __init__(self, device, host: DataBatch) -> None:
+        self.device = device
+        self.host = host
+
+
 class Trainer:
     """Config-driven trainer; mirrors the INetTrainer contract
     (reference: src/nnet/nnet.h:18-92)."""
@@ -45,6 +55,8 @@ class Trainer:
         self.compute_dtype = "float32"
         self.model_parallel = 1
         self.seq_parallel = 1
+        self.pipeline_parallel = 1
+        self.zero = 0
         self.epoch_counter = 0
         self.sample_counter = 0
         self.round = 0
@@ -81,6 +93,10 @@ class Trainer:
             self.model_parallel = int(val)
         elif name == "seq_parallel":
             self.seq_parallel = int(val)
+        elif name == "pipeline_parallel":
+            self.pipeline_parallel = int(val)
+        elif name == "zero":
+            self.zero = int(val)
         if name.startswith("metric"):
             import re
             m = re.match(r"metric\[([^,\]]+),([^\]]+)\]", name)
@@ -121,11 +137,13 @@ class Trainer:
         devices = parallel.select_devices(self.dev)
         mp = self.model_parallel
         sp = self.seq_parallel
-        inner = mp * sp
+        pp = self.pipeline_parallel
+        inner = mp * sp * pp
         if len(devices) % inner != 0:
             raise ValueError(
-                "model_parallel=%d * seq_parallel=%d does not divide %d "
-                "devices" % (mp, sp, len(devices)))
+                "model_parallel=%d * seq_parallel=%d * pipeline_parallel"
+                "=%d does not divide %d devices"
+                % (mp, sp, pp, len(devices)))
         if jax.process_count() > 1:
             # trimming devices could orphan a whole process's chips;
             # require an even split instead, with data shards aligned to
@@ -149,10 +167,12 @@ class Trainer:
                 print("Warning: using %d of %d devices to split "
                       "batch_size=%d" % (ndev, len(devices), self.batch_size))
         self.mesh = parallel.make_mesh(devices[:ndev], model_parallel=mp,
-                                       seq_parallel=sp)
+                                       seq_parallel=sp,
+                                       pipeline_parallel=pp)
         self.n_devices = ndev
-        if sp > 1:
+        if sp > 1 or pp > 1:
             self.net.mesh = self.mesh
+        if sp > 1:
             self.net.seq_axis = parallel.SEQ_AXIS
         # resolve eval node requests (reference nnet_impl-inl.hpp:363-374)
         self.eval_req: List[int] = []
@@ -188,13 +208,22 @@ class Trainer:
         # input node: additionally sharded over the seq axis when present
         xsh = parallel.input_sharding(self.mesh, self.net.node_shapes[0])
         psh = self._param_shardings(params)
-        # optimizer slots shard exactly like their weights
+        # optimizer slots shard like their weights; with zero=1 they
+        # additionally shard over the data axis (ZeRO-1,
+        # parallel.zero_sharding)
+        def slot_sharding(li, tag):
+            base = psh[li][tag]
+            if not self.zero:
+                return base
+            return parallel.zero_sharding(
+                self.mesh, base, tuple(np.shape(params[li][tag])))
         osh = []
         for li, s in enumerate(opt_state):
             if s is None:
                 osh.append(None)
             else:
-                osh.append({tag: {slot: psh[li][tag] for slot in slots}
+                osh.append({tag: {slot: slot_sharding(li, tag)
+                                  for slot in slots}
                             for tag, slots in s.items()})
         self.params = jax.device_put(params, psh)
         self.opt_state = jax.device_put(opt_state, osh)
@@ -338,9 +367,36 @@ class Trainer:
             return (self._put_data(data, self._xsh),
                     tuple(self._put_data(e) for e in extras),
                     [self._put_data(l) for l in labels])
+        if self.n_devices == 1:
+            # uncommitted put: the sharded-commit path costs 5-10x more on
+            # some transports (observed through the TPU tunnel) and a
+            # 1-device mesh needs no placement anyway
+            return jax.device_put((data, extras, labels))
         shard = (self._xsh, tuple([self._dsh] * len(extras)),
                  [self._dsh] * len(labels))
         return jax.device_put((data, extras, labels), shard)
+
+    def stage(self, batch: DataBatch) -> "StagedBatch":
+        """Start the host->device transfer of a batch ahead of time.
+
+        The returned handle can be passed to update() in place of the raw
+        batch; staging batch k+1 (typically from a helper thread) while
+        batch k computes double-buffers the H2D transfer behind the MXU
+        work — the device-side analogue of the reference's ThreadBuffer
+        prefetch stages (src/utils/thread_buffer.h:22).
+
+        The labels are snapshotted: iterators may legally reuse their
+        buffers after the next next() call, but update() reads the staged
+        batch's labels later for the train metric."""
+        self._maybe_set_norm(batch)
+        host = batch
+        if batch.label is not None:
+            host = DataBatch(
+                data=batch.data, label=np.array(batch.label),
+                num_batch_padd=batch.num_batch_padd,
+                extra_data=batch.extra_data, inst_index=batch.inst_index,
+                norm=batch.norm)
+        return StagedBatch(self._put_batch(batch), host)
 
     def _label_dict(self, batch: DataBatch,
                     skip_pad: bool = False) -> Dict[str, np.ndarray]:
@@ -377,10 +433,15 @@ class Trainer:
                                    cur_mean.reshape(-1)[:4], cur_scale))
 
     # ------------------------------------------------------------------
-    def update(self, batch: DataBatch) -> None:
-        """One minibatch of training (reference: nnet_impl-inl.hpp:141-185)."""
-        self._maybe_set_norm(batch)
-        data, extras, labels = self._put_batch(batch)
+    def update(self, batch) -> None:
+        """One minibatch of training (reference: nnet_impl-inl.hpp:141-185).
+        Accepts a DataBatch or a StagedBatch from stage()."""
+        if isinstance(batch, StagedBatch):
+            data, extras, labels = batch.device
+            batch = batch.host
+        else:
+            self._maybe_set_norm(batch)
+            data, extras, labels = self._put_batch(batch)
         self._step_count += 1
         if self.update_period == 1:
             (self.params, self.opt_state, self._rng, self._epoch_dev,

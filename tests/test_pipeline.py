@@ -1,0 +1,133 @@
+"""Pipeline parallelism: GPipe microbatch pipelining over the pipe axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.7). These tests
+check the SPMD pipeline (cxxnet_tpu/ops/pipeline.py) is numerically exact
+against the single-device depth scan, and that training a pipelined
+transformer matches the unpipelined trajectory.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu import config, models, parallel
+from cxxnet_tpu.io import DataBatch
+from cxxnet_tpu.ops import pipeline
+from cxxnet_tpu.trainer import Trainer
+
+
+def _block(lp, h):
+    # toy block: affine + tanh, params dict like the real layer's slices
+    return jnp.tanh(h @ lp["w"] + lp["b"])
+
+
+def _stacked(L, d, seed=0):
+    rs = np.random.RandomState(seed)
+    return {"w": jnp.asarray(rs.randn(L, d, d).astype(np.float32)) * 0.3,
+            "b": jnp.asarray(rs.randn(L, d).astype(np.float32)) * 0.1}
+
+
+def _scan_ref(params, x):
+    def body(h, lp):
+        return _block(lp, h), None
+    out, _ = jax.lax.scan(body, x, params)
+    return out
+
+
+@pytest.mark.parametrize("pp,nmb", [(2, 2), (4, 4), (4, 8)])
+def test_pipeline_matches_scan(pp, nmb):
+    L, d, b = 8, 16, 16
+    params = _stacked(L, d)
+    x = jnp.asarray(np.random.RandomState(1).randn(b, d).astype(np.float32))
+    ref = _scan_ref(params, x)
+    mesh = parallel.make_mesh(jax.devices()[:pp], pipeline_parallel=pp)
+    out = pipeline.sharded_pipeline(mesh, _block, params, x, nmb)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_with_data_axis():
+    L, d, b = 4, 8, 16
+    params = _stacked(L, d)
+    x = jnp.asarray(np.random.RandomState(2).randn(b, d).astype(np.float32))
+    ref = _scan_ref(params, x)
+    mesh = parallel.make_mesh(jax.devices()[:8], pipeline_parallel=4)
+    assert dict(mesh.shape) == {"data": 2, "pipe": 4}
+    out = pipeline.sharded_pipeline(mesh, _block, params, x, 2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_gradients_match():
+    L, d, b = 4, 8, 8
+    params = _stacked(L, d)
+    x = jnp.asarray(np.random.RandomState(3).randn(b, d).astype(np.float32))
+    mesh = parallel.make_mesh(jax.devices()[:4], pipeline_parallel=4)
+
+    g_ref = jax.grad(lambda p: jnp.sum(_scan_ref(p, x) ** 2))(params)
+    g_pp = jax.grad(lambda p: jnp.sum(
+        pipeline.sharded_pipeline(mesh, _block, p, x, 4) ** 2))(params)
+    for k in g_ref:
+        np.testing.assert_allclose(np.asarray(g_pp[k]),
+                                   np.asarray(g_ref[k]),
+                                   rtol=3e-5, atol=3e-5)
+
+
+# ----------------------------------------------------------------------
+def _trainer(pp, seed=0, nlayer=4):
+    tr = Trainer()
+    text = models.transformer_classifier(seq_len=8, embed=16, nlayer=nlayer,
+                                         nhead=2, nhidden_mlp=32)
+    for k, v in config.parse_string(text):
+        tr.set_param(k, v)
+    tr.set_param("dev", "cpu")
+    tr.set_param("batch_size", "8")
+    tr.set_param("eta", "0.1")
+    tr.set_param("seed", str(seed))
+    tr.set_param("metric", "error")
+    if pp > 1:
+        tr.set_param("pipeline_parallel", str(pp))
+    tr.init_model()
+    return tr
+
+
+def test_transformer_stack_trains_single_device():
+    tr = _trainer(pp=1)
+    rs = np.random.RandomState(0)
+    b = DataBatch(data=rs.randn(8, 1, 8, 16).astype(np.float32),
+                  label=rs.randint(0, 10, size=(8, 1)).astype(np.float32))
+    w0 = tr.get_weight("ts1", "wqkv").copy()
+    for _ in range(3):
+        tr.update(b)
+    w1 = tr.get_weight("ts1", "wqkv")
+    assert np.isfinite(w1).all() and np.abs(w1 - w0).max() > 0
+
+
+def test_pipelined_training_matches_single():
+    rs = np.random.RandomState(5)
+    batches = [
+        DataBatch(data=rs.randn(8, 1, 8, 16).astype(np.float32),
+                  label=rs.randint(0, 10, size=(8, 1)).astype(np.float32))
+        for _ in range(3)]
+    tr1 = _trainer(pp=1, seed=4)
+    tr2 = _trainer(pp=4, seed=4)
+    assert dict(tr2.mesh.shape) == {"data": 2, "pipe": 4}
+    # stack params sharded over the pipe axis
+    li = tr2.net_cfg.get_layer_index("ts1")
+    assert tuple(tr2._psh[li]["wqkv"].spec)[0] == parallel.PIPE_AXIS
+    for b in batches:
+        tr1.update(b)
+        tr2.update(b)
+    w1 = tr1.get_weight("ts1", "wo")
+    w2 = tr2.get_weight("ts1", "wo")
+    np.testing.assert_allclose(w1, w2, rtol=1e-4, atol=1e-5)
+
+
+def test_nlayer_must_divide_pipe():
+    with pytest.raises(ValueError, match="not divisible"):
+        tr = _trainer(pp=4, nlayer=3)
+        rs = np.random.RandomState(0)
+        tr.update(DataBatch(
+            data=rs.randn(8, 1, 8, 16).astype(np.float32),
+            label=rs.randint(0, 10, size=(8, 1)).astype(np.float32)))

@@ -1,0 +1,268 @@
+"""Ulysses (all-to-all) sequence parallelism, MoE expert parallelism, and
+ZeRO-1 optimizer-state sharding on the 8-device virtual mesh.
+
+All three are TPU-first capabilities beyond the reference's single
+data-parallel strategy (SURVEY.md §2.7 lists SP/EP as absent and the PS
+keeps full optimizer state everywhere).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu import config, models, parallel
+from cxxnet_tpu.io import DataBatch, create_iterator
+from cxxnet_tpu.ops import ring_attention as ra
+from cxxnet_tpu.ops import ulysses
+from cxxnet_tpu.trainer import Trainer
+
+
+def _qkv(b=2, h=4, s=32, d=8, seed=0):
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+# ----------------------------------------------------------------------
+# ulysses
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full(causal):
+    q, k, v = _qkv()
+    ref = ra.attention(q, k, v, causal=causal)
+    mesh = parallel.make_mesh(jax.devices()[:4], seq_parallel=4)
+    out = ulysses.sharded_ulysses(mesh, q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_matches_ring():
+    q, k, v = _qkv(b=4, s=16)
+    mesh = parallel.make_mesh(jax.devices()[:8], seq_parallel=4)
+    r = ra.sharded_attention(mesh, q, k, v)
+    u = ulysses.sharded_ulysses(mesh, q, k, v)
+    np.testing.assert_allclose(np.asarray(u), np.asarray(r),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_needs_divisible_heads():
+    q, k, v = _qkv(h=3, s=16)
+    mesh = parallel.make_mesh(jax.devices()[:4], seq_parallel=4)
+    with pytest.raises(ValueError, match="not divisible"):
+        ulysses.sharded_ulysses(mesh, q, k, v)
+
+
+def _seq_trainer(sp, algo, seed=0):
+    tr = Trainer()
+    text = models.seq_classifier(seq_len=16, embed=32, nhead=4)
+    if algo:
+        text = text.replace("layer[+1] = attention:att1",
+                            "layer[+1] = attention:att1\n  seq_algo = "
+                            + algo)
+    for k, v in config.parse_string(text):
+        tr.set_param(k, v)
+    tr.set_param("dev", "cpu")
+    tr.set_param("batch_size", "8")
+    tr.set_param("eta", "0.1")
+    tr.set_param("seed", str(seed))
+    tr.set_param("metric", "error")
+    if sp > 1:
+        tr.set_param("seq_parallel", str(sp))
+    tr.init_model()
+    return tr
+
+
+def test_ulysses_training_matches_single():
+    rs = np.random.RandomState(3)
+    batches = [
+        DataBatch(data=rs.randn(8, 1, 16, 32).astype(np.float32),
+                  label=rs.randint(0, 10, size=(8, 1)).astype(np.float32))
+        for _ in range(3)]
+    tr1 = _seq_trainer(1, None)
+    tr2 = _seq_trainer(4, "alltoall")
+    for b in batches:
+        tr1.update(b)
+        tr2.update(b)
+    w1 = tr1.get_weight("att1", "wqkv")
+    w2 = tr2.get_weight("att1", "wqkv")
+    np.testing.assert_allclose(w1, w2, rtol=1e-4, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# MoE + expert parallelism
+MOE_CONF = """
+netconfig=start
+layer[+1:m1] = moe_fullc:m1
+  nhidden = 32
+  nexpert = 4
+  moe_topk = 2
+  init_sigma = 0.1
+layer[+1:r1] = relu
+layer[r1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+dev = cpu
+eta = 0.1
+momentum = 0.9
+metric = error
+"""
+
+
+def _moe_trainer(**overrides):
+    tr = Trainer()
+    for k, v in config.parse_string(MOE_CONF):
+        tr.set_param(k, v)
+    for k, v in overrides.items():
+        tr.set_param(k, str(v))
+    tr.init_model()
+    return tr
+
+
+def _synth(batch=64):
+    return create_iterator([
+        ("iter", "synth"), ("batch_size", str(batch)), ("shape", "1,1,16"),
+        ("nclass", "4"), ("ninst", "256"), ("shuffle", "1"), ("iter", "end")])
+
+
+def test_moe_learns():
+    tr = _moe_trainer()
+    itr = _synth()
+    errs = []
+    for r in range(8):
+        tr.start_round(r)
+        itr.before_first()
+        while itr.next():
+            tr.update(itr.value)
+        errs.append(float(tr.evaluate(itr, "t").split(":")[-1]))
+    assert errs[-1] < 0.3, errs
+
+
+def test_moe_param_shapes_and_expert_sharding():
+    tr = _moe_trainer(model_parallel=2)
+    li = tr.net_cfg.get_layer_index("m1")
+    p = tr.params[li]
+    assert p["wmat"].shape == (4, 32, 16)
+    assert p["bias"].shape == (4, 32)
+    assert p["gate"].shape == (4, 16)
+    # experts sharded over the model axis
+    spec = tr._psh[li]["wmat"].spec
+    assert spec[0] == parallel.MODEL_AXIS
+    # one step runs under expert parallelism (params are donated, so
+    # re-read the post-step tensors)
+    itr = _synth()
+    itr.before_first(); itr.next()
+    tr.update(itr.value)
+    assert np.isfinite(np.asarray(tr.params[li]["wmat"])).all()
+
+
+def test_moe_ep_matches_dp():
+    """Expert-parallel training equals the replicated run."""
+    itr = _synth()
+    tr1 = _moe_trainer(seed=5)
+    tr2 = _moe_trainer(seed=5, model_parallel=4)
+    for r in range(2):
+        for tr in (tr1, tr2):
+            tr.start_round(r)
+            itr.before_first()
+            while itr.next():
+                tr.update(itr.value)
+    w1 = tr1.get_weight("m1", "gate")
+    w2 = tr2.get_weight("m1", "gate")
+    # sharded einsums reduce in a different order; drift compounds over
+    # the 2x4 training batches, so this is a trajectory check, not bitwise
+    np.testing.assert_allclose(w1, w2, rtol=5e-2, atol=5e-3)
+
+
+def test_moe_aux_loss_contributes():
+    tr = _moe_trainer()
+    li = tr.net_cfg.get_layer_index("m1")
+    mod = tr.net.modules[li]
+    assert mod.moe_loss > 0
+    from cxxnet_tpu.layers import ApplyContext
+    ctx = ApplyContext(train=True, compute_dtype=jnp.float32,
+                       rng=jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 1, 1, 16),
+                    jnp.float32)
+    mod.apply(tr.params[li], [x], ctx)
+    assert len(ctx.losses) == 1
+    assert float(ctx.losses[0]) >= 0
+
+
+def test_moe_capacity_drops_overflow():
+    """With capacity_factor tiny, most tokens drop but the layer still
+    produces finite output."""
+    tr = _moe_trainer(capacity_factor="0.1")
+    itr = _synth()
+    itr.before_first(); itr.next()
+    tr.update(itr.value)
+    out = tr.predict(itr.value)
+    assert np.isfinite(out).all()
+
+
+# ----------------------------------------------------------------------
+# ZeRO-1
+MLP_CONF = MOE_CONF.replace(
+    """layer[+1:m1] = moe_fullc:m1
+  nhidden = 32
+  nexpert = 4
+  moe_topk = 2
+  init_sigma = 0.1""",
+    """layer[+1:m1] = fullc:m1
+  nhidden = 32
+  init_sigma = 0.1""")
+
+
+def _mlp_trainer(**overrides):
+    tr = Trainer()
+    for k, v in config.parse_string(MLP_CONF):
+        tr.set_param(k, v)
+    for k, v in overrides.items():
+        tr.set_param(k, str(v))
+    tr.init_model()
+    return tr
+
+
+def test_zero_shards_opt_state_and_matches_dp():
+    tr1 = _mlp_trainer(seed=2)
+    tr2 = _mlp_trainer(seed=2, zero=1)
+    # momentum slots sharded over the data axis
+    li = tr2.net_cfg.get_layer_index("fc2")
+    s = tr2.opt_state[li]["wmat"]
+    slot = next(iter(s.values()))
+    assert parallel.DATA_AXIS in set(
+        ax for ax in tuple(slot.sharding.spec) if ax)
+    # single-step equivalence: the sharded update computes the same math
+    # (over many momentum steps the all-reduce vs reduce-scatter orders
+    # compound chaotically, so longer trajectories are not bitwise)
+    itr = _synth()
+    itr.before_first(); itr.next()
+    b = itr.value
+    tr1.update(b)
+    tr2.update(b)
+    np.testing.assert_allclose(tr1.get_weight("fc2", "wmat"),
+                               tr2.get_weight("fc2", "wmat"),
+                               rtol=1e-4, atol=1e-5)
+    # and a longer sharded run stays healthy
+    for r in range(2):
+        tr2.start_round(r)
+        itr.before_first()
+        while itr.next():
+            tr2.update(itr.value)
+    assert np.isfinite(tr2.get_weight("fc2", "wmat")).all()
+
+
+def test_zero_checkpoint_roundtrip(tmp_path):
+    tr = _moe_trainer(zero=1)  # MoE here: exercises sharded 3D slots
+    itr = _synth()
+    itr.before_first(); itr.next()
+    tr.update(itr.value)
+    path = str(tmp_path / "m.model")
+    tr.save_model(path)
+    tr2 = _moe_trainer(zero=1)
+    tr2.load_model(path)
+    np.testing.assert_allclose(tr.get_weight("m1", "gate"),
+                               tr2.get_weight("m1", "gate"), rtol=1e-6)
