@@ -1,8 +1,16 @@
 """Evaluation metrics (reference: src/utils/metric.h:20-236).
 
-Metrics run host-side on numpy arrays copied off-device, like the
-reference's CPU metric path, and print in the identical
-``\\tname-metric:value`` stderr format.
+Two execution paths with identical math and the identical
+``\\tname-metric:value`` stderr format:
+
+* host path (``add_eval``) — numpy on arrays copied off-device, like the
+  reference's CPU metric path; used by the wrapper API.
+* device path (``device_eval`` / ``MetricSet.device_stats``) — the same
+  statistics computed inside the jitted step and accumulated into a tiny
+  (n_metrics, 2) running (sum, count) buffer carried on device; the host
+  fetches it ONCE per round instead of copying every batch's scores
+  off-device (a per-step D2H round trip the reference pays by design,
+  nnet_impl-inl.hpp:174-180).
 """
 
 from __future__ import annotations
@@ -35,6 +43,11 @@ class Metric:
     def _calc(self, pred: np.ndarray, label: np.ndarray) -> float:
         raise NotImplementedError
 
+    def device_eval(self, pred, label, mask):
+        """jnp (sum, cnt) over the masked rows — same math as add_eval.
+        pred (n, k), label (n, w), mask (n,) f32 row-validity weights."""
+        raise NotImplementedError
+
 
 class MetricRMSE(Metric):
     """Summed squared error per instance (reference: metric.h:73-89 —
@@ -46,6 +59,14 @@ class MetricRMSE(Metric):
             raise ValueError("RMSE: size of prediction and label must match")
         self.sum_metric += float(((pred - label) ** 2).sum())
         self.cnt_inst += pred.shape[0]
+
+    def device_eval(self, pred, label, mask):
+        import jax.numpy as jnp
+        res = jnp.square(pred - label).sum(axis=1)
+        # where, not multiply: garbage in masked-out padding rows (NaN/Inf)
+        # must not poison the sum (the host path slices them off)
+        s = jnp.sum(jnp.where(mask > 0, res, 0.0))
+        return s, jnp.sum(mask)
 
 
 class MetricError(Metric):
@@ -60,6 +81,15 @@ class MetricError(Metric):
             maxidx = (pred[:, 0] > 0.0).astype(np.int64)
         self.sum_metric += float((maxidx != label[:, 0].astype(np.int64)).sum())
         self.cnt_inst += pred.shape[0]
+
+    def device_eval(self, pred, label, mask):
+        import jax.numpy as jnp
+        if pred.shape[1] != 1:
+            maxidx = jnp.argmax(pred, axis=1)
+        else:
+            maxidx = (pred[:, 0] > 0.0).astype(jnp.int32)
+        wrong = (maxidx != label[:, 0].astype(jnp.int32)).astype(jnp.float32)
+        return jnp.sum(jnp.where(mask > 0, wrong, 0.0)), jnp.sum(mask)
 
 
 class MetricLogloss(Metric):
@@ -82,6 +112,22 @@ class MetricLogloss(Metric):
             self.sum_metric += float(res.sum())
         self.cnt_inst += n
 
+    def device_eval(self, pred, label, mask):
+        import jax.numpy as jnp
+        if pred.shape[1] != 1:
+            tgt = label[:, 0].astype(jnp.int32)
+            py = jnp.take_along_axis(pred, tgt[:, None], axis=1)[:, 0]
+            py = jnp.clip(py, 1e-15, 1.0 - 1e-15)
+            res = -jnp.log(py)
+        else:
+            py = jnp.clip(pred[:, 0], 1e-15, 1.0 - 1e-15)
+            y = label[:, 0]
+            # note: the host path raises on NaN here (a data-bug guard);
+            # a jitted program cannot raise, so a NaN label surfaces as a
+            # nan metric at round end instead of an immediate error
+            res = -(y * jnp.log(py) + (1.0 - y) * jnp.log(1.0 - py))
+        return jnp.sum(jnp.where(mask > 0, res, 0.0)), jnp.sum(mask)
+
 
 class MetricRecall(Metric):
     """rec@n (reference: metric.h:135-172)."""
@@ -101,6 +147,19 @@ class MetricRecall(Metric):
         top = np.argsort(-pred, kind="stable")[: self.topn]
         hit = sum(1 for lab in label if lab in top)
         return float(hit) / label.shape[0]
+
+    def device_eval(self, pred, label, mask):
+        import jax
+        import jax.numpy as jnp
+        if pred.shape[1] < self.topn:
+            raise ValueError(
+                "rec@%d meaningless for list of %d"
+                % (self.topn, pred.shape[1]))
+        _, top = jax.lax.top_k(pred, self.topn)        # (n, topn)
+        hit = (top[:, None, :] == label[:, :, None].astype(jnp.int32)
+               ).any(axis=2).sum(axis=1).astype(jnp.float32)
+        rec = hit / label.shape[1]
+        return jnp.sum(jnp.where(mask > 0, rec, 0.0)), jnp.sum(mask)
 
 
 def create_metric(name: str) -> Optional[Metric]:
@@ -142,6 +201,49 @@ class MetricSet:
             if field not in labels:
                 raise ValueError("Metric: unknown target = %s" % field)
             m.add_eval(pred, labels[field])
+
+    def device_stats(self, predscores, labels: Dict[str, "np.ndarray"],
+                     mask):
+        """Inside a jit trace: (n_metrics, 2) array of (sum, cnt) for one
+        batch — the device half of the once-per-round metric path."""
+        import jax.numpy as jnp
+        if len(predscores) != len(self.evals):
+            raise ValueError("Metric: #scores must equal #metrics")
+        rows = []
+        for m, field, pred in zip(self.evals, self.label_fields, predscores):
+            if field not in labels:
+                raise ValueError("Metric: unknown target = %s" % field)
+            s, c = m.device_eval(pred, labels[field], mask)
+            rows.append(jnp.stack([s.astype(jnp.float32),
+                                   c.astype(jnp.float32)]))
+        return jnp.stack(rows)
+
+    def accum_zero(self) -> "np.ndarray":
+        """Fresh device accumulator: (n_metrics, 2, 2) of Kahan
+        (value, compensation) pairs for (sum, cnt)."""
+        return np.zeros((len(self.evals), 2, 2), np.float32)
+
+    @staticmethod
+    def device_fold(accum, stats):
+        """Kahan-compensated accumulate of one batch's (n_metrics, 2)
+        stats into the (n_metrics, 2, 2) running buffer — f32 on device
+        would otherwise drift over a long round (the host path sums in
+        f64)."""
+        import jax.numpy as jnp
+        total, comp = accum[..., 0], accum[..., 1]
+        y = stats - comp
+        t = total + y
+        comp = (t - total) - y
+        return jnp.stack([t, comp], axis=-1)
+
+    def add_stats(self, accum: "np.ndarray") -> None:
+        """Fold a fetched (n_metrics, 2, 2) Kahan buffer into the running
+        host totals."""
+        accum = np.asarray(accum, np.float64)
+        vals = accum[..., 0] - accum[..., 1]  # value minus pending comp
+        for i, m in enumerate(self.evals):
+            m.sum_metric += float(vals[i, 0])
+            m.cnt_inst += int(round(float(vals[i, 1])))
 
     def print(self, evname: str) -> str:
         out = []

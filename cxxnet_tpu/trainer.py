@@ -245,6 +245,32 @@ class Trainer:
         net, opt_ = self.net, self.opt
         eval_req = tuple(self.eval_req)
 
+        # device-side metric accumulation: a (n_metrics, 2) (sum, cnt)
+        # buffer rides the step and is fetched ONCE per round, replacing
+        # the reference's per-batch score copy-off (nnet_impl-inl.hpp:174)
+        self._use_dev_metric = (self.eval_train != 0
+                                and bool(self.train_metric.evals))
+        gbatch = self.global_batch
+        label_names = dict(self.net_cfg.label_name_map)
+
+        def metric_stats(metric_set, evals, labels, mask):
+            lab = {name: labels[idx] for name, idx in label_names.items()}
+            preds = [e.reshape(e.shape[0], -1) for e in evals]
+            return metric_set.device_stats(preds, lab, mask)
+
+        def fold_train_metric(maccum, evals, labels):
+            if not self._use_dev_metric:
+                return maccum
+            mask = jnp.ones((gbatch,), jnp.float32)
+            stats = metric_stats(self.train_metric, evals, labels, mask)
+            return MetricSet.device_fold(maccum, stats)
+
+        self._maccum_zero = (self.train_metric.accum_zero()
+                             if self._use_dev_metric
+                             else np.zeros((0, 2, 2), np.float32))
+        self._maccum = jax.device_put(jnp.asarray(self._maccum_zero), rep)
+        self._eaccum_zero = self.metric.accum_zero()
+
         def fwd_bwd(params, data, extras, labels, rng, epoch):
             def loss_fn(p):
                 values, loss = net.apply(
@@ -255,21 +281,35 @@ class Trainer:
                 loss_fn, has_aux=True)(params)
             return loss, evals, grads
 
-        def train_step(params, opt_state, rng, epoch, data, extras, labels):
+        def train_step(params, opt_state, rng, epoch, maccum,
+                       data, extras, labels):
             use, nxt = jax.random.split(rng)
             loss, evals, grads = fwd_bwd(params, data, extras, labels,
                                          use, epoch)
             grads = _strip_nones(grads)
             params2, opt2 = opt_.apply(params, grads, opt_state, epoch)
-            return params2, opt2, nxt, epoch + 1, loss, evals
+            maccum = fold_train_metric(maccum, evals, labels)
+            return params2, opt2, nxt, epoch + 1, maccum, loss
 
-        def accum_step(grad_accum, rng, params, epoch, data, extras, labels):
+        def accum_step(grad_accum, rng, maccum, params, epoch,
+                       data, extras, labels):
             use, nxt = jax.random.split(rng)
             loss, evals, grads = fwd_bwd(params, data, extras, labels,
                                          use, epoch)
             grads = _strip_nones(grads)
             acc = jax.tree.map(jnp.add, grad_accum, grads)
-            return acc, nxt, loss, evals
+            maccum = fold_train_metric(maccum, evals, labels)
+            return acc, nxt, maccum, loss
+
+        def eval_step(params, eaccum, data, extras, labels, mask):
+            # mask is built host-side per process (each process's padding
+            # sits at its LOCAL tail, so no global index threshold works
+            # multi-host) and ships sharded like the labels
+            values, _ = net.apply(params, data, extra_data=extras,
+                                  train=False)
+            evals = tuple(values[i] for i in eval_req)
+            stats = metric_stats(self.metric, evals, labels, mask)
+            return MetricSet.device_fold(eaccum, stats)
 
         def apply_accum(params, opt_state, grad_accum, epoch):
             params2, opt2 = opt_.apply(params, grad_accum, opt_state, epoch)
@@ -285,13 +325,17 @@ class Trainer:
         # without them XLA's sharding propagation may reshard an output
         # (e.g. over the seq axis), desyncing from in_shardings next step
         self._train_step = jax.jit(
-            train_step, donate_argnums=(0, 1, 2, 3),
-            in_shardings=(psh, osh, rep, rep, xsh, dsh, dsh),
-            out_shardings=(psh, osh, rep, rep, None, None))
+            train_step, donate_argnums=(0, 1, 2, 3, 4),
+            in_shardings=(psh, osh, rep, rep, rep, xsh, dsh, dsh),
+            out_shardings=(psh, osh, rep, rep, rep, None))
         self._accum_step = jax.jit(
-            accum_step, donate_argnums=(0, 1),
-            in_shardings=(gsh, rep, psh, rep, xsh, dsh, dsh),
-            out_shardings=(gsh, rep, None, None))
+            accum_step, donate_argnums=(0, 1, 2),
+            in_shardings=(gsh, rep, rep, psh, rep, xsh, dsh, dsh),
+            out_shardings=(gsh, rep, rep, None))
+        self._eval_step = jax.jit(
+            eval_step, donate_argnums=(1,),
+            in_shardings=(psh, rep, xsh, dsh, dsh, dsh),
+            out_shardings=rep)
         self._apply_accum = jax.jit(
             apply_accum, donate_argnums=(0, 1, 2, 3),
             in_shardings=(psh, osh, gsh, rep),
@@ -385,27 +429,11 @@ class Trainer:
         work — the device-side analogue of the reference's ThreadBuffer
         prefetch stages (src/utils/thread_buffer.h:22).
 
-        The labels are snapshotted: iterators may legally reuse their
-        buffers after the next next() call, but update() reads the staged
-        batch's labels later for the train metric."""
+        Everything update() consumes is in the device tuple (metrics
+        accumulate on device), so no host field outlives this call and
+        iterators may legally reuse their buffers afterwards."""
         self._maybe_set_norm(batch)
-        host = batch
-        if batch.label is not None:
-            host = DataBatch(
-                data=batch.data, label=np.array(batch.label),
-                num_batch_padd=batch.num_batch_padd,
-                extra_data=batch.extra_data, inst_index=batch.inst_index,
-                norm=batch.norm)
-        return StagedBatch(self._put_batch(batch), host)
-
-    def _label_dict(self, batch: DataBatch,
-                    skip_pad: bool = False) -> Dict[str, np.ndarray]:
-        n = batch.batch_size - (batch.num_batch_padd if skip_pad else 0)
-        out = {}
-        for fname, idx in self.net_cfg.label_name_map.items():
-            a, b = self.net_cfg.label_range[idx]
-            out[fname] = np.asarray(batch.label[:n, a:b])
-        return out
+        return StagedBatch(self._put_batch(batch), batch)
 
     def start_round(self, round_: int) -> None:
         self.round = round_
@@ -438,29 +466,25 @@ class Trainer:
         Accepts a DataBatch or a StagedBatch from stage()."""
         if isinstance(batch, StagedBatch):
             data, extras, labels = batch.device
-            batch = batch.host
         else:
             self._maybe_set_norm(batch)
             data, extras, labels = self._put_batch(batch)
         self._step_count += 1
         if self.update_period == 1:
             (self.params, self.opt_state, self._rng, self._epoch_dev,
-             loss, evals) = self._train_step(
+             self._maccum, loss) = self._train_step(
                 self.params, self.opt_state, self._rng, self._epoch_dev,
-                data, extras, labels)
+                self._maccum, data, extras, labels)
         else:
-            self.grad_accum, self._rng, loss, evals = self._accum_step(
-                self.grad_accum, self._rng, self.params, self._epoch_dev,
-                data, extras, labels)
+            (self.grad_accum, self._rng, self._maccum,
+             loss) = self._accum_step(
+                self.grad_accum, self._rng, self._maccum, self.params,
+                self._epoch_dev, data, extras, labels)
             if (self.sample_counter + 1) % self.update_period == 0:
                 (self.params, self.opt_state, self.grad_accum,
                  self._epoch_dev) = self._apply_accum(
                     self.params, self.opt_state, self.grad_accum,
                     self._epoch_dev)
-        if self.eval_train != 0 and self.train_metric.evals:
-            scores = [self._fetch_local(e) for e in evals]
-            scores = [e.reshape(e.shape[0], -1) for e in scores]
-            self.train_metric.add_eval(scores, self._label_dict(batch))
         self.sample_counter += 1
         if self.sample_counter >= self.update_period:
             self.sample_counter = 0
@@ -504,9 +528,18 @@ class Trainer:
     # ------------------------------------------------------------------
     def evaluate(self, iter_eval: Optional[DataIterator],
                  data_name: str) -> str:
-        """Round-end metric report (reference: nnet_impl-inl.hpp:224-245)."""
+        """Round-end metric report (reference: nnet_impl-inl.hpp:224-245).
+
+        Both halves run on accumulated device statistics: the train
+        metric buffer rode the train steps; the eval set streams through
+        a jitted forward+metric step. Exactly one small D2H fetch per
+        MetricSet per round."""
+        rep = parallel.replicated(self.mesh)
         ret = ""
-        if self.eval_train != 0 and self.train_metric.evals:
+        if self._use_dev_metric:
+            self.train_metric.add_stats(np.asarray(self._maccum))
+            self._maccum = jax.device_put(
+                jnp.asarray(self._maccum_zero), rep)
             ret += self.train_metric.print("train")
             self.train_metric.clear()
         if iter_eval is None:
@@ -514,13 +547,19 @@ class Trainer:
         if not self.metric.evals:
             return ret
         self.metric.clear()
+        eaccum = jax.device_put(jnp.asarray(self._eaccum_zero), rep)
         iter_eval.before_first()
         while iter_eval.next():
             batch = iter_eval.value
-            outs = self.forward_nodes(batch, self.eval_req)
-            n = batch.batch_size - batch.num_batch_padd
-            scores = [o[:n].reshape(n, -1) for o in outs]
-            self.metric.add_eval(scores, self._label_dict(batch, skip_pad=True))
+            self._maybe_set_norm(batch)
+            data, extras, labels = self._put_batch(batch)
+            nvalid = batch.batch_size - batch.num_batch_padd
+            hmask = np.zeros((batch.batch_size,), np.float32)
+            hmask[:nvalid] = 1.0
+            mask = self._put_data(hmask, self._dsh)
+            eaccum = self._eval_step(self.params, eaccum, data, extras,
+                                     labels, mask)
+        self.metric.add_stats(np.asarray(eaccum))
         ret += self.metric.print(data_name)
         return ret
 
