@@ -162,10 +162,15 @@ class LearnTask:
 
     def create_iterators(self) -> None:
         """Order-sensitive iterator sections (reference:
-        cxxnet_main.cpp:214-264): data/eval/pred ... iter=end."""
+        cxxnet_main.cpp:214-264): data/eval/pred ... iter=end. Global
+        (outside-section) keys are broadcast to every iterator before
+        init, like the reference's defcfg + InitIter — that is how a
+        global ``batch_size``/``input_shape`` reaches the pipeline."""
         flag = 0
         evname = ""
         itcfg: List[ConfigEntry] = []
+        defcfg: List[ConfigEntry] = []
+        pending: List[Tuple[int, str, List[ConfigEntry]]] = []
         for name, val in self.cfg:
             if name == "data":
                 flag = 1
@@ -179,20 +184,24 @@ class LearnTask:
                 self.name_pred = val
                 continue
             if name == "iter" and val == "end":
-                if flag == 1 and self.task != "pred":
-                    assert self.itr_train is None, "can only have one data"
-                    self.itr_train = create_iterator(itcfg)
-                elif flag == 2 and self.task != "pred":
-                    self.itr_evals.append(create_iterator(itcfg))
-                    self.eval_names.append(evname)
-                elif flag == 3 and self.task in ("pred", "extract"):
-                    assert self.itr_pred is None, "can only have one pred"
-                    self.itr_pred = create_iterator(itcfg)
+                pending.append((flag, evname, itcfg))
                 flag = 0
                 itcfg = []
                 continue
             if flag != 0:
                 itcfg.append((name, val))
+            else:
+                defcfg.append((name, val))
+        for flag, evname, itcfg in pending:
+            if flag == 1 and self.task != "pred":
+                assert self.itr_train is None, "can only have one data"
+                self.itr_train = create_iterator(itcfg, defcfg)
+            elif flag == 2 and self.task != "pred":
+                self.itr_evals.append(create_iterator(itcfg, defcfg))
+                self.eval_names.append(evname)
+            elif flag == 3 and self.task in ("pred", "extract"):
+                assert self.itr_pred is None, "can only have one pred"
+                self.itr_pred = create_iterator(itcfg, defcfg)
 
     # ------------------------------------------------------------------
     def save_model_file(self) -> None:

@@ -419,9 +419,16 @@ class AttachTxtIterator(DataIterator):
         return self._batch
 
 
-def create_iterator(cfg: Sequence[ConfigEntry]) -> DataIterator:
+def create_iterator(cfg: Sequence[ConfigEntry],
+                    defaults: Sequence[ConfigEntry] = ()) -> DataIterator:
     """Factory chaining iterators in config order
-    (reference: src/io/data.cpp:24-75)."""
+    (reference: src/io/data.cpp:24-75).
+
+    ``defaults`` are the global (outside-section) config keys, applied to
+    the finished chain after the section keys and before init — exactly
+    the reference's InitIter(itr, defcfg) broadcast
+    (cxxnet_main.cpp:205-212), which is how global ``batch_size`` /
+    ``input_shape`` reach every iterator."""
     base: Optional[DataIterator] = None
     pre_params: List[ConfigEntry] = []
     for name, val in cfg:
@@ -465,5 +472,7 @@ def create_iterator(cfg: Sequence[ConfigEntry]) -> DataIterator:
             base.set_param(name, val)
     if base is None:
         raise ValueError("config does not declare an iterator")
+    for k, v in defaults:
+        base.set_param(k, v)
     base.init()
     return base

@@ -1088,6 +1088,7 @@ class AttentionLayer(Layer):
         self.nhead = 1
         self.causal = 0
         self.seq_algo = "ring"
+        self.attn_impl = "xla"
 
     def set_param(self, name, val):
         if name == "nhead":
@@ -1098,6 +1099,10 @@ class AttentionLayer(Layer):
             if val not in ("ring", "alltoall", "ulysses"):
                 raise ValueError("seq_algo must be ring|alltoall|ulysses")
             self.seq_algo = val
+        elif name == "attn_impl":
+            if val not in ("xla", "pallas"):
+                raise ValueError("attn_impl must be xla|pallas")
+            self.attn_impl = val
         else:
             super().set_param(name, val)
 
@@ -1132,10 +1137,22 @@ class AttentionLayer(Layer):
             if self.seq_algo in ("alltoall", "ulysses"):
                 from .ops import ulysses
                 out = ulysses.sharded_ulysses(mesh, q, k, v, seq_axis=axis,
-                                              causal=bool(self.causal))
+                                              causal=bool(self.causal),
+                                              impl=self.attn_impl)
+            elif self.attn_impl == "pallas":
+                raise ValueError(
+                    "attention: attn_impl=pallas composes with "
+                    "seq_algo=alltoall (flash is the local attend after "
+                    "the head re-partition); ring attention uses its own "
+                    "online-softmax block attend")
             else:
                 out = ra.sharded_attention(mesh, q, k, v, seq_axis=axis,
                                            causal=bool(self.causal))
+        elif self.attn_impl == "pallas":
+            # flash attention: VMEM-blocked online softmax, O(s*d) memory
+            # (cxxnet_tpu/ops/flash_attention.py)
+            from .ops import flash_attention as fa
+            out = fa.flash_attention(q, k, v, bool(self.causal))
         else:
             out = ra.attention(q, k, v, causal=bool(self.causal))
         out = out.transpose(0, 2, 1, 3).reshape(b, s, e)

@@ -1,0 +1,310 @@
+"""Flash attention as Pallas TPU kernels (fwd + bwd, jax.custom_vjp).
+
+The XLA attention path (cxxnet_tpu/ops/ring_attention.attention)
+materialises the (s, s) logits in HBM — O(s^2) memory and two HBM round
+trips per layer. These kernels stream K/V through VMEM in blocks and
+keep the online-softmax statistics (running max / sum) in registers, so
+per-core attention memory is O(s*d + block^2):
+
+* forward — grid (batch*heads, q_blocks); fori_loop over k blocks with
+  the (m, l, acc) online-softmax carry; saves the per-row
+  log-sum-exp for the backward pass.
+* backward dq — same grid/loop shape; recomputes p = exp(qk - lse)
+  per block (the flash-attention recompute trick) and accumulates
+  dq += (p * (do.v^T - delta)) @ k.
+* backward dk/dv — grid over k blocks, looping q blocks, accumulating
+  dv += p^T do and dk += ds^T q.
+
+The kernels run compiled on TPU and in interpreter mode elsewhere, so
+the CPU test suite exercises the same code path the chip runs. Used by
+the attention layer via ``attn_impl = pallas``; composes with ulysses
+sequence parallelism (flash is the local attend after the all-to-all
+head re-partition). Ring attention keeps its own online-softmax block
+attend — its per-hop partials ARE the flash recurrence, just spread
+across chips.
+
+No reference analogue (cxxnet has no attention at all, SURVEY.md §5);
+this is the framework's marquee hand-written TPU kernel next to the
+Pallas LRN (cxxnet_tpu/ops/lrn.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(s: int, target: int = 128) -> int:
+    """Block size for sequence length s, honoring the TPU block-tiling
+    rule: a block must be a multiple of 128 (the lse lane dimension) or
+    equal to s (the equal-to-array-dim escape). Prefers the largest
+    128-multiple divisor of s up to ``target``; falls back to the whole
+    sequence (one block) when none exists."""
+    b = (min(s, target) // 128) * 128
+    while b >= 128:
+        if s % b == 0:
+            return b
+        b -= 128
+    return s
+
+
+def _causal_mask(qi, kb, block_q, block_k):
+    rows = qi * block_q + lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 0)
+    cols = kb * block_k + lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1)
+    return rows >= cols
+
+
+# ----------------------------------------------------------------------
+# forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale, causal, block_q, block_k, s):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale            # (bq, d)
+    d = q.shape[-1]
+    nk = s // block_k
+    if causal:
+        # skip k blocks entirely above the diagonal (their contribution
+        # is exactly zero) — the standard causal flash schedule
+        nk = jnp.minimum(nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        if block_k == s:
+            # static full slice: Mosaic requires dynamic offsets to be
+            # provably 128-aligned, which only multi-block (128-multiple,
+            # see _pick_block) layouts satisfy
+            k = k_ref[0].astype(jnp.float32)
+            v = v_ref[0].astype(jnp.float32)
+        else:
+            k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+            v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        logits = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if causal:
+            logits = jnp.where(_causal_mask(qi, kb, block_q, block_k),
+                               logits, NEG_INF)
+        mb = jnp.max(logits, axis=-1)
+        m2 = jnp.maximum(m, mb)
+        p = jnp.exp(logits - m2[:, None])
+        corr = jnp.exp(m - m2)
+        l2 = l * corr + p.sum(axis=-1)
+        acc2 = acc * corr[:, None] + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m2, l2, acc2
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    lsafe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / lsafe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(lsafe)
+
+
+def _fwd_impl(q, k, v, scale, causal, block_q, block_k):
+    bh, s, d = q.shape
+    grid = (bh, s // block_q)
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             block_q=block_q, block_k=block_k, s=s)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            # stats ride a (bh, 1, s) layout: a (1, 1, block_q) block
+            # satisfies the TPU (8, 128) tiling rule via the
+            # equal-to-array-dim escape on the singleton dim
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+# ----------------------------------------------------------------------
+# backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_q, block_k, s):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    d = q.shape[-1]
+    nk = s // block_k
+    if causal:
+        nk = jnp.minimum(nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+
+    def body(kb, dq):
+        if block_k == s:
+            k = k_ref[0].astype(jnp.float32)
+            v = v_ref[0].astype(jnp.float32)
+        else:
+            k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+            v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        logits = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        if causal:
+            logits = jnp.where(_causal_mask(qi, kb, block_q, block_k),
+                               logits, NEG_INF)
+        p = jnp.exp(logits - lse[:, None])
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, nk, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, block_q, block_k, s):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                    # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+    nq = s // block_q
+    q_lo = (ki * block_k) // block_q if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        if block_q == s:
+            q = q_ref[0].astype(jnp.float32)
+            do = do_ref[0].astype(jnp.float32)
+            lse = lse_ref[0, 0]
+            delta = delta_ref[0, 0]
+        else:
+            q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+            do = do_ref[0, pl.ds(qb * block_q, block_q),
+                        :].astype(jnp.float32)
+            lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+            delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        logits = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        if causal:
+            logits = jnp.where(_causal_mask(qb, ki, block_q, block_k),
+                               logits, NEG_INF)
+        p = jnp.exp(logits - lse[:, None])              # (bq, bk)
+        dv2 = dv + lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk2 = dk + lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        return dk2, dv2
+
+    z = jnp.zeros((k.shape[0], d), jnp.float32)
+    dk, dv = lax.fori_loop(q_lo, nq, body, (z, z))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+    bh, s, d = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, None, :]                 # (bh, 1, s)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, s=s),
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, s=s),
+        grid=(bh, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = False, scale=None):
+    """(b, h, s, d) attention, O(s*d) memory. Exact — same math as
+    ring_attention.attention, block-streamed."""
+    out, _ = _flash_fwd(q, k, v, causal, scale)
+    return out
+
+
+def _prep(q):
+    b, h, s, d = q.shape
+    return q.reshape(b * h, s, d)
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    b, h, s, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    block_q = _pick_block(s)
+    block_k = _pick_block(s)
+    q3, k3, v3 = _prep(q), _prep(k), _prep(v)
+    o3, lse = _fwd_impl(q3, k3, v3, scale, causal, block_q, block_k)
+    out = o3.reshape(b, h, s, d)
+    return out, (q3, k3, v3, o3, lse, out.shape)
+
+
+def _flash_bwd(causal, scale, res, g):
+    q3, k3, v3, o3, lse, shape = res
+    b, h, s, d = shape
+    if scale is None:
+        scale = d ** -0.5
+    block_q = _pick_block(s)
+    block_k = _pick_block(s)
+    do3 = g.reshape(b * h, s, d)
+    dq, dk, dv = _bwd_impl(q3, k3, v3, o3, lse, do3, scale, causal,
+                           block_q, block_k)
+    rs = lambda t: t.reshape(b, h, s, d)
+    return rs(dq), rs(dk), rs(dv)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

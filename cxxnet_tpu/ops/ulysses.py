@@ -29,11 +29,15 @@ from .ring_attention import attention as _full_attention
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis_name: str, causal: bool = False,
-                      scale: Optional[float] = None) -> jnp.ndarray:
+                      scale: Optional[float] = None,
+                      impl: str = "xla") -> jnp.ndarray:
     """Attention over sequence-sharded q/k/v inside shard_map.
 
     q/k/v: LOCAL (b, h, s_local, d) shards, sequence sharded over
-    ``axis_name``. Requires h divisible by the axis size.
+    ``axis_name``. Requires h divisible by the axis size. ``impl`` picks
+    the local full-attention implementation: ``xla`` (einsum) or
+    ``pallas`` (the flash-attention kernel — O(s*d) per-core memory,
+    cxxnet_tpu/ops/flash_attention.py).
     """
     n = lax.psum(1, axis_name)
     h = q.shape[1]
@@ -52,12 +56,16 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                               tiled=True)
 
     qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
-    out = _full_attention(qh, kh, vh, causal=causal, scale=scale)
+    if impl == "pallas":
+        from .flash_attention import flash_attention
+        out = flash_attention(qh, kh, vh, causal, scale)
+    else:
+        out = _full_attention(qh, kh, vh, causal=causal, scale=scale)
     return head_to_seq(out)
 
 
 def sharded_ulysses(mesh: Mesh, q, k, v, seq_axis: str = "seq",
-                    causal: bool = False) -> jnp.ndarray:
+                    causal: bool = False, impl: str = "xla") -> jnp.ndarray:
     """shard_map ulysses_attention over ``mesh``'s seq axis; global
     (b, h, s, d) in and out (mirror of ring_attention.sharded_attention)."""
     try:
@@ -68,6 +76,16 @@ def sharded_ulysses(mesh: Mesh, q, k, v, seq_axis: str = "seq",
     data = "data" if "data" in mesh.shape else None
     spec = P(data, None, seq_axis, None)
     fn = functools.partial(ulysses_attention, axis_name=seq_axis,
-                           causal=causal)
+                           causal=causal, impl=impl)
+    kw = {}
+    if impl == "pallas":
+        # pallas_call outputs carry no varying-mesh-axes annotation, so
+        # shard_map's replication checker must be off for the flash path
+        import inspect
+        params = inspect.signature(shard_map).parameters
+        if "check_vma" in params:
+            kw["check_vma"] = False
+        elif "check_rep" in params:
+            kw["check_rep"] = False
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+                     out_specs=spec, **kw)(q, k, v)
