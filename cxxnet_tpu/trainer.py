@@ -57,6 +57,8 @@ class Trainer:
         self.seq_parallel = 1
         self.pipeline_parallel = 1
         self.zero = 0
+        self.test_on_server = 0
+        self.nan_guard = 0
         self.epoch_counter = 0
         self.sample_counter = 0
         self.round = 0
@@ -97,6 +99,10 @@ class Trainer:
             self.pipeline_parallel = int(val)
         elif name == "zero":
             self.zero = int(val)
+        elif name == "test_on_server":
+            self.test_on_server = int(val)
+        elif name == "nan_guard":
+            self.nan_guard = int(val)
         if name.startswith("metric"):
             import re
             m = re.match(r"metric\[([^,\]]+),([^\]]+)\]", name)
@@ -258,16 +264,26 @@ class Trainer:
             preds = [e.reshape(e.shape[0], -1) for e in evals]
             return metric_set.device_stats(preds, lab, mask)
 
-        def fold_train_metric(maccum, evals, labels):
-            if not self._use_dev_metric:
-                return maccum
-            mask = jnp.ones((gbatch,), jnp.float32)
-            stats = metric_stats(self.train_metric, evals, labels, mask)
-            return MetricSet.device_fold(maccum, stats)
+        nan_guard = self.nan_guard != 0
 
-        self._maccum_zero = (self.train_metric.accum_zero()
-                             if self._use_dev_metric
-                             else np.zeros((0, 2, 2), np.float32))
+        def fold_train_metric(maccum, evals, labels, loss):
+            rows = []
+            if self._use_dev_metric:
+                mask = jnp.ones((gbatch,), jnp.float32)
+                rows.append(metric_stats(self.train_metric, evals,
+                                         labels, mask))
+            if nan_guard:
+                # an extra (nan-steps, steps) row so the watchdog works
+                # even with eval_train=0 / no train metric configured
+                isnan = jnp.isnan(loss).astype(jnp.float32)
+                rows.append(jnp.stack([isnan, jnp.asarray(1.0)])[None, :])
+            if not rows:
+                return maccum
+            return MetricSet.device_fold(maccum, jnp.concatenate(rows))
+
+        nrows = (len(self.train_metric.evals)
+                 if self._use_dev_metric else 0) + (1 if nan_guard else 0)
+        self._maccum_zero = np.zeros((nrows, 2, 2), np.float32)
         self._maccum = jax.device_put(jnp.asarray(self._maccum_zero), rep)
         self._eaccum_zero = self.metric.accum_zero()
 
@@ -288,7 +304,7 @@ class Trainer:
                                          use, epoch)
             grads = _strip_nones(grads)
             params2, opt2 = opt_.apply(params, grads, opt_state, epoch)
-            maccum = fold_train_metric(maccum, evals, labels)
+            maccum = fold_train_metric(maccum, evals, labels, loss)
             return params2, opt2, nxt, epoch + 1, maccum, loss
 
         def accum_step(grad_accum, rng, maccum, params, epoch,
@@ -298,7 +314,7 @@ class Trainer:
                                          use, epoch)
             grads = _strip_nones(grads)
             acc = jax.tree.map(jnp.add, grad_accum, grads)
-            maccum = fold_train_metric(maccum, evals, labels)
+            maccum = fold_train_metric(maccum, evals, labels, loss)
             return acc, nxt, maccum, loss
 
         def eval_step(params, eaccum, data, extras, labels, mask):
@@ -437,6 +453,43 @@ class Trainer:
 
     def start_round(self, round_: int) -> None:
         self.round = round_
+        if self.test_on_server:
+            bad = self.check_replica_consistency()
+            if bad:
+                raise RuntimeError(
+                    "replica consistency check failed for: %s"
+                    % ", ".join(bad))
+
+    def check_replica_consistency(self, atol: float = 0.0) -> List[str]:
+        """Verify every device's copy of each replicated weight agrees —
+        the mesh-native form of the reference's ``test_on_server`` check
+        (workers pull the PS's weights and diff them against their local
+        replica, async_updater-inl.hpp:148-153). With XLA collectives,
+        divergence means a broken collective / bad donation, so this is a
+        debugging aid, enabled per round with ``test_on_server = 1``.
+        Returns the names of divergent tensors."""
+        bad = []
+        for li, p in enumerate(self.params):
+            if p is None:
+                continue
+            lname = self.net_cfg.layers[li].name or ("layer%d" % li)
+            for tag, w in p.items():
+                if not w.is_fully_replicated:
+                    continue  # intentionally sharded (tp/ep/pipe)
+                shards = w.addressable_shards
+                if len(shards) < 2:
+                    continue
+                ref = np.asarray(shards[0].data)
+                for sh in shards[1:]:
+                    # equal_nan: bitwise-identical NaN replicas are
+                    # *consistent* — a NaN weight is a divergence problem,
+                    # not a broken collective, and must not be misreported
+                    if not np.allclose(np.asarray(sh.data), ref,
+                                       rtol=0.0, atol=atol,
+                                       equal_nan=True):
+                        bad.append("%s.%s" % (lname, tag))
+                        break
+        return bad
 
     def _maybe_set_norm(self, batch: DataBatch) -> None:
         """Adopt the pipeline's deferred normalization (DataBatch.norm).
@@ -536,10 +589,32 @@ class Trainer:
         MetricSet per round."""
         rep = parallel.replicated(self.mesh)
         ret = ""
-        if self._use_dev_metric:
-            self.train_metric.add_stats(np.asarray(self._maccum))
+        if self._use_dev_metric or self.nan_guard:
+            acc = np.asarray(self._maccum)
             self._maccum = jax.device_put(
                 jnp.asarray(self._maccum_zero), rep)
+            if self.nan_guard:
+                # round-end NaN containment: the per-element NaN-zeroing
+                # clip (updater._clip_nan) stops weight corruption; this
+                # stops a silently-NaN loss from burning further rounds.
+                # The last accum row counted NaN losses, so the guard
+                # works even with eval_train=0 / no train metric.
+                nan_steps = float(acc[-1, 0, 0] - acc[-1, 0, 1])
+                acc = acc[:-1]
+                if nan_steps > 0:
+                    raise RuntimeError(
+                        "nan_guard: the loss was NaN on %d step(s) this "
+                        "round; lower eta or set clip_gradient, and "
+                        "resume from the last checkpoint (continue=1)"
+                        % int(round(nan_steps)))
+        if self._use_dev_metric:
+            self.train_metric.add_stats(acc)
+            if self.nan_guard:
+                for m in self.train_metric.evals:
+                    if m.cnt_inst and np.isnan(m.get()):
+                        raise RuntimeError(
+                            "nan_guard: train metric '%s' is NaN (bad "
+                            "labels or diverged loss)" % m.name)
             ret += self.train_metric.print("train")
             self.train_metric.clear()
         if iter_eval is None:

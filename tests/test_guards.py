@@ -1,0 +1,118 @@
+"""In-band self-defense checks: replica consistency (the mesh-native
+test_on_server, reference async_updater-inl.hpp:148-153) and the NaN
+watchdog on top of the updater's NaN-zeroing clip."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu import config
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.trainer import Trainer
+
+CONF = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.1
+layer[+1:r1] = relu
+layer[r1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+dev = cpu
+eta = 0.1
+metric = error
+"""
+
+
+def _trainer(**overrides):
+    tr = Trainer()
+    for k, v in config.parse_string(CONF):
+        tr.set_param(k, v)
+    for k, v in overrides.items():
+        tr.set_param(k, str(v))
+    tr.init_model()
+    return tr
+
+
+def _synth(batch=64):
+    return create_iterator([
+        ("iter", "synth"), ("batch_size", str(batch)), ("shape", "1,1,16"),
+        ("nclass", "4"), ("ninst", "128"), ("iter", "end")])
+
+
+def test_replica_consistency_clean():
+    tr = _trainer(test_on_server=1)
+    itr = _synth()
+    itr.before_first(); itr.next()
+    tr.update(itr.value)
+    tr.start_round(1)  # runs the check; must not raise
+    assert tr.check_replica_consistency() == []
+
+
+def test_replica_consistency_detects_divergence():
+    tr = _trainer()
+    li = tr.net_cfg.get_layer_index("fc1")
+    w = np.asarray(tr.params[li]["wmat"])
+    # plant a divergent per-device copy behind the mesh's back
+    devs = list(tr.mesh.devices.flat)
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices")
+    copies = []
+    for i, d in enumerate(devs):
+        wi = w + (1.0 if i == len(devs) - 1 else 0.0)
+        copies.append(jax.device_put(wi, d))
+    bad = jax.make_array_from_single_device_arrays(
+        w.shape,
+        jax.sharding.NamedSharding(tr.mesh,
+                                   jax.sharding.PartitionSpec()),
+        copies)
+    params = list(tr.params)
+    params[li] = dict(params[li], wmat=bad)
+    tr.params = params
+    assert "fc1.wmat" in tr.check_replica_consistency()
+
+
+def test_nan_guard_trips():
+    tr = _trainer(nan_guard=1, metric="logloss")
+    itr = _synth()
+    itr.before_first(); itr.next()
+    b = itr.value
+    tr.update(b)
+    # poison the accumulated metric buffer
+    import jax.numpy as jnp
+    bad = np.array(tr._maccum)
+    bad[0, 0, 0] = np.nan
+    tr._maccum = jnp.asarray(bad)
+    with pytest.raises(RuntimeError, match="nan_guard"):
+        tr.evaluate(None, "train")
+
+
+def test_nan_guard_works_without_train_metric():
+    """eval_train=0 disables the train metric; the guard still watches
+    the loss itself via its own accumulator row."""
+    tr = _trainer(nan_guard=1, eval_train=0)
+    itr = _synth()
+    itr.before_first(); itr.next()
+    tr.update(itr.value)
+    assert tr._maccum.shape == (1, 2, 2)  # just the loss-nan row
+    bad = np.array(tr._maccum)
+    bad[-1, 0, 0] = 3.0  # pretend 3 steps had NaN loss
+    import jax.numpy as jnp
+    tr._maccum = jnp.asarray(bad)
+    with pytest.raises(RuntimeError, match="loss was NaN on 3"):
+        tr.evaluate(None, "train")
+
+
+def test_nan_guard_quiet_on_healthy_run():
+    tr = _trainer(nan_guard=1)
+    itr = _synth()
+    for b in itr:
+        tr.update(b)
+    out = tr.evaluate(None, "train")
+    assert "train-error" in out
