@@ -299,6 +299,7 @@ class LearnTask:
                         print("device memory: %s" % mem)
             self.save_model_file()
         self.trace.close()
+        self.trainer.wait_for_save()
         if not self.silent:
             print("\nupdating end, %d sec in all" % int(time.time() - start))
 

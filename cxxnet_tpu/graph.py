@@ -48,7 +48,9 @@ KNOWN_LAYER_TYPES = frozenset([
     # and mixture-of-experts fullc (expert parallelism over the model axis)
     # and pipelined transformer stacks (depth-stacked params, scanned on
     # one chip, pipelined over the pipe axis under pipeline_parallel)
+    # elewise_add closes residual/skip connections (ResNet-family nets)
     "lrn_pallas", "attention", "moe_fullc", "transformer_stack",
+    "elewise_add",
 ])
 
 # self-loop loss layers (in == out node); see src/layer/loss/

@@ -436,6 +436,35 @@ class SplitLayer(Layer):
         return [inputs[0]] * self.n_out
 
 
+@register("elewise_add")
+class ElementwiseAddLayer(Layer):
+    """N -> 1 elementwise sum of same-shape nodes.
+
+    No reference analogue (cxxnet predates residual networks); this is
+    the residual-connection primitive: ``layer[a,b->c] = elewise_add``
+    closes a skip connection, enabling ResNet-family configs with the
+    existing split/conv/batch_norm zoo.
+    """
+
+    def infer_shape(self, in_shapes):
+        if len(in_shapes) < 2:
+            raise ValueError("elewise_add needs at least 2 inputs")
+        for s in in_shapes[1:]:
+            if s != in_shapes[0]:
+                raise ValueError(
+                    "elewise_add shapes must match: %s vs %s"
+                    % (in_shapes[0], s))
+        self.in_shapes = list(in_shapes)
+        self.out_shapes = [in_shapes[0]]
+        return self.out_shapes
+
+    def apply(self, params, inputs, ctx):
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = out + x
+        return [out]
+
+
 class _ConcatBase(Layer):
     """N -> 1 concat along an axis (reference: src/layer/concat_layer-inl.hpp:12-82)."""
     axis = 3

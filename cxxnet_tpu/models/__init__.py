@@ -220,6 +220,80 @@ input_shape = 3,32,32
 """
 
 
+def resnet(nclass: int = 10, nstage: int = 3, nblock: int = 2,
+           base_channel: int = 16, input_shape=(3, 32, 32)) -> str:
+    """CIFAR-style pre-activation ResNet built from split + elewise_add
+    residual blocks (no reference analogue — cxxnet predates ResNets;
+    this exercises skip connections through the DAG interpreter).
+
+    nstage stages of nblock residual blocks; channels double and the map
+    halves at each stage boundary (projection shortcut via 1x1 conv).
+    Skip connections fan the block-input node out to both the trunk and
+    the shortcut — the functional DAG interpreter allows multi-reader
+    nodes directly (the reference would need an explicit split because
+    its backprop overwrites node activations in place)."""
+    c, h, w = input_shape
+    down = 2 ** (nstage - 1)
+    if h != w or h % down != 0:
+        raise ValueError(
+            "resnet: input must be square with side divisible by %d "
+            "(nstage=%d downsamplings), got %dx%d" % (down, nstage, h, w))
+    lines = ["netconfig=start",
+             "layer[0->stem] = conv:conv0",
+             "  kernel_size = 3", "  pad = 1", "  stride = 1",
+             "  nchannel = %d" % base_channel]
+    ch = base_channel
+    cur = "stem"
+    for s in range(nstage):
+        for b in range(nblock):
+            name = "s%db%d" % (s, b)
+            stride = 2 if (s > 0 and b == 0) else 1
+            in_ch = ch
+            if s > 0 and b == 0:
+                ch = ch * 2
+            # trunk: pre-activation bn-relu-conv x2
+            lines += [
+                "layer[%s->%s_a] = batch_norm:%s_bn1" % (cur, name, name),
+                "layer[%s_a->%s_b] = relu" % (name, name),
+                "layer[%s_b->%s_c] = conv:%s_c1" % (name, name, name),
+                "  kernel_size = 3", "  pad = 1",
+                "  stride = %d" % stride,
+                "  nchannel = %d" % ch,
+                "layer[%s_c->%s_d] = batch_norm:%s_bn2" % (name, name, name),
+                "layer[%s_d->%s_e] = relu" % (name, name),
+                "layer[%s_e->%s_f] = conv:%s_c2" % (name, name, name),
+                "  kernel_size = 3", "  pad = 1", "  stride = 1",
+                "  nchannel = %d" % ch]
+            if stride != 1 or in_ch != ch:
+                # projection shortcut (1x1, strided) off the block input
+                lines += [
+                    "layer[%s->%s_p] = conv:%s_proj" % (cur, name, name),
+                    "  kernel_size = 1", "  pad = 0",
+                    "  stride = %d" % stride,
+                    "  nchannel = %d" % ch,
+                    "layer[%s_f,%s_p->%s_o] = elewise_add"
+                    % (name, name, name)]
+            else:
+                lines += ["layer[%s_f,%s->%s_o] = elewise_add"
+                          % (name, cur, name)]
+            cur = "%s_o" % name
+    pool = h // (2 ** (nstage - 1))
+    lines += ["layer[%s->head_a] = batch_norm:bn_last" % cur,
+              "layer[head_a->head_b] = relu",
+              "layer[head_b->head_c] = avg_pooling",
+              "  kernel_size = %d" % pool,
+              "  stride = %d" % pool,
+              "layer[head_c->head_d] = flatten",
+              "layer[head_d->head_e] = fullc:fc_out",
+              "  nhidden = %d" % nclass,
+              "  init_sigma = 0.01",
+              "layer[head_e->head_e] = softmax",
+              "netconfig=end",
+              "input_shape = %d,%d,%d" % (c, h, w),
+              "random_type = kaiming"]
+    return "\n".join(lines) + "\n"
+
+
 def transformer_classifier(seq_len: int = 16, embed: int = 32,
                            nlayer: int = 4, nhead: int = 4,
                            nclass: int = 10, causal: int = 0,

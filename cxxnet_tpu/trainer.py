@@ -59,6 +59,7 @@ class Trainer:
         self.zero = 0
         self.test_on_server = 0
         self.nan_guard = 0
+        self.save_async = 0
         self.epoch_counter = 0
         self.sample_counter = 0
         self.round = 0
@@ -103,6 +104,8 @@ class Trainer:
             self.test_on_server = int(val)
         elif name == "nan_guard":
             self.nan_guard = int(val)
+        elif name == "save_async":
+            self.save_async = int(val)
         if name.startswith("metric"):
             import re
             m = re.match(r"metric\[([^,\]]+),([^\]]+)\]", name)
@@ -681,13 +684,45 @@ class Trainer:
         params = fetch(self.params)
         opt_state = fetch(self.opt_state)
         if jax.process_index() == 0:
-            checkpoint.save_model(path, self.net_cfg, self.epoch_counter,
-                                  params, opt_state)
+            if self.save_async:
+                # the fetched host copies are immutable snapshots, so the
+                # serialization + disk write can run behind the next
+                # round's training; one writer at a time keeps files whole
+                import threading
+                self.wait_for_save()
+
+                def write(args=(path, self.net_cfg, self.epoch_counter,
+                                params, opt_state)):
+                    try:
+                        checkpoint.save_model(*args)
+                    except BaseException as e:  # surfaced by wait_for_save
+                        self._save_error = e
+                self._save_error = None
+                self._save_thread = threading.Thread(
+                    target=write, name="ckpt-save", daemon=False)
+                self._save_thread.start()
+            else:
+                checkpoint.save_model(path, self.net_cfg,
+                                      self.epoch_counter, params, opt_state)
+
+    def wait_for_save(self) -> None:
+        """Block until a pending async checkpoint write finishes; re-raise
+        its failure (a silently missing checkpoint would surface rounds
+        later as a stale continue=1 resume)."""
+        t = getattr(self, "_save_thread", None)
+        if t is not None:
+            t.join()
+            self._save_thread = None
+            err = getattr(self, "_save_error", None)
+            if err is not None:
+                self._save_error = None
+                raise RuntimeError("async checkpoint write failed") from err
 
     def load_model(self, path: str) -> None:
         """Restore structure + epoch + weights (+ optimizer state, which
         the reference loses on resume — SURVEY.md §5)."""
         from . import checkpoint
+        self.wait_for_save()
         net_cfg, epoch, params, opt_state, _ = checkpoint.load_model(path)
         self.net_cfg = net_cfg
         # refresh training-param buckets + verify declared structure
