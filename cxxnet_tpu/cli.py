@@ -225,6 +225,8 @@ class LearnTask:
             sys.stderr.write("\n")
             sys.stderr.flush()
         if self.itr_train is None:
+            # still surface a failed async write of the round-0 checkpoint
+            self.trainer.wait_for_save()
             return
         if self.test_io:
             print("start I/O test")

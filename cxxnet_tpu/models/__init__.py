@@ -286,7 +286,6 @@ def resnet(nclass: int = 10, nstage: int = 3, nblock: int = 2,
               "layer[head_c->head_d] = flatten",
               "layer[head_d->head_e] = fullc:fc_out",
               "  nhidden = %d" % nclass,
-              "  init_sigma = 0.01",
               "layer[head_e->head_e] = softmax",
               "netconfig=end",
               "input_shape = %d,%d,%d" % (c, h, w),

@@ -1,0 +1,74 @@
+"""Compatibility contract: the REFERENCE's own example configs
+(/root/reference/example) must parse, graph-build, and shape-infer
+unchanged — a cxxnet user's files work here with only ``dev`` adjusted
+(BASELINE.md requirement). Read-only access to the reference tree.
+"""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config
+from cxxnet_tpu.graph import NetConfig
+from cxxnet_tpu.model import Network
+
+REF = "/root/reference/example"
+
+
+def _netconfigs():
+    # every reference config must PARSE; only the ones declaring a net
+    # are graph-built (mpi.conf etc. are launcher configs). A parse crash
+    # here fails collection — parser regressions must not silently shrink
+    # the compat coverage.
+    out = []
+    for path in sorted(glob.glob(os.path.join(REF, "*", "*.conf"))):
+        entries = config.parse_file(path)
+        if any(k == "netconfig" for k, _ in entries):
+            out.append(path)
+    return out
+
+CONFS = _netconfigs() if os.path.isdir(REF) else []
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_reference_examples_found():
+    names = {os.path.basename(p) for p in CONFS}
+    # the reference ships at least these four model configs
+    assert {"MNIST.conf", "MNIST_CONV.conf", "ImageNet.conf",
+            "bowl.conf"} <= names, names
+
+
+@pytest.mark.parametrize("conf", CONFS,
+                         ids=[os.path.basename(c) for c in CONFS])
+def test_reference_config_builds(conf):
+    entries = config.parse_file(conf)
+    net = NetConfig()
+    net.configure(entries)
+    assert net.num_layers > 0
+    # full shape inference = every layer type, key, and node wiring in
+    # the reference config is understood
+    Network(net, batch_size=4)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_reference_mnist_mlp_trains():
+    """The reference MNIST MLP config runs a real training step here
+    (synthetic data in place of the idx files, which are not shipped)."""
+    from cxxnet_tpu.io import DataBatch
+    from cxxnet_tpu.trainer import Trainer
+
+    path = os.path.join(REF, "MNIST", "MNIST.conf")
+    tr = Trainer()
+    for k, v in config.parse_file(path):
+        tr.set_param(k, v)
+    tr.set_param("dev", "cpu")
+    tr.set_param("batch_size", "64")
+    tr.init_model()
+    shp = tr.net_cfg.input_shape
+    rs = np.random.RandomState(0)
+    b = DataBatch(
+        data=rs.randn(64, *shp).astype(np.float32),
+        label=rs.randint(0, 10, size=(64, 1)).astype(np.float32))
+    tr.update(b)
+    assert tr.predict(b).shape == (64,)
