@@ -105,10 +105,19 @@ def model_path(model_dir: str, counter: int) -> str:
 def find_latest_model(model_dir: str,
                       start_counter: int = 0) -> Optional[Tuple[str, int]]:
     """Scan model_dir/%04d.model upward from start_counter for the last
-    existing file (reference SyncLastestModel, cxxnet_main.cpp:135-157)."""
-    last = None
-    c = start_counter
-    while os.path.exists(model_path(model_dir, c)):
-        last = (model_path(model_dir, c), c)
-        c += 1
-    return last
+    existing file (reference SyncLastestModel, cxxnet_main.cpp:135-157).
+
+    The reference's consecutive probe misses any checkpoint after a gap
+    (save_model > 1, or a mid-run cadence change) — a directory listing
+    for the highest-numbered model subsumes it entirely, so continue=1
+    always resumes from the newest state."""
+    import re
+    best = -1
+    if os.path.isdir(model_dir):
+        for f in os.listdir(model_dir):
+            m = re.match(r"(\d+)\.model$", f)
+            if m and int(m.group(1)) >= start_counter:
+                best = max(best, int(m.group(1)))
+    if best >= 0:
+        return model_path(model_dir, best), best
+    return None

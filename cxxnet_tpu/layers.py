@@ -161,6 +161,11 @@ class ApplyContext:
     epoch: jnp.ndarray = 0                     # update counter (may be traced)
     losses: List[jnp.ndarray] = field(default_factory=list)
     compute_dtype: jnp.dtype = jnp.float32
+    # non-trainable layer-state writes (running BN stats): layers record
+    # {(layer_index, tag): new_value}; the trainer folds them back into
+    # params after the optimizer step
+    layer_index: int = -1
+    state_updates: Dict = field(default_factory=dict)
     # sequence parallelism: when set, attention layers run ring attention
     # sharded over this mesh axis (cxxnet_tpu/ops/ring_attention.py)
     mesh: Optional[object] = None
@@ -181,6 +186,9 @@ class Layer:
     type_name = "?"
     has_params = False
     is_loss = False
+    # parameter tags that are STATE, not trainable weights: excluded from
+    # the optimizer; written via ctx.state_updates (e.g. BN running stats)
+    state_tags: Tuple[str, ...] = ()
 
     def __init__(self) -> None:
         self.param = LayerParam()
@@ -955,6 +963,13 @@ class BatchNormLayer(Layer):
     statistics are used in both train and eval mode — there are no running
     averages in the reference model format. Channel axis is 1 for conv
     nodes and 3 for flat nodes, like the reference's size(1)==1 dispatch.
+
+    ``bn_running = 1`` opts into standard running statistics (an
+    improvement over the reference, SURVEY.md §7 hard part e): training
+    still normalizes with batch stats but maintains EMA running
+    mean/variance (``bn_momentum``, default 0.9) as non-trainable state
+    tags ``rmean``/``rvar``; eval normalizes with them. The state rides
+    the checkpoint like any other parameter.
     """
     has_params = True
 
@@ -963,6 +978,8 @@ class BatchNormLayer(Layer):
         self.init_slope = 1.0
         self.init_bias = 0.0
         self.eps = 1e-10
+        self.bn_running = 0
+        self.bn_momentum = 0.9
 
     def set_param(self, name, val):
         if name == "init_slope":
@@ -971,6 +988,11 @@ class BatchNormLayer(Layer):
             self.init_bias = float(val)
         elif name == "eps":
             self.eps = float(val)
+        elif name == "bn_running":
+            self.bn_running = int(val)
+            self.state_tags = ("rmean", "rvar") if self.bn_running else ()
+        elif name == "bn_momentum":
+            self.bn_momentum = float(val)
         else:
             super().set_param(name, val)
 
@@ -981,16 +1003,32 @@ class BatchNormLayer(Layer):
         return [s]
 
     def init_params(self, rng) -> Params:
-        return {"wmat": jnp.full((self.channel,), self.init_slope, jnp.float32),
-                "bias": jnp.full((self.channel,), self.init_bias, jnp.float32)}
+        p = {"wmat": jnp.full((self.channel,), self.init_slope, jnp.float32),
+             "bias": jnp.full((self.channel,), self.init_bias, jnp.float32)}
+        if self.bn_running:
+            p["rmean"] = jnp.zeros((self.channel,), jnp.float32)
+            p["rvar"] = jnp.ones((self.channel,), jnp.float32)
+        return p
 
     def apply(self, params, inputs, ctx):
         x = inputs[0]
         axes = tuple(i for i in range(4) if i != self.axis)
         shape = [1, 1, 1, 1]
         shape[self.axis] = self.channel
-        mean = x.mean(axis=axes)
-        var = jnp.square(x - mean.reshape(shape)).mean(axis=axes)
+        if self.bn_running and not ctx.train:
+            mean = params["rmean"]
+            var = params["rvar"]
+        else:
+            mean = x.mean(axis=axes)
+            var = jnp.square(x - mean.reshape(shape)).mean(axis=axes)
+            if self.bn_running and ctx.train:
+                m = self.bn_momentum
+                ctx.state_updates[(ctx.layer_index, "rmean")] = \
+                    jax.lax.stop_gradient(
+                        m * params["rmean"] + (1.0 - m) * mean)
+                ctx.state_updates[(ctx.layer_index, "rvar")] = \
+                    jax.lax.stop_gradient(
+                        m * params["rvar"] + (1.0 - m) * var)
         xhat = (x - mean.reshape(shape)) / jnp.sqrt(
             var.reshape(shape) + self.eps)
         return [xhat * params["wmat"].reshape(shape)

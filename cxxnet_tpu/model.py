@@ -124,11 +124,16 @@ class Network:
               labels: Optional[List[jnp.ndarray]] = None,
               train: bool = False,
               rng: Optional[jnp.ndarray] = None,
-              epoch=0) -> Tuple[Dict[int, jnp.ndarray], jnp.ndarray]:
+              epoch=0,
+              state_out: Optional[Dict] = None
+              ) -> Tuple[Dict[int, jnp.ndarray], jnp.ndarray]:
         """Run the DAG; returns ({node_index: value}, scalar_loss).
 
         ``labels`` is the list of label-field arrays in label_range order
         (reference GetLabelInfo, nnet_impl-inl.hpp:271-285).
+        ``state_out``, when given, receives {(layer_index, tag): value}
+        non-trainable state writes (BN running stats) for the trainer to
+        fold back into params.
         """
         ctx = L.ApplyContext(
             train=train, rng=rng, labels=labels,
@@ -149,10 +154,10 @@ class Network:
         for i, x in enumerate(extra_data):
             values[i + 1] = x
         for li, (info, mod) in enumerate(zip(self.cfg.layers, self.modules)):
-            layer_ctx = ctx
-            if rng is not None:
-                layer_ctx = dataclasses.replace(
-                    ctx, rng=jax.random.fold_in(rng, li))
+            layer_ctx = dataclasses.replace(
+                ctx, layer_index=li,
+                rng=(jax.random.fold_in(rng, li)
+                     if rng is not None else None))
             inputs = [values[ni] for ni in info.nindex_in]
             outputs = mod.apply(self._layer_params(params, li),
                                 inputs, layer_ctx)
@@ -162,6 +167,8 @@ class Network:
             loss = sum(ctx.losses[1:], ctx.losses[0])
         else:
             loss = jnp.zeros((), jnp.float32)
+        if state_out is not None:
+            state_out.update(ctx.state_updates)
         return values, loss
 
     # ------------------------------------------------------------------

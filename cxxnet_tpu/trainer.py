@@ -292,33 +292,39 @@ class Trainer:
 
         def fwd_bwd(params, data, extras, labels, rng, epoch):
             def loss_fn(p):
+                supd = {}
                 values, loss = net.apply(
                     p, data, extra_data=extras, labels=labels, train=True,
-                    rng=rng, epoch=epoch)
-                return loss, tuple(values[i] for i in eval_req)
-            (loss, evals), grads = jax.value_and_grad(
+                    rng=rng, epoch=epoch, state_out=supd)
+                return loss, (tuple(values[i] for i in eval_req), supd)
+            (loss, (evals, supd)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            return loss, evals, grads
+            return loss, evals, supd, grads
+
 
         def train_step(params, opt_state, rng, epoch, maccum,
                        data, extras, labels):
             use, nxt = jax.random.split(rng)
-            loss, evals, grads = fwd_bwd(params, data, extras, labels,
-                                         use, epoch)
+            loss, evals, supd, grads = fwd_bwd(params, data, extras,
+                                               labels, use, epoch)
             grads = _strip_nones(grads)
             params2, opt2 = opt_.apply(params, grads, opt_state, epoch)
+            params2 = _merge_state(params2, supd)
             maccum = fold_train_metric(maccum, evals, labels, loss)
             return params2, opt2, nxt, epoch + 1, maccum, loss
 
         def accum_step(grad_accum, rng, maccum, params, epoch,
                        data, extras, labels):
             use, nxt = jax.random.split(rng)
-            loss, evals, grads = fwd_bwd(params, data, extras, labels,
-                                         use, epoch)
+            loss, evals, supd, grads = fwd_bwd(params, data, extras,
+                                               labels, use, epoch)
             grads = _strip_nones(grads)
             acc = jax.tree.map(jnp.add, grad_accum, grads)
             maccum = fold_train_metric(maccum, evals, labels, loss)
-            return acc, nxt, maccum, loss
+            # state writes (small vectors) surface as outputs; the host
+            # folds them into self.params since params aren't an output
+            # of the accumulation-only step
+            return acc, nxt, maccum, loss, supd
 
         def eval_step(params, eaccum, data, extras, labels, mask):
             # mask is built host-side per process (each process's padding
@@ -347,10 +353,16 @@ class Trainer:
             train_step, donate_argnums=(0, 1, 2, 3, 4),
             in_shardings=(psh, osh, rep, rep, rep, xsh, dsh, dsh),
             out_shardings=(psh, osh, rep, rep, rep, None))
+        # state writes fold back into self.params host-side, so their
+        # output shardings must match the params' declared placement
+        ssh = {(li, tag): psh[li][tag]
+               for li, mod in enumerate(net.modules)
+               for tag in getattr(mod, "state_tags", ())
+               if psh[li] and tag in psh[li]}
         self._accum_step = jax.jit(
             accum_step, donate_argnums=(0, 1, 2),
             in_shardings=(gsh, rep, rep, psh, rep, xsh, dsh, dsh),
-            out_shardings=(gsh, rep, rep, None))
+            out_shardings=(gsh, rep, rep, None, ssh))
         self._eval_step = jax.jit(
             eval_step, donate_argnums=(1,),
             in_shardings=(psh, rep, xsh, dsh, dsh, dsh),
@@ -533,9 +545,10 @@ class Trainer:
                 self._maccum, data, extras, labels)
         else:
             (self.grad_accum, self._rng, self._maccum,
-             loss) = self._accum_step(
+             loss, supd) = self._accum_step(
                 self.grad_accum, self._rng, self._maccum, self.params,
                 self._epoch_dev, data, extras, labels)
+            self.params = _merge_state(self.params, supd)
             if (self.sample_counter + 1) % self.update_period == 0:
                 (self.params, self.opt_state, self.grad_accum,
                  self._epoch_dev) = self._apply_accum(
@@ -730,11 +743,30 @@ class Trainer:
         self.epoch_counter = epoch
         self._build_network()
         params = jax.tree.map(jnp.asarray, params)
+        # seed state tags absent from the checkpoint (e.g. bn_running
+        # newly enabled on a model saved without running stats)
+        fresh_p = None
+        for li, mod in enumerate(self.net.modules):
+            missing = [t for t in getattr(mod, "state_tags", ())
+                       if params[li] is not None and t not in params[li]]
+            if missing:
+                if fresh_p is None:
+                    fresh_p = self.net.init_params(jax.random.PRNGKey(0))
+                for t in missing:
+                    params[li][t] = fresh_p[li][t]
         opt = NetUpdater(self.net)
-        if opt_state is None:
-            opt_state = opt.init_state(params)
-        else:
-            opt_state = jax.tree.map(jnp.asarray, opt_state)
+        # merge loaded slots onto a freshly initialized structure: empty
+        # slot dicts (non-trainable state tags) are not serialized, and a
+        # structural mismatch would desync the jitted step's out_shardings
+        fresh = opt.init_state(params)
+        if opt_state is not None:
+            for li, loaded in enumerate(opt_state):
+                if loaded is None or fresh[li] is None:
+                    continue
+                for tag, slots in loaded.items():
+                    if tag in fresh[li] and slots:
+                        fresh[li][tag] = jax.tree.map(jnp.asarray, slots)
+        opt_state = fresh
         self._finish_init(params, opt, opt_state)
 
     def copy_model_from(self, path: str) -> None:
@@ -770,3 +802,15 @@ class Trainer:
 def _strip_nones(tree):
     """Replace per-layer None slots with empty dicts so tree ops line up."""
     return [({} if t is None else t) for t in tree]
+
+
+def _merge_state(params, supd):
+    """Fold non-trainable state writes {(layer, tag): value} (BN running
+    stats) into a params list. Works both inside a jit trace and on host
+    arrays."""
+    if not supd:
+        return params
+    params = list(params)
+    for (li, tag), v in supd.items():
+        params[li] = dict(params[li], **{tag: v})
+    return params

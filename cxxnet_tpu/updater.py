@@ -254,8 +254,11 @@ class NetUpdater:
             if p is None:
                 states.append(None)
             else:
+                # tags without an updater are non-trainable state (BN
+                # running stats): no optimizer slots
                 states.append({
-                    tag: self.updaters[li][tag].init_state(w)
+                    tag: (self.updaters[li][tag].init_state(w)
+                          if tag in self.updaters[li] else {})
                     for tag, w in p.items()})
         return states
 
@@ -269,7 +272,10 @@ class NetUpdater:
                 continue
             np_, ns_ = {}, {}
             for tag, w in p.items():
-                upd = self.updaters[li][tag]
+                upd = self.updaters[li].get(tag)
+                if upd is None:   # non-trainable state tag: passthrough
+                    np_[tag], ns_[tag] = w, {}
+                    continue
                 np_[tag], ns_[tag] = upd.update(
                     opt_state[li][tag], w, grads[li][tag], epoch)
             new_params.append(np_)

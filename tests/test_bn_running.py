@@ -1,0 +1,198 @@
+"""Batch norm running statistics (bn_running = 1).
+
+Default BN keeps the reference's semantics (batch statistics in train
+AND eval, batch_norm_layer-inl.hpp:122-135). bn_running=1 is the
+standard-ML improvement: EMA running mean/var maintained during training
+as non-trainable state, used at eval, checkpointed with the model.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cxxnet_tpu import config
+from cxxnet_tpu.io import DataBatch, create_iterator
+from cxxnet_tpu.trainer import Trainer
+
+CONF = """
+netconfig=start
+layer[0->a] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.5
+layer[a->a] = batch_norm:bn1
+%s
+layer[a->b] = relu
+layer[b->c] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[c->c] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+dev = cpu:0
+eta = 0.1
+momentum = 0.9
+metric = error
+"""
+
+
+def _trainer(running, **overrides):
+    tr = Trainer()
+    extra = "  bn_running = 1" if running else ""
+    for k, v in config.parse_string(CONF % extra):
+        tr.set_param(k, v)
+    for k, v in overrides.items():
+        tr.set_param(k, str(v))
+    tr.init_model()
+    return tr
+
+
+def _batch(seed=0, n=64):
+    rs = np.random.RandomState(seed)
+    return DataBatch(
+        data=(rs.randn(n, 1, 1, 16) * 2 + 1).astype(np.float32),
+        label=rs.randint(0, 4, size=(n, 1)).astype(np.float32))
+
+
+def test_default_has_no_state_tags():
+    tr = _trainer(False)
+    li = tr.net_cfg.get_layer_index("bn1")
+    assert set(tr.params[li]) == {"wmat", "bias"}
+
+
+def test_running_stats_update_during_training():
+    tr = _trainer(True)
+    li = tr.net_cfg.get_layer_index("bn1")
+    assert set(tr.params[li]) == {"wmat", "bias", "rmean", "rvar"}
+    r0 = np.array(tr.params[li]["rmean"])
+    assert (r0 == 0).all()
+    for i in range(5):
+        tr.update(_batch(i))
+    r1 = np.asarray(tr.params[li]["rmean"])
+    v1 = np.asarray(tr.params[li]["rvar"])
+    assert np.abs(r1).max() > 0          # EMA moved toward batch means
+    assert not np.allclose(v1, 1.0)
+
+
+def test_eval_uses_running_stats():
+    """With wildly shifted eval data, running-stat BN normalizes with the
+    TRAIN distribution (outputs differ from batch-stat BN)."""
+    tr_run = _trainer(True, seed=3)
+    tr_ref = _trainer(False, seed=3)
+    for i in range(5):
+        b = _batch(i)
+        tr_run.update(b)
+        tr_ref.update(b)
+    shifted = _batch(99)
+    shifted.data = shifted.data + 50.0   # distribution shift
+    pr = tr_run.forward_nodes(shifted, [tr_run.net.out_node])[0]
+    pb = tr_ref.forward_nodes(shifted, [tr_ref.net.out_node])[0]
+    # batch-stat BN renormalizes the shift away; running-stat BN must not
+    assert not np.allclose(pr, pb, atol=1e-3)
+
+
+def test_running_stats_not_touched_by_optimizer():
+    """Weight decay / momentum must never apply to rmean/rvar: with
+    frozen weights (eta=0) and a fixed batch, the EMA from r0=0 obeys
+    r2 = (1+m) * r1 exactly; wd=0.5 would break the relation."""
+    tr = _trainer(True, wd="0.5", eta="0", momentum="0")
+    li = tr.net_cfg.get_layer_index("bn1")
+    s = tr.opt_state[li]
+    assert s["rmean"] == {} and s["rvar"] == {}
+    b = _batch(0)
+    tr.update(b)
+    r1 = np.asarray(tr.params[li]["rmean"]).copy()
+    tr.update(b)
+    r2 = np.asarray(tr.params[li]["rmean"])
+    m = 0.9
+    np.testing.assert_allclose(r2, (1.0 + m) * r1, rtol=1e-5, atol=1e-7)
+
+
+def test_running_stats_checkpoint_roundtrip(tmp_path):
+    tr = _trainer(True)
+    for i in range(3):
+        tr.update(_batch(i))
+    p = str(tmp_path / "bn.model")
+    tr.save_model(p)
+    tr2 = _trainer(True)
+    tr2.load_model(p)
+    li = tr.net_cfg.get_layer_index("bn1")
+    np.testing.assert_allclose(np.asarray(tr2.params[li]["rmean"]),
+                               np.asarray(tr.params[li]["rmean"]))
+    b = _batch(7)
+    np.testing.assert_allclose(
+        tr.forward_nodes(b, [tr.net.out_node])[0],
+        tr2.forward_nodes(b, [tr2.net.out_node])[0])
+
+
+def test_running_stats_with_update_period():
+    """The accumulation path folds state writes into params too."""
+    tr = _trainer(True, update_period=2)
+    li = tr.net_cfg.get_layer_index("bn1")
+    for i in range(4):
+        tr.update(_batch(i))
+    assert np.abs(np.asarray(tr.params[li]["rmean"])).max() > 0
+
+
+def test_resume_with_state_tags_and_gapped_checkpoints(tmp_path):
+    """Optimizer-state structure survives the checkpoint (state tags have
+    no slots); find_latest_model falls back to a directory scan when
+    save_model > 1 leaves gaps."""
+    from cxxnet_tpu import checkpoint
+
+    tr = _trainer(True)
+    for i in range(3):
+        tr.update(_batch(i))
+    mdir = str(tmp_path / "models")
+    import os
+    os.makedirs(mdir)
+    # gapped files: 0001 and 0003 only (save_model = 2 cadence)
+    tr.save_model(checkpoint.model_path(mdir, 1))
+    tr.update(_batch(3))
+    tr.save_model(checkpoint.model_path(mdir, 3))
+
+    found = checkpoint.find_latest_model(mdir, 0)
+    assert found is not None and found[1] == 3
+
+    tr2 = _trainer(True)
+    tr2.load_model(found[0])
+    # training continues without structural mismatch
+    tr2.update(_batch(4))
+    li = tr2.net_cfg.get_layer_index("bn1")
+    assert np.isfinite(np.asarray(tr2.params[li]["rmean"])).all()
+    # loaded momentum slots actually carried over (non-zero)
+    s = tr2.opt_state[tr2.net_cfg.get_layer_index("fc2")]["wmat"]
+    leaf = next(iter(s.values()))
+    assert float(np.abs(np.asarray(leaf)).max()) > 0
+
+
+def test_enable_running_on_old_checkpoint(tmp_path):
+    """bn_running=1 on a checkpoint saved WITHOUT running stats: load
+    seeds fresh rmean/rvar instead of crashing."""
+    tr = _trainer(False)
+    tr.update(_batch(0))
+    p = str(tmp_path / "old.model")
+    tr.save_model(p)
+    tr2 = _trainer(True)  # config now declares bn_running=1
+    tr2.load_model(p)
+    li = tr2.net_cfg.get_layer_index("bn1")
+    assert "rmean" in tr2.params[li]
+    tr2.update(_batch(1))                   # trains
+    b = _batch(2)
+    assert np.isfinite(tr2.predict(b)).all()
+
+
+def test_gap_after_consecutive_run(tmp_path):
+    """Checkpoints 0000..0002 then a gap then 0005: resume must pick
+    0005, not the stale consecutive tail."""
+    from cxxnet_tpu import checkpoint
+
+    tr = _trainer(True)
+    tr.update(_batch(0))
+    mdir = str(tmp_path / "m")
+    import os
+    os.makedirs(mdir)
+    for c in (0, 1, 2, 5):
+        tr.save_model(checkpoint.model_path(mdir, c))
+    found = checkpoint.find_latest_model(mdir, 0)
+    assert found is not None and found[1] == 5
