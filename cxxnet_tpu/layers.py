@@ -1243,7 +1243,11 @@ class TransformerStackLayer(Layer):
     Block: x += attn(rmsnorm(x)); x += mlp(rmsnorm(x)) with a ReLU MLP of
     width ``nhidden_mlp`` (default 4*embed). Config: ``nlayer``,
     ``nhead``, ``causal``, ``nhidden_mlp``, ``n_microbatch`` (pipeline
-    microbatches per local batch, default = pipe size).
+    microbatches per local batch, default = pipe size), ``remat``
+    (rematerialize each block's intermediates in the backward pass —
+    jax.checkpoint — so only one (b, s, e) boundary activation per layer
+    is kept instead of every intra-block tensor; the standard
+    FLOPs-for-HBM trade for deep stacks).
     """
     has_params = True
     param_tags = ("wqkv", "wo", "w1", "w2", "norm1", "norm2")
@@ -1255,6 +1259,7 @@ class TransformerStackLayer(Layer):
         self.causal = 0
         self.nhidden_mlp = 0
         self.n_microbatch = 0
+        self.remat = 0
 
     def set_param(self, name, val):
         if name == "nlayer":
@@ -1267,6 +1272,8 @@ class TransformerStackLayer(Layer):
             self.nhidden_mlp = int(val)
         elif name == "n_microbatch":
             self.n_microbatch = int(val)
+        elif name == "remat":
+            self.remat = int(val)
         else:
             super().set_param(name, val)
 
@@ -1325,6 +1332,8 @@ class TransformerStackLayer(Layer):
         dt = ctx.compute_dtype
         h = inputs[0].reshape(b, s, e).astype(dt)
         block = self._block_fn(dt)
+        if self.remat:
+            block = jax.checkpoint(block)
         mesh = ctx.mesh
         pipe = mesh.shape.get("pipe", 1) if mesh is not None else 1
         if pipe > 1:

@@ -131,3 +131,41 @@ def test_nlayer_must_divide_pipe():
         tr.update(DataBatch(
             data=rs.randn(8, 1, 8, 16).astype(np.float32),
             label=rs.randint(0, 10, size=(8, 1)).astype(np.float32)))
+
+
+def test_remat_matches_no_remat():
+    """remat=1 recomputes activations in the backward pass; the training
+    trajectory is identical (same math, less memory)."""
+    rs = np.random.RandomState(11)
+    batches = [
+        DataBatch(data=rs.randn(8, 1, 8, 16).astype(np.float32),
+                  label=rs.randint(0, 10, size=(8, 1)).astype(np.float32))
+        for _ in range(2)]
+
+    def build(remat):
+        tr = Trainer()
+        text = models.transformer_classifier(seq_len=8, embed=16,
+                                             nlayer=4, nhead=2,
+                                             nhidden_mlp=32)
+        if remat:
+            text = text.replace(
+                "layer[0->1] = transformer_stack:ts1",
+                "layer[0->1] = transformer_stack:ts1\n  remat = 1")
+            assert "remat = 1" in text  # template drift guard
+        for k, v in config.parse_string(text):
+            tr.set_param(k, v)
+        tr.set_param("dev", "cpu:0")
+        tr.set_param("batch_size", "8")
+        tr.set_param("eta", "0.1")
+        tr.set_param("seed", "6")
+        tr.set_param("metric", "error")
+        tr.init_model()
+        return tr
+
+    t1, t2 = build(False), build(True)
+    for b in batches:
+        t1.update(b)
+        t2.update(b)
+    np.testing.assert_allclose(t1.get_weight("ts1", "wo"),
+                               t2.get_weight("ts1", "wo"),
+                               rtol=1e-5, atol=1e-6)
