@@ -1,0 +1,50 @@
+#!/usr/bin/env python3
+"""Build a .lst file for the plankton (kaggle national data science bowl)
+directory layout — the counterpart of the reference's Python-2 script
+(reference: example/kaggle_bowl/gen_img_list.py), rewritten for this
+framework.
+
+Train layout: <folder>/<class_name>/<image>; class order is taken from
+the sample submission header so predictions map onto the expected
+columns.
+
+Usage:
+  gen_img_list.py train sample_submission.csv train_folder/ train.lst
+  gen_img_list.py test  sample_submission.csv test_folder/  test.lst
+"""
+import csv
+import os
+import random
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) < 5:
+        print(__doc__)
+        return 1
+    task, sub_csv, folder, out = sys.argv[1:5]
+    random.seed(888)
+    with open(sub_csv, newline="") as f:
+        classes = next(csv.reader(f))[1:]   # header minus the image col
+
+    rows = []
+    if task == "train":
+        for ci, cname in enumerate(classes):
+            cdir = os.path.join(folder, cname)
+            for img in sorted(os.listdir(cdir)):
+                rows.append((ci, os.path.join(cname, img)))
+        random.shuffle(rows)
+    else:
+        for img in sorted(os.listdir(folder)):
+            rows.append((0, img))
+
+    with open(out, "w") as f:
+        for idx, (label, path) in enumerate(rows):
+            f.write("%d\t%d\t%s\n" % (idx, label, path))
+    print("wrote %d entries (%d classes) to %s"
+          % (len(rows), len(classes), out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""Turn an ``task=extract`` probability dump into a kaggle submission
+CSV (counterpart of the reference's make_submission.py, rewritten).
+
+Run prediction with raw probabilities first:
+
+  python -m cxxnet_tpu bowl.conf task=extract extract_node_name=top[-1] \\
+      pred=prob.txt model_in=models/0100.model
+
+Usage: make_submission.py prob.txt test.lst sample_submission.csv out.csv
+"""
+import csv
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) < 5:
+        print(__doc__)
+        return 1
+    prob_txt, test_lst, sub_csv, out = sys.argv[1:5]
+    with open(sub_csv, newline="") as f:
+        header = next(csv.reader(f))
+    names = []
+    with open(test_lst) as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) >= 3:
+                names.append(parts[2])
+    with open(prob_txt) as fp, open(out, "w", newline="") as fo:
+        w = csv.writer(fo)
+        w.writerow(header)
+        for name, line in zip(names, fp):
+            probs = line.split()
+            w.writerow([name] + probs[: len(header) - 1])
+    print("wrote %s" % out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
