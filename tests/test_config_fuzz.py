@@ -1,0 +1,91 @@
+"""Property-based fuzz for the config dialect and graph builder.
+
+The `k = v` dialect is the framework's API spine (SURVEY.md §5); the
+parser must never crash uncontrolled, and the graph builder must reject
+malformed structure with GraphConfigError — not arbitrary exceptions.
+"""
+import string
+
+from hypothesis import given, settings, strategies as st
+
+from cxxnet_tpu import config
+from cxxnet_tpu.graph import GraphConfigError, NetConfig
+
+IDENT = st.text(string.ascii_lowercase + string.digits + "_", min_size=1,
+                max_size=12)
+VALUE = st.text(string.ascii_letters + string.digits + "_.,-", min_size=1,
+                max_size=16)
+
+
+@given(st.lists(st.tuples(IDENT, VALUE), max_size=20))
+@settings(max_examples=200, deadline=None)
+def test_parse_roundtrip_arbitrary_pairs(pairs):
+    """Any k = v stream serializes and parses back identically."""
+    text = "\n".join("%s = %s" % (k, v) for k, v in pairs)
+    out = config.parse_string(text)
+    assert out == list(pairs)
+
+
+@given(st.text(alphabet=string.printable, max_size=300))
+@settings(max_examples=300, deadline=None)
+def test_parser_never_crashes_uncontrolled(blob):
+    """Arbitrary text either parses or raises ValueError — nothing else."""
+    import warnings
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # malformed-entry notices
+            config.parse_string(blob)
+    except ValueError:
+        pass
+
+
+@given(st.lists(st.tuples(IDENT, VALUE), max_size=12))
+@settings(max_examples=200, deadline=None)
+def test_graph_builder_controlled_errors(pairs):
+    """Arbitrary config entries (no netconfig section) never produce an
+    uncontrolled crash from the graph builder."""
+    cfg = NetConfig()
+    try:
+        cfg.configure(list(pairs))
+    except (GraphConfigError, ValueError):
+        pass
+
+
+@given(st.integers(1, 5), st.integers(1, 64), st.integers(1, 32))
+@settings(max_examples=50, deadline=None)
+def test_mlp_chain_always_builds(depth, nhidden, width):
+    """Any depth of fullc+relu chains shape-infers successfully."""
+    from cxxnet_tpu.model import Network
+
+    lines = ["netconfig=start"]
+    for i in range(depth):
+        lines += ["layer[+1] = fullc:f%d" % i,
+                  "  nhidden = %d" % nhidden,
+                  "layer[+0] = relu"]
+    lines += ["layer[+0] = softmax", "netconfig=end",
+              "input_shape = 1,1,%d" % width]
+    cfg = NetConfig()
+    cfg.configure(config.parse_string("\n".join(lines)))
+    net = Network(cfg, batch_size=2)
+    assert net.node_shapes[net.out_node] == (2, 1, 1, nhidden)
+
+
+@given(st.sampled_from(["relu", "sigmoid", "tanh", "softplus", "xelu",
+                        "insanity", "dropout"]),
+       st.integers(2, 16))
+@settings(max_examples=40, deadline=None)
+def test_activation_layers_preserve_shape(act, width):
+    from cxxnet_tpu.model import Network
+
+    text = """netconfig=start
+layer[+1] = fullc:f0
+  nhidden = %d
+layer[+0] = %s
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+""" % (width, act)
+    cfg = NetConfig()
+    cfg.configure(config.parse_string(text))
+    net = Network(cfg, batch_size=2)
+    assert net.node_shapes[net.out_node] == (2, 1, 1, width)
