@@ -73,14 +73,18 @@ def main() -> None:
     best = 0.0
     deadline = time.perf_counter() + BUDGET_S
     trials = 0
-    while trials < TRIALS or (time.perf_counter() < deadline
-                              and best < QUIET_IMAGES_PER_SEC):
+    while True:
         t0 = time.perf_counter()
         run(ITERS)
         dt = time.perf_counter() - t0
         best = max(best, BATCH * ITERS / dt)
         trials += 1
-        if time.perf_counter() > deadline:
+        # the budget is authoritative (the driver may enforce its own
+        # timeout); below it, run at least TRIALS windows and keep
+        # sampling while every reading looks contended
+        if time.perf_counter() >= deadline:
+            break
+        if trials >= TRIALS and best >= QUIET_IMAGES_PER_SEC:
             break
 
     images_per_sec = best
