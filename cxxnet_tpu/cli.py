@@ -204,6 +204,17 @@ class LearnTask:
                 self.itr_pred = create_iterator(itcfg, defcfg)
 
     # ------------------------------------------------------------------
+    def _print_progress(self, sample_counter: int, start: float) -> None:
+        """Reference progress line every print_step batches
+        (cxxnet_main.cpp:378-387)."""
+        if sample_counter % self.print_step != 0 or self.silent:
+            return
+        elapsed = int(time.time() - start)
+        print("\r%80s\r" % "", end="")
+        print("round %8d:[%8d] %d sec elapsed"
+              % (self.start_counter - 1, sample_counter, elapsed), end="")
+        sys.stdout.flush()
+
     def save_model_file(self) -> None:
         """Reference: cxxnet_main.cpp:173-182 (cadence check + %04d name)."""
         counter = self.start_counter
@@ -249,14 +260,7 @@ class LearnTask:
                     if not has_next:
                         break
                     sample_counter += 1
-                    if sample_counter % self.print_step == 0 \
-                            and not self.silent:
-                        elapsed = int(time.time() - start)
-                        print("\r%80s\r" % "", end="")
-                        print("round %8d:[%8d] %d sec elapsed"
-                              % (self.start_counter - 1, sample_counter,
-                                 elapsed), end="")
-                        sys.stdout.flush()
+                    self._print_progress(sample_counter, start)
                     continue
                 nxt = None
                 if has_next:
@@ -270,14 +274,7 @@ class LearnTask:
                         self.trainer.update(pending)
                     self.timer.tick()
                     sample_counter += 1
-                    if sample_counter % self.print_step == 0 \
-                            and not self.silent:
-                        elapsed = int(time.time() - start)
-                        print("\r%80s\r" % "", end="")
-                        print("round %8d:[%8d] %d sec elapsed"
-                              % (self.start_counter - 1, sample_counter,
-                                 elapsed), end="")
-                        sys.stdout.flush()
+                    self._print_progress(sample_counter, start)
                 # resolve before touching the iterator again: next() may
                 # reuse the buffers the stager is still reading
                 pending = nxt.result() if nxt is not None else None

@@ -129,21 +129,21 @@ class TraceSession:
     def step(self):
         """Context manager wrapping one train step: starts/stops the trace
         at the configured batch indices and annotates the step."""
+        n = self._step
+        self._step += 1
         if not self.enabled or self._done:
-            self._step += 1
             return contextlib.nullcontext()
         import jax
 
-        if not self._active and self._step >= self.start_batch:
+        if not self._active and n >= self.start_batch:
             os.makedirs(self.dir, exist_ok=True)
             jax.profiler.start_trace(self.dir)
             self._active = True
-        if self._active and self._step >= self.stop_batch:
+        if self._active and n >= self.stop_batch:
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
-        n = self._step
-        self._step += 1
+            return contextlib.nullcontext()
         if self._active:
             return jax.profiler.StepTraceAnnotation("train", step_num=n)
         return contextlib.nullcontext()
