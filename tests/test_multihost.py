@@ -1,0 +1,118 @@
+"""Real multi-process training over jax.distributed (2 local processes).
+
+This exercises the path that replaces the reference's distributed
+parameter server (SURVEY.md §2.7 / §3.4): init_distributed,
+per-process batch shards assembled into global arrays, the SPMD step
+with cross-process gradient reduction, replica agreement, and the
+allgather + process-0-writes checkpoint path — all on the CPU backend
+with 2 coordinated subprocesses.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+rank = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, %(repo)r)
+from cxxnet_tpu import config, parallel
+parallel.init_distributed("127.0.0.1:" + port, 2, rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import numpy as np
+from cxxnet_tpu.io import DataBatch
+from cxxnet_tpu.trainer import Trainer
+
+CONF = '''
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1:r1] = relu
+layer[r1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 8
+dev = cpu
+eta = 0.2
+momentum = 0.9
+metric = error
+'''
+tr = Trainer()
+for k, v in config.parse_string(CONF):
+    tr.set_param(k, v)
+tr.init_model()
+assert tr.global_batch == 16
+
+rs = np.random.RandomState(7)
+full = rs.randn(4, 16, 1, 1, 8).astype(np.float32)
+lab = rs.randint(0, 4, size=(4, 16, 1)).astype(np.float32)
+for i in range(4):
+    # each process feeds ITS half of the global batch
+    lo, hi = rank * 8, rank * 8 + 8
+    tr.update(DataBatch(data=full[i, lo:hi], label=lab[i, lo:hi]))
+w = tr.get_weight("fc1", "wmat")
+np.save(out, w)
+if rank == 0:
+    tr.save_model(out + ".model")
+else:
+    tr.save_model(out + ".ignored")  # joins the allgather, writes nothing
+""" % {"repo": REPO}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_training_agrees(tmp_path):
+    port = str(_free_port())
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    procs = []
+    outs = []
+    for rank in (0, 1):
+        out = str(tmp_path / ("w%d.npy" % rank))
+        outs.append(out)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(rank), port, out],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env={**os.environ, "PALLAS_AXON_POOL_IPS": ""}))
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process run timed out")
+        assert p.returncode == 0, err[-3000:]
+
+    w0 = np.load(outs[0])
+    w1 = np.load(outs[1])
+    # both processes hold identical replicas after cross-process reduction
+    np.testing.assert_allclose(w0, w1, rtol=1e-6, atol=1e-7)
+
+    # process 0 wrote the checkpoint; process 1 did not
+    assert os.path.exists(outs[0] + ".model")
+    assert not os.path.exists(outs[1] + ".ignored")
+
+    # the checkpoint loads in a plain single-process trainer and matches
+    from cxxnet_tpu import checkpoint
+    _, _, params, _, _ = checkpoint.load_model(outs[0] + ".model")
+    np.testing.assert_allclose(
+        np.asarray(params[0]["wmat"]).reshape(w0.shape), w0,
+        rtol=1e-6, atol=1e-7)
