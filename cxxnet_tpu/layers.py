@@ -795,12 +795,17 @@ class _PoolingLayer(Layer):
         n, c, h, w = in_shapes[0]
         if p.kernel_height <= 0 or p.kernel_width <= 0:
             raise ValueError("must set kernel_size correctly")
-        if p.kernel_width > w or p.kernel_height > h:
+        # `pad` extends the reference semantics (its pooling has no
+        # padding; pad defaults to 0 = exact parity). Symmetric padding
+        # applies before the reference's partial-edge-window rule —
+        # pad=(k-1)/2 with stride 1 gives "same" pooling (inception).
+        h2, w2 = h + 2 * p.pad_y, w + 2 * p.pad_x
+        if p.kernel_width > w2 or p.kernel_height > h2:
             raise ValueError("kernel size exceeds input")
-        oh = min(h - p.kernel_height + p.stride - 1, h - 1) // p.stride + 1
-        ow = min(w - p.kernel_width + p.stride - 1, w - 1) // p.stride + 1
-        self._pad = ((oh - 1) * p.stride + p.kernel_height - h,
-                     (ow - 1) * p.stride + p.kernel_width - w)
+        oh = min(h2 - p.kernel_height + p.stride - 1, h2 - 1) // p.stride + 1
+        ow = min(w2 - p.kernel_width + p.stride - 1, w2 - 1) // p.stride + 1
+        self._pad = ((oh - 1) * p.stride + p.kernel_height - h2,
+                     (ow - 1) * p.stride + p.kernel_width - w2)
         return [(n, c, oh, ow)]
 
     def apply(self, params, inputs, ctx):
@@ -811,7 +816,8 @@ class _PoolingLayer(Layer):
         pad_h, pad_w = self._pad
         dims = (1, 1, p.kernel_height, p.kernel_width)
         strides = (1, 1, p.stride, p.stride)
-        padding = ((0, 0), (0, 0), (0, pad_h), (0, pad_w))
+        padding = ((0, 0), (0, 0), (p.pad_y, pad_h + p.pad_y),
+                   (p.pad_x, pad_w + p.pad_x))
         if self.reducer == "max":
             init = -jnp.inf
             out = lax.reduce_window(x, init, lax.max, dims, strides, padding)
@@ -856,6 +862,14 @@ class InsanityPoolingLayer(_PoolingLayer):
     custom InsanityPoolingExp expression implements.
     """
     reducer = "max"
+
+    def _infer(self, in_shapes):
+        if self.param.pad_y or self.param.pad_x:
+            # padding has no defined semantics for probability-weighted
+            # window sampling (a -inf/zero pad would skew the weights);
+            # the window-slicing apply below doesn't support it either
+            raise ValueError("insanity pooling does not support pad")
+        return super()._infer(in_shapes)
 
     def apply(self, params, inputs, ctx):
         p = self.param

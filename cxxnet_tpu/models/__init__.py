@@ -220,6 +220,77 @@ input_shape = 3,32,32
 """
 
 
+def inception(nclass: int = 10, input_shape=(3, 32, 32),
+              base: int = 16) -> str:
+    """GoogLeNet-style net from stacked inception modules (BASELINE.md
+    parity target 4): each module runs four branches — 1x1, 1x1->3x3,
+    1x1->5x5, pool->1x1 — joined with ch_concat, the reference's
+    multi-input concat graph machinery (concat_layer-inl.hpp) at real
+    scale rather than the single-block demo."""
+    c, h, w = input_shape
+    if h != w or h % 2 != 0:
+        raise ValueError(
+            "inception: input must be square with even side (one 2x "
+            "downsampling + global average pool head), got %dx%d" % (h, w))
+    lines = ["netconfig=start",
+             "layer[0->stem] = conv:conv0",
+             "  kernel_size = 3", "  pad = 1", "  stride = 1",
+             "  nchannel = %d" % (2 * base)]
+    cur = "stem"
+
+    def module(name, cur, c1, c3r, c3, c5r, c5, pp):
+        out = []
+        out += ["layer[%s->%s_b1] = conv:%s_c1" % (cur, name, name),
+                "  kernel_size = 1", "  pad = 0", "  stride = 1",
+                "  nchannel = %d" % c1]
+        out += ["layer[%s->%s_r3] = conv:%s_c3r" % (cur, name, name),
+                "  kernel_size = 1", "  pad = 0", "  stride = 1",
+                "  nchannel = %d" % c3r,
+                "layer[%s_r3->%s_b3] = conv:%s_c3" % (name, name, name),
+                "  kernel_size = 3", "  pad = 1", "  stride = 1",
+                "  nchannel = %d" % c3]
+        out += ["layer[%s->%s_r5] = conv:%s_c5r" % (cur, name, name),
+                "  kernel_size = 1", "  pad = 0", "  stride = 1",
+                "  nchannel = %d" % c5r,
+                "layer[%s_r5->%s_b5] = conv:%s_c5" % (name, name, name),
+                "  kernel_size = 5", "  pad = 2", "  stride = 1",
+                "  nchannel = %d" % c5]
+        out += ["layer[%s->%s_pp] = max_pooling" % (cur, name),
+                "  kernel_size = 3", "  pad = 1", "  stride = 1",
+                "layer[%s_pp->%s_b4] = conv:%s_cp" % (name, name, name),
+                "  kernel_size = 1", "  pad = 0", "  stride = 1",
+                "  nchannel = %d" % pp]
+        out += ["layer[%s_b1,%s_b3,%s_b5,%s_b4->%s_o] = ch_concat"
+                % (name, name, name, name, name),
+                "layer[%s_o->%s_o] = batch_norm:%s_bn" % (name, name, name),
+                "layer[%s_o->%s_o] = relu" % (name, name)]
+        return out, "%s_o" % name
+
+    m, cur = module("i1", cur, base, base, 2 * base, base // 2, base, base)
+    lines += m
+    m, cur = module("i2", cur, 2 * base, base, 3 * base, base, 2 * base,
+                    base)
+    lines += m
+    lines += ["layer[%s->mid] = max_pooling" % cur,
+              "  kernel_size = 2", "  pad = 0", "  stride = 2"]
+    m, cur = module("i3", "mid", 2 * base, base, 4 * base, base, 2 * base,
+                    2 * base)
+    lines += m
+    lines += ["layer[%s->head_a] = avg_pooling" % cur,
+              "  kernel_size = %d" % (h // 2),
+              "  stride = %d" % (h // 2),
+              "layer[head_a->head_b] = flatten",
+              "layer[head_b->head_c] = dropout",
+              "  threshold = 0.4",
+              "layer[head_c->head_d] = fullc:fc_out",
+              "  nhidden = %d" % nclass,
+              "layer[head_d->head_d] = softmax",
+              "netconfig=end",
+              "input_shape = %d,%d,%d" % (c, h, w),
+              "random_type = kaiming"]
+    return "\n".join(lines) + "\n"
+
+
 def resnet(nclass: int = 10, nstage: int = 3, nblock: int = 2,
            base_channel: int = 16, input_shape=(3, 32, 32)) -> str:
     """CIFAR-style pre-activation ResNet built from split + elewise_add

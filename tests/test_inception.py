@@ -1,0 +1,85 @@
+"""Inception/GoogLeNet-style model + padded pooling.
+
+BASELINE.md parity target 4: a multi-branch ch_concat graph at real
+scale. Pooling `pad` is an additive capability (the reference's pooling
+has none; pad=0 keeps its exact edge semantics).
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from cxxnet_tpu import config, models
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.layers import ApplyContext, create_layer
+from cxxnet_tpu.trainer import Trainer
+
+
+def test_pooling_pad_same():
+    """kernel 3 / stride 1 / pad 1 preserves spatial dims and matches a
+    hand-padded numpy max pool."""
+    mod = create_layer("max_pooling", [("kernel_size", "3"),
+                                       ("stride", "1"), ("pad", "1")],
+                       {"label": 0})
+    assert mod.infer_shape([(2, 3, 8, 8)]) == [(2, 3, 8, 8)]
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    out = np.asarray(mod.apply({}, [jnp.asarray(x)], ApplyContext())[0])
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)),
+                constant_values=-np.inf)
+    ref = np.zeros_like(x)
+    for i in range(8):
+        for j in range(8):
+            ref[:, :, i, j] = xp[:, :, i:i + 3, j:j + 3].max(axis=(2, 3))
+    np.testing.assert_allclose(out, ref)
+
+
+def test_pooling_pad_zero_keeps_reference_semantics():
+    """pad=0: the reference's partial-edge-window output size."""
+    mod = create_layer("max_pooling", [("kernel_size", "3"),
+                                       ("stride", "2")], {"label": 0})
+    # reference: min(h-k+s-1, h-1)//s + 1 = min(7-3+1, 6)//2+1 = 3
+    assert mod.infer_shape([(1, 1, 7, 7)]) == [(1, 1, 3, 3)]
+
+
+def test_inception_builds_and_learns():
+    tr = Trainer()
+    for k, v in config.parse_string(
+            models.inception(nclass=4, input_shape=(3, 16, 16), base=8)):
+        tr.set_param(k, v)
+    tr.set_param("batch_size", "16")
+    tr.set_param("dev", "cpu:0")
+    tr.set_param("eta", "0.05")
+    tr.set_param("momentum", "0.9")
+    tr.set_param("metric", "error")
+    tr.init_model()
+    # four branches concat: c1 + c3 + c5 + pp channels
+    li = tr.net_cfg.get_layer_index("i1_c1")
+    assert tr.params[li] is not None
+    itr = create_iterator([
+        ("iter", "synth"), ("batch_size", "16"), ("shape", "3,16,16"),
+        ("nclass", "4"), ("ninst", "64"), ("shuffle", "1"), ("iter", "end")])
+    errs = []
+    for r in range(6):
+        tr.start_round(r)
+        itr.before_first()
+        while itr.next():
+            tr.update(itr.value)
+        errs.append(float(tr.evaluate(itr, "t").split(":")[-1]))
+    assert errs[-1] < errs[0], errs
+
+
+def test_insanity_pooling_rejects_pad():
+    import pytest
+    mod = create_layer("insanity_max_pooling",
+                       [("kernel_size", "3"), ("stride", "1"),
+                        ("pad", "1")], {"label": 0})
+    with pytest.raises(ValueError, match="does not support pad"):
+        mod.infer_shape([(1, 1, 8, 8)])
+
+
+def test_inception_rejects_bad_shapes():
+    import pytest
+    with pytest.raises(ValueError, match="square"):
+        models.inception(input_shape=(3, 32, 16))
+    with pytest.raises(ValueError, match="even"):
+        models.inception(input_shape=(3, 17, 17))
