@@ -50,7 +50,7 @@ KNOWN_LAYER_TYPES = frozenset([
     # one chip, pipelined over the pipe axis under pipeline_parallel)
     # elewise_add closes residual/skip connections (ResNet-family nets)
     "lrn_pallas", "attention", "moe_fullc", "transformer_stack",
-    "elewise_add",
+    "elewise_add", "embed",
 ])
 
 # self-loop loss layers (in == out node); see src/layer/loss/

@@ -139,6 +139,7 @@ class SyntheticIterator(ArrayIterator):
         self.seed = 0
         self.round_batch_cfg = True
         self.label_width = 1
+        self.token_vocab = 0   # > 0: emit integer token ids in [0, V)
 
     def set_param(self, name: str, val: str) -> None:
         if name == "shape":
@@ -159,15 +160,31 @@ class SyntheticIterator(ArrayIterator):
             self.round_batch_cfg = bool(int(val))
         elif name == "label_width":
             self.label_width = int(val)
+        elif name == "token_vocab":
+            self.token_vocab = int(val)
 
     def init(self) -> None:
         rng = np.random.RandomState(self.seed + 42)
         c, h, w = self.shape
         # the labeling rule is drawn FIRST so train/eval iterators with
         # different ninst share the same ground-truth function
-        proj = rng.randn(c * h * w, self.nclass).astype(np.float32)
-        x = rng.randn(self.ninst, c, h, w).astype(np.float32)
-        logits = x.reshape(self.ninst, -1) @ proj
+        if self.token_vocab > 0:
+            # token sequences: label = argmax of a fixed projection of
+            # the token histogram (learnable by embedding + attention)
+            tproj = rng.randn(self.token_vocab,
+                              self.nclass).astype(np.float32)
+            x = rng.randint(0, self.token_vocab,
+                            size=(self.ninst, c, h, w)).astype(np.float32)
+            hist = np.zeros((self.ninst, self.token_vocab), np.float32)
+            flat = x.reshape(self.ninst, -1).astype(np.int64)
+            for i in range(self.ninst):
+                hist[i] = np.bincount(flat[i],
+                                      minlength=self.token_vocab)
+            logits = hist @ tproj
+        else:
+            proj = rng.randn(c * h * w, self.nclass).astype(np.float32)
+            x = rng.randn(self.ninst, c, h, w).astype(np.float32)
+            logits = x.reshape(self.ninst, -1) @ proj
         y = logits.argmax(axis=1).astype(np.float32)
         label = np.tile(y[:, None], (1, self.label_width))
         super().__init__(x, label, self.batch_size_cfg,

@@ -270,6 +270,66 @@ class FullConnectLayer(Layer):
         return [out.reshape(n, 1, 1, self.param.num_hidden)]
 
 
+@register("embed")
+class EmbeddingLayer(Layer):
+    """Token embedding lookup: (b, 1, s, 1) ids -> (b, 1, s, nhidden).
+
+    No reference analogue (cxxnet is a vision framework); this is the
+    entry point for token models feeding the attention /
+    transformer_stack layers. Ids arrive as the float data tensor (the
+    pipeline's uniform dtype) and are cast to int32. ``learn_pos = 1``
+    adds a learned positional embedding (attention is otherwise
+    permutation-equivariant). Config: ``vocab_size``, ``nhidden``,
+    ``learn_pos``. Tags: ``wmat`` (vocab, nhidden), ``pos``
+    (seq, nhidden).
+    """
+    has_params = True
+    param_tags = ("wmat", "pos")
+
+    def __init__(self):
+        super().__init__()
+        self.vocab_size = 0
+        self.learn_pos = 0
+
+    def set_param(self, name, val):
+        if name == "vocab_size":
+            self.vocab_size = int(val)
+        elif name == "learn_pos":
+            self.learn_pos = int(val)
+        else:
+            super().set_param(name, val)
+
+    def _infer(self, in_shapes):
+        n, c, s, w = in_shapes[0]
+        if c != 1 or w != 1:
+            raise ValueError("embed: input must be (batch,1,seq,1) ids")
+        if self.vocab_size <= 0 or self.param.num_hidden <= 0:
+            raise ValueError("embed: must set vocab_size and nhidden")
+        self.seq_len = s
+        return [(n, 1, s, self.param.num_hidden)]
+
+    def init_params(self, rng) -> Params:
+        e = self.param.num_hidden
+        r1, r2 = jax.random.split(rng)
+        p = {"wmat": jax.random.normal(r1, (self.vocab_size, e),
+                                       jnp.float32) * (e ** -0.5)}
+        if self.learn_pos:
+            p["pos"] = jax.random.normal(r2, (self.seq_len, e),
+                                         jnp.float32) * 0.02
+        return p
+
+    def apply(self, params, inputs, ctx):
+        n, _, s, _ = inputs[0].shape
+        ids = jnp.clip(inputs[0].reshape(n, s).astype(jnp.int32),
+                       0, self.vocab_size - 1)
+        out = jnp.take(params["wmat"].astype(ctx.compute_dtype), ids,
+                       axis=0)                        # (b, s, e)
+        if self.learn_pos:
+            out = out + params["pos"].astype(ctx.compute_dtype)[None]
+        return [out.astype(jnp.float32).reshape(
+            n, 1, s, self.param.num_hidden)]
+
+
 @register("moe_fullc")
 class MoEFullConnectLayer(Layer):
     """Mixture-of-experts fullc with top-k token-choice routing.

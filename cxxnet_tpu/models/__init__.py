@@ -391,6 +391,33 @@ input_shape = 1,{seq_len},{embed}
 """
 
 
+def token_classifier(seq_len: int = 16, vocab: int = 64, embed: int = 32,
+                     nlayer: int = 2, nhead: int = 4,
+                     nclass: int = 10) -> str:
+    """Token-sequence classifier: embedding (+ learned positions) into a
+    transformer stack — the full token-model path (no reference
+    analogue; cxxnet has no embeddings or sequence models)."""
+    return f"""
+netconfig=start
+layer[0->1] = embed:emb
+  vocab_size = {vocab}
+  nhidden = {embed}
+  learn_pos = 1
+layer[1->2] = transformer_stack:ts1
+  nlayer = {nlayer}
+  nhead = {nhead}
+  nhidden_mlp = {4 * embed}
+  random_type = xavier
+layer[2->3] = flatten
+layer[3->4] = fullc:fc1
+  nhidden = {nclass}
+  init_sigma = 0.01
+layer[4->4] = softmax
+netconfig=end
+input_shape = 1,{seq_len},1
+"""
+
+
 def seq_classifier(seq_len: int = 16, embed: int = 32, nhead: int = 4,
                    nclass: int = 10, causal: int = 0) -> str:
     """Attention-based sequence classifier (no reference equivalent —
