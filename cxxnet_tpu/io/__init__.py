@@ -140,6 +140,7 @@ class SyntheticIterator(ArrayIterator):
         self.round_batch_cfg = True
         self.label_width = 1
         self.token_vocab = 0   # > 0: emit integer token ids in [0, V)
+        self.lm_labels = 0     # 1: labels are the next token per position
 
     def set_param(self, name: str, val: str) -> None:
         if name == "shape":
@@ -162,12 +163,37 @@ class SyntheticIterator(ArrayIterator):
             self.label_width = int(val)
         elif name == "token_vocab":
             self.token_vocab = int(val)
+        elif name == "lm_labels":
+            self.lm_labels = int(val)
 
     def init(self) -> None:
         rng = np.random.RandomState(self.seed + 42)
         c, h, w = self.shape
         # the labeling rule is drawn FIRST so train/eval iterators with
         # different ninst share the same ground-truth function
+        if self.token_vocab > 0 and self.lm_labels:
+            # language-modeling data: sequences from a fixed sparse
+            # Markov chain (each token has 2 likely successors), labels =
+            # the next token per position. A causal model can learn the
+            # transitions; iid tokens would be unlearnable.
+            V = self.token_vocab
+            s = c * h * w
+            nxt = rng.randint(0, V, size=(V, 2))
+            x = np.zeros((self.ninst, s), np.int64)
+            x[:, 0] = rng.randint(0, V, size=self.ninst)
+            for t in range(1, s):
+                pick = nxt[x[:, t - 1], rng.randint(0, 2, self.ninst)]
+                x[:, t] = pick
+            label = np.zeros((self.ninst, s), np.float32)
+            label[:, :-1] = x[:, 1:]
+            label[:, -1] = x[:, 0]  # wrap (positionally meaningless tail)
+            data = x.reshape(self.ninst, c, h, w).astype(np.float32)
+            self.label_width = s
+            super().__init__(data, label, self.batch_size_cfg,
+                             shuffle=self.shuffle_cfg,
+                             round_batch=self.round_batch_cfg,
+                             seed=self.seed)
+            return
         if self.token_vocab > 0:
             # token sequences: label = argmax of a fixed projection of
             # the token histogram (learnable by embedding + attention)

@@ -237,17 +237,37 @@ class FullConnectLayer(Layer):
     """
     has_params = True
 
+    def __init__(self):
+        super().__init__()
+        self.seq = 0
+
+    def set_param(self, name, val):
+        if name == "seq":
+            self.seq = int(val)
+        else:
+            super().set_param(name, val)
+
     def _infer(self, in_shapes):
         (n, c, h, w) = in_shapes[0]
-        if not _is_mat(in_shapes[0]):
-            raise ValueError("FullcLayer: input needs to be a matrix")
+        # matrix input like the reference; ``seq = 1`` opts into
+        # position-wise application on (b, 1, s, e) sequence nodes —
+        # the per-token projection a language-model head needs. The
+        # opt-in keeps the reference's forgot-the-flatten error for
+        # image nodes.
+        if self.seq:
+            if c != 1:
+                raise ValueError("FullcLayer(seq): input must be "
+                                 "(b,1,s,e)")
+        elif not _is_mat(in_shapes[0]):
+            raise ValueError("FullcLayer: input needs to be a matrix "
+                             "(or set seq = 1 for position-wise use)")
         if self.param.num_hidden <= 0:
             raise ValueError("FullcLayer: must set nhidden correctly")
         if self.param.num_input_node == 0:
             self.param.num_input_node = w
         elif self.param.num_input_node != w:
             raise ValueError("FullcLayer: input hidden nodes inconsistent")
-        return [(n, 1, 1, self.param.num_hidden)]
+        return [(n, 1, h, self.param.num_hidden)]
 
     def init_params(self, rng) -> Params:
         nh, ni = self.param.num_hidden, self.param.num_input_node
@@ -258,7 +278,8 @@ class FullConnectLayer(Layer):
         return p
 
     def apply(self, params, inputs, ctx):
-        x = _mat(inputs[0])
+        n, _, s, e = inputs[0].shape
+        x = inputs[0].reshape(n * s, e)
         # bf16 operands, f32 result: the MXU accumulates f32 internally;
         # avoiding preferred_element_type keeps the grad transposes
         # same-dtype (their f32 accumulation is likewise implicit)
@@ -266,8 +287,7 @@ class FullConnectLayer(Layer):
         out = jnp.dot(x.astype(ctx.compute_dtype), w.T).astype(jnp.float32)
         if self.param.no_bias == 0:
             out = out + params["bias"]
-        n = inputs[0].shape[0]
-        return [out.reshape(n, 1, 1, self.param.num_hidden)]
+        return [out.reshape(n, 1, s, self.param.num_hidden)]
 
 
 @register("embed")
@@ -322,8 +342,10 @@ class EmbeddingLayer(Layer):
         n, _, s, _ = inputs[0].shape
         ids = jnp.clip(inputs[0].reshape(n, s).astype(jnp.int32),
                        0, self.vocab_size - 1)
-        out = jnp.take(params["wmat"].astype(ctx.compute_dtype), ids,
-                       axis=0)                        # (b, s, e)
+        # gather first, cast after: converting the whole (vocab, e) table
+        # per step would touch V*e elements to use b*s rows
+        out = jnp.take(params["wmat"], ids,
+                       axis=0).astype(ctx.compute_dtype)  # (b, s, e)
         if self.learn_pos:
             out = out + params["pos"].astype(ctx.compute_dtype)[None]
         return [out.astype(jnp.float32).reshape(
@@ -1437,6 +1459,29 @@ class SoftmaxLayer(_LossLayer):
     """
 
     def apply(self, params, inputs, ctx):
+        n, c, s, v = inputs[0].shape
+        if c == 1 and s > 1:
+            # sequence node (b, 1, s, V): per-position softmax CE against
+            # an s-wide label field — the language-model objective (no
+            # reference analogue; cxxnet's softmax is per-instance only).
+            # Loss normalized per token so grad_scale semantics carry over.
+            logits = inputs[0].reshape(n, s, v)
+            probs = jax.nn.softmax(logits, axis=-1)
+            if ctx.labels is not None:
+                y = self._label(ctx).astype(jnp.int32)      # (n, s)
+                if y.shape[1] != s:
+                    # a narrower field would silently broadcast one label
+                    # across every position — a wrong objective
+                    raise ValueError(
+                        "softmax on a %d-position sequence needs an "
+                        "equally wide label field (declare "
+                        "label_vec[0,%d) = %s and set label_width); got "
+                        "width %d" % (s, s, self.target, y.shape[1]))
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                ce = -jnp.take_along_axis(logp, y[..., None],
+                                          axis=2).sum()
+                ctx.losses.append(ce * self._scale(ctx) / s)
+            return [probs.reshape(inputs[0].shape)]
         logits = _mat(inputs[0])
         probs = jax.nn.softmax(logits, axis=-1)
         if ctx.labels is not None:

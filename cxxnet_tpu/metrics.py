@@ -129,6 +129,39 @@ class MetricLogloss(Metric):
         return jnp.sum(jnp.where(mask > 0, res, 0.0)), jnp.sum(mask)
 
 
+class MetricTokenError(Metric):
+    """Mean per-position argmax error for sequence predictions: pred is
+    the flattened (n, s*V) per-position distribution, label the (n, s)
+    target ids. No reference analogue (cxxnet has no sequence models);
+    the language-model companion to `error`."""
+    name = "token_error"
+
+    def add_eval(self, pred, label):
+        n, k = pred.shape
+        s = label.shape[1]
+        if k % s != 0:
+            raise ValueError(
+                "token_error: pred width %d not a multiple of label "
+                "width %d" % (k, s))
+        idx = pred.reshape(n, s, k // s).argmax(axis=2)
+        wrong = (idx != label.astype(np.int64)).mean(axis=1)
+        self.sum_metric += float(wrong.sum())
+        self.cnt_inst += n
+
+    def device_eval(self, pred, label, mask):
+        import jax.numpy as jnp
+        n, k = pred.shape
+        s = label.shape[1]
+        if k % s != 0:
+            raise ValueError(
+                "token_error: pred width %d not a multiple of label "
+                "width %d" % (k, s))
+        idx = jnp.argmax(pred.reshape(n, s, k // s), axis=2)
+        wrong = (idx != label.astype(jnp.int32)).astype(
+            jnp.float32).mean(axis=1)
+        return jnp.sum(jnp.where(mask > 0, wrong, 0.0)), jnp.sum(mask)
+
+
 class MetricRecall(Metric):
     """rec@n (reference: metric.h:135-172)."""
 
@@ -167,6 +200,8 @@ def create_metric(name: str) -> Optional[Metric]:
         return MetricRMSE()
     if name == "error":
         return MetricError()
+    if name == "token_error":
+        return MetricTokenError()
     if name == "logloss":
         return MetricLogloss()
     if name.startswith("rec@"):

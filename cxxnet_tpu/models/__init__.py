@@ -418,6 +418,36 @@ input_shape = 1,{seq_len},1
 """
 
 
+def tiny_lm(seq_len: int = 32, vocab: int = 32, embed: int = 32,
+            nlayer: int = 2, nhead: int = 4) -> str:
+    """Causal language model: embed (+positions) -> causal transformer
+    stack -> position-wise vocab head -> per-position softmax CE. The
+    s-wide label field carries the next token per position (the synth
+    iterator's ``lm_labels = 1`` mode generates Markov data for it).
+    No reference analogue — the complete token-LM training path."""
+    return f"""
+netconfig=start
+layer[0->1] = embed:emb
+  vocab_size = {vocab}
+  nhidden = {embed}
+  learn_pos = 1
+layer[1->2] = transformer_stack:ts1
+  nlayer = {nlayer}
+  nhead = {nhead}
+  causal = 1
+  nhidden_mlp = {4 * embed}
+  random_type = xavier
+layer[2->3] = fullc:lm_head
+  nhidden = {vocab}
+  seq = 1
+  init_sigma = 0.02
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,{seq_len},1
+label_vec[0,{seq_len}) = label
+"""
+
+
 def seq_classifier(seq_len: int = 16, embed: int = 32, nhead: int = 4,
                    nclass: int = 10, causal: int = 0) -> str:
     """Attention-based sequence classifier (no reference equivalent —
