@@ -22,4 +22,19 @@ __version__ = "0.1.0"
 from . import config
 from . import graph
 
-__all__ = ["config", "graph", "__version__"]
+__all__ = ["config", "graph", "models", "wrapper", "Trainer",
+           "__version__"]
+
+
+def __getattr__(name):
+    # heavy subsystems (jax import) load lazily so `import cxxnet_tpu`
+    # stays cheap for config-only users (e.g. tools/)
+    if name == "Trainer":
+        from .trainer import Trainer
+        return Trainer
+    if name in ("models", "wrapper", "trainer", "io", "parallel",
+                "metrics", "checkpoint", "profiler", "layers", "model",
+                "updater"):
+        import importlib
+        return importlib.import_module("." + name, __name__)
+    raise AttributeError(name)
