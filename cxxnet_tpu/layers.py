@@ -352,6 +352,49 @@ class EmbeddingLayer(Layer):
             n, 1, s, self.param.num_hidden)]
 
 
+def moe_route(x, gate, topk: int, capacity: int, dt):
+    """GShard-style top-k token-choice routing, shared by moe_fullc and
+    the MoE transformer blocks.
+
+    x (B, i) tokens, gate (E, i) router weights. Returns (dispatch
+    (B, E, C) one-hot slots, combine (B, E, C) gate-weighted slots,
+    aux load-balance loss scalar — GShard eq.4). All shapes static
+    (MXU-friendly one-hot einsum dispatch); tokens over an expert's
+    capacity drop.
+    """
+    B, E = x.shape[0], gate.shape[0]
+    C = capacity
+    logits = jnp.dot(x.astype(dt), gate.astype(dt).T)      # (B, E)
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # iterative top-k selection (k small): one-hot choice per round,
+    # chosen experts masked out for the next round
+    masked = gates
+    dispatch = jnp.zeros((B, E, C), jnp.float32)
+    combine = jnp.zeros((B, E, C), jnp.float32)
+    # position counters per expert accumulate across rounds so that
+    # round-2 tokens take slots after round-1 tokens
+    base_count = jnp.zeros((E,), jnp.int32)
+    frac_routed = jnp.zeros((E,), jnp.float32)
+    for _ in range(topk):
+        idx = jnp.argmax(masked, axis=-1)               # (B,)
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        frac_routed = frac_routed + onehot.mean(axis=0)
+        # slot position of each token within its chosen expert
+        pos = jnp.cumsum(onehot, axis=0) - onehot + base_count
+        keep = (pos < C) * onehot                       # drop overflow
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                              dtype=jnp.float32) * keep[..., None]
+        gate_w = (gates * onehot).sum(-1, keepdims=True)  # (B, 1)
+        dispatch = dispatch + slot
+        combine = combine + slot * gate_w[..., None]
+        base_count = base_count + keep.sum(0).astype(jnp.int32)
+        masked = masked * (1.0 - onehot)
+
+    aux = E * jnp.sum(gates.mean(axis=0) * frac_routed / topk)
+    return dispatch, combine, aux
+
+
 @register("moe_fullc")
 class MoEFullConnectLayer(Layer):
     """Mixture-of-experts fullc with top-k token-choice routing.
@@ -425,44 +468,12 @@ class MoEFullConnectLayer(Layer):
     def apply(self, params, inputs, ctx):
         x = _mat(inputs[0])                         # (B, ni)
         dt = ctx.compute_dtype
-        B, E = x.shape[0], self.nexpert
-        C = self._capacity(B)
         xc = x.astype(dt)
-
-        logits = jnp.dot(xc, params["gate"].astype(dt).T)  # (B, E)
-        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-
-        # iterative top-k selection (k small): one-hot choice per round,
-        # chosen experts masked out for the next round
-        masked = gates
-        dispatch = jnp.zeros((B, E, C), jnp.float32)
-        combine = jnp.zeros((B, E, C), jnp.float32)
-        # position counters per expert accumulate across rounds so that
-        # round-2 tokens take slots after round-1 tokens
-        base_count = jnp.zeros((E,), jnp.int32)
-        frac_routed = jnp.zeros((E,), jnp.float32)
-        for _ in range(self.topk):
-            idx = jnp.argmax(masked, axis=-1)               # (B,)
-            onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
-            frac_routed = frac_routed + onehot.mean(axis=0)
-            # slot position of each token within its chosen expert
-            pos = jnp.cumsum(onehot, axis=0) - onehot + base_count
-            keep = (pos < C) * onehot                       # drop overflow
-            slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
-                                  dtype=jnp.float32) * keep[..., None]
-            gate_w = (gates * onehot).sum(-1, keepdims=True)  # (B, 1)
-            dispatch = dispatch + slot
-            combine = combine + slot * gate_w[..., None]
-            base_count = base_count + keep.sum(0).astype(jnp.int32)
-            masked = masked * (1.0 - onehot)
-
-        # aux load-balance loss (GShard eq.4): E * sum_e mean(gate_e) *
-        # mean(routed_e); scaled like other losses by grad_scale semantics
+        C = self._capacity(x.shape[0])
+        dispatch, combine, aux = moe_route(
+            xc, params["gate"], self.topk, C, dt)
         if ctx.train and self.moe_loss > 0.0:
-            aux = E * jnp.sum(gates.mean(axis=0)
-                              * frac_routed / self.topk)
             ctx.losses.append(self.moe_loss * aux)
-
         # scatter -> expert fullc -> gather (einsum dispatch, all static)
         xin = jnp.einsum("bec,bi->eci", dispatch.astype(dt), xc)
         h = jnp.einsum("eci,eoi->eco", xin, params["wmat"].astype(dt))
@@ -1346,7 +1357,7 @@ class TransformerStackLayer(Layer):
     FLOPs-for-HBM trade for deep stacks).
     """
     has_params = True
-    param_tags = ("wqkv", "wo", "w1", "w2", "norm1", "norm2")
+    param_tags = ("wqkv", "wo", "w1", "w2", "norm1", "norm2", "gate")
 
     def __init__(self):
         super().__init__()
@@ -1356,6 +1367,11 @@ class TransformerStackLayer(Layer):
         self.nhidden_mlp = 0
         self.n_microbatch = 0
         self.remat = 0
+        self.moe = 0
+        self.nexpert = 0
+        self.topk = 2
+        self.capacity_factor = 1.25
+        self.moe_loss = 0.01
 
     def set_param(self, name, val):
         if name == "nlayer":
@@ -1370,6 +1386,16 @@ class TransformerStackLayer(Layer):
             self.n_microbatch = int(val)
         elif name == "remat":
             self.remat = int(val)
+        elif name == "moe":
+            self.moe = int(val)
+        elif name == "nexpert":
+            self.nexpert = int(val)
+        elif name == "moe_topk":
+            self.topk = int(val)
+        elif name == "capacity_factor":
+            self.capacity_factor = float(val)
+        elif name == "moe_loss":
+            self.moe_loss = float(val)
         else:
             super().set_param(name, val)
 
@@ -1388,14 +1414,30 @@ class TransformerStackLayer(Layer):
     def init_params(self, rng) -> Params:
         e, m, L = self.in_shapes[0][3], self.nhidden_mlp, self.nlayer
         p = self.param
-        ks = jax.random.split(rng, 4)
-        return {
+        ks = jax.random.split(rng, 5)
+        out = {
             "wqkv": p.rand_init_weight(ks[0], (L, 3 * e, e), e, 3 * e),
             "wo": p.rand_init_weight(ks[1], (L, e, e), e, e),
-            "w1": p.rand_init_weight(ks[2], (L, m, e), e, m),
-            "w2": p.rand_init_weight(ks[3], (L, e, m), m, e),
             "norm1": jnp.ones((L, e), jnp.float32),
             "norm2": jnp.ones((L, e), jnp.float32)}
+        if self.moe:
+            if self.nexpert <= 0:
+                raise ValueError("transformer_stack: moe=1 needs nexpert")
+            if self.topk > self.nexpert:
+                # excess rounds would silently re-route to expert 0 with
+                # full gate weight (moe_fullc rejects this too)
+                raise ValueError(
+                    "transformer_stack: moe_topk %d > nexpert %d"
+                    % (self.topk, self.nexpert))
+            E = self.nexpert
+            out["w1"] = p.rand_init_weight(ks[2], (L, E, m, e), e, m)
+            out["w2"] = p.rand_init_weight(ks[3], (L, E, e, m), m, e)
+            out["gate"] = jax.random.normal(
+                ks[4], (L, E, e), jnp.float32) * (e ** -0.5)
+        else:
+            out["w1"] = p.rand_init_weight(ks[2], (L, m, e), e, m)
+            out["w2"] = p.rand_init_weight(ks[3], (L, e, m), m, e)
+        return out
 
     def _block_fn(self, dt):
         from .ops import ring_attention as ra
@@ -1407,6 +1449,30 @@ class TransformerStackLayer(Layer):
             return (x.astype(jnp.float32) * jax.lax.rsqrt(ms + 1e-6)
                     ).astype(dt) * g.astype(dt)
 
+        moe = self.moe
+        topk, cap_f = self.topk, self.capacity_factor
+        nexpert = self.nexpert
+
+        def mlp(lp, x):
+            b, s, e = x.shape
+            if not moe:
+                y = jax.nn.relu(
+                    jnp.einsum("bse,me->bsm", x, lp["w1"].astype(dt)))
+                return jnp.einsum("bsm,em->bse", y,
+                                  lp["w2"].astype(dt)), 0.0
+            # mixture-of-experts MLP: tokens route to per-layer experts
+            # (experts shard over the model axis — expert parallelism
+            # inside the stack)
+            tok = x.reshape(b * s, e)
+            C = max(int(math.ceil(topk * b * s / nexpert * cap_f)), 1)
+            dispatch, combine, aux = moe_route(tok, lp["gate"], topk, C, dt)
+            xin = jnp.einsum("bec,bi->eci", dispatch.astype(dt), tok)
+            hmid = jax.nn.relu(
+                jnp.einsum("eci,emi->ecm", xin, lp["w1"].astype(dt)))
+            yexp = jnp.einsum("ecm,eom->eco", hmid, lp["w2"].astype(dt))
+            y = jnp.einsum("bec,eco->bo", combine.astype(dt), yexp)
+            return y.reshape(b, s, e), aux
+
         def block(lp, h):
             b, s, e = h.shape
             d = e // nh
@@ -1417,10 +1483,8 @@ class TransformerStackLayer(Layer):
             att = att.transpose(0, 2, 1, 3).reshape(b, s, e)
             h = h + jnp.einsum("bse,fe->bsf", att, lp["wo"].astype(dt))
             x = rmsnorm(h, lp["norm2"])
-            x = jax.nn.relu(
-                jnp.einsum("bse,me->bsm", x, lp["w1"].astype(dt)))
-            h = h + jnp.einsum("bsm,em->bse", x, lp["w2"].astype(dt))
-            return h
+            y, aux = mlp(lp, x)
+            return h + y, aux
         return block
 
     def apply(self, params, inputs, ctx):
@@ -1437,15 +1501,27 @@ class TransformerStackLayer(Layer):
                 raise ValueError(
                     "transformer_stack: nlayer %d not divisible by "
                     "pipeline_parallel %d" % (self.nlayer, pipe))
+            if self.moe:
+                raise ValueError(
+                    "transformer_stack: moe=1 does not compose with "
+                    "pipeline_parallel yet (the per-block aux loss needs "
+                    "a cross-stage reduction); use expert parallelism "
+                    "via model_parallel instead")
             from .ops import pipeline
             nmb = self.n_microbatch or pipe
             cast = {k: v.astype(dt) if v.ndim > 2 else v
                     for k, v in params.items()}
-            h = pipeline.sharded_pipeline(mesh, block, cast, h, nmb)
+            h = pipeline.sharded_pipeline(
+                mesh, lambda lp, hh: block(lp, hh)[0], cast, h, nmb)
         else:
-            def body(hh, lp):
-                return block(lp, hh), None
-            h, _ = jax.lax.scan(body, h, params)
+            def body(carry, lp):
+                hh, aux = carry
+                h2, a = block(lp, hh)
+                return (h2, aux + a), None
+            (h, aux_total), _ = jax.lax.scan(
+                body, (h, jnp.zeros((), jnp.float32)), params)
+            if self.moe and ctx.train and self.moe_loss > 0.0:
+                ctx.losses.append(self.moe_loss * aux_total / self.nlayer)
         return [h.astype(jnp.float32).reshape(b, 1, s, e)]
 
 

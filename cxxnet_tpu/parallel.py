@@ -181,11 +181,23 @@ def param_sharding(mesh: Mesh, layer_type: str, tag: str,
     On a 1D mesh everything is replicated (pure data parallelism).
     """
     # pipeline parallelism: depth-stacked transformer params shard their
-    # layer dimension over the pipe axis — each stage owns L/P blocks
-    if layer_type == "transformer_stack" and PIPE_AXIS in mesh.shape \
-            and shape and shape[0] % mesh.shape[PIPE_AXIS] == 0:
-        return NamedSharding(mesh, P(*([PIPE_AXIS]
-                                       + [None] * (len(shape) - 1))))
+    # layer dimension over the pipe axis — each stage owns L/P blocks.
+    # MoE stack tensors (gate (L,E,e), w1/w2 (L,E,.,.)) additionally
+    # shard the expert dimension over the model axis (expert parallelism
+    # inside the stack).
+    if layer_type == "transformer_stack" and shape:
+        spec = [None] * len(shape)
+        if PIPE_AXIS in mesh.shape \
+                and shape[0] % mesh.shape[PIPE_AXIS] == 0:
+            spec[0] = PIPE_AXIS
+        is_moe_tensor = ((tag == "gate" and len(shape) == 3)
+                         or (tag in ("w1", "w2") and len(shape) == 4))
+        if is_moe_tensor and MODEL_AXIS in mesh.shape \
+                and shape[1] % mesh.shape[MODEL_AXIS] == 0:
+            spec[1] = MODEL_AXIS
+        if any(spec):
+            return NamedSharding(mesh, P(*spec))
+        return replicated(mesh)
     if MODEL_AXIS not in mesh.shape:
         return replicated(mesh)
     n_model = mesh.shape[MODEL_AXIS]

@@ -1,0 +1,140 @@
+"""MoE transformer blocks: moe=1 makes every block's MLP a
+mixture-of-experts (the modern MoE-LLM architecture), sharing moe_route
+with moe_fullc and composing with EP/DP/remat and the LM objective."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu import config, models, parallel
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.trainer import Trainer
+
+LM_BASE = """
+netconfig=start
+layer[0->1] = embed:emb
+  vocab_size = 16
+  nhidden = 16
+  learn_pos = 1
+layer[1->2] = transformer_stack:ts1
+  nlayer = 2
+  nhead = 2
+  causal = 1
+  nhidden_mlp = 32
+%s
+  random_type = xavier
+layer[2->3] = fullc:lm_head
+  nhidden = 16
+  seq = 1
+  init_sigma = 0.02
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,16,1
+label_vec[0,16) = label
+"""
+
+
+def _trainer(moe_cfg, **overrides):
+    tr = Trainer()
+    for k, v in config.parse_string(LM_BASE % moe_cfg):
+        tr.set_param(k, v)
+    tr.set_param("batch_size", "32")
+    tr.set_param("dev", "cpu:0")
+    tr.set_param("eta", "0.3")
+    tr.set_param("momentum", "0.9")
+    tr.set_param("metric", "token_error")
+    for k, v in overrides.items():
+        tr.set_param(k, str(v))
+    tr.init_model()
+    return tr
+
+
+def _lm_iter():
+    return create_iterator([
+        ("iter", "synth"), ("batch_size", "32"), ("shape", "1,16,1"),
+        ("token_vocab", "16"), ("lm_labels", "1"), ("ninst", "256"),
+        ("shuffle", "1"), ("iter", "end")])
+
+
+def test_single_expert_equals_dense():
+    """nexpert=1, topk=1, ample capacity: the router sends every token to
+    the one expert with gate weight softmax(1)=1, so the MoE block equals
+    the dense block with the same weights exactly."""
+    td = _trainer("", seed=9)
+    tm = _trainer("  moe = 1\n  nexpert = 1\n  moe_topk = 1\n"
+                  "  capacity_factor = 2.0\n  moe_loss = 0", seed=9)
+    li = td.net_cfg.get_layer_index("ts1")
+    # graft the dense weights into the moe layout (add the expert dim)
+    pm = dict(tm.params[li])
+    for t in ("w1", "w2"):
+        pm[t] = jnp.asarray(np.asarray(td.params[li][t])[:, None])
+    for t in ("wqkv", "wo", "norm1", "norm2"):
+        pm[t] = td.params[li][t]
+    params = list(tm.params)
+    params[li] = pm
+    tm.params = jax.device_put(params, tm._psh)
+    # embed + head weights too
+    for name in ("emb", "lm_head"):
+        for tag, w in td.params[td.net_cfg.get_layer_index(name)].items():
+            tm.set_weight(np.asarray(w).reshape(
+                np.asarray(w).shape[0], -1) if np.asarray(w).ndim > 1
+                else np.asarray(w), name, tag)
+    rs = np.random.RandomState(0)
+    from cxxnet_tpu.io import DataBatch
+    b = DataBatch(data=rs.randint(0, 16, (8, 1, 16, 1)).astype(np.float32),
+                  label=rs.randint(0, 16, (8, 16)).astype(np.float32))
+    pd = td.forward_nodes(b, [td.net.out_node])[0]
+    pmo = tm.forward_nodes(b, [tm.net.out_node])[0]
+    np.testing.assert_allclose(pmo, pd, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_lm_trains():
+    tr = _trainer("  moe = 1\n  nexpert = 4\n  moe_topk = 2")
+    li = tr.net_cfg.get_layer_index("ts1")
+    assert tr.params[li]["gate"].shape == (2, 4, 16)
+    assert tr.params[li]["w1"].shape == (2, 4, 32, 16)
+    itr = _lm_iter()
+    errs = []
+    for r in range(6):
+        tr.start_round(r)
+        itr.before_first()
+        while itr.next():
+            tr.update(itr.value)
+        errs.append(float(tr.evaluate(itr, "t").split(":")[-1]))
+    assert errs[-1] < errs[0], errs
+
+
+def test_moe_stack_expert_parallel_sharding():
+    tr = _trainer("  moe = 1\n  nexpert = 2\n  moe_topk = 1",
+                  model_parallel=2, dev="cpu")
+    li = tr.net_cfg.get_layer_index("ts1")
+    spec = tuple(tr._psh[li]["w1"].spec)
+    assert spec[1] == parallel.MODEL_AXIS      # experts over model axis
+    itr = _lm_iter()
+    itr.before_first(); itr.next()
+    tr.update(itr.value)                        # EP step runs
+    assert np.isfinite(np.asarray(tr.params[li]["gate"])).all()
+
+
+def test_moe_plus_pipeline_rejected():
+    tr = _trainer("  moe = 1\n  nexpert = 2", pipeline_parallel=2,
+                  dev="cpu")
+    itr = _lm_iter()
+    itr.before_first(); itr.next()
+    with pytest.raises(ValueError, match="does not compose"):
+        tr.update(itr.value)
+
+
+def test_moe_with_remat_trains():
+    tr = _trainer("  moe = 1\n  nexpert = 2\n  remat = 1")
+    itr = _lm_iter()
+    itr.before_first(); itr.next()
+    tr.update(itr.value)
+    li = tr.net_cfg.get_layer_index("ts1")
+    assert np.isfinite(np.asarray(tr.params[li]["w1"])).all()
+
+
+def test_moe_topk_must_not_exceed_nexpert():
+    with pytest.raises(ValueError, match="moe_topk"):
+        _trainer("  moe = 1\n  nexpert = 1")  # default moe_topk = 2
