@@ -21,7 +21,9 @@ BATCH = 256
 WARMUP = 3
 ITERS = 12
 TRIALS = 4          # minimum trial windows
-BUDGET_S = 300      # keep sampling up to this long while contended
+BUDGET_S = 210      # keep sampling up to this long while contended
+                    # (leave headroom under external runner timeouts —
+                    # one fully-contended window can take ~2 minutes)
 QUIET_IMAGES_PER_SEC = 2000.0   # a reading above this means a quiet window
 
 

@@ -170,6 +170,10 @@ class ApplyContext:
     # sharded over this mesh axis (cxxnet_tpu/ops/ring_attention.py)
     mesh: Optional[object] = None
     seq_axis: Optional[str] = None
+    # the platform the surrounding jit targets ("tpu"/"cpu"/...), set by
+    # the trainer from its mesh — gates compiled-vs-interpreted Pallas
+    # (the process default backend can differ from the jit target)
+    platform: str = "cpu"
 
 
 def _mat(x: jnp.ndarray) -> jnp.ndarray:
@@ -352,6 +356,13 @@ class EmbeddingLayer(Layer):
             n, 1, s, self.param.num_hidden)]
 
 
+def moe_capacity(topk: int, n_tokens: int, nexpert: int,
+                 factor: float) -> int:
+    """Per-expert slot count for token-choice routing (shared by
+    moe_fullc and the MoE transformer blocks)."""
+    return max(int(math.ceil(topk * n_tokens / nexpert * factor)), 1)
+
+
 def moe_route(x, gate, topk: int, capacity: int, dt):
     """GShard-style top-k token-choice routing, shared by moe_fullc and
     the MoE transformer blocks.
@@ -460,16 +471,13 @@ class MoEFullConnectLayer(Layer):
             "gate": jax.random.normal(rg, (e, ni), jnp.float32)
             * (ni ** -0.5)}
 
-    def _capacity(self, n_tokens: int) -> int:
-        c = int(math.ceil(self.topk * n_tokens / self.nexpert
-                          * self.capacity_factor))
-        return max(c, 1)
 
     def apply(self, params, inputs, ctx):
         x = _mat(inputs[0])                         # (B, ni)
         dt = ctx.compute_dtype
         xc = x.astype(dt)
-        C = self._capacity(x.shape[0])
+        C = moe_capacity(self.topk, x.shape[0], self.nexpert,
+                         self.capacity_factor)
         dispatch, combine, aux = moe_route(
             xc, params["gate"], self.topk, C, dt)
         if ctx.train and self.moe_loss > 0.0:
@@ -1021,19 +1029,20 @@ class LRNLayer(Layer):
         else:
             super().set_param(name, val)
 
-    def _want_pallas(self) -> bool:
+    def _want_pallas(self, ctx) -> bool:
         if self.use_pallas == 0:
             return False
         if self.use_pallas == 1:
             return True
-        return jax.default_backend() == "tpu"
+        return ctx.platform == "tpu"
 
     def apply(self, params, inputs, ctx):
         x = inputs[0]
-        if self._want_pallas():
+        if self._want_pallas(ctx):
             from .ops import lrn_pallas
             return [lrn_pallas(x, self.nsize, self.alpha, self.beta,
-                               self.knorm)]
+                               self.knorm,
+                               interpret=ctx.platform != "tpu")]
         salpha = self.alpha / self.nsize
         # centered cross-channel window of nsize, zero-padded (chpool<sum>)
         lo = self.nsize // 2
@@ -1310,9 +1319,10 @@ class AttentionLayer(Layer):
                 and mesh.shape.get(axis, 1) > 1:
             if self.seq_algo in ("alltoall", "ulysses"):
                 from .ops import ulysses
-                out = ulysses.sharded_ulysses(mesh, q, k, v, seq_axis=axis,
-                                              causal=bool(self.causal),
-                                              impl=self.attn_impl)
+                out = ulysses.sharded_ulysses(
+                    mesh, q, k, v, seq_axis=axis,
+                    causal=bool(self.causal), impl=self.attn_impl,
+                    interpret=ctx.platform != "tpu")
             elif self.attn_impl == "pallas":
                 raise ValueError(
                     "attention: attn_impl=pallas composes with "
@@ -1326,7 +1336,8 @@ class AttentionLayer(Layer):
             # flash attention: VMEM-blocked online softmax, O(s*d) memory
             # (cxxnet_tpu/ops/flash_attention.py)
             from .ops import flash_attention as fa
-            out = fa.flash_attention(q, k, v, bool(self.causal))
+            out = fa.flash_attention(q, k, v, bool(self.causal),
+                                     interpret=ctx.platform != "tpu")
         else:
             out = ra.attention(q, k, v, causal=bool(self.causal))
         out = out.transpose(0, 2, 1, 3).reshape(b, s, e)
@@ -1464,7 +1475,7 @@ class TransformerStackLayer(Layer):
             # (experts shard over the model axis — expert parallelism
             # inside the stack)
             tok = x.reshape(b * s, e)
-            C = max(int(math.ceil(topk * b * s / nexpert * cap_f)), 1)
+            C = moe_capacity(topk, b * s, nexpert, cap_f)
             dispatch, combine, aux = moe_route(tok, lp["gate"], topk, C, dt)
             xin = jnp.einsum("bec,bi->eci", dispatch.astype(dt), tok)
             hmid = jax.nn.relu(

@@ -49,6 +49,9 @@ class Network:
             [None] * net_cfg.num_nodes)
         self.mesh = None       # set by the trainer for sequence parallelism
         self.seq_axis: Optional[str] = None
+        # the jit target platform, set by the trainer from its devices;
+        # gates compiled-vs-interpreted Pallas kernels
+        self.platform: str = "cpu"
         # deferred input normalization (mean, scale): applied on-device to
         # uint8 input batches so raw pixels cross host->device as 1 byte
         # (set by the trainer from DataBatch.norm before the first trace)
@@ -139,7 +142,8 @@ class Network:
             train=train, rng=rng, labels=labels,
             batch_size=self.batch_size, update_period=self.update_period,
             epoch=epoch, compute_dtype=self.compute_dtype,
-            mesh=self.mesh, seq_axis=self.seq_axis)
+            mesh=self.mesh, seq_axis=self.seq_axis,
+            platform=self.platform)
         if data.dtype == jnp.uint8:
             # raw-pixel feed: normalize on device, fused into the step
             # (the reference normalizes on the host and ships float32,

@@ -41,7 +41,8 @@ NEG_INF = -1e30
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from . import pallas_env
+    return pallas_env.interpret()
 
 
 def _pick_block(s: int, target: int = 128) -> int:
@@ -114,7 +115,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     lse_ref[0, 0] = m + jnp.log(lsafe)
 
 
-def _fwd_impl(q, k, v, scale, causal, block_q, block_k):
+def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
     bh, s, d = q.shape
     grid = (bh, s // block_q)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -138,7 +139,7 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k):
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
-        interpret=_interpret(),
+        interpret=interpret,
     )(q, k, v)
 
 
@@ -222,7 +223,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q,
+              block_k, interpret):
     bh, s, d = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, None, :]                 # (bh, 1, s)
@@ -240,7 +242,7 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k):
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        interpret=_interpret(),
+        interpret=interpret,
     )(q, k, v, do, lse, delta)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
@@ -262,17 +264,29 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             jax.ShapeDtypeStruct((bh, s, d), k.dtype),
             jax.ShapeDtypeStruct((bh, s, d), v.dtype),
         ],
-        interpret=_interpret(),
+        interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
 # ----------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal: bool = False, scale=None):
+def flash_attention(q, k, v, causal: bool = False, scale=None,
+                    interpret=None):
     """(b, h, s, d) attention, O(s*d) memory. Exact — same math as
-    ring_attention.attention, block-streamed."""
-    out, _ = _flash_fwd(q, k, v, causal, scale)
+    ring_attention.attention, block-streamed.
+
+    ``interpret`` (None = consult pallas_env / the default backend) is
+    resolved HERE, at forward-trace time, and carried through the
+    custom_vjp as a nondiff arg — the backward pass may be traced after
+    the caller's interpret_mode context has exited."""
+    if interpret is None:
+        interpret = _interpret()
+    return _flash(q, k, v, causal, scale, bool(interpret))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, scale, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, scale, interpret)
     return out
 
 
@@ -281,19 +295,20 @@ def _prep(q):
     return q.reshape(b * h, s, d)
 
 
-def _flash_fwd(q, k, v, causal, scale):
+def _flash_fwd(q, k, v, causal, scale, interpret):
     b, h, s, d = q.shape
     if scale is None:
         scale = d ** -0.5
     block_q = _pick_block(s)
     block_k = _pick_block(s)
     q3, k3, v3 = _prep(q), _prep(k), _prep(v)
-    o3, lse = _fwd_impl(q3, k3, v3, scale, causal, block_q, block_k)
+    o3, lse = _fwd_impl(q3, k3, v3, scale, causal, block_q,
+                        block_k, interpret)
     out = o3.reshape(b, h, s, d)
     return out, (q3, k3, v3, o3, lse, out.shape)
 
 
-def _flash_bwd(causal, scale, res, g):
+def _flash_bwd(causal, scale, interpret, res, g):
     q3, k3, v3, o3, lse, shape = res
     b, h, s, d = shape
     if scale is None:
@@ -302,9 +317,9 @@ def _flash_bwd(causal, scale, res, g):
     block_k = _pick_block(s)
     do3 = g.reshape(b * h, s, d)
     dq, dk, dv = _bwd_impl(q3, k3, v3, o3, lse, do3, scale, causal,
-                           block_q, block_k)
+                           block_q, block_k, interpret)
     rs = lambda t: t.reshape(b, h, s, d)
     return rs(dq), rs(dk), rs(dv)
 
 
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+_flash.defvjp(_flash_fwd, _flash_bwd)

@@ -32,7 +32,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from . import pallas_env
+    return pallas_env.interpret()
 
 
 def _windowed_sum(t: jnp.ndarray, n_above: int, n_below: int) -> jnp.ndarray:
@@ -96,9 +97,20 @@ def _bwd_kernel(x_ref, scale_ref, g_ref, gx_ref, *, lo, hi, salpha, beta):
     gx_ref[0] = gx.astype(gx_ref.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
 def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float,
-        knorm: float) -> jnp.ndarray:
+        knorm: float, interpret=None) -> jnp.ndarray:
+    """Public wrapper: resolves the interpret decision ONCE at
+    forward-trace time and carries it through the custom_vjp as a
+    nondiff arg — the backward pass may be traced after the caller's
+    interpret_mode context has exited."""
+    if interpret is None:
+        interpret = _interpret()
+    return _lrn(x, nsize, alpha, beta, knorm, bool(interpret))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float,
+         knorm: float, interpret: bool) -> jnp.ndarray:
     """Fused LRN over a (N, C, H, W) activation.
 
     The primal (inference) path uses a forward-only kernel that skips the
@@ -116,7 +128,7 @@ def lrn(x: jnp.ndarray, nsize: int, alpha: float, beta: float,
         in_specs=[blk],
         out_specs=blk,
         out_shape=jax.ShapeDtypeStruct((n, c, s), x.dtype),
-        interpret=_interpret(),
+        interpret=interpret,
     )(x.reshape(n, c, s))
     return out.reshape(n, c, h, w)
 
@@ -127,7 +139,7 @@ def _specs(c, s):
     return blk
 
 
-def _lrn_fwd_impl(x, nsize, alpha, beta, knorm):
+def _lrn_fwd_impl(x, nsize, alpha, beta, knorm, interpret):
     n, c, h, w = x.shape
     s = h * w
     lo = nsize // 2
@@ -143,17 +155,18 @@ def _lrn_fwd_impl(x, nsize, alpha, beta, knorm):
         out_specs=(blk, blk),
         out_shape=(jax.ShapeDtypeStruct((n, c, s), x.dtype),
                    jax.ShapeDtypeStruct((n, c, s), jnp.float32)),
-        interpret=_interpret(),
+        interpret=interpret,
     )(x3)
     return out.reshape(n, c, h, w), scale
 
 
-def _lrn_fwd(x, nsize, alpha, beta, knorm):
-    out, scale = _lrn_fwd_impl(x, nsize, alpha, beta, knorm)
+def _lrn_fwd(x, nsize, alpha, beta, knorm, interpret):
+    out, scale = _lrn_fwd_impl(x, nsize, alpha, beta, knorm,
+                               interpret)
     return out, (x, scale)
 
 
-def _lrn_bwd(nsize, alpha, beta, knorm, res, g):
+def _lrn_bwd(nsize, alpha, beta, knorm, interpret, res, g):
     x, scale = res
     n, c, h, w = x.shape
     s = h * w
@@ -167,9 +180,9 @@ def _lrn_bwd(nsize, alpha, beta, knorm, res, g):
         in_specs=[blk, blk, blk],
         out_specs=blk,
         out_shape=jax.ShapeDtypeStruct((n, c, s), x.dtype),
-        interpret=_interpret(),
+        interpret=interpret,
     )(x.reshape(n, c, s), scale, g.reshape(n, c, s))
     return (gx.reshape(n, c, h, w),)
 
 
-lrn.defvjp(_lrn_fwd, _lrn_bwd)
+_lrn.defvjp(_lrn_fwd, _lrn_bwd)

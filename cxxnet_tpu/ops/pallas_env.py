@@ -1,0 +1,40 @@
+"""Trace-time Pallas execution-mode override.
+
+Pallas kernels compile for TPU and run in interpreter mode elsewhere.
+"Elsewhere" must be judged by the backend the surrounding jit actually
+targets, not the process default: on a machine whose default backend is
+TPU, a trainer built with ``dev = cpu`` traces its step for CPU, and a
+kernel that consulted ``jax.default_backend()`` would wrongly pick the
+compiled path. The layer code knows its target platform (the trainer's
+mesh) and pins it here around the op call; ``interpret=...`` is bound at
+trace time, so a plain context manager suffices.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+
+_FORCE: Optional[bool] = None
+
+
+@contextlib.contextmanager
+def interpret_mode(force: Optional[bool]):
+    """Within the context, pallas ops use ``force`` for interpret=...;
+    None defers to the default-backend heuristic."""
+    global _FORCE
+    prev = _FORCE
+    _FORCE = force
+    try:
+        yield
+    finally:
+        _FORCE = prev
+
+
+def interpret() -> bool:
+    """Should pallas_call run in interpreter mode (trace-time check)?"""
+    if _FORCE is not None:
+        return _FORCE
+    return jax.default_backend() != "tpu"

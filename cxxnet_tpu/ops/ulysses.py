@@ -30,7 +30,8 @@ from .ring_attention import attention as _full_attention
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis_name: str, causal: bool = False,
                       scale: Optional[float] = None,
-                      impl: str = "xla") -> jnp.ndarray:
+                      impl: str = "xla",
+                      interpret=None) -> jnp.ndarray:
     """Attention over sequence-sharded q/k/v inside shard_map.
 
     q/k/v: LOCAL (b, h, s_local, d) shards, sequence sharded over
@@ -58,14 +59,16 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
     if impl == "pallas":
         from .flash_attention import flash_attention
-        out = flash_attention(qh, kh, vh, causal, scale)
+        out = flash_attention(qh, kh, vh, causal, scale,
+                              interpret=interpret)
     else:
         out = _full_attention(qh, kh, vh, causal=causal, scale=scale)
     return head_to_seq(out)
 
 
 def sharded_ulysses(mesh: Mesh, q, k, v, seq_axis: str = "seq",
-                    causal: bool = False, impl: str = "xla") -> jnp.ndarray:
+                    causal: bool = False, impl: str = "xla",
+                    interpret=None) -> jnp.ndarray:
     """shard_map ulysses_attention over ``mesh``'s seq axis; global
     (b, h, s, d) in and out (mirror of ring_attention.sharded_attention)."""
     try:
@@ -76,7 +79,8 @@ def sharded_ulysses(mesh: Mesh, q, k, v, seq_axis: str = "seq",
     data = "data" if "data" in mesh.shape else None
     spec = P(data, None, seq_axis, None)
     fn = functools.partial(ulysses_attention, axis_name=seq_axis,
-                           causal=causal, impl=impl)
+                           causal=causal, impl=impl,
+                           interpret=interpret)
     kw = {}
     if impl == "pallas":
         # pallas_call outputs carry no varying-mesh-axes annotation, so

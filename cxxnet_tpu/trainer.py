@@ -179,6 +179,9 @@ class Trainer:
                                        seq_parallel=sp,
                                        pipeline_parallel=pp)
         self.n_devices = ndev
+        # the platform the step's jit actually targets — may differ from
+        # the process default backend (dev=cpu on a TPU-default box)
+        self.net.platform = devices[0].platform
         if sp > 1 or pp > 1:
             self.net.mesh = self.mesh
         if sp > 1:
