@@ -20,6 +20,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = r"""
 import os, sys
 rank = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+mode = sys.argv[4] if len(sys.argv) > 4 else "dp" 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 sys.path.insert(0, %(repo)r)
@@ -52,6 +53,10 @@ metric = error
 tr = Trainer()
 for k, v in config.parse_string(CONF):
     tr.set_param(k, v)
+if mode == "tp":
+    # model axis spans the two processes' devices: dp=2 (= process
+    # count), model=2 — fullc weights shard across hosts
+    tr.set_param("model_parallel", "2")
 tr.init_model()
 assert tr.global_batch == 16
 
@@ -79,7 +84,8 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_training_agrees(tmp_path):
+@pytest.mark.parametrize("mode", ["dp", "tp"])
+def test_two_process_training_agrees(tmp_path, mode):
     port = str(_free_port())
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
@@ -89,7 +95,7 @@ def test_two_process_training_agrees(tmp_path):
         out = str(tmp_path / ("w%d.npy" % rank))
         outs.append(out)
         procs.append(subprocess.Popen(
-            [sys.executable, str(script), str(rank), port, out],
+            [sys.executable, str(script), str(rank), port, out, mode],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env={**os.environ, "PALLAS_AXON_POOL_IPS": ""}))
     for p in procs:
