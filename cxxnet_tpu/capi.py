@@ -1,0 +1,185 @@
+"""Python-side glue for the native C ABI (native/capi.cc).
+
+The reference exposes its trainer through a C ABI shared library
+(reference: wrapper/cxxnet_wrapper.h:29-225, wrapper/cxxnet_wrapper.cpp)
+so other languages can bind to it.  Here the trainer itself is
+Python/JAX, so the native library embeds CPython and calls the
+functions in this module; every argument and return value is a
+primitive (string / int / pointer-as-int) so the C side needs no
+numpy or object marshalling of its own.
+
+Handles own the last array/string returned to C: the reference
+documents that returned pointers are valid only until the next call on
+the same handle (reference: wrapper/cxxnet_wrapper.h:163-164), and the
+``hold`` slot implements exactly that lifetime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .wrapper import DataIter, Net
+
+
+def _as_np(ptr: int, shape, dtype=np.float32) -> np.ndarray:
+    """Copy a C buffer (address, shape) into a fresh numpy array."""
+    n = int(np.prod(shape)) if shape else 0
+    if n == 0:
+        return np.zeros(shape, dtype)
+    ctype = np.ctypeslib.as_ctypes_type(dtype)
+    buf = ctypes.cast(int(ptr), ctypes.POINTER(ctype))
+    return np.array(np.ctypeslib.as_array(buf, shape=tuple(shape)),
+                    dtype=dtype, copy=True)
+
+
+def _addr(arr: np.ndarray) -> int:
+    return arr.ctypes.data
+
+
+class IOHandle:
+    def __init__(self, cfg: str) -> None:
+        self.it = DataIter(cfg)
+        # data and label pin separately: the reference keeps them in
+        # separate iterator buffers, so C clients legitimately call
+        # GetData + GetLabel and use both pointers together
+        self.hold_data = None
+        self.hold_label = None
+
+
+class NetHandle:
+    def __init__(self, device: str, cfg: str) -> None:
+        self.net = Net(dev=device or "", cfg=cfg)
+        self.hold = None
+
+
+# ---------------------------------------------------------------- io --
+def io_create(cfg: str) -> IOHandle:
+    return IOHandle(cfg)
+
+
+def io_next(h: IOHandle) -> int:
+    return 1 if h.it.next() else 0
+
+
+def io_before_first(h: IOHandle) -> None:
+    h.it.before_first()
+
+
+def io_get_data(h: IOHandle):
+    """-> (addr, n, c, y, x, stride) of the current batch data."""
+    arr = np.ascontiguousarray(h.it.get_data(), np.float32)
+    h.hold_data = arr
+    n, c, y, x = arr.shape
+    return _addr(arr), n, c, y, x, x
+
+
+def io_get_label(h: IOHandle):
+    """-> (addr, n, label_width, stride) of the current batch label."""
+    arr = np.ascontiguousarray(h.it.get_label(), np.float32)
+    h.hold_label = arr
+    n, w = arr.shape
+    return _addr(arr), n, w, w
+
+
+# --------------------------------------------------------------- net --
+def net_create(device: str, cfg: str) -> NetHandle:
+    return NetHandle(device, cfg)
+
+
+def net_set_param(h: NetHandle, name: str, val: str) -> None:
+    h.net.set_param(name, val)
+
+
+def net_init_model(h: NetHandle) -> None:
+    h.net.init_model()
+
+
+def net_save_model(h: NetHandle, fname: str) -> None:
+    h.net.save_model(fname)
+
+
+def net_load_model(h: NetHandle, fname: str) -> None:
+    h.net.load_model(fname)
+
+
+def net_start_round(h: NetHandle, round_: int) -> None:
+    h.net.start_round(round_)
+
+
+def net_set_weight(h: NetHandle, ptr: int, size: int,
+                   layer_name: str, tag: str) -> None:
+    """Flat array in the weight's own layout, like the reference
+    (reference: wrapper/cxxnet_wrapper.h:107-118)."""
+    cur = h.net.get_weight(layer_name, tag)
+    if cur is None:
+        raise ValueError("no %s weight in layer %s" % (tag, layer_name))
+    flat = _as_np(ptr, (int(size),))
+    h.net.set_weight(flat.reshape(cur.shape), layer_name, tag)
+
+
+def net_get_weight(h: NetHandle, layer_name: str, tag: str):
+    """-> (addr, ndim, s0, s1, s2, s3); addr == 0 when absent."""
+    w = h.net.get_weight(layer_name, tag)
+    if w is None:
+        return 0, 0, 0, 0, 0, 0
+    arr = np.ascontiguousarray(w, np.float32)
+    h.hold = arr
+    shape = list(arr.shape[:4]) + [0] * (4 - min(arr.ndim, 4))
+    return (_addr(arr), arr.ndim) + tuple(shape)
+
+
+def _batch(dptr, d0, d1, d2, d3, lptr=0, l0=0, l1=0):
+    data = _as_np(dptr, (d0, d1, d2, d3))
+    label = _as_np(lptr, (l0, l1)) if lptr else None
+    return data, label
+
+
+def net_update_iter(h: NetHandle, io: IOHandle) -> None:
+    h.net.update(io.it)
+
+
+def net_update_batch(h: NetHandle, dptr, d0, d1, d2, d3,
+                     lptr, l0, l1) -> None:
+    data, label = _batch(dptr, d0, d1, d2, d3, lptr, l0, l1)
+    h.net.update(data, label)
+
+
+def net_predict_batch(h: NetHandle, dptr, d0, d1, d2, d3):
+    """-> (addr, out_size)."""
+    data, _ = _batch(dptr, d0, d1, d2, d3)
+    out = np.ascontiguousarray(h.net.predict(data), np.float32)
+    h.hold = out
+    return _addr(out), out.size
+
+
+def net_predict_iter(h: NetHandle, io: IOHandle):
+    out = np.ascontiguousarray(h.net.predict(io.it), np.float32)
+    h.hold = out
+    return _addr(out), out.size
+
+
+def _extract_out(h: NetHandle, out: np.ndarray):
+    out = np.ascontiguousarray(out, np.float32)
+    if out.ndim < 4:  # (batch, flat) -> (batch, 1, 1, flat), like 2D nodes
+        out = out.reshape(out.shape[0], 1, 1, -1)
+    h.hold = out
+    return (_addr(out),) + tuple(out.shape)
+
+
+def net_extract_batch(h: NetHandle, dptr, d0, d1, d2, d3, node_name: str):
+    """-> (addr, n, c, y, x)."""
+    data, _ = _batch(dptr, d0, d1, d2, d3)
+    return _extract_out(h, h.net.extract(data, node_name))
+
+
+def net_extract_iter(h: NetHandle, io: IOHandle, node_name: str):
+    return _extract_out(h, h.net.extract(io.it, node_name))
+
+
+def net_evaluate(h: NetHandle, io: IOHandle, data_name: str) -> bytes:
+    io.it.before_first()
+    s = h.net.evaluate(io.it, data_name)
+    h.hold = s.encode("utf-8") + b"\0"
+    return h.hold

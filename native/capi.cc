@@ -1,0 +1,376 @@
+// C ABI for the cxxnet_tpu framework, mirroring the reference's wrapper
+// library surface (reference: wrapper/cxxnet_wrapper.h:29-225) so that
+// C/C++ (or any FFI-capable language) programs can drive training the
+// same way the reference's libcxxnetwrapper.so allowed.
+//
+// The compute path of this framework is Python/JAX; this library embeds
+// a CPython interpreter (or joins the already-running one when loaded
+// into a Python process) and forwards every call to cxxnet_tpu.capi,
+// which exposes a primitives-only calling convention. Returned pointers
+// follow the reference's lifetime rule: valid until the next call on
+// the same handle.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+
+// the public header declares every exported function: including it here
+// makes the compiler enforce that header and implementation agree
+#include "cxxnet_wrapper.h"
+
+#define CXXNET_DLL __attribute__((visibility("default")))
+
+namespace {
+
+PyObject* g_mod = nullptr;  // cxxnet_tpu.capi, imported once
+
+// When this library initialized the interpreter itself (standalone C
+// program), the GIL is released right after init so that every API call
+// can use the uniform PyGILState_Ensure/Release protocol, which also
+// works when the host process is Python (ctypes) and already owns an
+// interpreter.
+void EnsureInterpreter() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    PyEval_SaveThread();
+  }
+}
+
+// Directory juggling: the library lives at <repo>/cxxnet_tpu/lib/, so
+// <repo> (two levels up) must be importable when the embedder did not
+// set PYTHONPATH.
+void AddRepoToPath() {
+  Dl_info info;
+  if (!dladdr(reinterpret_cast<void*>(&AddRepoToPath), &info) ||
+      info.dli_fname == nullptr) {
+    return;
+  }
+  std::string p(info.dli_fname);
+  for (int up = 0; up < 3; ++up) {
+    size_t slash = p.find_last_of('/');
+    if (slash == std::string::npos) return;
+    p.resize(slash);
+  }
+  PyObject* sys_path = PySys_GetObject("path");  // borrowed
+  PyObject* dir = PyUnicode_FromString(p.c_str());
+  if (sys_path != nullptr && dir != nullptr) {
+    PyList_Append(sys_path, dir);
+  }
+  Py_XDECREF(dir);
+}
+
+PyObject* Module() {
+  if (g_mod == nullptr) {
+    g_mod = PyImport_ImportModule("cxxnet_tpu.capi");
+    if (g_mod == nullptr) {
+      PyErr_Clear();
+      AddRepoToPath();
+      g_mod = PyImport_ImportModule("cxxnet_tpu.capi");
+    }
+    if (g_mod == nullptr) {
+      PyErr_Print();
+      std::fprintf(stderr,
+                   "cxxnet_wrapper: cannot import cxxnet_tpu.capi "
+                   "(set PYTHONPATH to the repo root)\n");
+    }
+  }
+  return g_mod;
+}
+
+struct Gil {
+  PyGILState_STATE state;
+  Gil() {
+    EnsureInterpreter();
+    state = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state); }
+};
+
+// Call cxxnet_tpu.capi.<fn>(...) and return the new-reference result
+// (nullptr on error, with the Python traceback printed to stderr).
+PyObject* Call(const char* fn, const char* fmt, ...) {
+  PyObject* mod = Module();
+  if (mod == nullptr) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) {
+    PyErr_Print();
+    return nullptr;
+  }
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  PyObject* ret = nullptr;
+  if (args != nullptr) {
+    ret = PyObject_CallObject(f, args);
+    Py_DECREF(args);
+  }
+  Py_DECREF(f);
+  if (ret == nullptr) PyErr_Print();
+  return ret;
+}
+
+// Unpack a tuple of ints returned by the glue into out[0..n).
+bool UnpackInts(PyObject* tup, uint64_t* out, int n) {
+  if (tup == nullptr || !PyTuple_Check(tup) || PyTuple_Size(tup) < n) {
+    return false;
+  }
+  for (int i = 0; i < n; ++i) {
+    out[i] = PyLong_AsUnsignedLongLong(PyTuple_GetItem(tup, i));
+    if (PyErr_Occurred()) {
+      PyErr_Print();
+      return false;
+    }
+  }
+  return true;
+}
+
+inline long long Addr(const void* p) {
+  return static_cast<long long>(reinterpret_cast<uintptr_t>(p));
+}
+
+}  // namespace
+
+extern "C" {
+
+// ------------------------------------------------------------- io ---
+CXXNET_DLL void* CXNIOCreateFromConfig(const char* cfg) {
+  Gil gil;
+  return Call("io_create", "(s)", cfg);
+}
+
+CXXNET_DLL int CXNIONext(void* handle) {
+  Gil gil;
+  PyObject* r = Call("io_next", "(O)", static_cast<PyObject*>(handle));
+  if (r == nullptr) return 0;
+  int ret = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return ret;
+}
+
+CXXNET_DLL void CXNIOBeforeFirst(void* handle) {
+  Gil gil;
+  Py_XDECREF(Call("io_before_first", "(O)",
+                  static_cast<PyObject*>(handle)));
+}
+
+CXXNET_DLL const cxx_real_t* CXNIOGetData(void* handle,
+                                          cxx_uint oshape[4],
+                                          cxx_uint* ostride) {
+  Gil gil;
+  PyObject* r = Call("io_get_data", "(O)", static_cast<PyObject*>(handle));
+  uint64_t v[6];
+  if (!UnpackInts(r, v, 6)) {
+    Py_XDECREF(r);
+    return nullptr;
+  }
+  for (int i = 0; i < 4; ++i) oshape[i] = static_cast<cxx_uint>(v[1 + i]);
+  *ostride = static_cast<cxx_uint>(v[5]);
+  Py_DECREF(r);
+  return reinterpret_cast<const cxx_real_t*>(v[0]);
+}
+
+CXXNET_DLL const cxx_real_t* CXNIOGetLabel(void* handle,
+                                           cxx_uint oshape[2],
+                                           cxx_uint* ostride) {
+  Gil gil;
+  PyObject* r = Call("io_get_label", "(O)", static_cast<PyObject*>(handle));
+  uint64_t v[4];
+  if (!UnpackInts(r, v, 4)) {
+    Py_XDECREF(r);
+    return nullptr;
+  }
+  oshape[0] = static_cast<cxx_uint>(v[1]);
+  oshape[1] = static_cast<cxx_uint>(v[2]);
+  *ostride = static_cast<cxx_uint>(v[3]);
+  Py_DECREF(r);
+  return reinterpret_cast<const cxx_real_t*>(v[0]);
+}
+
+CXXNET_DLL void CXNIOFree(void* handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+}
+
+// ------------------------------------------------------------ net ---
+CXXNET_DLL void* CXNNetCreate(const char* device, const char* cfg) {
+  Gil gil;
+  return Call("net_create", "(ss)", device == nullptr ? "" : device, cfg);
+}
+
+CXXNET_DLL void CXNNetFree(void* handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+}
+
+CXXNET_DLL void CXNNetSetParam(void* handle, const char* name,
+                               const char* val) {
+  Gil gil;
+  Py_XDECREF(Call("net_set_param", "(Oss)",
+                  static_cast<PyObject*>(handle), name, val));
+}
+
+CXXNET_DLL void CXNNetInitModel(void* handle) {
+  Gil gil;
+  Py_XDECREF(Call("net_init_model", "(O)",
+                  static_cast<PyObject*>(handle)));
+}
+
+CXXNET_DLL void CXNNetSaveModel(void* handle, const char* fname) {
+  Gil gil;
+  Py_XDECREF(Call("net_save_model", "(Os)",
+                  static_cast<PyObject*>(handle), fname));
+}
+
+CXXNET_DLL void CXNNetLoadModel(void* handle, const char* fname) {
+  Gil gil;
+  Py_XDECREF(Call("net_load_model", "(Os)",
+                  static_cast<PyObject*>(handle), fname));
+}
+
+CXXNET_DLL void CXNNetStartRound(void* handle, int round) {
+  Gil gil;
+  Py_XDECREF(Call("net_start_round", "(Oi)",
+                  static_cast<PyObject*>(handle), round));
+}
+
+CXXNET_DLL void CXNNetSetWeight(void* handle, cxx_real_t* p_weight,
+                                cxx_uint size_weight,
+                                const char* layer_name, const char* wtag) {
+  Gil gil;
+  Py_XDECREF(Call("net_set_weight", "(OLIss)",
+                  static_cast<PyObject*>(handle), Addr(p_weight),
+                  size_weight, layer_name, wtag));
+}
+
+CXXNET_DLL const cxx_real_t* CXNNetGetWeight(void* handle,
+                                             const char* layer_name,
+                                             const char* wtag,
+                                             cxx_uint wshape[4],
+                                             cxx_uint* out_dim) {
+  Gil gil;
+  PyObject* r = Call("net_get_weight", "(Oss)",
+                     static_cast<PyObject*>(handle), layer_name, wtag);
+  uint64_t v[6];
+  if (!UnpackInts(r, v, 6)) {
+    Py_XDECREF(r);
+    return nullptr;
+  }
+  Py_DECREF(r);
+  if (v[0] == 0) return nullptr;
+  *out_dim = static_cast<cxx_uint>(v[1]);
+  for (int i = 0; i < 4; ++i) wshape[i] = static_cast<cxx_uint>(v[2 + i]);
+  return reinterpret_cast<const cxx_real_t*>(v[0]);
+}
+
+CXXNET_DLL void CXNNetUpdateIter(void* handle, void* data_handle) {
+  Gil gil;
+  Py_XDECREF(Call("net_update_iter", "(OO)",
+                  static_cast<PyObject*>(handle),
+                  static_cast<PyObject*>(data_handle)));
+}
+
+CXXNET_DLL void CXNNetUpdateBatch(void* handle, cxx_real_t* p_data,
+                                  const cxx_uint dshape[4],
+                                  cxx_real_t* p_label,
+                                  const cxx_uint lshape[2]) {
+  Gil gil;
+  Py_XDECREF(Call("net_update_batch", "(OLIIIILII)",
+                  static_cast<PyObject*>(handle), Addr(p_data), dshape[0],
+                  dshape[1], dshape[2], dshape[3], Addr(p_label),
+                  lshape[0], lshape[1]));
+}
+
+CXXNET_DLL const cxx_real_t* CXNNetPredictBatch(void* handle,
+                                                cxx_real_t* p_data,
+                                                const cxx_uint dshape[4],
+                                                cxx_uint* out_size) {
+  Gil gil;
+  PyObject* r = Call("net_predict_batch", "(OLIIII)",
+                     static_cast<PyObject*>(handle), Addr(p_data),
+                     dshape[0], dshape[1], dshape[2], dshape[3]);
+  uint64_t v[2];
+  if (!UnpackInts(r, v, 2)) {
+    Py_XDECREF(r);
+    return nullptr;
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<cxx_uint>(v[1]);
+  return reinterpret_cast<const cxx_real_t*>(v[0]);
+}
+
+CXXNET_DLL const cxx_real_t* CXNNetPredictIter(void* handle,
+                                               void* data_handle,
+                                               cxx_uint* out_size) {
+  Gil gil;
+  PyObject* r = Call("net_predict_iter", "(OO)",
+                     static_cast<PyObject*>(handle),
+                     static_cast<PyObject*>(data_handle));
+  uint64_t v[2];
+  if (!UnpackInts(r, v, 2)) {
+    Py_XDECREF(r);
+    return nullptr;
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<cxx_uint>(v[1]);
+  return reinterpret_cast<const cxx_real_t*>(v[0]);
+}
+
+CXXNET_DLL const cxx_real_t* CXNNetExtractBatch(void* handle,
+                                                cxx_real_t* p_data,
+                                                const cxx_uint dshape[4],
+                                                const char* node_name,
+                                                cxx_uint oshape[4]) {
+  Gil gil;
+  PyObject* r = Call("net_extract_batch", "(OLIIIIs)",
+                     static_cast<PyObject*>(handle), Addr(p_data),
+                     dshape[0], dshape[1], dshape[2], dshape[3],
+                     node_name);
+  uint64_t v[5];
+  if (!UnpackInts(r, v, 5)) {
+    Py_XDECREF(r);
+    return nullptr;
+  }
+  Py_DECREF(r);
+  for (int i = 0; i < 4; ++i) oshape[i] = static_cast<cxx_uint>(v[1 + i]);
+  return reinterpret_cast<const cxx_real_t*>(v[0]);
+}
+
+CXXNET_DLL const cxx_real_t* CXNNetExtractIter(void* handle,
+                                               void* data_handle,
+                                               const char* node_name,
+                                               cxx_uint oshape[4]) {
+  Gil gil;
+  PyObject* r = Call("net_extract_iter", "(OOs)",
+                     static_cast<PyObject*>(handle),
+                     static_cast<PyObject*>(data_handle), node_name);
+  uint64_t v[5];
+  if (!UnpackInts(r, v, 5)) {
+    Py_XDECREF(r);
+    return nullptr;
+  }
+  Py_DECREF(r);
+  for (int i = 0; i < 4; ++i) oshape[i] = static_cast<cxx_uint>(v[1 + i]);
+  return reinterpret_cast<const cxx_real_t*>(v[0]);
+}
+
+CXXNET_DLL const char* CXNNetEvaluate(void* handle, void* data_handle,
+                                      const char* data_name) {
+  Gil gil;
+  PyObject* r = Call("net_evaluate", "(OOs)",
+                     static_cast<PyObject*>(handle),
+                     static_cast<PyObject*>(data_handle), data_name);
+  if (r == nullptr) return nullptr;
+  // the glue pinned the bytes on the handle; the pointer stays valid
+  // until the next call on this net handle
+  const char* s = PyBytes_AsString(r);
+  Py_DECREF(r);
+  return s;
+}
+
+}  // extern "C"

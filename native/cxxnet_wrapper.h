@@ -1,0 +1,85 @@
+// Public C ABI of the cxxnet_tpu framework — drop-in surface parity
+// with the reference wrapper library (reference: wrapper/cxxnet_wrapper.h).
+//
+// Link against libcxxnet_wrapper.so (built by `make -C native wrapper`).
+// The library embeds CPython: a standalone C program needs no Python
+// code of its own, but the repo root must be importable (the library
+// locates it relative to its own path, or set PYTHONPATH).
+//
+// Lifetime rule (same as the reference): any pointer returned by these
+// functions is owned by the handle it came from and is valid only until
+// the next call on that handle — copy the data out before calling again.
+#ifndef CXXNET_TPU_WRAPPER_H_
+#define CXXNET_TPU_WRAPPER_H_
+
+typedef unsigned long cxx_ulong;
+typedef unsigned int cxx_uint;
+typedef float cxx_real_t;
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Data iterators. cfg is the config-dialect string that would sit in
+ * a `data = ... iter = end` block (iterator chain + params). */
+void *CXNIOCreateFromConfig(const char *cfg);
+int CXNIONext(void *handle);
+void CXNIOBeforeFirst(void *handle);
+/* Current batch data as (batch, channel, height, width) float32;
+ * oshape receives the 4 dims, ostride the innermost stride. */
+const cxx_real_t *CXNIOGetData(void *handle, cxx_uint oshape[4],
+                               cxx_uint *ostride);
+/* Current batch label as (batch, label_width) float32. */
+const cxx_real_t *CXNIOGetLabel(void *handle, cxx_uint oshape[2],
+                                cxx_uint *ostride);
+void CXNIOFree(void *handle);
+
+/* Nets. device may be NULL/"" to use the config's `dev` entry; cfg is
+ * the full config-dialect string (netconfig block + globals). */
+void *CXNNetCreate(const char *device, const char *cfg);
+void CXNNetFree(void *handle);
+void CXNNetSetParam(void *handle, const char *name, const char *val);
+void CXNNetInitModel(void *handle);
+void CXNNetSaveModel(void *handle, const char *fname);
+void CXNNetLoadModel(void *handle, const char *fname);
+void CXNNetStartRound(void *handle, int round);
+
+/* Weight access by layer name and tag ("wmat"/"bias"); p_weight is a
+ * flat array in the weight's own layout. */
+void CXNNetSetWeight(void *handle, cxx_real_t *p_weight,
+                     cxx_uint size_weight, const char *layer_name,
+                     const char *wtag);
+const cxx_real_t *CXNNetGetWeight(void *handle, const char *layer_name,
+                                  const char *wtag, cxx_uint wshape[4],
+                                  cxx_uint *out_dim);
+
+/* One training step on the iterator's current batch / a raw batch. */
+void CXNNetUpdateIter(void *handle, void *data_handle);
+void CXNNetUpdateBatch(void *handle, cxx_real_t *p_data,
+                       const cxx_uint dshape[4], cxx_real_t *p_label,
+                       const cxx_uint lshape[2]);
+
+/* Prediction / feature extraction; out_size (or oshape) receives the
+ * result extent. */
+const cxx_real_t *CXNNetPredictBatch(void *handle, cxx_real_t *p_data,
+                                     const cxx_uint dshape[4],
+                                     cxx_uint *out_size);
+const cxx_real_t *CXNNetPredictIter(void *handle, void *data_handle,
+                                    cxx_uint *out_size);
+const cxx_real_t *CXNNetExtractBatch(void *handle, cxx_real_t *p_data,
+                                     const cxx_uint dshape[4],
+                                     const char *node_name,
+                                     cxx_uint oshape[4]);
+const cxx_real_t *CXNNetExtractIter(void *handle, void *data_handle,
+                                    const char *node_name,
+                                    cxx_uint oshape[4]);
+
+/* Sweep the iterator with the configured metrics; returns the
+ * reference-format eval line ("\tname-metric:value..."). */
+const char *CXNNetEvaluate(void *handle, void *data_handle,
+                           const char *data_name);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* CXXNET_TPU_WRAPPER_H_ */

@@ -1,0 +1,106 @@
+// im2bin: pack images named by a .lst index into a BinaryPage packfile.
+// Native equivalent of the reference tool (reference: tools/im2bin.cpp),
+// emitting the same bit-compatible packfile the imgbin/imgbinx iterators
+// read. tools/im2bin.py is the scripted front end; this binary covers
+// the "pack ImageNet in hours, not days" bulk path with zero Python.
+//
+//   ./im2bin <image.lst> <image_root> <output.bin>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+extern "C" {
+void* cxn_packer_open(const char* path);
+int cxn_packer_push(void* h, const uint8_t* buf, int64_t len);
+int cxn_packer_close(void* h);
+}
+
+namespace {
+
+bool ReadFile(const std::string& path, std::vector<uint8_t>* out) {
+  std::FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  const long n = std::ftell(f);
+  if (n < 0) {  // non-seekable file (e.g. a FIFO): clean error, no throw
+    std::fclose(f);
+    return false;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(n);
+  const bool ok = n == 0 || std::fread(out->data(), 1, n, f) ==
+                                static_cast<size_t>(n);
+  std::fclose(f);
+  return ok;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr, "Usage: <image.lst> <image_root> <output.bin>\n");
+    return 1;
+  }
+  std::ifstream lst(argv[1]);
+  if (!lst) {
+    std::fprintf(stderr, "im2bin: cannot open %s\n", argv[1]);
+    return 1;
+  }
+  std::string root(argv[2]);
+  if (!root.empty() && root.back() != '/') root += '/';
+  void* packer = cxn_packer_open(argv[3]);
+  if (!packer) {
+    std::fprintf(stderr, "im2bin: cannot create %s\n", argv[3]);
+    return 1;
+  }
+
+  std::string line;
+  std::vector<uint8_t> bytes;
+  long count = 0;
+  while (std::getline(lst, line)) {
+    // index \t label[ \t label...] \t filename — same acceptance rule
+    // as tools/im2bin.py / pack_images: strip the line, split on tabs,
+    // require at least (index, label, filename), take the last field
+    while (!line.empty() &&
+           (line.back() == '\r' || line.back() == '\n' ||
+            line.back() == ' ' || line.back() == '\t')) {
+      line.pop_back();
+    }
+    std::vector<std::string> parts;
+    size_t start = 0;
+    for (size_t tab = line.find('\t'); tab != std::string::npos;
+         tab = line.find('\t', start)) {
+      parts.push_back(line.substr(start, tab - start));
+      start = tab + 1;
+    }
+    parts.push_back(line.substr(start));
+    if (parts.size() < 3) continue;
+    const std::string& fname = parts.back();
+    if (fname.empty()) continue;
+    if (!ReadFile(root + fname, &bytes)) {
+      std::fprintf(stderr, "im2bin: cannot read %s\n",
+                   (root + fname).c_str());
+      return 1;
+    }
+    if (!cxn_packer_push(packer, bytes.data(),
+                         static_cast<int64_t>(bytes.size()))) {
+      std::fprintf(stderr, "im2bin: write failed (object too large for "
+                   "a page, or disk full)\n");
+      return 1;
+    }
+    if (++count % 1000 == 0) {
+      std::fprintf(stderr, "\r%8ld images packed", count);
+    }
+  }
+  if (!cxn_packer_close(packer)) {
+    std::fprintf(stderr, "im2bin: final page write failed\n");
+    return 1;
+  }
+  std::fprintf(stderr, "\r%8ld images packed into %s\n", count, argv[3]);
+  return 0;
+}

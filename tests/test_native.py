@@ -149,3 +149,40 @@ def test_imgbin_iterator_native_matches_python(tmp_path):
     for (d0, l0), (d1, l1) in zip(batches[0], batches[1]):
         assert np.array_equal(d0, d1)
         assert np.array_equal(l0, l1)
+
+
+def test_im2bin_binary_matches_python(tmp_path):
+    """The native im2bin tool (reference: tools/im2bin.cpp) produces a
+    packfile bit-identical to the pure-Python BinaryPageWriter packer
+    (pack_images would delegate to the native packer here, so write the
+    reference file with the Python writer explicitly)."""
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ndir = os.path.join(root, "native")
+    r = subprocess.run(["make", "-C", ndir, "im2bin"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("native toolchain unavailable: %s" % r.stderr[-300:])
+    tool = os.path.join(root, "cxxnet_tpu", "lib", "im2bin")
+
+    lst, _ = _make_imgbin(tmp_path)
+    py_bin = str(tmp_path / "python.bin")
+    with binpage.BinaryPageWriter(py_bin) as w:
+        with open(lst) as f:
+            for line in f:
+                parts = line.strip().split("\t")
+                if len(parts) < 3:
+                    continue
+                with open(str(tmp_path / "imgs" / parts[-1]), "rb") as img:
+                    w.push(img.read())
+
+    out = str(tmp_path / "native.bin")
+    # 2-field and trailing-tab lines must follow pack_images' acceptance
+    # rule (skip both) on the native side too
+    with open(lst, "a") as f:
+        f.write("97\tnolabel.jpg\n98\t0\t\n")
+    r = subprocess.run([tool, lst, str(tmp_path / "imgs"), out],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    with open(py_bin, "rb") as a, open(out, "rb") as b:
+        assert a.read() == b.read()
