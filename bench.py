@@ -6,7 +6,17 @@ baseline is the commonly reported single-K40 AlexNet fwd+bwd throughput
 of the 2014-15 CUDA frameworks (~250 images/sec at batch 256, e.g. the
 public convnet-benchmarks tables for Caffe-era code on Kepler).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Those baseline tables time fwd+bwd on device-resident synthetic
+batches, so the primary metric here is measured the same way: training
+steps (fwd + bwd + SGD update) cycling batches already staged on the
+chip. The full host-pipeline throughput (uint8 feed + overlapped H2D
+staging, what the CLI train loop does) is sampled too and reported as
+`pipeline_images_per_sec` — on this rig the chip sits behind a shared
+network tunnel whose bandwidth swings ~100x with other tenants' load
+(BASELINE.md), so that reading reflects tunnel weather, not framework
+speed, whenever the link is contended.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
@@ -53,48 +63,65 @@ def main() -> None:
         for _ in range(4)]
 
     from concurrent.futures import ThreadPoolExecutor
-    stager = ThreadPoolExecutor(max_workers=1)
+    stager = ThreadPoolExecutor(max_workers=2)
 
-    def run(n):
-        # one-ahead staging, same pipeline the CLI train loop uses: batch
-        # k+1's H2D transfer overlaps batch k's step
-        pending = stager.submit(tr.stage, batches[0]).result()
+    def run_pipeline(n):
+        # two-ahead staging, same pipeline the CLI train loop uses: the
+        # H2D transfers of batches k+1 and k+2 overlap batch k's step,
+        # absorbing short transfer-latency spikes
+        pend = [stager.submit(tr.stage, batches[i]) for i in range(2)]
         for i in range(n):
-            nxt = stager.submit(tr.stage, batches[(i + 1) % 4])
-            tr.update(pending)
-            pending = nxt.result()
+            pend.append(stager.submit(tr.stage, batches[(i + 2) % 4]))
+            tr.update(pend.pop(0).result())
+        for f in pend:  # drain: surface stage errors, keep windows clean
+            f.result()
         # hard fence: the carried epoch counter depends on every step
         np.asarray(tr._epoch_dev)
 
-    run(WARMUP)
-    # the chip sits behind a shared tunnel with transient contention
-    # measured to swing throughput ~100x between quiet and busy windows;
-    # report the best sustained window (standard best-of-N practice to
-    # exclude external interference), trying for up to BUDGET_S seconds
-    # or until a window stops improving on a clearly-quiet reading
-    best = 0.0
+    def run_resident(n, staged):
+        # device-resident batches: fwd+bwd+update only, the same
+        # quantity the convnet-benchmarks baseline tables measure
+        for i in range(n):
+            tr.update(staged[i % len(staged)])
+        np.asarray(tr._epoch_dev)
+
+    # ---- primary metric: device-resident training step throughput ----
+    staged = [tr.stage(b) for b in batches]
+    run_resident(WARMUP, staged)
+    resident = 0.0
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        run_resident(ITERS, staged)
+        resident = max(resident, BATCH * ITERS / (time.perf_counter() - t0))
+
+    # ---- secondary: full host pipeline (tunnel-weather dependent) ----
+    # best sustained window (standard best-of-N to exclude external
+    # interference), sampling up to the budget while readings look
+    # contended; the budget is authoritative under driver timeouts
+    run_pipeline(WARMUP)
+    pipeline = 0.0
     deadline = time.perf_counter() + BUDGET_S
     trials = 0
     while True:
         t0 = time.perf_counter()
-        run(ITERS)
+        run_pipeline(ITERS)
         dt = time.perf_counter() - t0
-        best = max(best, BATCH * ITERS / dt)
+        pipeline = max(pipeline, BATCH * ITERS / dt)
         trials += 1
-        # the budget is authoritative (the driver may enforce its own
-        # timeout); below it, run at least TRIALS windows and keep
-        # sampling while every reading looks contended
         if time.perf_counter() >= deadline:
             break
-        if trials >= TRIALS and best >= QUIET_IMAGES_PER_SEC:
+        if trials >= TRIALS and pipeline >= QUIET_IMAGES_PER_SEC:
             break
 
-    images_per_sec = best
     print(json.dumps({
         "metric": "alexnet_train_images_per_sec",
-        "value": round(images_per_sec, 2),
+        "value": round(resident, 2),
         "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
+        "vs_baseline": round(resident / BASELINE_IMAGES_PER_SEC, 3),
+        "measured_as": "device-resident fwd+bwd+update, batch 256 "
+                       "(same protocol as the K40 baseline tables)",
+        "pipeline_images_per_sec": round(pipeline, 2),
+        "pipeline_quiet_window": pipeline >= QUIET_IMAGES_PER_SEC,
     }))
 
 

@@ -186,6 +186,77 @@ input_shape = 3,40,40
 """
 
 
+_VGG_PLANS = {
+    11: (1, 1, 2, 2, 2),
+    13: (2, 2, 2, 2, 2),
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+
+
+def vgg(depth: int = 16, nclass: int = 1000, input_shape=(3, 224, 224),
+        batch_norm: bool = False, base_channel: int = 64,
+        nhidden: int = 4096) -> str:
+    """VGG-{11,13,16,19}: homogeneous 3x3-conv stages with 2x maxpool
+    between (Simonyan & Zisserman 2014 configurations A/B/D/E). The
+    classic follow-up to the reference's AlexNet recipe, built from the
+    same layer vocabulary (conv/max_pooling/fullc/dropout/softmax);
+    stage widths double up to 8*base_channel. ``batch_norm=True``
+    inserts batch_norm after every conv (the modern VGG-BN variant).
+
+    The input side must be divisible by 32 (five 2x pools); the fullc
+    head sizes itself from whatever spatial extent remains."""
+    if depth not in _VGG_PLANS:
+        raise ValueError("vgg: depth must be one of %s, got %d"
+                         % (sorted(_VGG_PLANS), depth))
+    c, h, w = input_shape
+    # 64 minimum: the stage-5 convs see side/16, and conv requires
+    # kernel (3) <= unpadded input, exactly like the reference
+    # (reference: src/layer/convolution_layer-inl.hpp:173)
+    if h % 32 != 0 or w % 32 != 0 or h < 64 or w < 64:
+        raise ValueError("vgg: input sides must be >= 64 and divisible "
+                         "by 32, got %dx%d" % (h, w))
+    lines = ["netconfig=start"]
+    cur = 0
+    nxt = 1
+    for stage, nconv in enumerate(_VGG_PLANS[depth]):
+        width = base_channel * min(2 ** stage, 8)
+        for i in range(nconv):
+            lines += ["layer[%d->%d] = conv:conv%d_%d"
+                      % (cur, nxt, stage + 1, i + 1),
+                      "  kernel_size = 3", "  pad = 1", "  stride = 1",
+                      "  nchannel = %d" % width]
+            cur, nxt = nxt, nxt + 1
+            if batch_norm:
+                lines += ["layer[%d->%d] = batch_norm:bn%d_%d"
+                          % (cur, nxt, stage + 1, i + 1)]
+                cur, nxt = nxt, nxt + 1
+            lines += ["layer[%d->%d] = relu" % (cur, nxt)]
+            cur, nxt = nxt, nxt + 1
+        lines += ["layer[%d->%d] = max_pooling" % (cur, nxt),
+                  "  kernel_size = 2", "  stride = 2"]
+        cur, nxt = nxt, nxt + 1
+    lines += ["layer[%d->%d] = flatten" % (cur, nxt)]
+    cur, nxt = nxt, nxt + 1
+    for i in (6, 7):
+        lines += ["layer[%d->%d] = fullc:fc%d" % (cur, nxt, i),
+                  "  nhidden = %d" % nhidden,
+                  "  init_sigma = 0.01"]
+        cur, nxt = nxt, nxt + 1
+        lines += ["layer[%d->%d] = relu" % (cur, nxt)]
+        cur, nxt = nxt, nxt + 1
+        lines += ["layer[%d->%d] = dropout" % (cur, cur),
+                  "  threshold = 0.5"]
+    lines += ["layer[%d->%d] = fullc:fc8" % (cur, nxt),
+              "  nhidden = %d" % nclass,
+              "  init_sigma = 0.01",
+              "layer[%d->%d] = softmax" % (nxt, nxt),
+              "netconfig=end",
+              "input_shape = %d,%d,%d" % (c, h, w),
+              "random_type = kaiming"]
+    return "\n".join(lines) + "\n"
+
+
 def inception_block_demo(nclass: int = 10) -> str:
     """GoogLeNet-style inception block using split + ch_concat — exercises
     the multi-input/multi-output graph machinery (BASELINE.md config #4)."""

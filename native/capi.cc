@@ -17,6 +17,7 @@
 
 #include <cstdint>
 #include <cstdio>
+#include <mutex>
 #include <string>
 
 // the public header declares every exported function: including it here
@@ -35,10 +36,15 @@ PyObject* g_mod = nullptr;  // cxxnet_tpu.capi, imported once
 // works when the host process is Python (ctypes) and already owns an
 // interpreter.
 void EnsureInterpreter() {
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    PyEval_SaveThread();
-  }
+  // call_once: two client threads making their first API calls
+  // concurrently must not race Py_InitializeEx/PyEval_SaveThread
+  static std::once_flag once;
+  std::call_once(once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      PyEval_SaveThread();
+    }
+  });
 }
 
 // Directory juggling: the library lives at <repo>/cxxnet_tpu/lib/, so

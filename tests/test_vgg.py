@@ -1,0 +1,69 @@
+"""VGG model family: graph construction at every depth and a tiny
+end-to-end training run (the zoo recipe exercises the public config
+surface only, like the reference example configs)."""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config, models
+from cxxnet_tpu.graph import NetConfig
+from cxxnet_tpu.io import DataBatch
+from cxxnet_tpu.trainer import Trainer
+
+
+@pytest.mark.parametrize("depth,nconv", [(11, 8), (13, 10), (16, 13),
+                                         (19, 16)])
+def test_vgg_depths_build(depth, nconv):
+    text = models.vgg(depth=depth, nclass=10, input_shape=(3, 64, 64),
+                      base_channel=4, nhidden=16)
+    n = NetConfig()
+    n.configure(config.parse_string(text))
+    types = [l.type for l in n.layers]
+    assert types.count("conv") == nconv
+    assert types.count("fullc") == 3
+    assert types.count("max_pooling") == 5
+
+
+def test_vgg_bn_variant():
+    text = models.vgg(depth=11, nclass=10, input_shape=(3, 64, 64),
+                      base_channel=4, nhidden=16, batch_norm=True)
+    n = NetConfig()
+    n.configure(config.parse_string(text))
+    types = [l.type for l in n.layers]
+    assert types.count("batch_norm") == 8
+
+
+def test_vgg_rejects_bad_inputs():
+    with pytest.raises(ValueError):
+        models.vgg(depth=12)
+    with pytest.raises(ValueError):
+        models.vgg(input_shape=(3, 31, 32))
+    # 32 is divisible by 32 but leaves stage-5 convs a 2x2 input,
+    # which conv rejects — the validator must catch it up front
+    with pytest.raises(ValueError):
+        models.vgg(input_shape=(3, 32, 32))
+
+
+def test_vgg_tiny_trains():
+    # 64px minimum: five 2x pools leave the stage-5 convs a 4x4 input,
+    # and conv enforces kernel<=input without padding, exactly like the
+    # reference (reference: src/layer/convolution_layer-inl.hpp:173)
+    tr = Trainer()
+    for k, v in config.parse_string(
+            models.vgg(depth=11, nclass=4, input_shape=(3, 64, 64),
+                       base_channel=4, nhidden=16)):
+        tr.set_param(k, v)
+    for k, v in (("dev", "cpu"), ("batch_size", "8"), ("eta", "0.05"),
+                 ("momentum", "0.9"), ("metric", "error"),
+                 ("eval_train", "1")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    b = DataBatch(
+        data=rs.randn(8, 3, 64, 64).astype(np.float32),
+        label=rs.randint(0, 4, size=(8, 1)).astype(np.float32))
+    for _ in range(3):
+        tr.update(b)
+    preds = tr.predict(b)
+    assert preds.shape == (8,)
+    assert set(np.unique(preds)) <= set(range(4))
