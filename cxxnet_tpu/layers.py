@@ -1383,6 +1383,7 @@ class TransformerStackLayer(Layer):
         self.topk = 2
         self.capacity_factor = 1.25
         self.moe_loss = 0.01
+        self.attn_impl = "xla"
 
     def set_param(self, name, val):
         if name == "nlayer":
@@ -1407,6 +1408,10 @@ class TransformerStackLayer(Layer):
             self.capacity_factor = float(val)
         elif name == "moe_loss":
             self.moe_loss = float(val)
+        elif name == "attn_impl":
+            if val not in ("xla", "pallas"):
+                raise ValueError("attn_impl must be xla|pallas")
+            self.attn_impl = val
         else:
             super().set_param(name, val)
 
@@ -1450,9 +1455,12 @@ class TransformerStackLayer(Layer):
             out["w2"] = p.rand_init_weight(ks[3], (L, e, m), m, e)
         return out
 
-    def _block_fn(self, dt):
+    def _block_fn(self, dt, interpret=True, mesh=None, seq_axis=None):
         from .ops import ring_attention as ra
         nh, causal = self.nhead, bool(self.causal)
+        use_flash = self.attn_impl == "pallas"
+        seq_sharded = (mesh is not None and seq_axis is not None
+                       and mesh.shape.get(seq_axis, 1) > 1)
 
         def rmsnorm(x, g):
             ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
@@ -1490,7 +1498,26 @@ class TransformerStackLayer(Layer):
             x = rmsnorm(h, lp["norm1"])
             qkv = jnp.einsum("bse,fe->bsf", x, lp["wqkv"].astype(dt))
             qkv = qkv.reshape(b, s, 3, nh, d).transpose(2, 0, 3, 1, 4)
-            att = ra.attention(qkv[0], qkv[1], qkv[2], causal=causal)
+            if seq_sharded:
+                # sequence parallelism: the attend must stay sharded —
+                # calling the local kernels on seq-sharded arrays would
+                # make GSPMD all-gather the full sequence per chip
+                if use_flash:
+                    from .ops import ulysses
+                    att = ulysses.sharded_ulysses(
+                        mesh, qkv[0], qkv[1], qkv[2], seq_axis=seq_axis,
+                        causal=causal, impl="pallas", interpret=interpret)
+                else:
+                    att = ra.sharded_attention(mesh, qkv[0], qkv[1],
+                                               qkv[2], seq_axis=seq_axis,
+                                               causal=causal)
+            elif use_flash:
+                # VMEM-blocked online-softmax kernel: O(s*d) memory
+                from .ops import flash_attention as fa
+                att = fa.flash_attention(qkv[0], qkv[1], qkv[2], causal,
+                                         interpret=interpret)
+            else:
+                att = ra.attention(qkv[0], qkv[1], qkv[2], causal=causal)
             att = att.transpose(0, 2, 1, 3).reshape(b, s, e)
             h = h + jnp.einsum("bse,fe->bsf", att, lp["wo"].astype(dt))
             x = rmsnorm(h, lp["norm2"])
@@ -1502,11 +1529,15 @@ class TransformerStackLayer(Layer):
         b, _, s, e = inputs[0].shape
         dt = ctx.compute_dtype
         h = inputs[0].reshape(b, s, e).astype(dt)
-        block = self._block_fn(dt)
-        if self.remat:
-            block = jax.checkpoint(block)
         mesh = ctx.mesh
         pipe = mesh.shape.get("pipe", 1) if mesh is not None else 1
+        # the pipeline path reshards x to P(data) in its shard_map
+        # in_specs, so only the scan path runs seq-parallel attends
+        block = self._block_fn(dt, interpret=ctx.platform != "tpu",
+                               mesh=None if pipe > 1 else mesh,
+                               seq_axis=getattr(ctx, "seq_axis", None))
+        if self.remat:
+            block = jax.checkpoint(block)
         if pipe > 1:
             if self.nlayer % pipe != 0:
                 raise ValueError(

@@ -223,23 +223,30 @@ def param_sharding(mesh: Mesh, layer_type: str, tag: str,
 
 def zero_sharding(mesh: Mesh, base: NamedSharding,
                   shape: Tuple[int, ...]) -> NamedSharding:
-    """ZeRO-1 placement for one optimizer slot (momentum/adam moments).
+    """ZeRO placement for one tensor: shard it over the ``data`` axis.
 
     The reference keeps a full optimizer state per weight on every worker
     (and a second full copy on the PS server under update_on_server,
-    nnet_ps_server.cpp:116-129). Here slots shard over the ``data`` axis:
-    each data-parallel replica owns 1/n of the momentum, GSPMD turns the
-    gradient all-reduce + update into reduce-scatter / local update /
-    all-gather — the ZeRO-1 pattern, expressed purely as a sharding
-    annotation on the slot.
+    nnet_ps_server.cpp:116-129). Here the tensor shards over ``data``:
+    each data-parallel replica owns 1/n of it, and GSPMD materialises the
+    matching collectives (reduce-scatter for gradients flowing in,
+    all-gather where the full value is consumed) — the ZeRO pattern,
+    expressed purely as a sharding annotation. The trainer applies this
+    to optimizer slots (``zero = 1``), to gradient-accumulation buffers
+    as well (``zero = 2``), and to the parameters themselves
+    (``zero = 3``, FSDP-style fully-sharded training).
 
-    Extends the weight's own placement (tensor-parallel dims stay as they
-    are) by sharding the first free, divisible dimension over ``data``.
+    Extends the tensor's own placement (tensor-parallel dims stay as they
+    are) by sharding the first free, divisible dimension over ``data``;
+    returns ``base`` unchanged if ``data`` is already used or no
+    dimension divides.
     """
     ndata = mesh.shape.get(DATA_AXIS, 1)
     if ndata <= 1:
         return base
     spec = list(base.spec) + [None] * (len(shape) - len(base.spec))
+    if DATA_AXIS in spec:
+        return base
     for dim, (used, size) in enumerate(zip(spec, shape)):
         if used is None and size % ndata == 0 and size > 0:
             spec[dim] = DATA_AXIS

@@ -200,17 +200,25 @@ class Trainer:
 
     def _param_shardings(self, params):
         """Per-tensor placement: replicated on a 1D mesh, tensor-parallel
-        over the model axis on a 2D mesh (parallel.param_sharding)."""
+        over the model axis on a 2D mesh (parallel.param_sharding); with
+        ``zero = 3`` the parameters themselves additionally shard over
+        the data axis (FSDP — GSPMD all-gathers each weight where used
+        and reduce-scatters its gradient)."""
         out = []
         for li, p in enumerate(params):
             if p is None:
                 out.append(None)
                 continue
             ltype = self.net_cfg.layers[li].type
-            out.append({
-                tag: parallel.param_sharding(
+            sh = {}
+            for tag, w in p.items():
+                s = parallel.param_sharding(
                     self.mesh, ltype, tag, tuple(np.shape(w)))
-                for tag, w in p.items()})
+                if self.zero >= 3:
+                    s = parallel.zero_sharding(
+                        self.mesh, s, tuple(np.shape(w)))
+                sh[tag] = s
+            out.append(sh)
         return out
 
     def _finish_init(self, params, opt, opt_state) -> None:
@@ -241,6 +249,15 @@ class Trainer:
         self.opt_state = jax.device_put(opt_state, osh)
         self._psh, self._dsh, self._xsh = psh, dsh, xsh
         gsh = [s or {} for s in psh]  # grad tree shardings (None -> {})
+        if self.zero >= 2:
+            # ZeRO-2: the gradient-accumulation buffers shard over the
+            # data axis too (each accum step becomes a reduce-scatter
+            # into the local shard); no-op at zero=3 where the params —
+            # and hence gsh — are already data-sharded
+            gsh = [{tag: parallel.zero_sharding(
+                        self.mesh, s, tuple(np.shape(params[li][tag])))
+                    for tag, s in d.items()} if d else {}
+                   for li, d in enumerate(gsh)]
         if self.update_period > 1:
             zeros = jax.tree.map(jnp.zeros_like, _strip_nones(self.params))
             self.grad_accum = jax.device_put(zeros, gsh)

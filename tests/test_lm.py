@@ -123,3 +123,67 @@ def test_sequence_softmax_rejects_narrow_label():
     ctx = ApplyContext(train=True, labels=[y], batch_size=2)
     with pytest.raises(ValueError, match="equally wide label field"):
         mod.apply({}, [x], ctx)
+
+
+def test_stack_flash_attention_matches_xla():
+    """transformer_stack attn_impl=pallas (interpret mode on CPU) computes
+    the same function as the XLA path — the long-context kernel is a
+    drop-in (on TPU it compiles the real VMEM-blocked kernel; at seq 2048+
+    it is the only path that fits, docs/performance.md)."""
+    rs = np.random.RandomState(4)
+    toks = rs.randint(0, 16, size=(32, 1, 16, 1)).astype(np.float32)
+    labels = rs.randint(0, 16, size=(32, 16)).astype(np.float32)
+    b = DataBatch(data=toks, label=labels)
+    outs = {}
+    for impl in ("xla", "pallas"):
+        # attn_impl is a layer-scoped key: patch the config text
+        tr = Trainer()
+        text = models.tiny_lm(seq_len=16, vocab=16, embed=16, nlayer=2,
+                              nhead=2)
+        text = text.replace("  causal = 1",
+                            "  causal = 1\n  attn_impl = " + impl)
+        for k, v in config.parse_string(text):
+            tr.set_param(k, v)
+        for k, v in (("batch_size", "32"), ("dev", "cpu:0"),
+                     ("eta", "0.1"), ("seed", "11")):
+            tr.set_param(k, v)
+        tr.init_model()
+        tr.update(b)
+        outs[impl] = (tr.extract_feature(b, "3"),
+                      tr.get_weight("lm_head", "wmat"))
+    np.testing.assert_allclose(outs["xla"][0], outs["pallas"][0],
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(outs["xla"][1], outs["pallas"][1],
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_stack_seq_parallel_matches_single(impl):
+    """transformer_stack under seq_parallel routes the attend through
+    ring (xla) / ulysses+flash (pallas) instead of letting GSPMD
+    all-gather the sequence; the math must match the 1-device run."""
+    rs = np.random.RandomState(7)
+    toks = rs.randint(0, 16, size=(32, 1, 16, 1)).astype(np.float32)
+    labels = rs.randint(0, 16, size=(32, 16)).astype(np.float32)
+    b = DataBatch(data=toks, label=labels)
+    outs = {}
+    for sp in (1, 2):
+        tr = Trainer()
+        text = models.tiny_lm(seq_len=16, vocab=16, embed=16, nlayer=2,
+                              nhead=2)
+        text = text.replace("  causal = 1",
+                            "  causal = 1\n  attn_impl = " + impl)
+        for k, v in config.parse_string(text):
+            tr.set_param(k, v)
+        for k, v in (("batch_size", "32"), ("eta", "0.1"), ("seed", "5"),
+                     ("dev", "cpu" if sp > 1 else "cpu:0"),
+                     ("seq_parallel", str(sp))):
+            tr.set_param(k, v)
+        tr.init_model()
+        tr.update(b)
+        outs[sp] = (tr.extract_feature(b, "3"),
+                    tr.get_weight("lm_head", "wmat"))
+    np.testing.assert_allclose(outs[1][0], outs[2][0],
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(outs[1][1], outs[2][1],
+                               rtol=2e-4, atol=2e-5)
