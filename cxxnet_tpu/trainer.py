@@ -675,12 +675,31 @@ class Trainer:
         return ret
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _fetch_global(x) -> np.ndarray:
+        """Full global value on this host. A weight sharded across
+        processes (multi-host tensor parallelism or zero=3 FSDP) has
+        shards this process cannot address, so it must be all-gathered —
+        every process must call this collectively."""
+        if jax.process_count() == 1 or x.is_fully_replicated:
+            return np.asarray(x)
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+    # ------------------------------------------------------------------
     # weight access (reference: nnet_impl-inl.hpp:246-268 + visitor.h)
     def get_weight(self, layer_name: str, tag: str) -> np.ndarray:
+        """Full (global) weight as (rows, cols).
+
+        Multi-host note: when the weight is sharded across processes
+        (cross-host tensor parallelism or ``zero = 3``), this is a
+        COLLECTIVE — every process must call it together, like
+        ``save_model``; a lone ``if rank == 0: get_weight(...)`` call
+        hangs in the all-gather."""
         idx = self.net_cfg.get_layer_index(layer_name)
         if self.params[idx] is None or tag not in self.params[idx]:
             raise ValueError("layer %s has no %s" % (layer_name, tag))
-        w = np.asarray(self.params[idx][tag])
+        w = self._fetch_global(self.params[idx][tag])
         return w.reshape(w.shape[0], -1) if w.ndim > 1 else w.reshape(1, -1)
 
     def set_weight(self, weight: np.ndarray, layer_name: str,
@@ -700,17 +719,10 @@ class Trainer:
     def save_model(self, path: str) -> None:
         from . import checkpoint
 
-        def fetch_global(x):
-            """Full global value on this host — unlike _fetch_local, a
-            model-sharded weight whose shards live on other processes must
-            be all-gathered or the checkpoint would be silently truncated."""
-            if jax.process_count() == 1 or x.is_fully_replicated:
-                return np.asarray(x)
-            from jax.experimental import multihost_utils
-            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-
         def fetch(t):
-            return jax.tree.map(fetch_global, t)
+            # unlike _fetch_local, cross-process-sharded weights must be
+            # all-gathered or the checkpoint would be silently truncated
+            return jax.tree.map(self._fetch_global, t)
         # every process joins the allgather collectives; only process 0
         # writes (the path normally sits on a shared filesystem in a pod
         # job — concurrent writers would corrupt the file)

@@ -193,6 +193,9 @@ class Net:
         self._net.set_weight(np.asarray(weight, np.float32), layer_name, tag)
 
     def get_weight(self, layer_name: str, tag: str) -> Optional[np.ndarray]:
+        """Multi-host: collective when the weight is sharded across
+        processes (zero=3 / cross-host TP) — all ranks must call it
+        together (see Trainer.get_weight)."""
         if tag not in ("bias", "wmat"):
             raise ValueError("tag must be bias or wmat")
         try:
