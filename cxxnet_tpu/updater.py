@@ -37,7 +37,11 @@ class UpdaterHyperParams:
     base_lr: float = 0.01
     wd: float = 0.0
     momentum: float = 0.9
-    lr_schedule: int = 0        # 0 const, 1 expdecay, 2 polydecay, 3 factor
+    lr_schedule: int = 0        # 0 const, 1 expdecay, 2 polydecay,
+                                # 3 factor, 4 cosine (TPU-first addition)
+    warmup_epochs: int = 0      # linear LR warmup over the first N
+                                # updates (composes with any schedule)
+    total_epochs: int = 0       # horizon for the cosine schedule
     momentum_schedule: int = 0
     lr_step: int = 1
     lr_gamma: float = 0.5
@@ -85,8 +89,13 @@ class UpdaterHyperParams:
             sub = name.split(":", 1)[1]
             if sub == "schedule":
                 self.lr_schedule = {"constant": 0, "expdecay": 1,
-                                    "polydecay": 2, "factor": 3}.get(
+                                    "polydecay": 2, "factor": 3,
+                                    "cosine": 4}.get(
                                         val, self.lr_schedule)
+            elif sub == "warmup":
+                self.warmup_epochs = int(val)
+            elif sub == "total":
+                self.total_epochs = int(val)
             elif sub == "gamma":
                 self.lr_gamma = float(val)
             elif sub == "alpha":
@@ -116,6 +125,21 @@ class UpdaterHyperParams:
         elif self.lr_schedule == 3:
             lr = self.base_lr * jnp.power(
                 self.lr_factor, jnp.floor(e / self.lr_step))
+        elif self.lr_schedule == 4:
+            # cosine decay to lr_minimum over lr:total updates (warmup
+            # excluded from the decay horizon) — the standard LM recipe;
+            # no reference analogue (its schedules are param.h:76-94)
+            if self.total_epochs <= 0:
+                raise ValueError("lr:schedule = cosine needs lr:total")
+            if self.warmup_epochs >= self.total_epochs:
+                raise ValueError(
+                    "lr:warmup (%d) must be smaller than lr:total (%d) — "
+                    "both count UPDATES, not rounds"
+                    % (self.warmup_epochs, self.total_epochs))
+            span = max(self.total_epochs - self.warmup_epochs, 1)
+            frac = jnp.clip((e - self.warmup_epochs) / span, 0.0, 1.0)
+            lr = self.lr_minimum + (self.base_lr - self.lr_minimum) \
+                * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
         else:
             raise ValueError("unknown schedule type")
         mom = jnp.asarray(self.momentum, jnp.float32)
@@ -128,6 +152,9 @@ class UpdaterHyperParams:
         lr = jnp.maximum(lr, self.lr_minimum)
         if self.start_epoch > 0:
             lr = jnp.where(e < self.start_epoch, self.base_lr, lr)
+        if self.warmup_epochs > 0:
+            # linear ramp 0 -> scheduled lr over the first warmup updates
+            lr = lr * jnp.clip((e + 1.0) / self.warmup_epochs, 0.0, 1.0)
         return lr, mom
 
 
@@ -182,7 +209,10 @@ class NAGUpdater(TensorUpdater):
 class AdamUpdater(TensorUpdater):
     """Bias-corrected Adam exactly as the reference writes it
     (reference: src/updater/adam_updater-inl.hpp:66-76), including the
-    grad -= wd*w pre-step and no LR schedule."""
+    grad -= wd*w pre-step. The reference has no Adam LR schedule; here a
+    configured ``lr:schedule`` / ``lr:warmup`` scales the rate (the
+    transformer-LM recipe), and with neither set the reference's
+    constant-rate behavior is preserved exactly."""
 
     def init_state(self, w):
         return {"m1": jnp.zeros_like(w), "m2": jnp.zeros_like(w)}
@@ -194,7 +224,11 @@ class AdamUpdater(TensorUpdater):
         e = jnp.asarray(epoch, jnp.float32)
         fix1 = 1.0 - jnp.power(1.0 - hp.beta1, e + 1)
         fix2 = 1.0 - jnp.power(1.0 - hp.beta2, e + 1)
-        lr_t = hp.base_lr * jnp.sqrt(fix2) / fix1
+        if hp.lr_schedule or hp.warmup_epochs:
+            base, _ = hp.schedule(epoch)
+        else:   # no floor/clamp applied — bit-exact reference behavior
+            base = hp.base_lr
+        lr_t = base * jnp.sqrt(fix2) / fix1
         m1 = state["m1"] + hp.beta1 * (grad - state["m1"])
         m2 = state["m2"] + hp.beta2 * (jnp.square(grad) - state["m2"])
         w = w - lr_t * (m1 / (jnp.sqrt(m2) + 1e-8))

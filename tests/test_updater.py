@@ -109,3 +109,79 @@ def test_layer_cfg_overrides_global():
     cfgs = [[("eta", "0.1")], [("eta", "0.9")]]
     upd = create_tensor_updater("sgd", "wmat", cfgs)
     assert upd.hp.base_lr == 0.9
+
+
+def test_cosine_schedule_with_warmup():
+    hp = UpdaterHyperParams(base_lr=1.0)
+    hp.set_param("lr:schedule", "cosine")
+    hp.set_param("lr:total", "110")
+    hp.set_param("lr:warmup", "10")
+    hp.set_param("lr:minimum_lr", "0.0")
+    # linear ramp over the first 10 updates
+    np.testing.assert_allclose(hp.schedule(0)[0], 0.1, rtol=1e-6)
+    np.testing.assert_allclose(hp.schedule(4)[0], 0.5, rtol=1e-6)
+    np.testing.assert_allclose(hp.schedule(9)[0], 1.0, rtol=1e-6)
+    # cosine: peak right after warmup, half at mid-span, ~0 at the end
+    np.testing.assert_allclose(hp.schedule(10)[0], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(hp.schedule(60)[0], 0.5, rtol=1e-5)
+    assert float(hp.schedule(110)[0]) < 1e-6
+    # clamps flat past the horizon rather than rising again
+    assert float(hp.schedule(200)[0]) < 1e-6
+
+
+def test_cosine_requires_total():
+    hp = UpdaterHyperParams(base_lr=1.0)
+    hp.set_param("lr:schedule", "cosine")
+    try:
+        hp.schedule(0)
+        assert False, "expected ValueError"
+    except ValueError as e:
+        assert "lr:total" in str(e)
+
+
+def test_warmup_composes_with_expdecay():
+    hp = UpdaterHyperParams(base_lr=1.0)
+    hp.set_param("lr:schedule", "expdecay")
+    hp.set_param("lr:gamma", "0.5")
+    hp.set_param("lr:step", "10")
+    hp.set_param("lr:warmup", "4")
+    np.testing.assert_allclose(hp.schedule(0)[0], 0.25 * 1.0, rtol=1e-5)
+    np.testing.assert_allclose(
+        hp.schedule(10)[0], 0.5, rtol=1e-5)  # past warmup: pure expdecay
+
+
+def test_adam_respects_warmup_and_cosine():
+    import jax.numpy as jnp
+    hp = UpdaterHyperParams(base_lr=0.1)
+    hp.set_param("lr:schedule", "cosine")
+    hp.set_param("lr:total", "100")
+    hp.set_param("lr:warmup", "10")
+    up = AdamUpdater(hp)
+    w = jnp.ones((4,))
+    g = jnp.full((4,), 0.5)
+    s = up.init_state(w)
+    w1_early, _ = up.update(s, w, g, 0)     # warmup: tiny step
+    w1_peak, _ = up.update(s, w, g, 10)     # post-warmup: full step
+    step_early = float(jnp.abs(w - w1_early).max())
+    step_peak = float(jnp.abs(w - w1_peak).max())
+    # warmup multiplies base lr by 1/10 at e=0, but Adam's bias
+    # correction partially offsets it; the step must still be much
+    # smaller than the post-warmup one
+    assert step_early < 0.3 * step_peak
+    # without schedule keys, reference behavior: schedule ignored
+    hp0 = UpdaterHyperParams(base_lr=1e-6)  # below the lr_minimum floor
+    up0 = AdamUpdater(hp0)
+    wa, _ = up0.update(up0.init_state(w), w, g, 0)
+    assert float(jnp.abs(w - wa).max()) < 1e-4   # not floored to 1e-5
+
+
+def test_cosine_rejects_warmup_past_total():
+    hp = UpdaterHyperParams(base_lr=1.0)
+    hp.set_param("lr:schedule", "cosine")
+    hp.set_param("lr:total", "100")
+    hp.set_param("lr:warmup", "200")
+    try:
+        hp.schedule(0)
+        assert False, "expected ValueError"
+    except ValueError as e:
+        assert "lr:warmup" in str(e)
