@@ -26,20 +26,22 @@ from .graph import NetConfig
 MAGIC = "cxxnet_tpu.model.v1"
 
 
-def _collect_arrays(params, prefix: str) -> dict:
-    out = {}
-    for li, p in enumerate(params):
-        if not p:
+def _iter_tensors(tree, prefix: str):
+    """Yield (key, tensor) over a params/opt_state tree; keys are the
+    single-file npz names ('L3:wmat', 'O3:wmat:mom', ...)."""
+    for li, p in enumerate(tree or []):
+        if not p or not isinstance(p, dict):
             continue
-        if isinstance(p, dict):
-            for tag, v in p.items():
-                if isinstance(v, dict):  # optimizer slots
-                    for slot, w in v.items():
-                        out["%s%d:%s:%s" % (prefix, li, tag, slot)] = \
-                            np.asarray(w)
-                else:
-                    out["%s%d:%s" % (prefix, li, tag)] = np.asarray(v)
-    return out
+        for tag, v in p.items():
+            if isinstance(v, dict):  # optimizer slots
+                for slot, w in v.items():
+                    yield "%s%d:%s:%s" % (prefix, li, tag, slot), w
+            else:
+                yield "%s%d:%s" % (prefix, li, tag), v
+
+
+def _collect_arrays(params, prefix: str) -> dict:
+    return {k: np.asarray(v) for k, v in _iter_tensors(params, prefix)}
 
 
 def save_model(path: str, net_cfg: NetConfig, epoch_counter: int,
@@ -64,20 +66,8 @@ def save_model(path: str, net_cfg: NetConfig, epoch_counter: int,
     os.replace(tmp, path)
 
 
-def load_model(path: str):
-    """Read a .model file -> (net_cfg, epoch, params, opt_state, net_type).
-
-    params/opt_state are lists indexed by layer with dict leaves, matching
-    Network.init_params layout; slots missing from the file are None.
-    """
-    with zipfile.ZipFile(path, "r") as z:
-        header = json.loads(z.read("header.json"))
-        if header.get("magic") != MAGIC:
-            raise ValueError("%s: not a cxxnet_tpu model file" % path)
-        npz = np.load(io.BytesIO(z.read("arrays.npz")))
-        arrays = {k: npz[k] for k in npz.files}
-    net_cfg = NetConfig.from_structure_state(header["structure"])
-    nlayers = net_cfg.num_layers
+def _trees_from_arrays(arrays: dict, nlayers: int):
+    """Flat {key: array} -> (params, opt_state) layer-indexed trees."""
     params: List[Optional[dict]] = [None] * nlayers
     opt_state: List[Optional[dict]] = [None] * nlayers
     for key, arr in arrays.items():
@@ -92,6 +82,154 @@ def load_model(path: str):
             li = int(m.group(1))
             opt_state[li] = opt_state[li] or {}
             opt_state[li].setdefault(m.group(2), {})[m.group(3)] = arr
+    return params, opt_state
+
+
+def load_model(path: str):
+    """Read a .model file (or sharded .model directory, save_sharded=1)
+    -> (net_cfg, epoch, params, opt_state, net_type).
+
+    params/opt_state are lists indexed by layer with dict leaves, matching
+    Network.init_params layout; slots missing from the file are None.
+    """
+    if os.path.isdir(path):
+        return _load_model_sharded(path)
+    with zipfile.ZipFile(path, "r") as z:
+        header = json.loads(z.read("header.json"))
+        if header.get("magic") != MAGIC:
+            raise ValueError("%s: not a cxxnet_tpu model file" % path)
+        npz = np.load(io.BytesIO(z.read("arrays.npz")))
+        arrays = {k: npz[k] for k in npz.files}
+    net_cfg = NetConfig.from_structure_state(header["structure"])
+    params, opt_state = _trees_from_arrays(arrays, net_cfg.num_layers)
+    if not header.get("has_opt_state"):
+        opt_state = None
+    return (net_cfg, header["epoch_counter"], params, opt_state,
+            header.get("net_type", 0))
+
+
+# ----------------------------------------------------------------------
+# Sharded checkpoints (save_sharded = 1): a .model DIRECTORY where each
+# process writes only its addressable shards. Removes the save-side
+# bottleneck of the single-file format at FSDP/cross-host-TP scale —
+# the cross-process allgather collective, the one-host serialization of
+# the whole model, and the single-writer disk stream all go away (IO is
+# per-process parallel). Layout: meta.json (structure header, process 0
+# writes, LAST — its presence marks the directory complete) +
+# shards-p{rank}.npz + shards-p{rank}.json (shard index manifest).
+# The single-file format stays the default and the two interconvert:
+# load_model() dispatches on the path type. Load currently reassembles
+# global host arrays (the same host footprint as a single-file load).
+
+def collect_shards(params, opt_state=None):
+    """Snapshot this process's addressable shards to host memory.
+
+    Returns (arrays, manifest) — the synchronous half of a sharded
+    save, safe to hand to a background writer thread afterwards (the
+    device buffers may be donated away by the next training step).
+    Writes one copy per distinct shard globally (replica 0 only).
+    """
+    manifest = []
+    arrays = {}
+    n = 0
+    for key, w in list(_iter_tensors(params, "L")) + \
+            list(_iter_tensors(opt_state, "O")):
+        shards = getattr(w, "addressable_shards", None)
+        if shards is None:   # plain host array
+            arrays["a%d" % n] = np.asarray(w)
+            manifest.append({"key": key, "arr": "a%d" % n,
+                             "shape": list(np.shape(w)), "index": None})
+            n += 1
+            continue
+        for s in shards:
+            if s.replica_id != 0:   # one writer per distinct shard
+                continue
+            arrays["a%d" % n] = np.asarray(s.data)
+            manifest.append({
+                "key": key, "arr": "a%d" % n,
+                "shape": list(w.shape),
+                "index": [[sl.start or 0,
+                           sl.stop if sl.stop is not None else dim]
+                          for sl, dim in zip(s.index, w.shape)]})
+            n += 1
+    return arrays, manifest
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def write_shards(path: str, arrays: dict, manifest: list,
+                 net_cfg: NetConfig, epoch_counter: int,
+                 has_opt_state: bool, net_type: int = 0,
+                 process_index: int = 0, process_count: int = 1) -> None:
+    """Write one process's collected shards into the .model directory.
+    Every file lands via tmp+rename; process 0 writes meta.json last, so
+    a directory with meta.json present is whole (a crash mid-save leaves
+    no meta.json and resume skips the directory)."""
+    os.makedirs(path, exist_ok=True)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    _atomic_write(os.path.join(path, "shards-p%d.npz" % process_index),
+                  buf.getvalue())
+    _atomic_write(os.path.join(path, "shards-p%d.json" % process_index),
+                  json.dumps(manifest).encode())
+    if process_index == 0:
+        header = {
+            "magic": MAGIC + ".sharded",
+            "net_type": net_type,
+            "epoch_counter": int(epoch_counter),
+            "structure": net_cfg.structure_state(),
+            "has_opt_state": has_opt_state,
+            "process_count": int(process_count),
+        }
+        _atomic_write(os.path.join(path, "meta.json"),
+                      json.dumps(header).encode())
+
+
+def save_model_sharded(path: str, net_cfg: NetConfig, epoch_counter: int,
+                       params, opt_state=None, net_type: int = 0,
+                       process_index: int = 0,
+                       process_count: int = 1) -> None:
+    """collect_shards + write_shards in one call (the synchronous path).
+    Every process calls this with the same path (shared filesystem, like
+    the reference's model_dir in dist-PS mode)."""
+    arrays, manifest = collect_shards(params, opt_state)
+    write_shards(path, arrays, manifest, net_cfg, epoch_counter,
+                 opt_state is not None, net_type, process_index,
+                 process_count)
+
+
+def _load_model_sharded(path: str):
+    with open(os.path.join(path, "meta.json")) as f:
+        header = json.load(f)
+    if header.get("magic") != MAGIC + ".sharded":
+        raise ValueError("%s: not a sharded cxxnet_tpu model dir" % path)
+    full = {}
+    for rank in range(header.get("process_count", 1)):
+        jpath = os.path.join(path, "shards-p%d.json" % rank)
+        if not os.path.exists(jpath):
+            raise ValueError(
+                "%s: missing shards for process %d of %d — was the "
+                "checkpoint written on a shared filesystem by all "
+                "processes?" % (path, rank, header.get("process_count")))
+        with open(jpath) as f:
+            manifest = json.load(f)
+        npz = np.load(os.path.join(path, "shards-p%d.npz" % rank))
+        for ent in manifest:
+            arr = npz[ent["arr"]]
+            if ent["index"] is None:
+                full[ent["key"]] = arr
+                continue
+            if ent["key"] not in full:
+                full[ent["key"]] = np.zeros(ent["shape"], arr.dtype)
+            full[ent["key"]][tuple(slice(a, b) for a, b in ent["index"])] \
+                = arr
+    net_cfg = NetConfig.from_structure_state(header["structure"])
+    params, opt_state = _trees_from_arrays(full, net_cfg.num_layers)
     if not header.get("has_opt_state"):
         opt_state = None
     return (net_cfg, header["epoch_counter"], params, opt_state,
@@ -116,8 +254,15 @@ def find_latest_model(model_dir: str,
     if os.path.isdir(model_dir):
         for f in os.listdir(model_dir):
             m = re.match(r"(\d+)\.model$", f)
-            if m and int(m.group(1)) >= start_counter:
-                best = max(best, int(m.group(1)))
+            if not m or int(m.group(1)) < start_counter:
+                continue
+            full = os.path.join(model_dir, f)
+            # a sharded directory is only complete once meta.json landed
+            # (written last, atomically) — skip crash-truncated saves
+            if os.path.isdir(full) and \
+                    not os.path.exists(os.path.join(full, "meta.json")):
+                continue
+            best = max(best, int(m.group(1)))
     if best >= 0:
         return model_path(model_dir, best), best
     return None

@@ -60,6 +60,7 @@ class Trainer:
         self.test_on_server = 0
         self.nan_guard = 0
         self.save_async = 0
+        self.save_sharded = 0
         self.epoch_counter = 0
         self.sample_counter = 0
         self.round = 0
@@ -106,6 +107,8 @@ class Trainer:
             self.nan_guard = int(val)
         elif name == "save_async":
             self.save_async = int(val)
+        elif name == "save_sharded":
+            self.save_sharded = int(val)
         if name.startswith("metric"):
             import re
             m = re.match(r"metric\[([^,\]]+),([^\]]+)\]", name)
@@ -718,6 +721,36 @@ class Trainer:
     # checkpointing (reference: nnet_impl-inl.hpp:82-134, SURVEY.md §3.3)
     def save_model(self, path: str) -> None:
         from . import checkpoint
+
+        if self.save_sharded:
+            # each process writes only its addressable shards into a
+            # .model directory — no allgather collective and no one-host
+            # serialization of the whole model (path on a shared
+            # filesystem, like the reference's model_dir in dist-PS
+            # mode). Shards snapshot to host synchronously (the next
+            # step donates the device buffers); with save_async=1 the
+            # file writes then run behind the next round's training.
+            self.wait_for_save()
+            arrays, manifest = checkpoint.collect_shards(
+                self.params, self.opt_state)
+            args = (path, arrays, manifest, self.net_cfg,
+                    self.epoch_counter, self.opt_state is not None, 0,
+                    jax.process_index(), jax.process_count())
+            if self.save_async:
+                import threading
+
+                def write(args=args):
+                    try:
+                        checkpoint.write_shards(*args)
+                    except BaseException as e:
+                        self._save_error = e
+                self._save_error = None
+                self._save_thread = threading.Thread(
+                    target=write, name="ckpt-save", daemon=False)
+                self._save_thread.start()
+            else:
+                checkpoint.write_shards(*args)
+            return
 
         def fetch(t):
             # unlike _fetch_local, cross-process-sharded weights must be

@@ -73,6 +73,12 @@ for i in range(4):
     tr.update(DataBatch(data=full[i, lo:hi], label=lab[i, lo:hi]))
 w = tr.get_weight("fc1", "wmat")
 np.save(out, w)
+if mode == "zero3":
+    # sharded checkpoint: BOTH ranks write their own shard files of ONE
+    # shared .model directory, no allgather (save_sharded = 1)
+    tr.set_param("save_sharded", "1")
+    tr.save_model(os.path.join(os.path.dirname(out), "shared.smodel"))
+    tr.save_sharded = 0
 if rank == 0:
     tr.save_model(out + ".model")
 else:
@@ -138,6 +144,21 @@ def test_two_process_training_agrees(tmp_path, mode):
         ref.update(DataBatch(data=full[i], label=lab[i]))
     np.testing.assert_allclose(w0, ref.get_weight("fc1", "wmat"),
                                rtol=1e-4, atol=1e-5)
+
+    if mode == "zero3":
+        # the per-process sharded checkpoint reassembles to the same
+        # global weights as the gathered single-file one
+        from cxxnet_tpu import checkpoint
+        import os as _os
+        sdir = os.path.join(os.path.dirname(outs[0]), "shared.smodel")
+        assert _os.path.isdir(sdir)
+        assert _os.path.exists(_os.path.join(sdir, "shards-p1.npz"))
+        _, _, sparams, sopt, _ = checkpoint.load_model(sdir)
+        _, _, gparams, _, _ = checkpoint.load_model(outs[0] + ".model")
+        np.testing.assert_allclose(np.asarray(sparams[0]["wmat"]),
+                                   np.asarray(gparams[0]["wmat"]),
+                                   rtol=1e-6, atol=1e-7)
+        assert sopt is not None   # optimizer slots shard-saved too
 
     # process 0 wrote the checkpoint; process 1 did not
     assert os.path.exists(outs[0] + ".model")
