@@ -14,3 +14,18 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 from cxxnet_tpu.parallel import force_host_cpu
 
 force_host_cpu(8)
+
+
+def write_idx(path, arr):
+    """Synthesize an MNIST idx(.gz) file: 4-byte magic (0x08=ubyte, low
+    byte=ndim), big-endian dims, raw uint8 payload — shared by the MNIST
+    reader tests and the reference-config end-to-end run."""
+    import gzip
+    import struct
+    magic = (0x08 << 8) | arr.ndim
+    head = struct.pack(">i", magic) + b"".join(
+        struct.pack(">i", d) for d in arr.shape)
+    data = head + arr.astype("uint8").tobytes()
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(str(path), "wb") as f:
+        f.write(data)
