@@ -116,3 +116,44 @@ def test_reference_mnist_conf_runs_unchanged_via_cli(tmp_path, monkeypatch):
     assert final_err < 0.5, lines   # chance is 0.75 on 4 classes
     # the save_model=1 cadence wrote numbered checkpoints
     assert os.path.exists(os.path.join("models", "0003.model"))
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_reference_mnist_conv_conf_runs_unchanged_via_cli(tmp_path,
+                                                          monkeypatch):
+    """BASELINE.md functional-parity config #2: the reference's
+    MNIST_CONV.conf (conv + max_pooling + dropout + fullc stack,
+    input_flat=0) executes unchanged through the CLI on synthesized idx
+    data and learns the quadrant task."""
+    from conftest import write_idx
+    from cxxnet_tpu.cli import main
+
+    rs = np.random.RandomState(1)
+    data = tmp_path / "data"
+    data.mkdir()
+
+    def make(n):
+        labs = rs.randint(0, 4, size=(n,)).astype(np.uint8)
+        imgs = rs.randint(0, 40, size=(n, 28, 28)).astype(np.uint8)
+        for i, l in enumerate(labs):
+            y, x = divmod(int(l), 2)
+            imgs[i, y * 14:(y + 1) * 14, x * 14:(x + 1) * 14] += 120
+        return imgs, labs
+    ti, tl = make(600)
+    ei, el = make(200)
+    write_idx(str(data / "train-images-idx3-ubyte.gz"), ti)
+    write_idx(str(data / "train-labels-idx1-ubyte.gz"), tl)
+    write_idx(str(data / "t10k-images-idx3-ubyte.gz"), ei)
+    write_idx(str(data / "t10k-labels-idx1-ubyte.gz"), el)
+
+    monkeypatch.chdir(tmp_path)
+    import io as _io
+    import contextlib
+    err = _io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = main([os.path.join(REF, "MNIST", "MNIST_CONV.conf"),
+                   "num_round=10", "max_round=10", "silent=1"])
+    assert rc == 0
+    lines = [l for l in err.getvalue().splitlines() if "test-error" in l]
+    assert lines, err.getvalue()
+    assert float(lines[-1].rsplit(":", 1)[1]) < 0.5, lines
