@@ -29,3 +29,26 @@ def write_idx(path, arr):
     opener = gzip.open if str(path).endswith(".gz") else open
     with opener(str(path), "wb") as f:
         f.write(data)
+
+
+def make_quadrant_mnist(data_dir, seed=0, ntrain=600, ntest=200):
+    """Write the four MNIST idx.gz files with a learnable synthetic
+    task (label = brightest 14x14 quadrant of a 28x28 canvas) — used by
+    the reference-config end-to-end CLI tests."""
+    import os
+    import numpy as np
+    rs = np.random.RandomState(seed)
+
+    def make(n):
+        labs = rs.randint(0, 4, size=(n,)).astype(np.uint8)
+        imgs = rs.randint(0, 40, size=(n, 28, 28)).astype(np.uint8)
+        for i, l in enumerate(labs):
+            y, x = divmod(int(l), 2)
+            imgs[i, y * 14:(y + 1) * 14, x * 14:(x + 1) * 14] += 120
+        return imgs, labs
+    ti, tl = make(ntrain)
+    ei, el = make(ntest)
+    write_idx(os.path.join(str(data_dir), "train-images-idx3-ubyte.gz"), ti)
+    write_idx(os.path.join(str(data_dir), "train-labels-idx1-ubyte.gz"), tl)
+    write_idx(os.path.join(str(data_dir), "t10k-images-idx3-ubyte.gz"), ei)
+    write_idx(os.path.join(str(data_dir), "t10k-labels-idx1-ubyte.gz"), el)

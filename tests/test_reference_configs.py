@@ -81,26 +81,12 @@ def test_reference_mnist_conf_runs_unchanged_via_cli(tmp_path, monkeypatch):
     the config names (./data/...-ubyte.gz), and the only overrides are
     run-length ones a user would type (num_round). This is BASELINE.md
     functional-parity config #1 executed, not just parsed."""
-    from conftest import write_idx
+    from conftest import make_quadrant_mnist
     from cxxnet_tpu.cli import main
 
-    rs = np.random.RandomState(0)
     data = tmp_path / "data"
     data.mkdir()
-    # tiny but learnable: label = brightest quadrant of a 28x28 canvas
-    def make(n):
-        labs = rs.randint(0, 4, size=(n,)).astype(np.uint8)
-        imgs = rs.randint(0, 40, size=(n, 28, 28)).astype(np.uint8)
-        for i, l in enumerate(labs):
-            y, x = divmod(int(l), 2)
-            imgs[i, y * 14:(y + 1) * 14, x * 14:(x + 1) * 14] += 120
-        return imgs, labs
-    ti, tl = make(600)
-    ei, el = make(200)
-    write_idx(str(data / "train-images-idx3-ubyte.gz"), ti)
-    write_idx(str(data / "train-labels-idx1-ubyte.gz"), tl)
-    write_idx(str(data / "t10k-images-idx3-ubyte.gz"), ei)
-    write_idx(str(data / "t10k-labels-idx1-ubyte.gz"), el)
+    make_quadrant_mnist(data, seed=0)
 
     monkeypatch.chdir(tmp_path)
     import io as _io
@@ -125,26 +111,12 @@ def test_reference_mnist_conv_conf_runs_unchanged_via_cli(tmp_path,
     MNIST_CONV.conf (conv + max_pooling + dropout + fullc stack,
     input_flat=0) executes unchanged through the CLI on synthesized idx
     data and learns the quadrant task."""
-    from conftest import write_idx
+    from conftest import make_quadrant_mnist
     from cxxnet_tpu.cli import main
 
-    rs = np.random.RandomState(1)
     data = tmp_path / "data"
     data.mkdir()
-
-    def make(n):
-        labs = rs.randint(0, 4, size=(n,)).astype(np.uint8)
-        imgs = rs.randint(0, 40, size=(n, 28, 28)).astype(np.uint8)
-        for i, l in enumerate(labs):
-            y, x = divmod(int(l), 2)
-            imgs[i, y * 14:(y + 1) * 14, x * 14:(x + 1) * 14] += 120
-        return imgs, labs
-    ti, tl = make(600)
-    ei, el = make(200)
-    write_idx(str(data / "train-images-idx3-ubyte.gz"), ti)
-    write_idx(str(data / "train-labels-idx1-ubyte.gz"), tl)
-    write_idx(str(data / "t10k-images-idx3-ubyte.gz"), ei)
-    write_idx(str(data / "t10k-labels-idx1-ubyte.gz"), el)
+    make_quadrant_mnist(data, seed=1)
 
     monkeypatch.chdir(tmp_path)
     import io as _io
