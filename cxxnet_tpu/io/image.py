@@ -592,6 +592,9 @@ class AugmentIterator(InstIterator):
 def _save_mean(path: str, img: np.ndarray) -> None:
     """Mean-image file: mshadow SaveBinary layout — uint32 shape dims then
     float32 data (reference mshadow tensor SaveBinary convention)."""
+    d = os.path.dirname(path)
+    if d:   # reference configs point into model_dir, which may not exist
+        os.makedirs(d, exist_ok=True)
     with open(path, "wb") as f:
         np.asarray(img.shape, "<u4").tofile(f)
         img.astype("<f4").tofile(f)

@@ -52,3 +52,25 @@ def make_quadrant_mnist(data_dir, seed=0, ntrain=600, ntest=200):
     write_idx(os.path.join(str(data_dir), "train-labels-idx1-ubyte.gz"), tl)
     write_idx(os.path.join(str(data_dir), "t10k-images-idx3-ubyte.gz"), ei)
     write_idx(os.path.join(str(data_dir), "t10k-labels-idx1-ubyte.gz"), el)
+
+
+def make_packfile(img_root, lst_path, bin_path, n, seed=0, side=48,
+                  nclass=121, prefix="im"):
+    """Synthesize n random jpegs + .lst index and pack them into a
+    BinaryPage packfile — shared by reference-config end-to-end tests."""
+    import os
+    import cv2
+    import numpy as np
+    from cxxnet_tpu.io import binpage
+    rs = np.random.RandomState(seed)
+    os.makedirs(str(img_root), exist_ok=True)
+    lines = []
+    for i in range(n):
+        name = "%s_%d.jpg" % (prefix, i)
+        img = rs.randint(0, 255, size=(side, side, 3), dtype=np.uint8)
+        cv2.imwrite(os.path.join(str(img_root), name), img)
+        lines.append("%d\t%d\t%s" % (i, rs.randint(0, nclass), name))
+    with open(str(lst_path), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    binpage.pack_images(str(lst_path), str(img_root), str(bin_path),
+                        silent=True)

@@ -129,3 +129,67 @@ def test_reference_mnist_conv_conf_runs_unchanged_via_cli(tmp_path,
     lines = [l for l in err.getvalue().splitlines() if "test-error" in l]
     assert lines, err.getvalue()
     assert float(lines[-1].rsplit(":", 1)[1]) < 0.5, lines
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_reference_imagenet_conf_runs_unchanged_via_cli(tmp_path,
+                                                        monkeypatch):
+    """BASELINE.md functional-parity config #3: the reference's
+    ImageNet.conf (AlexNet: grouped convs, LRN, dropout; imgbin iterator
+    with rand_crop/rand_mirror, mean-image compute+cache, threadbuffer)
+    executes unchanged through the CLI — the packfile, .lst files, and
+    directory layout are synthesized at the exact relative paths the
+    config names; only batch/round sizes are overridden (the full 256
+    batch x 45 rounds is a cluster run, not a unit test)."""
+    pytest.importorskip("cv2")
+    from conftest import make_packfile
+    from cxxnet_tpu.cli import main
+
+    # config paths are relative to a run dir two levels deep
+    run_dir = tmp_path / "example" / "ImageNet"
+    run_dir.mkdir(parents=True)
+    img_root = tmp_path / "data" / "resize256"
+    for split, n in (("train", 16), ("test", 8)):
+        make_packfile(img_root, tmp_path / ("NameList.%s" % split),
+                      tmp_path / ("%s.BIN" % split.upper()), n, seed=2,
+                      side=256, nclass=1000, prefix=split)
+
+    monkeypatch.chdir(run_dir)
+    import io as _io
+    import contextlib
+    err = _io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = main([os.path.join(REF, "ImageNet", "ImageNet.conf"),
+                   "dev=cpu", "batch_size=8", "num_round=1", "max_round=1",
+                   "silent=1"])
+    assert rc == 0
+    assert "test-error:" in err.getvalue(), err.getvalue()
+    # the mean image was computed over the train pack and cached
+    assert os.path.exists("models/image_net_mean.bin")
+    assert os.path.exists("models/0000.model")
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_reference_bowl_conf_runs_unchanged_via_cli(tmp_path, monkeypatch):
+    """BASELINE.md functional-parity config #5: the reference's
+    bowl.conf (121-class plankton net, heavy augmentation: rotation,
+    shear, aspect, crop-size ranges) executes unchanged through the CLI
+    on a synthesized packfile; only round count is overridden."""
+    pytest.importorskip("cv2")
+    from conftest import make_packfile
+    from cxxnet_tpu.cli import main
+
+    for split, n in (("tr", 64), ("va", 16)):
+        make_packfile(tmp_path / "imgs", tmp_path / ("%s.lst" % split),
+                      tmp_path / ("%s.bin" % split), n, seed=3,
+                      prefix=split)
+
+    monkeypatch.chdir(tmp_path)
+    import io as _io
+    import contextlib
+    err = _io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = main([os.path.join(REF, "kaggle_bowl", "bowl.conf"),
+                   "dev=cpu", "num_round=1", "max_round=1", "silent=1"])
+    assert rc == 0
+    assert "val-error:" in err.getvalue(), err.getvalue()
