@@ -5,6 +5,7 @@ scale. Pooling `pad` is an additive capability (the reference's pooling
 has none; pad=0 keeps its exact edge semantics).
 """
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 
@@ -83,3 +84,40 @@ def test_inception_rejects_bad_shapes():
         models.inception(input_shape=(3, 32, 16))
     with pytest.raises(ValueError, match="even"):
         models.inception(input_shape=(3, 17, 17))
+
+
+def test_inception_data_parallel_imgbin(tmp_path):
+    """BASELINE.md parity target #4: a GoogLeNet-style inception net
+    training data-parallel over the (virtual 8-chip) mesh, fed by the
+    imgbin packfile pipeline — the multi-chip ImageNet story end to end."""
+    pytest.importorskip("cv2")
+    from conftest import make_packfile
+    from cxxnet_tpu.io import create_iterator
+
+    make_packfile(tmp_path / "imgs", tmp_path / "tr.lst",
+                  tmp_path / "tr.bin", 32, seed=4, side=40, nclass=10)
+    it = create_iterator([
+        ("iter", "imgbin"), ("image_list", str(tmp_path / "tr.lst")),
+        ("image_bin", str(tmp_path / "tr.bin")),
+        ("input_shape", "3,32,32"), ("rand_crop", "1"),
+        ("rand_mirror", "1"), ("batch_size", "16"), ("silent", "1"),
+        ("iter", "threadbuffer"), ("iter", "end")])
+
+    tr = Trainer()
+    for k, v in config.parse_string(
+            models.inception(nclass=10, input_shape=(3, 32, 32), base=8)):
+        tr.set_param(k, v)
+    for k, v in (("dev", "cpu"), ("batch_size", "16"), ("eta", "0.05"),
+                 ("momentum", "0.9"), ("metric", "error")):
+        tr.set_param(k, v)
+    tr.init_model()
+    assert tr.n_devices == 8          # batch 16 shards over all 8 devices
+    assert dict(tr.mesh.shape) == {"data": 8}
+    for r in range(2):
+        tr.start_round(r)
+        it.before_first()
+        while it.next():
+            tr.update(it.value)
+    it.before_first()
+    it.next()
+    assert np.isfinite(tr.predict(it.value)).all()
