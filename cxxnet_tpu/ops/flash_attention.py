@@ -45,12 +45,17 @@ def _interpret() -> bool:
     return pallas_env.interpret()
 
 
-def _pick_block(s: int, target: int = 128) -> int:
+def _pick_block(s: int, target: int = 512) -> int:
     """Block size for sequence length s, honoring the TPU block-tiling
     rule: a block must be a multiple of 128 (the lse lane dimension) or
     equal to s (the equal-to-array-dim escape). Prefers the largest
     128-multiple divisor of s up to ``target``; falls back to the whole
-    sequence (one block) when none exists."""
+    sequence (one block) when none exists.
+
+    target=512 measured best on v5e (GPT-2-small-class stack, bf16):
+    50.6k tok/s @128, 72.1k @256, 86.6k @512, 83.8k @1024 at seq 2048 —
+    bigger blocks amortize the k-loop and keep the MXU busier, while
+    2048-wide blocks blow the VMEM budget and fail to compile."""
     b = (min(s, target) // 128) * 128
     while b >= 128:
         if s % b == 0:

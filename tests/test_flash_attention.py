@@ -31,9 +31,19 @@ def test_forward_matches_xla(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.fixture
+def small_blocks(monkeypatch):
+    """Force 128-wide blocks so s=256 exercises the multi-block paths
+    (with the default target 512, s=256 would run as a single block and
+    the merge/skip/dynamic-slice code would go untested)."""
+    import functools
+    monkeypatch.setattr(fa, "_pick_block",
+                        functools.partial(fa._pick_block, target=128))
+
+
 @pytest.mark.parametrize("causal", [False, True])
-def test_forward_multiple_blocks(causal):
-    """s=256 -> block 128: the online-softmax merge across k blocks (the
+def test_forward_multiple_blocks(causal, small_blocks):
+    """s=256 at block 128: the online-softmax merge across k blocks (the
     corr rescale) actually runs, causal block-skipping included."""
     q, k, v = _qkv(b=1, h=2, s=256, d=16)
     ref = ra.attention(q, k, v, causal=causal)
@@ -42,7 +52,7 @@ def test_forward_multiple_blocks(causal):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_backward_multiple_blocks():
+def test_backward_multiple_blocks(small_blocks):
     q, k, v = _qkv(b=1, h=1, s=256, d=8, seed=9)
     for causal in (False, True):
         g_ref = jax.grad(lambda a: jnp.sum(
@@ -55,13 +65,17 @@ def test_backward_multiple_blocks():
 
 
 def test_pick_block_tiling_rule():
-    # valid blocks are 128-multiples dividing s, else the whole sequence
-    assert fa._pick_block(256) == 128
-    assert fa._pick_block(512) == 128
+    # valid blocks are 128-multiples dividing s, else the whole sequence;
+    # default target 512 (measured optimum on v5e, see _pick_block)
+    assert fa._pick_block(256) == 256
+    assert fa._pick_block(512) == 512
+    assert fa._pick_block(1024) == 512
     assert fa._pick_block(96) == 96      # s <= 128: one block
     assert fa._pick_block(192) == 192    # no 128-multiple divisor
     assert fa._pick_block(136) == 136
-    assert fa._pick_block(384) == 128
+    assert fa._pick_block(384) == 384
+    assert fa._pick_block(640) == 128    # 512,384,256 don't divide 640
+    assert fa._pick_block(256, target=128) == 128
 
 
 @pytest.mark.parametrize("causal", [False, True])
