@@ -1271,7 +1271,7 @@ class AttentionLayer(Layer):
         self.nhead = 1
         self.causal = 0
         self.seq_algo = "ring"
-        self.attn_impl = "xla"
+        self.attn_impl = "auto"
 
     def set_param(self, name, val):
         if name == "nhead":
@@ -1283,8 +1283,8 @@ class AttentionLayer(Layer):
                 raise ValueError("seq_algo must be ring|alltoall|ulysses")
             self.seq_algo = val
         elif name == "attn_impl":
-            if val not in ("xla", "pallas"):
-                raise ValueError("attn_impl must be xla|pallas")
+            if val not in ("auto", "xla", "pallas"):
+                raise ValueError("attn_impl must be auto|xla|pallas")
             self.attn_impl = val
         else:
             super().set_param(name, val)
@@ -1306,10 +1306,12 @@ class AttentionLayer(Layer):
                 "wo": p.rand_init_weight(r2, (e, e), e, e)}
 
     def apply(self, params, inputs, ctx):
+        from .ops import flash_attention as fa
         from .ops import ring_attention as ra
         b, _, s, e = inputs[0].shape
         nh, d = self.nhead, e // self.nhead
         dt = ctx.compute_dtype
+        impl = fa.resolve_impl(self.attn_impl, ctx.platform, s)
         x = inputs[0].reshape(b, s, e).astype(dt)
         qkv = jnp.einsum("bse,fe->bsf", x, params["wqkv"].astype(dt))
         qkv = qkv.reshape(b, s, 3, nh, d).transpose(2, 0, 3, 1, 4)
@@ -1321,7 +1323,7 @@ class AttentionLayer(Layer):
                 from .ops import ulysses
                 out = ulysses.sharded_ulysses(
                     mesh, q, k, v, seq_axis=axis,
-                    causal=bool(self.causal), impl=self.attn_impl,
+                    causal=bool(self.causal), impl=impl,
                     interpret=ctx.platform != "tpu")
             elif self.attn_impl == "pallas":
                 raise ValueError(
@@ -1330,12 +1332,13 @@ class AttentionLayer(Layer):
                     "the head re-partition); ring attention uses its own "
                     "online-softmax block attend")
             else:
+                # auto under seq sharding: ring has no head-divisibility
+                # requirement, so it stays the safe default
                 out = ra.sharded_attention(mesh, q, k, v, seq_axis=axis,
                                            causal=bool(self.causal))
-        elif self.attn_impl == "pallas":
+        elif impl == "pallas":
             # flash attention: VMEM-blocked online softmax, O(s*d) memory
             # (cxxnet_tpu/ops/flash_attention.py)
-            from .ops import flash_attention as fa
             out = fa.flash_attention(q, k, v, bool(self.causal),
                                      interpret=ctx.platform != "tpu")
         else:
@@ -1383,7 +1386,7 @@ class TransformerStackLayer(Layer):
         self.topk = 2
         self.capacity_factor = 1.25
         self.moe_loss = 0.01
-        self.attn_impl = "xla"
+        self.attn_impl = "auto"
 
     def set_param(self, name, val):
         if name == "nlayer":
@@ -1409,8 +1412,8 @@ class TransformerStackLayer(Layer):
         elif name == "moe_loss":
             self.moe_loss = float(val)
         elif name == "attn_impl":
-            if val not in ("xla", "pallas"):
-                raise ValueError("attn_impl must be xla|pallas")
+            if val not in ("auto", "xla", "pallas"):
+                raise ValueError("attn_impl must be auto|xla|pallas")
             self.attn_impl = val
         else:
             super().set_param(name, val)
@@ -1455,12 +1458,17 @@ class TransformerStackLayer(Layer):
             out["w2"] = p.rand_init_weight(ks[3], (L, e, m), m, e)
         return out
 
-    def _block_fn(self, dt, interpret=True, mesh=None, seq_axis=None):
+    def _block_fn(self, dt, interpret=True, mesh=None, seq_axis=None,
+                  use_flash=False):
         from .ops import ring_attention as ra
         nh, causal = self.nhead, bool(self.causal)
-        use_flash = self.attn_impl == "pallas"
         seq_sharded = (mesh is not None and seq_axis is not None
                        and mesh.shape.get(seq_axis, 1) > 1)
+        # under seq sharding only an EXPLICIT pallas selects
+        # ulysses+flash (it needs nhead divisible by the shard count);
+        # auto keeps ring, which has no such requirement
+        if seq_sharded and self.attn_impl != "pallas":
+            use_flash = False
 
         def rmsnorm(x, g):
             ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
@@ -1531,11 +1539,15 @@ class TransformerStackLayer(Layer):
         h = inputs[0].reshape(b, s, e).astype(dt)
         mesh = ctx.mesh
         pipe = mesh.shape.get("pipe", 1) if mesh is not None else 1
+        from .ops import flash_attention as fa
+        use_flash = fa.resolve_impl(self.attn_impl, ctx.platform,
+                                    s) == "pallas"
         # the pipeline path reshards x to P(data) in its shard_map
         # in_specs, so only the scan path runs seq-parallel attends
         block = self._block_fn(dt, interpret=ctx.platform != "tpu",
                                mesh=None if pipe > 1 else mesh,
-                               seq_axis=getattr(ctx, "seq_axis", None))
+                               seq_axis=getattr(ctx, "seq_axis", None),
+                               use_flash=use_flash)
         if self.remat:
             block = jax.checkpoint(block)
         if pipe > 1:

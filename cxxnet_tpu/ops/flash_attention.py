@@ -45,14 +45,34 @@ def _interpret() -> bool:
     return pallas_env.interpret()
 
 
-def _pick_block(s: int, target: int = 512) -> int:
+def resolve_impl(attn_impl: str, platform: str, s: int) -> str:
+    """Resolve an ``attn_impl = auto`` config to a concrete backend.
+
+    auto -> 'pallas' on TPU when the kernel can tile s efficiently
+    (fastest at every such length, docs/performance.md), 'xla'
+    otherwise. The tiling guard matters: a sequence with no 128-multiple
+    divisor (2049, 3000, ...) would fall back to one whole-sequence
+    block, whose s x s logits tile blows the VMEM budget at long s —
+    those lengths keep the XLA attend instead of failing to compile."""
+    if attn_impl != "auto":
+        return attn_impl
+    if platform == "tpu" and _pick_block(s) <= DEFAULT_BLOCK_TARGET:
+        return "pallas"
+    return "xla"
+
+
+DEFAULT_BLOCK_TARGET = 512
+
+
+def _pick_block(s: int, target: int = DEFAULT_BLOCK_TARGET) -> int:
     """Block size for sequence length s, honoring the TPU block-tiling
     rule: a block must be a multiple of 128 (the lse lane dimension) or
     equal to s (the equal-to-array-dim escape). Prefers the largest
     128-multiple divisor of s up to ``target``; falls back to the whole
     sequence (one block) when none exists.
 
-    target=512 measured best on v5e (GPT-2-small-class stack, bf16):
+    The default target (DEFAULT_BLOCK_TARGET = 512, shared with the
+    resolve_impl auto policy) measured best on v5e (GPT-2-small-class stack, bf16):
     50.6k tok/s @128, 72.1k @256, 86.6k @512, 83.8k @1024 at seq 2048 —
     bigger blocks amortize the k-loop and keep the MXU busier, while
     2048-wide blocks blow the VMEM budget and fail to compile."""

@@ -182,3 +182,19 @@ def test_bf16_inputs():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         rtol=2e-2, atol=2e-2)
+
+
+def test_resolve_impl_auto_policy():
+    # explicit choices pass through
+    assert fa.resolve_impl("xla", "tpu", 2048) == "xla"
+    assert fa.resolve_impl("pallas", "cpu", 2048) == "pallas"
+    # auto: flash on TPU only when the kernel tiles s efficiently
+    assert fa.resolve_impl("auto", "tpu", 512) == "pallas"
+    assert fa.resolve_impl("auto", "tpu", 2048) == "pallas"
+    assert fa.resolve_impl("auto", "cpu", 512) == "xla"
+    # no 128-multiple divisor at long s -> whole-sequence block would
+    # blow VMEM; auto falls back to the XLA attend instead
+    assert fa.resolve_impl("auto", "tpu", 2049) == "xla"
+    assert fa.resolve_impl("auto", "tpu", 3000) == "xla"
+    # short sequences run as one block regardless
+    assert fa.resolve_impl("auto", "tpu", 96) == "pallas"
