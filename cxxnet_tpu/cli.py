@@ -215,6 +215,36 @@ class LearnTask:
               % (self.start_counter - 1, sample_counter, elapsed), end="")
         sys.stdout.flush()
 
+    def _recover_from_nan(self, msg: str) -> None:
+        """nan_guard=2 recovery: restore the newest checkpoint, halve the
+        learning rate, rewind the round counter to the restore point."""
+        found = checkpoint.find_latest_model(self.model_dir)
+        if found is None:
+            raise RuntimeError(
+                "nan_guard=2: no checkpoint in %s to recover from "
+                "(raise save_model cadence); original error: %s"
+                % (self.model_dir, msg))
+        path, counter = found
+        # GLOBAL eta only: entries inside the netconfig block are
+        # layer-scoped buckets that would override an appended global
+        # value anyway, so halving must start from (and replace) the
+        # global rate
+        eta = 0.01
+        in_net = False
+        for k, v in self.trainer.cfg:
+            if k == "netconfig":
+                in_net = v == "start"
+            elif not in_net and k in ("eta", "lr"):
+                eta = float(v)
+        self.trainer.set_param("eta", repr(eta * 0.5))
+        self.trainer.load_model(path)
+        self.start_counter = counter + 1
+        sys.stderr.write(
+            "nan_guard: %s\nnan_guard=2: restored %s, eta %g -> %g, "
+            "resuming at round %d\n"
+            % (msg, path, eta, eta * 0.5, self.start_counter))
+        sys.stderr.flush()
+
     def save_model_file(self) -> None:
         """Reference: cxxnet_main.cpp:173-182 (cadence check + %04d name)."""
         counter = self.start_counter
@@ -281,13 +311,26 @@ class LearnTask:
                 if not has_next:
                     break
             if self.test_io == 0:
-                sys.stderr.write("[%d]" % self.start_counter)
-                if not self.itr_evals:
-                    sys.stderr.write(self.trainer.evaluate(None, "train"))
-                for itr, name in zip(self.itr_evals, self.eval_names):
-                    sys.stderr.write(self.trainer.evaluate(itr, name))
-                sys.stderr.write("\n")
-                sys.stderr.flush()
+                try:
+                    sys.stderr.write("[%d]" % self.start_counter)
+                    if not self.itr_evals:
+                        sys.stderr.write(self.trainer.evaluate(None, "train"))
+                    for itr, name in zip(self.itr_evals, self.eval_names):
+                        sys.stderr.write(self.trainer.evaluate(itr, name))
+                    sys.stderr.write("\n")
+                    sys.stderr.flush()
+                except RuntimeError as e:
+                    # nan_guard = 2: elastic recovery — reload the latest
+                    # checkpoint, halve eta, re-run the round (beyond the
+                    # reference, whose only recovery is a manual restart
+                    # with continue=1; cxxnet_main.cpp:135-157). Each
+                    # attempt still burns max_round budget, so a
+                    # hopelessly diverging run terminates.
+                    if self.trainer.nan_guard < 2 \
+                            or "nan_guard" not in str(e):
+                        raise
+                    self._recover_from_nan(str(e))
+                    continue
             if not self.silent:
                 print("\nround %d speed: %s" % (
                     self.start_counter,

@@ -1566,7 +1566,8 @@ class TransformerStackLayer(Layer):
             cast = {k: v.astype(dt) if v.ndim > 2 else v
                     for k, v in params.items()}
             h = pipeline.sharded_pipeline(
-                mesh, lambda lp, hh: block(lp, hh)[0], cast, h, nmb)
+                mesh, lambda lp, hh: block(lp, hh)[0], cast, h, nmb,
+                contains_pallas=use_flash)
         else:
             def body(carry, lp):
                 hh, aux = carry

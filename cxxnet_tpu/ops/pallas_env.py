@@ -38,3 +38,17 @@ def interpret() -> bool:
     if _FORCE is not None:
         return _FORCE
     return jax.default_backend() != "tpu"
+
+
+def shard_map_nocheck_kwargs(shard_map_fn) -> dict:
+    """Kwargs that disable shard_map's replication checker, across jax
+    versions (check_vma in new jax, check_rep in older). pallas_call
+    outputs carry no varying-mesh-axes annotation, so any shard_map body
+    that may run a Pallas kernel needs the checker off."""
+    import inspect
+    params = inspect.signature(shard_map_fn).parameters
+    if "check_vma" in params:
+        return {"check_vma": False}
+    if "check_rep" in params:
+        return {"check_rep": False}
+    return {}

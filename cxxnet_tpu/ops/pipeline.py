@@ -91,18 +91,26 @@ def pipeline_blocks(block_fn: Callable, stage_params, x,
 
 def sharded_pipeline(mesh: Mesh, block_fn: Callable, stacked_params, x,
                      n_microbatch: int, pipe_axis: str = "pipe",
-                     data_axis: str = "data"):
+                     data_axis: str = "data",
+                     contains_pallas: bool = False):
     """shard_map pipeline_blocks over ``mesh``: params (L, ...) shard over
-    ``pipe`` on dim 0, x (b, ...) shards over ``data``; out like x."""
+    ``pipe`` on dim 0, x (b, ...) shards over ``data``; out like x.
+    ``contains_pallas``: the block runs a Pallas kernel (e.g. flash
+    attention), whose outputs the shard_map replication checker cannot
+    annotate — the checker is turned off for such blocks."""
     try:
         from jax import shard_map
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
+    kw = {}
+    if contains_pallas:
+        from .pallas_env import shard_map_nocheck_kwargs
+        kw = shard_map_nocheck_kwargs(shard_map)
     data = data_axis if data_axis in mesh.shape else None
     pspec = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
     xspec = P(data)
     fn = functools.partial(pipeline_blocks, block_fn,
                            n_microbatch=n_microbatch, axis_name=pipe_axis)
     return shard_map(fn, mesh=mesh, in_specs=(pspec, xspec),
-                     out_specs=xspec)(stacked_params, x)
+                     out_specs=xspec, **kw)(stacked_params, x)

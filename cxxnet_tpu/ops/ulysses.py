@@ -83,13 +83,7 @@ def sharded_ulysses(mesh: Mesh, q, k, v, seq_axis: str = "seq",
                            interpret=interpret)
     kw = {}
     if impl == "pallas":
-        # pallas_call outputs carry no varying-mesh-axes annotation, so
-        # shard_map's replication checker must be off for the flash path
-        import inspect
-        params = inspect.signature(shard_map).parameters
-        if "check_vma" in params:
-            kw["check_vma"] = False
-        elif "check_rep" in params:
-            kw["check_rep"] = False
+        from .pallas_env import shard_map_nocheck_kwargs
+        kw = shard_map_nocheck_kwargs(shard_map)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, **kw)(q, k, v)

@@ -116,3 +116,144 @@ def test_nan_guard_quiet_on_healthy_run():
         tr.update(b)
     out = tr.evaluate(None, "train")
     assert "train-error" in out
+
+
+def test_nan_guard_2_recovers_via_cli(tmp_path, monkeypatch):
+    """nan_guard=2 elastic recovery: on a NaN round the CLI restores the
+    newest checkpoint, halves eta, rewinds the round counter, and keeps
+    going — consuming max_round budget so a hopeless run still exits."""
+    import io as _io
+    import sys
+    import contextlib
+    from cxxnet_tpu.cli import main
+
+    conf = tmp_path / "bad.conf"
+    conf.write_text("""
+data = train
+iter = synth
+    shape = 1,1,16
+    nclass = 4
+    ninst = 128
+    batch_size = 64
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 1e20
+layer[+1:r1] = relu
+layer[r1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 1e20
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+dev = cpu
+eta = 0.1
+metric = error
+nan_guard = 2
+save_model = 1
+num_round = 3
+max_round = 4
+""")
+    monkeypatch.chdir(tmp_path)
+    err = _io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = main([str(conf), "silent=1"])
+    assert rc == 0
+    out = err.getvalue()
+    # recovery fired: checkpoint restored, eta halved, round rewound
+    assert "nan_guard=2: restored" in out, out
+    assert "eta 0.1 -> 0.05" in out, out
+    # the guard itself also reported the NaN round
+    assert "loss was NaN" in out
+
+
+def test_nan_guard_2_without_checkpoint_raises(tmp_path, monkeypatch):
+    """No checkpoint to restore (save_model=0): recovery must fail loudly
+    rather than loop."""
+    import io as _io
+    import contextlib
+    from cxxnet_tpu.cli import main
+
+    conf = tmp_path / "bad2.conf"
+    conf.write_text("""
+data = train
+iter = synth
+    shape = 1,1,16
+    nclass = 4
+    ninst = 128
+    batch_size = 64
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 1e20
+layer[+1:r1] = relu
+layer[r1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 1e20
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+dev = cpu
+eta = 0.1
+metric = error
+nan_guard = 2
+save_model = 0
+num_round = 2
+max_round = 2
+""")
+    monkeypatch.chdir(tmp_path)
+    err = _io.StringIO()
+    with pytest.raises(RuntimeError, match="no checkpoint"):
+        with contextlib.redirect_stderr(err):
+            main([str(conf), "silent=1"])
+
+
+def test_nan_guard_2_halves_global_eta_not_layer_scoped(tmp_path,
+                                                        monkeypatch):
+    """Recovery must read the GLOBAL eta, not a layer-scoped bucket
+    entry that a global append could never override."""
+    import io as _io
+    import contextlib
+    from cxxnet_tpu.cli import main
+
+    conf = tmp_path / "scoped.conf"
+    conf.write_text("""
+data = train
+iter = synth
+    shape = 1,1,16
+    nclass = 4
+    ninst = 128
+    batch_size = 64
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 1e20
+  eta = 0.9
+layer[+1:r1] = relu
+layer[r1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 1e20
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+dev = cpu
+eta = 0.2
+metric = error
+nan_guard = 2
+save_model = 1
+num_round = 2
+max_round = 3
+""")
+    monkeypatch.chdir(tmp_path)
+    err = _io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = main([str(conf), "silent=1"])
+    assert rc == 0
+    # 0.2 is the global rate; the fc1 bucket's 0.9 must not be picked up
+    assert "eta 0.2 -> 0.1" in err.getvalue(), err.getvalue()

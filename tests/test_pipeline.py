@@ -169,3 +169,43 @@ def test_remat_matches_no_remat():
     np.testing.assert_allclose(t1.get_weight("ts1", "wo"),
                                t2.get_weight("ts1", "wo"),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_with_flash_attention():
+    """pipeline_parallel composes with the Pallas flash attend (the
+    auto default on TPU): the shard_map replication checker is disabled
+    for pallas-bearing blocks, and the pipelined run matches the
+    unpipelined one."""
+    rs = np.random.RandomState(8)
+    toks = rs.randn(8, 1, 16, 32).astype(np.float32)
+    labels = rs.randint(0, 8, size=(8, 1)).astype(np.float32)
+    b = DataBatch(data=toks, label=labels)
+    outs = {}
+    for pp in (1, 2):
+        tr = Trainer()
+        text = """
+netconfig=start
+layer[0->1] = transformer_stack:ts1
+  nlayer = 4
+  nhead = 2
+  nhidden_mlp = 32
+  attn_impl = pallas
+  random_type = xavier
+layer[1->2] = flatten
+layer[2->3] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.05
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,16,32
+"""
+        for k, v in config.parse_string(text):
+            tr.set_param(k, v)
+        for k, v in (("batch_size", "8"), ("eta", "0.1"), ("seed", "3"),
+                     ("dev", "cpu" if pp > 1 else "cpu:0"),
+                     ("pipeline_parallel", str(pp))):
+            tr.set_param(k, v)
+        tr.init_model()
+        tr.update(b)
+        outs[pp] = tr.get_weight("fc1", "wmat")
+    np.testing.assert_allclose(outs[1], outs[2], rtol=2e-4, atol=2e-5)
