@@ -217,7 +217,10 @@ class LearnTask:
 
     def _recover_from_nan(self, msg: str) -> None:
         """nan_guard=2 recovery: restore the newest checkpoint, halve the
-        learning rate, rewind the round counter to the restore point."""
+        learning rate(s), rewind the round counter to the restore point."""
+        # join any in-flight async checkpoint write first: the newest
+        # checkpoint may still be landing on the ckpt-save thread
+        self.trainer.wait_for_save()
         found = checkpoint.find_latest_model(self.model_dir)
         if found is None:
             raise RuntimeError(
@@ -225,20 +228,12 @@ class LearnTask:
                 "(raise save_model cadence); original error: %s"
                 % (self.model_dir, msg))
         path, counter = found
-        # GLOBAL eta only: entries inside the netconfig block are
-        # layer-scoped buckets that would override an appended global
-        # value anyway, so halving must start from (and replace) the
-        # global rate
-        eta = 0.01
-        in_net = False
-        for k, v in self.trainer.cfg:
-            if k == "netconfig":
-                in_net = v == "start"
-            elif not in_net and k in ("eta", "lr"):
-                eta = float(v)
-        self.trainer.set_param("eta", repr(eta * 0.5))
+        rates = _global_rates(self.trainer.cfg)
+        for k, v in rates.items():
+            self.trainer.set_param(k, repr(v * 0.5))
         self.trainer.load_model(path)
         self.start_counter = counter + 1
+        eta = rates.get("eta", 0.01)
         sys.stderr.write(
             "nan_guard: %s\nnan_guard=2: restored %s, eta %g -> %g, "
             "resuming at round %d\n"
@@ -391,6 +386,28 @@ class LearnTask:
         with open(self.name_pred + ".meta", "w") as fm:
             fm.write("%d,%d,%d,%d\n" % ((nrow,) + tuple(dshape)))
         print("finished prediction, write into %s" % self.name_pred)
+
+
+def _global_rates(cfg) -> dict:
+    """The GLOBAL learning-rate entries of a config stream: the plain
+    ``eta``/``lr`` plus tag-scoped rates like ``wmat:lr`` (but not
+    ``lr:schedule``-family subkeys). Entries inside the netconfig block
+    are layer-scoped buckets that would override appended globals
+    anyway, so they are excluded. nan_guard=2 recovery halves ALL of
+    these: appending only a plain eta would override — not halve —
+    tag-scoped rates, since later config entries win."""
+    rates = {}
+    in_net = False
+    for k, v in cfg:
+        if k == "netconfig":
+            in_net = v == "start"
+        elif not in_net:
+            if k in ("eta", "lr"):
+                rates["eta"] = float(v)
+            elif (k.endswith(":lr") or k.endswith(":eta")) \
+                    and not k.startswith(("lr:", "eta:")):
+                rates[k] = float(v)
+    return rates
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -649,11 +649,15 @@ class Trainer:
         if self._use_dev_metric:
             self.train_metric.add_stats(acc)
             if self.nan_guard:
-                for m in self.train_metric.evals:
-                    if m.cnt_inst and np.isnan(m.get()):
-                        raise RuntimeError(
-                            "nan_guard: train metric '%s' is NaN (bad "
-                            "labels or diverged loss)" % m.name)
+                bad = [m.name for m in self.train_metric.evals
+                       if m.cnt_inst and np.isnan(m.get())]
+                if bad:
+                    # clear BEFORE raising: a stale NaN sum would poison
+                    # every later round, defeating nan_guard=2 recovery
+                    self.train_metric.clear()
+                    raise RuntimeError(
+                        "nan_guard: train metric '%s' is NaN (bad "
+                        "labels or diverged loss)" % bad[0])
             ret += self.train_metric.print("train")
             self.train_metric.clear()
         if iter_eval is None:
@@ -733,23 +737,11 @@ class Trainer:
             self.wait_for_save()
             arrays, manifest = checkpoint.collect_shards(
                 self.params, self.opt_state)
-            args = (path, arrays, manifest, self.net_cfg,
-                    self.epoch_counter, self.opt_state is not None, 0,
-                    jax.process_index(), jax.process_count())
-            if self.save_async:
-                import threading
-
-                def write(args=args):
-                    try:
-                        checkpoint.write_shards(*args)
-                    except BaseException as e:
-                        self._save_error = e
-                self._save_error = None
-                self._save_thread = threading.Thread(
-                    target=write, name="ckpt-save", daemon=False)
-                self._save_thread.start()
-            else:
-                checkpoint.write_shards(*args)
+            self._write_checkpoint(
+                checkpoint.write_shards, path, arrays, manifest,
+                self.net_cfg, self.epoch_counter,
+                self.opt_state is not None, 0, jax.process_index(),
+                jax.process_count())
             return
 
         def fetch(t):
@@ -762,26 +754,31 @@ class Trainer:
         params = fetch(self.params)
         opt_state = fetch(self.opt_state)
         if jax.process_index() == 0:
-            if self.save_async:
-                # the fetched host copies are immutable snapshots, so the
-                # serialization + disk write can run behind the next
-                # round's training; one writer at a time keeps files whole
-                import threading
-                self.wait_for_save()
+            self.wait_for_save()
+            self._write_checkpoint(checkpoint.save_model, path,
+                                   self.net_cfg, self.epoch_counter,
+                                   params, opt_state)
 
-                def write(args=(path, self.net_cfg, self.epoch_counter,
-                                params, opt_state)):
-                    try:
-                        checkpoint.save_model(*args)
-                    except BaseException as e:  # surfaced by wait_for_save
-                        self._save_error = e
-                self._save_error = None
-                self._save_thread = threading.Thread(
-                    target=write, name="ckpt-save", daemon=False)
-                self._save_thread.start()
-            else:
-                checkpoint.save_model(path, self.net_cfg,
-                                      self.epoch_counter, params, opt_state)
+    def _write_checkpoint(self, write_fn, *args) -> None:
+        """Run one checkpoint write, on a background thread when
+        save_async=1 (the args are immutable host snapshots, so
+        serialization + disk IO run behind the next round's training;
+        one writer at a time keeps files whole, and wait_for_save
+        re-raises any failure)."""
+        if not self.save_async:
+            write_fn(*args)
+            return
+        import threading
+
+        def write():
+            try:
+                write_fn(*args)
+            except BaseException as e:  # surfaced by wait_for_save
+                self._save_error = e
+        self._save_error = None
+        self._save_thread = threading.Thread(
+            target=write, name="ckpt-save", daemon=False)
+        self._save_thread.start()
 
     def wait_for_save(self) -> None:
         """Block until a pending async checkpoint write finishes; re-raise

@@ -257,3 +257,64 @@ max_round = 3
     assert rc == 0
     # 0.2 is the global rate; the fc1 bucket's 0.9 must not be picked up
     assert "eta 0.2 -> 0.1" in err.getvalue(), err.getvalue()
+
+
+def test_global_rates_scan():
+    from cxxnet_tpu.cli import _global_rates
+    cfg = [("eta", "0.2"), ("wmat:lr", "0.4"), ("lr:schedule", "expdecay"),
+           ("lr:gamma", "0.5"), ("netconfig", "start"),
+           ("eta", "0.9"), ("bias:eta", "0.8"), ("netconfig", "end"),
+           ("bias:eta", "0.05")]
+    rates = _global_rates(cfg)
+    # plain eta + tag-scoped rates, schedule subkeys and netconfig
+    # buckets excluded
+    assert rates == {"eta": 0.2, "wmat:lr": 0.4, "bias:eta": 0.05}
+
+
+def test_nan_guard_2_recovers_with_dirty_train_metric(tmp_path,
+                                                      monkeypatch):
+    """When the TRAIN METRIC (not just the loss) goes NaN, the metric
+    buffer must be cleared before the guard raises — a stale NaN sum
+    would re-trip the guard every round after an otherwise-successful
+    restore. logloss of a NaN prediction is NaN, so eval_train with
+    metric=logloss exercises that path end to end."""
+    import io as _io
+    import contextlib
+    from cxxnet_tpu.cli import main
+
+    conf = tmp_path / "dirty.conf"
+    conf.write_text("""
+data = train
+iter = synth
+    shape = 1,1,16
+    nclass = 4
+    ninst = 128
+    batch_size = 64
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 1e20
+layer[+1:r1] = relu
+layer[r1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 1e20
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+dev = cpu
+eta = 0.1
+metric = logloss
+eval_train = 1
+nan_guard = 2
+save_model = 1
+num_round = 3
+max_round = 4
+""")
+    monkeypatch.chdir(tmp_path)
+    err = _io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = main([str(conf), "silent=1"])
+    assert rc == 0
+    assert "nan_guard=2: restored" in err.getvalue()
