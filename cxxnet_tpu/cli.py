@@ -117,6 +117,8 @@ class LearnTask:
             self.task_predict()
         elif self.task == "extract":
             self.task_extract()
+        elif self.task == "export_model":
+            self.task_export()
         return 0
 
     # ------------------------------------------------------------------
@@ -192,11 +194,14 @@ class LearnTask:
                 itcfg.append((name, val))
             else:
                 defcfg.append((name, val))
+        # pred uses only its own iterator; export_model uses none at all
+        # (a serving box has the checkpoint, not the training packfiles)
+        no_train_io = self.task in ("pred", "export_model")
         for flag, evname, itcfg in pending:
-            if flag == 1 and self.task != "pred":
+            if flag == 1 and not no_train_io:
                 assert self.itr_train is None, "can only have one data"
                 self.itr_train = create_iterator(itcfg, defcfg)
-            elif flag == 2 and self.task != "pred":
+            elif flag == 2 and not no_train_io:
                 self.itr_evals.append(create_iterator(itcfg, defcfg))
                 self.eval_names.append(evname)
             elif flag == 3 and self.task in ("pred", "extract"):
@@ -355,6 +360,24 @@ class LearnTask:
                 for j in range(sz):
                     fo.write("%g\n" % preds[j])
         print("finished prediction, write into %s" % self.name_pred)
+
+    def task_export(self) -> None:
+        """task=export_model: AOT-serialize the forward pass (weights
+        baked in, versioned StableHLO) for serving without the framework
+        — no reference analogue (its only deployment was task=pred in
+        the training binary). Keys: export_out (path), export_batch
+        (serving batch size, default batch_size), export_platform
+        (comma list, default the training platform)."""
+        from . import serving
+        d = dict(self.cfg)
+        out = d.get("export_out", "model.export")
+        bs = int(d.get("export_batch", "0")) or None
+        plats = d.get("export_platform", "")
+        platforms = [p.strip() for p in plats.split(",") if p.strip()] \
+            or None
+        serving.export_model(self.trainer, out, batch_size=bs,
+                             platforms=platforms)
+        print("exported model to %s (+.meta)" % out)
 
     def task_extract(self) -> None:
         """Reference: cxxnet_main.cpp:284-343."""

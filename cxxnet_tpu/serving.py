@@ -1,0 +1,115 @@
+"""Model export for serving: AOT-compile and serialize the forward pass.
+
+No reference analogue — the reference's only deployment story is running
+``task=pred`` inside the training binary (reference: cxxnet_main.cpp:266).
+TPU-native deployment wants the opposite: a self-contained artifact with
+the weights baked in that any JAX runtime can execute without the
+framework, the config dialect, or the checkpoint format. ``jax.export``
+serializes the jitted forward as versioned StableHLO with strong
+compatibility guarantees; the artifact runs via ``load_exported`` here,
+or plain ``jax.export.deserialize`` anywhere else.
+
+CLI: ``task = export_model`` with ``model_in`` and ``export_out``
+(docs/tasks.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+MAGIC = "cxxnet_tpu.export.v1"
+
+
+def export_model(trainer, path: str,
+                 batch_size: Optional[int] = None,
+                 platforms: Optional[Sequence[str]] = None) -> None:
+    """Serialize ``trainer``'s forward pass (weights baked in) to
+    ``path`` (+ ``path.meta`` json with the io contract).
+
+    The exported function maps a ``(batch, c, h, w)`` input to the
+    output node's values (softmax probabilities for classifiers). The
+    input contract mirrors what the trainer itself accepts: normalized
+    float32 by default; when the trainer carries a raw-uint8 pipeline's
+    deferred normalization (``on_device_norm``, net.input_norm set),
+    the export takes raw uint8 pixels and bakes the ``(x-mean)*scale``
+    in — the meta file records ``input_dtype`` either way.
+
+    Multi-host: collective (all processes must call together to gather
+    cross-process-sharded weights); only process 0 writes the files."""
+    import jax
+    from jax import export as jexport
+
+    net = trainer.net
+    if trainer.net_cfg.extra_data_num > 0:
+        raise ValueError(
+            "export_model does not support nets with extra data inputs "
+            "(in_1.../attachtxt); the exported function takes the "
+            "single primary input node")
+    # gather (not device_get): zero=3 / cross-host-TP weights may span
+    # processes — every process joins, process 0 writes
+    params = jax.tree.map(
+        lambda w: trainer._fetch_global(w) if w is not None else None,
+        trainer.params)
+    if jax.process_index() != 0:
+        return
+    bs = batch_size or trainer.batch_size
+    shape = (bs,) + tuple(net.node_shapes[0][1:])
+    in_dtype = np.uint8 if net.input_norm is not None else np.float32
+
+    def forward(data):
+        values, _ = net.apply(params, data, train=False)
+        return values[net.out_node]
+
+    if platforms is None:
+        platforms = [trainer.mesh.devices.flat[0].platform]
+    exp = jexport.export(
+        jax.jit(forward), platforms=list(platforms))(
+            jax.ShapeDtypeStruct(shape, in_dtype))
+    out_shape = tuple(net.node_shapes[net.out_node])
+    blob = exp.serialize()
+    with open(path, "wb") as f:
+        f.write(blob)
+    with open(path + ".meta", "w") as f:
+        json.dump({
+            "magic": MAGIC,
+            "input_shape": list(shape),
+            "input_dtype": np.dtype(in_dtype).name,
+            "output_shape": [bs] + list(out_shape[1:]),
+            "platforms": list(platforms),
+        }, f)
+
+
+class ExportedModel:
+    """A deserialized export: ``__call__`` runs the forward, ``predict``
+    adds the argmax-per-row convention of ``task=pred``."""
+
+    def __init__(self, path: str):
+        from jax import export as jexport
+        with open(path, "rb") as f:
+            self._exp = jexport.deserialize(f.read())
+        meta_path = path + ".meta"
+        self.meta = None
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                self.meta = json.load(f)
+            if self.meta.get("magic") != MAGIC:
+                raise ValueError("%s: not a cxxnet_tpu export" % path)
+
+    def __call__(self, data: np.ndarray) -> np.ndarray:
+        dt = np.dtype((self.meta or {}).get("input_dtype", "float32"))
+        return np.asarray(self._exp.call(np.asarray(data, dt)))
+
+    def predict(self, data: np.ndarray) -> np.ndarray:
+        out = self(data)
+        out = out.reshape(out.shape[0], -1)
+        if out.shape[1] == 1:   # regression output: raw values
+            return out[:, 0]
+        return np.argmax(out, axis=1).astype(np.float32)
+
+
+def load_exported(path: str) -> ExportedModel:
+    return ExportedModel(path)

@@ -1,0 +1,197 @@
+"""Model export for serving (task=export_model / cxxnet_tpu.serving):
+the serialized artifact must reproduce the trainer's forward exactly
+and run standalone through jax.export.deserialize."""
+
+import os
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config, models, serving
+from cxxnet_tpu.io import DataBatch
+from cxxnet_tpu.trainer import Trainer
+
+
+def _trained(tmp_path):
+    tr = Trainer()
+    for k, v in config.parse_string(models.mnist_mlp(nhidden=16, nclass=4)):
+        tr.set_param(k, v)
+    for k, v in (("dev", "cpu:0"), ("batch_size", "16"), ("eta", "0.2"),
+                 ("input_shape", "1,1,32"), ("seed", "5")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    b = DataBatch(data=rs.randn(16, 1, 1, 32).astype(np.float32),
+                  label=rs.randint(0, 4, size=(16, 1)).astype(np.float32))
+    for _ in range(3):
+        tr.update(b)
+    return tr, b
+
+
+def test_export_roundtrip_matches_trainer(tmp_path):
+    tr, b = _trained(tmp_path)
+    path = str(tmp_path / "m.export")
+    serving.export_model(tr, path, platforms=["cpu"])
+    assert os.path.exists(path) and os.path.exists(path + ".meta")
+
+    m = serving.load_exported(path)
+    assert m.meta["input_shape"] == [16, 1, 1, 32]
+    probs = m(b.data)
+    # identical math: compare against the trainer's probabilities
+    ref = tr.extract_feature(b, "top[-1]")
+    np.testing.assert_allclose(probs.reshape(16, 4), ref.reshape(16, 4),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(m.predict(b.data), tr.predict(b))
+
+
+def test_export_bakes_weights(tmp_path):
+    """Mutating the trainer after export must not change the artifact."""
+    tr, b = _trained(tmp_path)
+    path = str(tmp_path / "m.export")
+    serving.export_model(tr, path, platforms=["cpu"])
+    before = serving.load_exported(path)(b.data)
+    w = tr.get_weight("fc1", "wmat")
+    tr.set_weight(w * 0.0, "fc1", "wmat")
+    after = serving.load_exported(path)(b.data)
+    np.testing.assert_allclose(before, after)
+
+
+def test_export_via_cli(tmp_path, monkeypatch):
+    """task=export_model end to end: train via CLI, export, serve."""
+    from cxxnet_tpu.cli import main
+
+    conf = tmp_path / "mlp.conf"
+    conf.write_text("""
+data = train
+iter = synth
+    shape = 1,1,16
+    nclass = 4
+    ninst = 128
+    batch_size = 32
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1:r1] = relu
+layer[r1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 32
+dev = cpu:0
+eta = 0.2
+metric = error
+num_round = 2
+max_round = 2
+""")
+    monkeypatch.chdir(tmp_path)
+    assert main([str(conf), "silent=1"]) == 0
+    assert main([str(conf), "task=export_model",
+                 "model_in=models/0001.model",
+                 "export_out=served.bin", "export_batch=8",
+                 "export_platform=cpu", "silent=1"]) == 0
+    m = serving.load_exported("served.bin")
+    assert m.meta["input_shape"] == [8, 1, 1, 16]
+    rs = np.random.RandomState(1)
+    preds = m.predict(rs.randn(8, 1, 1, 16).astype(np.float32))
+    assert preds.shape == (8,)
+    assert set(np.unique(preds)) <= {0.0, 1.0, 2.0, 3.0}
+
+
+def test_export_uint8_norm_pipeline(tmp_path):
+    """A trainer fed by a raw-uint8 on_device_norm pipeline exports a
+    uint8-input artifact with the (x-mean)*scale baked in."""
+    tr = Trainer()
+    for k, v in config.parse_string(models.mnist_mlp(nhidden=16, nclass=4)):
+        tr.set_param(k, v)
+    for k, v in (("dev", "cpu:0"), ("batch_size", "16"), ("eta", "0.2"),
+                 ("input_shape", "1,1,32"), ("seed", "5")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(2)
+    pix = rs.randint(0, 256, size=(16, 1, 1, 32), dtype=np.uint8)
+    b = DataBatch(data=pix,
+                  label=rs.randint(0, 4, size=(16, 1)).astype(np.float32),
+                  norm=(np.full((1, 1, 1), 100.0, np.float32), 0.01))
+    tr.update(b)
+    path = str(tmp_path / "u8.export")
+    serving.export_model(tr, path, platforms=["cpu"])
+    m = serving.load_exported(path)
+    assert m.meta["input_dtype"] == "uint8"
+    np.testing.assert_allclose(m.predict(pix), tr.predict(b))
+
+
+def test_export_rejects_extra_inputs(tmp_path):
+    tr = Trainer()
+    text = """
+extra_data_num = 1
+extra_data_shape[1] = 1,1,4
+netconfig=start
+layer[0->2] = flatten
+layer[in_1->3] = flatten
+layer[2,3->4] = concat
+layer[4->5] = fullc:fc1
+  nhidden = 4
+  init_sigma = 0.1
+layer[5->5] = softmax
+netconfig=end
+input_shape = 1,1,32
+"""
+    for k, v in config.parse_string(text):
+        tr.set_param(k, v)
+    for k, v in (("dev", "cpu:0"), ("batch_size", "8"), ("eta", "0.1")):
+        tr.set_param(k, v)
+    tr.init_model()
+    with pytest.raises(ValueError, match="extra data inputs"):
+        serving.export_model(tr, str(tmp_path / "x.export"),
+                             platforms=["cpu"])
+
+
+def test_export_cli_without_data_files(tmp_path, monkeypatch):
+    """task=export_model must not touch the training iterators: the
+    config names packfiles that do not exist on this box."""
+    from cxxnet_tpu.cli import main
+    # train with synth first to get a checkpoint
+    conf = tmp_path / "a.conf"
+    conf.write_text("""
+data = train
+iter = synth
+    shape = 1,1,16
+    nclass = 4
+    ninst = 64
+    batch_size = 32
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 32
+dev = cpu:0
+eta = 0.1
+metric = error
+num_round = 1
+max_round = 1
+""")
+    monkeypatch.chdir(tmp_path)
+    assert main([str(conf), "silent=1"]) == 0
+    # same net, but the data section now points at missing files
+    conf2 = tmp_path / "b.conf"
+    conf2.write_text(conf.read_text().replace(
+        """iter = synth
+    shape = 1,1,16
+    nclass = 4
+    ninst = 64
+    batch_size = 32""",
+        """iter = mnist
+    path_img = /nonexistent/img.gz
+    path_label = /nonexistent/lab.gz"""))
+    assert main([str(conf2), "task=export_model",
+                 "model_in=models/0000.model", "export_out=o.bin",
+                 "export_platform=cpu", "silent=1"]) == 0
+    assert serving.load_exported("o.bin").meta["input_dtype"] == "float32"
