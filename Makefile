@@ -1,0 +1,28 @@
+# Top-level driver, mirroring the reference's build UX (its Makefile
+# produces bin/cxxnet; here the "binary" is `python -m cxxnet_tpu` and
+# native code lives in native/).
+#
+#   make            - build the native IO runtime (libcxxnet_native.so)
+#   make wrapper    - C ABI library + demo + native im2bin
+#   make test       - full pytest suite (virtual 8-device CPU mesh)
+#   make bench      - AlexNet images/sec benchmark (one JSON line)
+#   make clean
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+wrapper:
+	$(MAKE) -C native wrapper demo im2bin
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+clean:
+	$(MAKE) -C native clean
+
+.PHONY: all native wrapper test bench clean
