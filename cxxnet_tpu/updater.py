@@ -281,6 +281,20 @@ class NetUpdater:
                 tag: create_tensor_updater(kind, tag, layer_cfgs)
                 for tag in tags})
         self._kind = kind
+        # clip_global_norm: rescale the WHOLE gradient to a maximum L2
+        # norm before the per-tensor updates — the modern LM recipe, on
+        # top of (not replacing) the reference's per-element clip
+        # (clip_gradient, sgd_updater-inl.hpp:15-22)
+        self.clip_global_norm = 0.0
+        for k, v in cfg.defcfg:
+            if k == "clip_global_norm":
+                self.clip_global_norm = float(v)
+        for li, bucket in enumerate(cfg.layercfg):
+            if any(k == "clip_global_norm" for k, _ in bucket):
+                raise ValueError(
+                    "clip_global_norm is a GLOBAL key (it rescales the "
+                    "whole gradient); move it out of layer %d's netconfig "
+                    "bucket" % li)
 
     def init_state(self, params):
         states = []
@@ -298,6 +312,25 @@ class NetUpdater:
 
     def apply(self, params, grads, opt_state, epoch):
         """One optimizer step over the whole net (pure)."""
+        if self.clip_global_norm > 0.0:
+            sq = jnp.zeros((), jnp.float32)
+            for li, g in enumerate(grads):
+                if not g or self.updaters[li] is None:
+                    continue
+                for tag, gv in g.items():
+                    if self.updaters[li].get(tag) is not None:
+                        sq = sq + jnp.sum(
+                            jnp.square(gv.astype(jnp.float32)))
+            gnorm = jnp.sqrt(sq)
+            scale = jnp.minimum(
+                1.0, self.clip_global_norm / jnp.maximum(gnorm, 1e-12))
+            # non-finite norm (NaN grads, or Inf incl. f32 overflow of
+            # the squared sum): leave grads to the per-element clip /
+            # nan_guard rather than silently zeroing the whole step
+            # (and minting inf*0 NaNs)
+            scale = jnp.where(jnp.isfinite(gnorm), scale, 1.0)
+            grads = [({tag: gv * scale for tag, gv in g.items()}
+                      if g else g) for g in grads]
         new_params, new_state = [], []
         for li, p in enumerate(params):
             if p is None:

@@ -185,3 +185,116 @@ def test_cosine_rejects_warmup_past_total():
         assert False, "expected ValueError"
     except ValueError as e:
         assert "lr:warmup" in str(e)
+
+
+def test_clip_global_norm():
+    """clip_global_norm rescales the whole gradient to the target L2
+    norm before the per-tensor updates (beyond the reference's
+    per-element clip_gradient)."""
+    import jax
+    from cxxnet_tpu import config
+    from cxxnet_tpu.graph import NetConfig
+    from cxxnet_tpu.model import Network
+    from cxxnet_tpu.updater import NetUpdater
+
+    text = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[+1:fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+eta = 0.5
+momentum = 0
+clip_global_norm = 1.0
+"""
+    cfg = NetConfig()
+    cfg.configure(config.parse_string(text))
+    net = Network(cfg, batch_size=4)
+    params = net.init_params(jax.random.PRNGKey(0))
+    opt = NetUpdater(net)
+    assert opt.clip_global_norm == 1.0
+    state = opt.init_state(params)
+    rs = np.random.RandomState(0)
+    grads = [({tag: jnp.asarray(rs.randn(*np.shape(w)).astype(np.float32))
+               * 100.0 for tag, w in p.items()} if p else p)
+             for p in params]
+    new_params, _ = opt.apply(params, grads, state, 0)
+    # total step norm == eta * clip (gradient norm >> clip here)
+    delta_sq = 0.0
+    for p0, p1 in zip(params, new_params):
+        if p0 is None:
+            continue
+        for tag in p0:
+            delta_sq += float(jnp.sum(jnp.square(p1[tag] - p0[tag])))
+    np.testing.assert_allclose(np.sqrt(delta_sq), 0.5 * 1.0, rtol=1e-4)
+    # small gradients pass through unscaled
+    tiny = [({tag: g * 1e-6 for tag, g in p.items()} if p else p)
+            for p in grads]
+    new2, _ = opt.apply(params, tiny, state, 0)
+    d2 = 0.0
+    gsq = 0.0
+    for p0, p1, g in zip(params, new2, tiny):
+        if p0 is None:
+            continue
+        for tag in p0:
+            d2 += float(jnp.sum(jnp.square(p1[tag] - p0[tag])))
+            gsq += float(jnp.sum(jnp.square(g[tag])))
+    np.testing.assert_allclose(np.sqrt(d2), 0.5 * np.sqrt(gsq), rtol=1e-4)
+
+
+def test_clip_global_norm_inf_safe_and_global_only():
+    import jax
+    from cxxnet_tpu import config
+    from cxxnet_tpu.graph import NetConfig
+    from cxxnet_tpu.model import Network
+    from cxxnet_tpu.updater import NetUpdater
+
+    base = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+eta = 0.5
+momentum = 0
+clip_global_norm = 1.0
+"""
+    cfg = NetConfig()
+    cfg.configure(config.parse_string(base))
+    net = Network(cfg, batch_size=4)
+    params = net.init_params(jax.random.PRNGKey(0))
+    opt = NetUpdater(net)
+    state = opt.init_state(params)
+    # one Inf element: the whole step must NOT be zeroed (scale falls
+    # back to 1.0 and the finite grads still apply)
+    grads = [({tag: jnp.ones(np.shape(w), jnp.float32)
+               for tag, w in p.items()} if p else p) for p in params]
+    li = next(i for i, p in enumerate(params) if p)
+    g0 = dict(grads[li])
+    bad = np.ones(np.shape(params[li]["wmat"]), np.float32)
+    bad[0, 0] = np.inf
+    g0["wmat"] = jnp.asarray(bad)
+    grads[li] = g0
+    new_params, _ = opt.apply(params, grads, state, 0)
+    b0 = np.asarray(params[li]["bias"])
+    b1 = np.asarray(new_params[li]["bias"])
+    np.testing.assert_allclose(b1, b0 - 0.5 * 1.0, rtol=1e-5)
+
+    # layer-scoped placement is rejected loudly
+    scoped = base.replace("  init_sigma = 0.1",
+                          "  init_sigma = 0.1\n  clip_global_norm = 2.0")
+    cfg2 = NetConfig()
+    cfg2.configure(config.parse_string(scoped))
+    net2 = Network(cfg2, batch_size=4)
+    try:
+        NetUpdater(net2)
+        assert False, "expected ValueError"
+    except ValueError as e:
+        assert "GLOBAL key" in str(e)
