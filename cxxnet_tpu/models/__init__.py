@@ -519,6 +519,17 @@ label_vec[0,{seq_len}) = label
 """
 
 
+def gpt2_small(seq_len: int = 512, vocab: int = 32768,
+               embed: int = 768, nlayer: int = 12, nhead: int = 12) -> str:
+    """GPT-2-small-class causal LM NETWORK (embed + causal stack +
+    vocab head) at the shape measured in docs/performance.md (~100k
+    tokens/sec at seq 512 on one v5e chip, bf16, flash attention).
+    Training hyperparameters (adam, decoupled_wd, warmup+cosine,
+    clip_global_norm) live in examples/transformer/gpt2_small.conf."""
+    return tiny_lm(seq_len=seq_len, vocab=vocab, embed=embed,
+                   nlayer=nlayer, nhead=nhead)
+
+
 def seq_classifier(seq_len: int = 16, embed: int = 32, nhead: int = 4,
                    nclass: int = 10, causal: int = 0) -> str:
     """Attention-based sequence classifier (no reference equivalent —

@@ -36,6 +36,7 @@ class UpdaterHyperParams:
     tag: str = ""
     base_lr: float = 0.01
     wd: float = 0.0
+    decoupled_wd: int = 0   # adam only: true AdamW decay (see AdamUpdater)
     momentum: float = 0.9
     lr_schedule: int = 0        # 0 const, 1 expdecay, 2 polydecay,
                                 # 3 factor, 4 cosine (TPU-first addition)
@@ -67,6 +68,8 @@ class UpdaterHyperParams:
             self.base_lr = float(val)
         elif name == "wd":
             self.wd = float(val)
+        elif name == "decoupled_wd":
+            self.decoupled_wd = int(val)
         elif name == "momentum":
             self.momentum = float(val)
         elif name == "silent":
@@ -208,18 +211,22 @@ class NAGUpdater(TensorUpdater):
 
 class AdamUpdater(TensorUpdater):
     """Bias-corrected Adam exactly as the reference writes it
-    (reference: src/updater/adam_updater-inl.hpp:66-76), including the
-    grad -= wd*w pre-step. The reference has no Adam LR schedule; here a
-    configured ``lr:schedule`` / ``lr:warmup`` scales the rate (the
-    transformer-LM recipe), and with neither set the reference's
-    constant-rate behavior is preserved exactly."""
+    (reference: src/updater/adam_updater-inl.hpp:66-81), including the
+    ``grad -= wd*w`` pre-step — note that the reference's sign makes
+    coupled wd ANTI-regularizing under its descent update (a faithfully
+    reproduced quirk). ``decoupled_wd = 1`` applies true AdamW decay
+    instead: ``w -= lr * wd * w`` outside the adaptive normalization.
+    The reference has no Adam LR schedule; here a configured
+    ``lr:schedule`` / ``lr:warmup`` scales the rate (the transformer-LM
+    recipe), and with neither set the reference's constant-rate behavior
+    is preserved exactly."""
 
     def init_state(self, w):
         return {"m1": jnp.zeros_like(w), "m2": jnp.zeros_like(w)}
 
     def update(self, state, w, grad, epoch):
         hp = self.hp
-        if hp.wd > 0.0:
+        if hp.wd > 0.0 and not hp.decoupled_wd:
             grad = grad - hp.wd * w
         e = jnp.asarray(epoch, jnp.float32)
         fix1 = 1.0 - jnp.power(1.0 - hp.beta1, e + 1)
@@ -232,6 +239,8 @@ class AdamUpdater(TensorUpdater):
         m1 = state["m1"] + hp.beta1 * (grad - state["m1"])
         m2 = state["m2"] + hp.beta2 * (jnp.square(grad) - state["m2"])
         w = w - lr_t * (m1 / (jnp.sqrt(m2) + 1e-8))
+        if hp.wd > 0.0 and hp.decoupled_wd:
+            w = w - base * hp.wd * w
         return w, {"m1": m1, "m2": m2}
 
 

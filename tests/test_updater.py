@@ -298,3 +298,22 @@ clip_global_norm = 1.0
         assert False, "expected ValueError"
     except ValueError as e:
         assert "GLOBAL key" in str(e)
+
+
+def test_adam_decoupled_wd_is_real_decay():
+    """decoupled_wd=1: w shrinks toward zero by lr*wd outside the
+    adaptive step (true AdamW); the reference's coupled wd quirk
+    (grad -= wd*w, sign-flipped) stays the default for parity."""
+    hp = UpdaterHyperParams(base_lr=0.01, wd=0.1)
+    hp.set_param("decoupled_wd", "1")
+    up = AdamUpdater(hp)
+    w = jnp.asarray([10.0])
+    g = jnp.asarray([0.0])
+    w1, _ = up.update(up.init_state(w), w, g, 0)
+    # zero grad: the only movement is the decay term w*(1 - lr*wd)
+    np.testing.assert_allclose(w1, [10.0 * (1 - 0.01 * 0.1)], rtol=1e-6)
+    # coupled default: zero grad becomes -wd*w, which PUSHES AWAY from 0
+    hp2 = UpdaterHyperParams(base_lr=0.01, wd=0.1)
+    up2 = AdamUpdater(hp2)
+    w2, _ = up2.update(up2.init_state(w), w, g, 0)
+    assert float(w2[0]) > 10.0   # the reference quirk, faithfully kept
