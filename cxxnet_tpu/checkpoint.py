@@ -16,6 +16,7 @@ import io
 import json
 import os
 import re
+import time
 import zipfile
 from typing import List, Optional, Tuple
 
@@ -162,22 +163,94 @@ def _atomic_write(path: str, data: bytes) -> None:
     os.replace(tmp, path)
 
 
+def _read_manifest(path: str):
+    """Read one rank's manifest file -> (nonce, entries), tolerating the
+    pre-nonce format (a bare entry list)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        return None, doc
+    return doc.get("nonce"), doc["entries"]
+
+
+def _await_all_shards(path: str, process_count: int, nonce,
+                      timeout: float = 600.0) -> None:
+    """Block until every rank's shard manifest FOR THIS SAVE is on the
+    (shared) FS.
+
+    This is the cross-process barrier before the meta.json completeness
+    marker: without it, rank 0 could stamp the directory complete while
+    rank N is still writing, and a crash/concurrent reader in that
+    window would see a "complete" directory that load rejects. When a
+    ``nonce`` is set (the Trainer path broadcasts one per save attempt),
+    a manifest only counts if it carries the same nonce — stale files
+    left in a reused directory by an earlier torn save at the same
+    counter cannot satisfy the barrier."""
+    deadline = time.monotonic() + timeout
+    pending = list(range(process_count))
+    while pending:
+        missing, stale = [], []
+        for r in pending:
+            jpath = os.path.join(path, "shards-p%d.json" % r)
+            try:
+                got_nonce, _ = _read_manifest(jpath)
+            except (OSError, ValueError, KeyError):
+                missing.append(r)
+                continue
+            if nonce is not None and got_nonce != nonce:
+                stale.append(r)
+        pending = missing + stale
+        if not pending:
+            return
+        if time.monotonic() > deadline:
+            detail = []
+            if missing:
+                detail.append(
+                    "process(es) %s did not appear — is model_dir on a "
+                    "filesystem shared by all processes?" % missing)
+            if stale:
+                detail.append(
+                    "process(es) %s only have a manifest from an EARLIER "
+                    "save attempt (torn directory reuse) — did that rank "
+                    "crash mid-save?" % stale)
+            raise RuntimeError(
+                "%s: shards incomplete after %gs: %s"
+                % (path, timeout, "; ".join(detail)))
+        time.sleep(0.05)
+
+
 def write_shards(path: str, arrays: dict, manifest: list,
                  net_cfg: NetConfig, epoch_counter: int,
                  has_opt_state: bool, net_type: int = 0,
-                 process_index: int = 0, process_count: int = 1) -> None:
+                 process_index: int = 0, process_count: int = 1,
+                 nonce=None) -> None:
     """Write one process's collected shards into the .model directory.
-    Every file lands via tmp+rename; process 0 writes meta.json last, so
-    a directory with meta.json present is whole (a crash mid-save leaves
-    no meta.json and resume skips the directory)."""
+    Every file lands via tmp+rename; process 0 waits for every rank's
+    manifest (matching ``nonce``, when given) and then writes meta.json
+    last, so a directory with meta.json present is whole across
+    processes (a crash mid-save leaves no meta.json and resume skips
+    the directory). Multi-process callers should agree on a fresh
+    ``nonce`` per save attempt (Trainer broadcasts one from rank 0) so
+    a reused directory's stale shards can neither release the barrier
+    nor mix into a load."""
     os.makedirs(path, exist_ok=True)
+    if process_index == 0:
+        # invalidate a stale completeness marker (directory reuse after
+        # a rewind) BEFORE any new shard lands: a legacy meta.json with
+        # no nonce would otherwise vouch for a mixed-attempt directory
+        try:
+            os.remove(os.path.join(path, "meta.json"))
+        except OSError:
+            pass
     buf = io.BytesIO()
     np.savez(buf, **arrays)
     _atomic_write(os.path.join(path, "shards-p%d.npz" % process_index),
                   buf.getvalue())
     _atomic_write(os.path.join(path, "shards-p%d.json" % process_index),
-                  json.dumps(manifest).encode())
+                  json.dumps({"nonce": nonce,
+                              "entries": manifest}).encode())
     if process_index == 0:
+        _await_all_shards(path, process_count, nonce)
         header = {
             "magic": MAGIC + ".sharded",
             "net_type": net_type,
@@ -185,6 +258,7 @@ def write_shards(path: str, arrays: dict, manifest: list,
             "structure": net_cfg.structure_state(),
             "has_opt_state": has_opt_state,
             "process_count": int(process_count),
+            "nonce": nonce,
         }
         _atomic_write(os.path.join(path, "meta.json"),
                       json.dumps(header).encode())
@@ -193,14 +267,14 @@ def write_shards(path: str, arrays: dict, manifest: list,
 def save_model_sharded(path: str, net_cfg: NetConfig, epoch_counter: int,
                        params, opt_state=None, net_type: int = 0,
                        process_index: int = 0,
-                       process_count: int = 1) -> None:
+                       process_count: int = 1, nonce=None) -> None:
     """collect_shards + write_shards in one call (the synchronous path).
     Every process calls this with the same path (shared filesystem, like
     the reference's model_dir in dist-PS mode)."""
     arrays, manifest = collect_shards(params, opt_state)
     write_shards(path, arrays, manifest, net_cfg, epoch_counter,
                  opt_state is not None, net_type, process_index,
-                 process_count)
+                 process_count, nonce)
 
 
 def _load_model_sharded(path: str):
@@ -216,8 +290,12 @@ def _load_model_sharded(path: str):
                 "%s: missing shards for process %d of %d — was the "
                 "checkpoint written on a shared filesystem by all "
                 "processes?" % (path, rank, header.get("process_count")))
-        with open(jpath) as f:
-            manifest = json.load(f)
+        got_nonce, manifest = _read_manifest(jpath)
+        if header.get("nonce") is not None and got_nonce != header["nonce"]:
+            raise ValueError(
+                "%s: shards-p%d.json belongs to a different save attempt "
+                "than meta.json (torn directory reuse) — refusing to "
+                "assemble mixed-epoch weights" % (path, rank))
         npz = np.load(os.path.join(path, "shards-p%d.npz" % rank))
         for ent in manifest:
             arr = npz[ent["arr"]]
@@ -240,29 +318,53 @@ def model_path(model_dir: str, counter: int) -> str:
     return os.path.join(model_dir, "%04d.model" % counter)
 
 
+def _sharded_dir_complete(path: str) -> bool:
+    """A sharded .model directory is loadable iff meta.json landed AND
+    every rank's shard pair it references exists (meta.json alone can
+    outlive shard files under partial deletion, or precede them if an
+    older writer without the barrier produced the directory)."""
+    meta = os.path.join(path, "meta.json")
+    try:
+        with open(meta) as f:
+            header = json.load(f)
+    except (OSError, ValueError):
+        return False
+    for r in range(int(header.get("process_count", 1))):
+        if not os.path.exists(os.path.join(path, "shards-p%d.npz" % r)):
+            return False
+        try:
+            got_nonce, _ = _read_manifest(
+                os.path.join(path, "shards-p%d.json" % r))
+        except (OSError, ValueError, KeyError):
+            return False
+        # a manifest from a different save attempt (torn re-save over a
+        # previously complete directory) makes the dir unloadable — skip
+        # it here so resume falls back instead of crash-looping
+        if header.get("nonce") is not None and got_nonce != header["nonce"]:
+            return False
+    return True
+
+
 def find_latest_model(model_dir: str,
                       start_counter: int = 0) -> Optional[Tuple[str, int]]:
-    """Scan model_dir/%04d.model upward from start_counter for the last
-    existing file (reference SyncLastestModel, cxxnet_main.cpp:135-157).
+    """Scan model_dir/%04d.model downward for the newest LOADABLE
+    checkpoint (reference SyncLastestModel, cxxnet_main.cpp:135-157).
 
     The reference's consecutive probe misses any checkpoint after a gap
     (save_model > 1, or a mid-run cadence change) — a directory listing
-    for the highest-numbered model subsumes it entirely, so continue=1
-    always resumes from the newest state."""
-    import re
-    best = -1
+    subsumes it entirely, so continue=1 always resumes from the newest
+    state. Incomplete sharded directories (missing meta.json or any
+    shard file) are skipped in favor of the next-older checkpoint, so
+    a torn save cannot crash-loop the resume path."""
+    counters = set()
     if os.path.isdir(model_dir):
         for f in os.listdir(model_dir):
             m = re.match(r"(\d+)\.model$", f)
-            if not m or int(m.group(1)) < start_counter:
-                continue
-            full = os.path.join(model_dir, f)
-            # a sharded directory is only complete once meta.json landed
-            # (written last, atomically) — skip crash-truncated saves
-            if os.path.isdir(full) and \
-                    not os.path.exists(os.path.join(full, "meta.json")):
-                continue
-            best = max(best, int(m.group(1)))
-    if best >= 0:
-        return model_path(model_dir, best), best
+            if m and int(m.group(1)) >= start_counter:
+                counters.add(int(m.group(1)))
+    for c in sorted(counters, reverse=True):
+        full = model_path(model_dir, c)
+        if os.path.isdir(full) and not _sharded_dir_complete(full):
+            continue
+        return full, c
     return None

@@ -227,22 +227,48 @@ class LearnTask:
         # checkpoint may still be landing on the ckpt-save thread
         self.trainer.wait_for_save()
         found = checkpoint.find_latest_model(self.model_dir)
+        import jax
+        if jax.process_count() > 1:
+            # ranks must agree on the restore point: an independent scan
+            # can resolve differently per rank (rank 0's meta.json still
+            # in flight, NFS attribute-cache lag), silently diverging
+            # the replicas — rank 0's verdict wins
+            import numpy as _np
+            from jax.experimental import multihost_utils
+            counter = int(multihost_utils.broadcast_one_to_all(
+                _np.int64(found[1] if found is not None else -1)))
+            found = (checkpoint.model_path(self.model_dir, counter),
+                     counter) if counter >= 0 else None
         if found is None:
             raise RuntimeError(
                 "nan_guard=2: no checkpoint in %s to recover from "
                 "(raise save_model cadence); original error: %s"
                 % (self.model_dir, msg))
         path, counter = found
-        rates = _global_rates(self.trainer.cfg)
-        for k, v in rates.items():
-            self.trainer.set_param(k, repr(v * 0.5))
+        # Halve every EFFECTIVE learning rate by compounding the
+        # recovery_lr_scale multiplier, an internal updater key that
+        # multiplies each updater's final rate (incl. Adam's constant-
+        # rate fast path). Appending halved eta/lr values cannot do
+        # this: layer-bucket and tag-scoped rates override appended
+        # globals, and a config with no global eta at all would yield
+        # nothing to halve. Only non-netconfig entries are scanned —
+        # a bucket entry is layer-scoped and would be the wrong
+        # compounding base for every other layer.
+        scale = 1.0
+        in_net = False
+        for k, v in self.trainer.cfg:
+            if k == "netconfig":
+                in_net = v == "start"
+            elif not in_net and k == "recovery_lr_scale":
+                scale = float(v)
+        self.trainer.set_param("recovery_lr_scale", repr(scale * 0.5))
         self.trainer.load_model(path)
         self.start_counter = counter + 1
-        eta = rates.get("eta", 0.01)
         sys.stderr.write(
-            "nan_guard: %s\nnan_guard=2: restored %s, eta %g -> %g, "
+            "nan_guard: %s\nnan_guard=2: restored %s, lr_scale %g -> %g "
+            "(halves every learning rate, incl. tag- and layer-scoped), "
             "resuming at round %d\n"
-            % (msg, path, eta, eta * 0.5, self.start_counter))
+            % (msg, path, scale, scale * 0.5, self.start_counter))
         sys.stderr.flush()
 
     def save_model_file(self) -> None:
@@ -409,28 +435,6 @@ class LearnTask:
         with open(self.name_pred + ".meta", "w") as fm:
             fm.write("%d,%d,%d,%d\n" % ((nrow,) + tuple(dshape)))
         print("finished prediction, write into %s" % self.name_pred)
-
-
-def _global_rates(cfg) -> dict:
-    """The GLOBAL learning-rate entries of a config stream: the plain
-    ``eta``/``lr`` plus tag-scoped rates like ``wmat:lr`` (but not
-    ``lr:schedule``-family subkeys). Entries inside the netconfig block
-    are layer-scoped buckets that would override appended globals
-    anyway, so they are excluded. nan_guard=2 recovery halves ALL of
-    these: appending only a plain eta would override — not halve —
-    tag-scoped rates, since later config entries win."""
-    rates = {}
-    in_net = False
-    for k, v in cfg:
-        if k == "netconfig":
-            in_net = v == "start"
-        elif not in_net:
-            if k in ("eta", "lr"):
-                rates["eta"] = float(v)
-            elif (k.endswith(":lr") or k.endswith(":eta")) \
-                    and not k.startswith(("lr:", "eta:")):
-                rates[k] = float(v)
-    return rates
 
 
 def main(argv: Optional[List[str]] = None) -> int:

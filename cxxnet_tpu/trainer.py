@@ -12,6 +12,7 @@ buffer and applies the updaters every k-th call.
 
 from __future__ import annotations
 
+import os
 import sys
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -735,13 +736,23 @@ class Trainer:
             # step donates the device buffers); with save_async=1 the
             # file writes then run behind the next round's training.
             self.wait_for_save()
+            # every rank stamps its shards with a per-save-attempt nonce
+            # agreed via broadcast: rank 0's pre-meta barrier then only
+            # accepts THIS attempt's manifests, so a reused directory's
+            # stale shards (torn earlier save at the same counter) can
+            # neither release the barrier early nor mix into a load
+            nonce = int.from_bytes(os.urandom(8), 'little') >> 2
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                nonce = int(multihost_utils.broadcast_one_to_all(
+                    np.int64(nonce)))
             arrays, manifest = checkpoint.collect_shards(
                 self.params, self.opt_state)
             self._write_checkpoint(
                 checkpoint.write_shards, path, arrays, manifest,
                 self.net_cfg, self.epoch_counter,
                 self.opt_state is not None, 0, jax.process_index(),
-                jax.process_count())
+                jax.process_count(), nonce)
             return
 
         def fetch(t):

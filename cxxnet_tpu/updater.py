@@ -54,6 +54,12 @@ class UpdaterHyperParams:
     final_momentum: float = 0.90
     saturation_epoch: int = 0
     clip_gradient: float = 0.0
+    recovery_lr_scale: float = 1.0
+    # ^ internal multiplier on every EFFECTIVE rate, compounded by
+    #   nan_guard=2 recovery. Deliberately its own key (not eta/lr): it
+    #   must reach rates that re-appended globals never could —
+    #   tag-scoped and layer-bucket lr entries — and it multiplies the
+    #   rate in Adam's bit-exact constant-rate fast path too.
     silent: int = 0
     # adam extras (reference adam_updater-inl.hpp:21-22)
     beta1: float = 0.1
@@ -78,6 +84,8 @@ class UpdaterHyperParams:
             self.momentum_schedule = int(val)
         elif name == "clip_gradient":
             self.clip_gradient = float(val)
+        elif name == "recovery_lr_scale":
+            self.recovery_lr_scale = float(val)
         elif name == "final_momentum":
             self.final_momentum = float(val)
         elif name == "base_momentum":
@@ -158,6 +166,10 @@ class UpdaterHyperParams:
         if self.warmup_epochs > 0:
             # linear ramp 0 -> scheduled lr over the first warmup updates
             lr = lr * jnp.clip((e + 1.0) / self.warmup_epochs, 0.0, 1.0)
+        # applied last so it scales past lr_minimum too: recovery must be
+        # able to reduce EVERY effective rate
+        if self.recovery_lr_scale != 1.0:
+            lr = lr * self.recovery_lr_scale
         return lr, mom
 
 
@@ -234,7 +246,7 @@ class AdamUpdater(TensorUpdater):
         if hp.lr_schedule or hp.warmup_epochs:
             base, _ = hp.schedule(epoch)
         else:   # no floor/clamp applied — bit-exact reference behavior
-            base = hp.base_lr
+            base = hp.base_lr * hp.recovery_lr_scale
         lr_t = base * jnp.sqrt(fix2) / fix1
         m1 = state["m1"] + hp.beta1 * (grad - state["m1"])
         m2 = state["m2"] + hp.beta2 * (jnp.square(grad) - state["m2"])
@@ -304,6 +316,13 @@ class NetUpdater:
                     "clip_global_norm is a GLOBAL key (it rescales the "
                     "whole gradient); move it out of layer %d's netconfig "
                     "bucket" % li)
+            if any(k == "recovery_lr_scale" for k, _ in bucket):
+                # a bucket entry replays after the appended global and
+                # would exempt that layer from nan_guard=2 recovery
+                raise ValueError(
+                    "recovery_lr_scale is reserved for nan_guard=2 "
+                    "recovery and must not appear in layer %d's "
+                    "netconfig bucket" % li)
 
     def init_state(self, params):
         states = []

@@ -114,3 +114,60 @@ def test_resume_skips_incomplete_sharded_dir(tmp_path):
     os.makedirs(checkpoint.model_path(str(tmp_path), 9))
     found = checkpoint.find_latest_model(str(tmp_path))
     assert found is not None and found[1] == 3
+
+
+def test_resume_skips_meta_without_shards(tmp_path):
+    """meta.json present but shard files gone (partial deletion, or a
+    torn save from a writer without the barrier): fall back to the
+    next-older checkpoint instead of crash-looping load_model."""
+    tr = _mlp(save_sharded="1")
+    tr.update(_batch(np.random.RandomState(5)))
+    tr.save_model(checkpoint.model_path(str(tmp_path), 3))
+    bad = checkpoint.model_path(str(tmp_path), 9)
+    tr.save_model(bad)
+    os.remove(os.path.join(bad, "shards-p0.npz"))
+    found = checkpoint.find_latest_model(str(tmp_path))
+    assert found is not None and found[1] == 3
+
+
+def test_await_all_shards_times_out(tmp_path):
+    """The pre-meta barrier raises (with the shared-FS hint) when a
+    rank's shard manifest never appears."""
+    import pytest
+    (tmp_path / "shards-p0.json").write_text("[]")
+    with pytest.raises(RuntimeError, match="process\\(es\\) \\[1\\]"):
+        checkpoint._await_all_shards(str(tmp_path), 2, None, timeout=0.3)
+
+
+def test_await_all_shards_rejects_stale_nonce(tmp_path):
+    """A manifest left by an earlier torn save (different nonce) must
+    not release the barrier — only THIS attempt's manifests count."""
+    import json
+    import pytest
+    (tmp_path / "shards-p0.json").write_text(
+        json.dumps({"nonce": 111, "entries": []}))
+    (tmp_path / "shards-p1.json").write_text(
+        json.dumps({"nonce": 999, "entries": []}))   # stale attempt
+    with pytest.raises(RuntimeError, match="process\\(es\\) \\[1\\]"):
+        checkpoint._await_all_shards(str(tmp_path), 2, 111, timeout=0.3)
+
+
+def test_load_rejects_mixed_save_attempts(tmp_path):
+    """meta.json from one attempt + a shard manifest from another must
+    refuse to assemble (silent mixed-epoch weights otherwise)."""
+    import json
+    import pytest
+    tr = _mlp(save_sharded="1")
+    tr.update(_batch(np.random.RandomState(7)))
+    path = checkpoint.model_path(str(tmp_path), 1)
+    tr.save_model(path)
+    jpath = os.path.join(path, "shards-p0.json")
+    nonce, entries = checkpoint._read_manifest(jpath)
+    assert nonce is not None
+    with open(jpath, "w") as f:
+        json.dump({"nonce": nonce + 1, "entries": entries}, f)
+    with pytest.raises(ValueError, match="different save attempt"):
+        checkpoint.load_model(path)
+    # ...and find_latest_model must skip the torn dir (resume falls back
+    # rather than crash-looping on the ValueError above)
+    assert checkpoint.find_latest_model(str(tmp_path)) is None

@@ -164,7 +164,7 @@ max_round = 4
     out = err.getvalue()
     # recovery fired: checkpoint restored, eta halved, round rewound
     assert "nan_guard=2: restored" in out, out
-    assert "eta 0.1 -> 0.05" in out, out
+    assert "lr_scale 1 -> 0.5" in out, out
     # the guard itself also reported the NaN round
     assert "loss was NaN" in out
 
@@ -255,20 +255,10 @@ max_round = 3
     with contextlib.redirect_stderr(err):
         rc = main([str(conf), "silent=1"])
     assert rc == 0
-    # 0.2 is the global rate; the fc1 bucket's 0.9 must not be picked up
-    assert "eta 0.2 -> 0.1" in err.getvalue(), err.getvalue()
-
-
-def test_global_rates_scan():
-    from cxxnet_tpu.cli import _global_rates
-    cfg = [("eta", "0.2"), ("wmat:lr", "0.4"), ("lr:schedule", "expdecay"),
-           ("lr:gamma", "0.5"), ("netconfig", "start"),
-           ("eta", "0.9"), ("bias:eta", "0.8"), ("netconfig", "end"),
-           ("bias:eta", "0.05")]
-    rates = _global_rates(cfg)
-    # plain eta + tag-scoped rates, schedule subkeys and netconfig
-    # buckets excluded
-    assert rates == {"eta": 0.2, "wmat:lr": 0.4, "bias:eta": 0.05}
+    # recovery reduces the effective rate of EVERY layer — including
+    # fc1's bucket-scoped 0.9, which an appended global eta could never
+    # override — via the single lr_scale multiplier
+    assert "lr_scale 1 -> 0.5" in err.getvalue(), err.getvalue()
 
 
 def test_nan_guard_2_recovers_with_dirty_train_metric(tmp_path,
@@ -318,3 +308,51 @@ max_round = 4
         rc = main([str(conf), "silent=1"])
     assert rc == 0
     assert "nan_guard=2: restored" in err.getvalue()
+
+
+def test_nan_guard_2_halves_default_eta_when_unset(tmp_path, monkeypatch):
+    """Config never sets a global eta: recovery must still reduce the
+    effective rate (the UpdaterHyperParams default), and the log must
+    report what was actually applied."""
+    import io as _io
+    import contextlib
+    from cxxnet_tpu.cli import main
+
+    conf = tmp_path / "bad.conf"
+    conf.write_text("""
+data = train
+iter = synth
+    shape = 1,1,16
+    nclass = 4
+    ninst = 128
+    batch_size = 64
+iter = end
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 1e20
+layer[+1:r1] = relu
+layer[r1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 1e20
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 64
+dev = cpu
+metric = error
+nan_guard = 2
+save_model = 1
+num_round = 3
+max_round = 4
+""")
+    monkeypatch.chdir(tmp_path)
+    err = _io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = main([str(conf), "silent=1"])
+    assert rc == 0
+    out = err.getvalue()
+    assert "nan_guard=2: restored" in out, out
+    # the effective (default-0.01) rate is halved via lr_scale — not the
+    # fabricated 'eta 0.01 -> 0.005' claim of old, which applied nothing
+    assert "lr_scale 1 -> 0.5" in out, out

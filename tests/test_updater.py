@@ -317,3 +317,51 @@ def test_adam_decoupled_wd_is_real_decay():
     up2 = AdamUpdater(hp2)
     w2, _ = up2.update(up2.init_state(w), w, g, 0)
     assert float(w2[0]) > 10.0   # the reference quirk, faithfully kept
+
+
+def test_recovery_lr_scale_reaches_adam_fast_path():
+    """nan_guard=2's recovery multiplier must scale Adam's bit-exact
+    constant-rate branch too (no lr:schedule configured), or recovery
+    would be a silent no-op for Adam runs."""
+    import jax.numpy as jnp
+    from cxxnet_tpu.updater import AdamUpdater, UpdaterHyperParams
+
+    w = jnp.ones((4, 4))
+    g = jnp.full((4, 4), 0.3)
+
+    def step(scale):
+        hp = UpdaterHyperParams(tag="wmat", base_lr=0.1)
+        hp.set_param("recovery_lr_scale", str(scale))
+        up = AdamUpdater(hp)
+        st = up.init_state(w)
+        w2, _ = up.update(st, w, g, 0)
+        return w - w2
+
+    full, half = step(1.0), step(0.5)
+    np.testing.assert_allclose(np.asarray(half), np.asarray(full) * 0.5,
+                               rtol=1e-6)
+
+
+def test_recovery_lr_scale_rejected_in_layer_bucket():
+    """A netconfig-bucket recovery_lr_scale would replay after the
+    global append and exempt that layer from recovery — reject it like
+    clip_global_norm."""
+    import pytest
+    from cxxnet_tpu import config
+    from cxxnet_tpu.graph import NetConfig
+    from cxxnet_tpu.model import Network
+    from cxxnet_tpu.updater import NetUpdater
+
+    cfg = NetConfig()
+    cfg.configure(config.parse_string("""
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+  recovery_lr_scale = 1.0
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,4
+batch_size = 4
+"""))
+    with pytest.raises(ValueError, match="recovery_lr_scale is reserved"):
+        NetUpdater(Network(cfg, 4))
