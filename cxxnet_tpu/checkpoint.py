@@ -95,6 +95,15 @@ def load_model(path: str):
     """
     if os.path.isdir(path):
         return _load_model_sharded(path)
+    if not zipfile.is_zipfile(path):
+        # a model trained by the original C++ framework: binary
+        # [net_type][SaveNet][epoch][layer blobs] layout
+        from . import refmodel
+        if refmodel.is_reference_model(path):
+            return refmodel.read_model(path)
+        raise ValueError(
+            "%s: neither a cxxnet_tpu container nor a reference binary "
+            ".model file" % path)
     with zipfile.ZipFile(path, "r") as z:
         header = json.loads(z.read("header.json"))
         if header.get("magic") != MAGIC:

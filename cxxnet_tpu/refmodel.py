@@ -1,0 +1,382 @@
+"""Reference binary ``.model`` compatibility (read AND write).
+
+A cxxnet checkpoint is ``[int32 net_type][NetConfig::SaveNet]
+[int64 epoch_counter][string blob of per-layer SaveModel records]``
+(reference: src/cxxnet_main.cpp:165-182, src/nnet/nnet_impl-inl.hpp:82-99).
+This module parses that byte layout into the same ``(net_cfg, epoch,
+params, opt_state, net_type)`` tuple our own container yields, so a model
+trained by the original C++ framework loads, finetunes and predicts here
+unchanged — and can be written back for the reverse migration.
+
+Byte layout, little-endian (x86 structs are dumped raw):
+
+* ``NetConfig::SaveNet`` (reference: src/nnet/nnet_config.h:126-146):
+  - ``NetParam`` 152 bytes: int32 num_nodes, int32 num_layers,
+    ``mshadow::Shape<3>`` input_shape (3 x uint32), int32 init_end,
+    int32 extra_data_num, int32 reserved[31]
+    (struct at src/nnet/nnet_config.h:28-48).
+  - if extra_data_num != 0: extra_shape as vector<int>.
+  - num_nodes node-name strings.
+  - per layer: int32 LayerType, int32 primary_layer_index, string name,
+    vector<int> nindex_in, vector<int> nindex_out.
+  Strings/vectors use the utils::IStream codec — uint64 count then raw
+  elements (reference: src/utils/io.h:40-88).
+* ``epoch_counter`` is a ``long`` → int64
+  (reference: src/nnet/nnet_impl-inl.hpp:420).
+* The weight blob is written as a std::string (uint64 length prefix,
+  nnet_impl-inl.hpp:86) holding each non-shared layer's SaveModel record
+  in connection order (src/nnet/neural_net-inl.hpp:55-64):
+  - fullc:  LayerParam + wmat(2d) + bias(1d)
+            (src/layer/fullc_layer-inl.hpp:46-50)
+  - conv:   LayerParam + wmat(3d) + bias(1d)
+            (src/layer/convolution_layer-inl.hpp:44-48)
+  - batch_norm: slope(1d) + bias(1d)   (no LayerParam)
+  - bias:   LayerParam + bias(1d)
+  - prelu:  slope(1d)
+  - every other layer writes nothing (ILayer default,
+    src/layer/layer.h:273).
+* ``LayerParam`` is 328 bytes: 18 int32/float32 scalars + int32
+  reserved[64] (struct at src/layer/param.h:15-54).
+* Tensor ``SaveBinary`` (mshadow io): raw ``Shape<dim>`` (dim x uint32)
+  followed by row-major float32 data. Weight orientations are identical
+  to ours by design (layers.py stores wmat exactly like the reference),
+  so buffers transfer without transposition.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .graph import LayerInfo, NetConfig
+
+# LayerType enum (reference: src/layer/layer.h:284-313) <-> config names
+LAYER_TYPES = {
+    0: "share", 1: "fullc", 2: "softmax", 3: "relu", 4: "sigmoid",
+    5: "tanh", 6: "softplus", 7: "flatten", 8: "dropout", 10: "conv",
+    11: "max_pooling", 12: "sum_pooling", 13: "avg_pooling", 15: "lrn",
+    17: "bias", 18: "concat", 19: "xelu", 21: "relu_max_pooling",
+    22: "maxout", 23: "split", 24: "insanity", 25: "insanity_max_pooling",
+    26: "l2_loss", 27: "multi_logistic", 28: "ch_concat", 29: "prelu",
+    30: "batch_norm", 31: "fixconn",
+}
+LAYER_IDS = {v: k for k, v in LAYER_TYPES.items()}
+PAIRTEST_GAP = 1024        # src/layer/layer.h:315
+
+# LayerParam scalar fields, in struct order (src/layer/param.h:15-53)
+_LP_FIELDS = [
+    ("num_hidden", "i"), ("init_sigma", "f"), ("init_sparse", "i"),
+    ("init_uniform", "f"), ("init_bias", "f"), ("num_channel", "i"),
+    ("random_type", "i"), ("num_group", "i"), ("kernel_height", "i"),
+    ("kernel_width", "i"), ("stride", "i"), ("pad_y", "i"), ("pad_x", "i"),
+    ("no_bias", "i"), ("temp_col_max", "i"), ("silent", "i"),
+    ("num_input_channel", "i"), ("num_input_node", "i"),
+]
+_LP_STRUCT = struct.Struct("<" + "".join(f for _, f in _LP_FIELDS))
+_LP_SIZE = _LP_STRUCT.size + 64 * 4      # + int32 reserved[64]
+_NETPARAM_STRUCT = struct.Struct("<ii3Iii")  # through extra_data_num
+_NETPARAM_SIZE = _NETPARAM_STRUCT.size + 31 * 4
+
+# (has LayerParam, [(tag, tensor rank), ...]) per saving layer type;
+# reference save bodies cited in the module docstring
+_BLOB_SPEC = {
+    "fullc": (True, [("wmat", 2), ("bias", 1)]),
+    "conv": (True, [("wmat", 3), ("bias", 1)]),
+    "batch_norm": (False, [("wmat", 1), ("bias", 1)]),  # slope_, bias_
+    "bias": (True, [("bias", 1)]),
+    "prelu": (False, [("bias", 1)]),                    # slope_ as "bias"
+}
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def raw(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise ValueError(
+                "reference .model truncated at byte %d (wanted %d more)"
+                % (self.pos, n))
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def scalar(self, fmt: str):
+        s = struct.Struct("<" + fmt)
+        return s.unpack(self.raw(s.size))[0]
+
+    def string(self) -> str:
+        n = self.scalar("Q")
+        return self.raw(n).decode("latin-1")
+
+    def int_vector(self) -> List[int]:
+        n = self.scalar("Q")
+        # plain ints: these land in structure_state -> json.dumps, which
+        # rejects np.int32
+        return [int(x) for x in np.frombuffer(self.raw(4 * n), "<i4")]
+
+    def tensor(self, rank: int) -> np.ndarray:
+        shape = tuple(np.frombuffer(self.raw(4 * rank), "<u4"))
+        n = int(np.prod(shape)) if rank else 0
+        return np.frombuffer(self.raw(4 * n), "<f4").reshape(shape).copy()
+
+    def layer_param(self) -> Dict[str, float]:
+        vals = _LP_STRUCT.unpack(self.raw(_LP_STRUCT.size))
+        self.raw(64 * 4)  # reserved
+        return {k: v for (k, _), v in zip(_LP_FIELDS, vals)}
+
+
+def _type_name(type_id: int) -> str:
+    if type_id >= PAIRTEST_GAP:
+        raise NotImplementedError(
+            "reference .model contains a pairtest-encoded layer (type %d);"
+            " strip the pairtest before exporting" % type_id)
+    if type_id not in LAYER_TYPES:
+        raise ValueError("unknown reference LayerType %d" % type_id)
+    return LAYER_TYPES[type_id]
+
+
+def read_model(path: str):
+    """Parse a reference binary checkpoint.
+
+    Returns the ``checkpoint.load_model`` 5-tuple: (net_cfg, epoch,
+    params, opt_state=None, net_type). The reference format stores no
+    optimizer state (layer SaveModel writes weights only — SURVEY.md §5),
+    so resume starts with fresh momenta, exactly as the reference would.
+    """
+    with open(path, "rb") as f:
+        r = _Reader(f.read())
+    net_type = r.scalar("i")
+    num_nodes, num_layers, s0, s1, s2, init_end, extra_data_num = \
+        _NETPARAM_STRUCT.unpack(r.raw(_NETPARAM_STRUCT.size))
+    r.raw(31 * 4)  # NetParam reserved
+    extra_shape: List[int] = []
+    if extra_data_num != 0:
+        extra_shape = r.int_vector()
+    node_names = [r.string() for _ in range(num_nodes)]
+
+    net = NetConfig()
+    net.input_shape = (s0, s1, s2)
+    net.extra_data_num = extra_data_num
+    net.extra_shape = extra_shape
+    net.node_names = node_names
+    net.node_name_map = {n: i for i, n in enumerate(node_names)}
+    for i in range(num_layers):
+        tname = _type_name(r.scalar("i"))
+        info = LayerInfo(type=tname)
+        info.primary_layer_index = r.scalar("i")
+        info.name = r.string()
+        info.nindex_in = r.int_vector()
+        info.nindex_out = r.int_vector()
+        net.layers.append(info)
+        net.layercfg.append([])
+        if info.name:
+            net.layer_name_map[info.name] = i
+
+    epoch = r.scalar("q")
+    blob_len = r.scalar("Q")
+    blob = _Reader(r.raw(blob_len))
+
+    params: List[Optional[dict]] = [None] * num_layers
+    for i, info in enumerate(net.layers):
+        tname = info.type
+        if tname == "share":
+            continue   # shared layers write nothing (neural_net-inl.hpp:60)
+        spec = _BLOB_SPEC.get(tname)
+        if spec is None:
+            continue
+        has_param, tensors = spec
+        lp = blob.layer_param() if has_param else None
+        p = {tag: blob.tensor(rank) for tag, rank in tensors}
+        if lp is not None and lp["no_bias"]:
+            p.pop("bias", None)   # our no_bias layers have no bias slot
+        params[i] = p
+        if lp is not None:
+            # carry the structure-bearing hyperparams into the layer's
+            # bucket so the graph rebuilds at the blob's sizes (the
+            # reference reads them back from the blob the same way,
+            # fullc_layer-inl.hpp:51-53)
+            net.layercfg[i] = _bucket_from_layer_param(tname, lp)
+    if blob.pos != len(blob.data):
+        raise ValueError(
+            "reference .model blob has %d trailing bytes — layer spec "
+            "mismatch?" % (len(blob.data) - blob.pos))
+    # finalize like from_structure_state: configure(cfg) then VERIFIES the
+    # conf's netconfig against this structure (the reference does the
+    # same check on LoadNet) and merges the blob-derived buckets
+    net._loaded_layercfg = [list(b) for b in net.layercfg]
+    net._loaded_defcfg = []
+    net.init_end = True
+    return net, int(epoch), params, None, int(net_type)
+
+
+def _bucket_from_layer_param(tname: str, lp: Dict[str, float]):
+    if tname == "fullc":
+        keys = ["nhidden", "no_bias"]
+    elif tname == "conv":
+        keys = ["nchannel", "kernel_height", "kernel_width", "stride",
+                "pad_y", "pad_x", "ngroup", "no_bias"]
+    else:
+        return []
+    remap = {"nhidden": "num_hidden", "nchannel": "num_channel",
+             "ngroup": "num_group"}
+    return [(k, str(int(lp[remap.get(k, k)]))) for k in keys]
+
+
+def is_reference_model(path: str) -> bool:
+    """Cheap sniff: our container is a zip (``PK``); a reference file
+    starts with a small int32 net_type followed by NetParam counts."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(16)
+    except (OSError, IsADirectoryError):
+        return False
+    if len(head) < 12 or head[:2] == b"PK":
+        return False
+    net_type, num_nodes, num_layers = struct.unpack("<iii", head[:12])
+    return (0 <= net_type < 1024 and 0 < num_nodes < 100000
+            and 0 < num_layers < 100000)
+
+
+# ----------------------------------------------------------------------
+# write side: export one of OUR models as a reference-readable binary
+
+class _Writer:
+    def __init__(self):
+        self.parts: List[bytes] = []
+
+    def raw(self, b: bytes) -> None:
+        self.parts.append(b)
+
+    def scalar(self, fmt: str, v) -> None:
+        self.raw(struct.pack("<" + fmt, v))
+
+    def string(self, s: str) -> None:
+        b = s.encode("latin-1")
+        self.scalar("Q", len(b))
+        self.raw(b)
+
+    def int_vector(self, v: List[int]) -> None:
+        self.scalar("Q", len(v))
+        self.raw(np.asarray(v, "<i4").tobytes())
+
+    def tensor(self, arr: np.ndarray) -> None:
+        arr = np.ascontiguousarray(arr, "<f4")
+        self.raw(np.asarray(arr.shape, "<u4").tobytes())
+        self.raw(arr.tobytes())
+
+    def layer_param(self, lp: Dict[str, float]) -> None:
+        self.raw(_LP_STRUCT.pack(*[
+            lp.get(k, 0) for k, _ in _LP_FIELDS]))
+        self.raw(b"\0" * (64 * 4))
+
+    def getvalue(self) -> bytes:
+        return b"".join(self.parts)
+
+
+def write_model(path: str, net_cfg: NetConfig, epoch_counter: int,
+                params, net_type: int = 0) -> None:
+    """Export as a reference-readable binary ``.model``.
+
+    Inverse of :func:`read_model`; layers our framework has that the
+    reference lacks (attention, moe, ...) cannot be encoded and raise.
+    """
+    w = _Writer()
+    w.scalar("i", net_type)
+    w.raw(_NETPARAM_STRUCT.pack(
+        len(net_cfg.node_names), len(net_cfg.layers),
+        *[int(x) for x in net_cfg.input_shape],
+        1, net_cfg.extra_data_num))
+    w.raw(b"\0" * (31 * 4))
+    if net_cfg.extra_data_num != 0:
+        w.int_vector(list(net_cfg.extra_shape))
+    for n in net_cfg.node_names:
+        w.string(n)
+    for info in net_cfg.layers:
+        if info.type not in LAYER_IDS:
+            raise NotImplementedError(
+                "layer type %r has no reference LayerType encoding"
+                % info.type)
+        w.scalar("i", LAYER_IDS[info.type])
+        w.scalar("i", info.primary_layer_index)
+        w.string(info.name)
+        w.int_vector(info.nindex_in)
+        w.int_vector(info.nindex_out)
+    w.scalar("q", int(epoch_counter))
+
+    blob = _Writer()
+    for i, info in enumerate(net_cfg.layers):
+        if info.type == "share":
+            continue
+        spec = _BLOB_SPEC.get(info.type)
+        if spec is None:
+            continue
+        has_param, tensors = spec
+        p = params[i] or {}
+        if has_param:
+            blob.layer_param(_layer_param_for(
+                info.type, p, net_cfg.layercfg[i]))
+        for tag, rank in tensors:
+            if tag in p:
+                arr = np.asarray(p[tag])
+            else:   # no_bias: the reference still writes the buffer
+                arr = np.zeros(_default_missing_shape(info.type, p),
+                               "<f4")
+            if arr.ndim != rank:
+                raise ValueError(
+                    "layer %d (%s) %s: rank %d != reference rank %d"
+                    % (i, info.type, tag, arr.ndim, rank))
+            blob.tensor(arr)
+    b = blob.getvalue()
+    w.scalar("Q", len(b))
+    w.raw(b)
+    with open(path, "wb") as f:
+        f.write(w.getvalue())
+
+
+def _layer_param_for(tname: str, p: dict, bucket) -> Dict[str, float]:
+    """Synthesize the blob LayerParam from our bucket + weight shapes.
+
+    The reference's layer LoadModel REPLACES its hyperparams with this
+    struct (fullc_layer-inl.hpp:51-53), so the conv geometry must be
+    complete or an exported model would mis-infer shapes over there."""
+    from .layers import LayerParam
+    ours = LayerParam()
+    for k, v in bucket or []:
+        try:
+            ours.set_param(k, v)
+        except ValueError:
+            pass
+    lp: Dict[str, float] = {
+        "init_sigma": ours.init_sigma, "init_uniform": ours.init_uniform,
+        "init_bias": ours.init_bias, "random_type": ours.random_type,
+        "stride": ours.stride, "pad_y": ours.pad_y, "pad_x": ours.pad_x,
+        "kernel_height": ours.kernel_height,
+        "kernel_width": ours.kernel_width, "num_group": 1,
+        "no_bias": 0 if "bias" in p else 1, "temp_col_max": 64,
+    }
+    if tname == "fullc":
+        wm = np.asarray(p["wmat"])
+        lp.update(num_hidden=wm.shape[0], num_input_node=wm.shape[1])
+    elif tname == "conv":
+        wm = np.asarray(p["wmat"])
+        g, opg, ikk = wm.shape
+        lp.update(num_group=g, num_channel=g * opg)
+        if ours.kernel_height and ours.kernel_width:
+            lp["num_input_channel"] = \
+                ikk * g // (ours.kernel_height * ours.kernel_width)
+    elif tname == "bias" and "bias" in p:
+        lp.update(num_input_node=int(np.asarray(p["bias"]).shape[0]))
+    return lp
+
+
+def _default_missing_shape(tname: str, p: dict) -> Tuple[int, ...]:
+    wm = np.asarray(p["wmat"])
+    if tname == "fullc":
+        return (wm.shape[0],)
+    if tname == "conv":
+        return (wm.shape[0] * wm.shape[1],)
+    raise ValueError("cannot synthesize missing tensor for %s" % tname)
