@@ -22,6 +22,7 @@ import dataclasses
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from . import layers as L
@@ -103,6 +104,24 @@ class Network:
                 self.node_shapes[no] = shp
             self.modules.append(mod)
 
+        # space-to-depth input packing: when a conv on the data node asks
+        # for it, the trainer packs batches on the host and the conv uses
+        # the packed kernel path; every other consumer of node 0 would
+        # see the packed layout, so require exclusivity
+        self.input_s2d = 0
+        consumers = [li for li, info in enumerate(net_cfg.layers)
+                     if 0 in info.nindex_in]
+        for li in consumers:
+            mod = self.modules[li]
+            b = getattr(mod, "s2d", 0)
+            if not b:
+                continue
+            if len(consumers) != 1:
+                raise ValueError(
+                    "space_to_depth conv must be the only consumer of the "
+                    "input node (layers %s all read it)" % consumers)
+            self.input_s2d = b
+
     # ------------------------------------------------------------------
     def init_params(self, rng) -> List[Optional[dict]]:
         """Per-layer parameter dicts; shared layers hold None and read the
@@ -151,6 +170,16 @@ class Network:
             x = data.astype(self.compute_dtype)
             if self.input_norm is not None:
                 mean, scale = self.input_norm
+                mean = np.asarray(mean, np.float32)
+                c = self.cfg.input_shape[0]
+                if self.input_s2d and data.shape[1] != c:
+                    # batch arrived host-packed: pack the mean the same
+                    # way (trace-time constant; packed zero rows subtract
+                    # mean but only zero kernel taps ever read them)
+                    from .layers import s2d_pack
+                    full = np.broadcast_to(
+                        mean, tuple(self.cfg.input_shape))
+                    mean = s2d_pack(full[None], self.input_s2d)[0]
                 x = (x - jnp.asarray(mean, x.dtype)) * jnp.asarray(
                     scale, x.dtype)
             data = x

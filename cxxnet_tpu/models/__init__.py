@@ -66,6 +66,7 @@ layer[0->1] = conv:conv1
   kernel_size = 11
   stride = 4
   nchannel = 96
+  space_to_depth = 4
 layer[1->2] = relu
 layer[2->3] = max_pooling
   kernel_size = 3

@@ -42,14 +42,17 @@ def split_pair_cfg(cfg: Sequence[ConfigEntry],
     """Route config entries: unprefixed to both sides, ``master:``/``slave:``
     prefixes to one (reference pairtest_layer-inl.hpp:127-135).
 
-    When the pair is an XLA-vs-Pallas comparison (slave type is
-    ``<master>_pallas``), the master is pinned to the XLA path: on TPU the
-    base layer's auto mode would otherwise pick the Pallas kernel on both
-    sides and the differential test would be vacuous."""
+    When the slave is a forced-implementation variant of the master
+    (``<master>_pallas``, ``<master>_band``), the master is pinned to
+    the baseline XLA lowering: on TPU the base layer's auto mode would
+    otherwise resolve to the same fast implementation on both sides and
+    the differential test would be vacuous."""
     mcfg: List[ConfigEntry] = []
     scfg: List[ConfigEntry] = []
     if slave_type and slave_type == master_type + "_pallas":
         mcfg.append(("use_pallas", "0"))
+    if slave_type and slave_type == master_type + "_band":
+        mcfg.append(("lrn_impl", "window"))
     for name, val in cfg:
         if name.startswith("master:"):
             mcfg.append((name[len("master:"):], val))

@@ -74,6 +74,7 @@ class Trainer:
         self.opt_state = None
         self.grad_accum = None
         self._step_count = 0
+        self._step_specs = None
 
     # ------------------------------------------------------------------
     def set_param(self, name: str, val: str) -> None:
@@ -448,6 +449,13 @@ class Trainer:
         data = np.asarray(batch.data)
         if data.dtype != np.uint8:   # raw-pixel batches stay 1 byte/px
             data = np.asarray(data, np.float32)
+        if getattr(self.net, "input_s2d", 0) and \
+                data.ndim == 4 and \
+                data.shape[1] == self.net_cfg.input_shape[0]:
+            # pack on the host (cheap strided copy; the equivalent device
+            # transpose is lane-hostile) — see ConvolutionLayer docstring
+            from .layers import s2d_pack
+            data = s2d_pack(data, self.net.input_s2d)
         extras = tuple(np.asarray(batch.extra_data[i], np.float32)
                        for i in range(n))
         labels = ([] if batch.label is None else
@@ -563,6 +571,13 @@ class Trainer:
             data, extras, labels = self._put_batch(batch)
         self._step_count += 1
         if self.update_period == 1:
+            if self._step_specs is None:
+                # abstract arg specs for step_cost_analysis (captured
+                # before the call: donation invalidates the buffers)
+                self._step_specs = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    (self.params, self.opt_state, self._rng,
+                     self._epoch_dev, self._maccum, data, extras, labels))
             (self.params, self.opt_state, self._rng, self._epoch_dev,
              self._maccum, loss) = self._train_step(
                 self.params, self.opt_state, self._rng, self._epoch_dev,
@@ -584,12 +599,41 @@ class Trainer:
             self.epoch_counter += 1
 
     # ------------------------------------------------------------------
+    def step_cost_analysis(self) -> dict:
+        """XLA's cost model for one training step (flops, bytes accessed):
+        the honest FLOP count behind a reported MFU. Uses the HLO-level
+        analysis of a fresh lowering from the recorded arg specs — no
+        recompile, no device traffic. Requires one prior update()."""
+        if self._step_specs is None:
+            raise RuntimeError("run at least one update() first "
+                               "(update_period=1 path)")
+        lowered = self._train_step.lower(*self._step_specs)
+        ca = dict(lowered.cost_analysis() or {})
+        if not ca.get("flops"):
+            # some backends (the axon-tunneled TPU) only report at the
+            # executable level; identical shapes usually hit the
+            # compilation cache so this is cheap after the first step
+            ca = lowered.compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+        return dict(ca or {})
+
+    # ------------------------------------------------------------------
     def forward_nodes(self, batch: DataBatch,
                       node_ids: Sequence[int]) -> List[np.ndarray]:
         self._maybe_set_norm(batch)
         data, extras, _ = self._put_batch(batch)
         values = self._forward(self.params, data, extras, tuple(node_ids))
-        return [self._fetch_local(v) for v in values]
+        out = [self._fetch_local(v) for v in values]
+        s2d = getattr(self.net, "input_s2d", 0)
+        if s2d:
+            # extracting the data node must return the caller-visible
+            # (N,C,H,W) layout, not the packed conv feed
+            from .layers import s2d_unpack
+            _, h, w = self.net_cfg.input_shape
+            out = [s2d_unpack(v, s2d, (h, w)) if ni == 0 else v
+                   for ni, v in zip(node_ids, out)]
+        return out
 
     def predict(self, batch: DataBatch) -> np.ndarray:
         """Argmax (or raw scalar) of the final node

@@ -98,3 +98,33 @@ def test_lrn_window_wider_than_channels():
     # grad path too
     g = jax.grad(lambda t: lrn_pallas(t, nsize, alpha, beta, knorm).sum())(x)
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_lrn_band_pairtest_fwd_bwd():
+    """The banded-matmul LRN (the TPU auto default) against the
+    reduce_window master, fwd + bwd through the pairtest harness."""
+    rep = pairtest.compare_layers(
+        "lrn", "lrn_band", LRN_CFG, [(2, 16, 7, 9)], train=True)
+    pairtest.assert_pair_ok(rep)
+
+
+@pytest.mark.parametrize("nsize", [3, 4, 5, 9])
+def test_lrn_band_matches_window(nsize):
+    """Band matmul == reduce_window exactly (f32 CPU), incl. even windows
+    and windows wider than C, plus the jax.grad backward."""
+    from cxxnet_tpu import layers as L
+    cfg = [("local_size", str(nsize)), ("alpha", "0.002"),
+           ("beta", "0.75"), ("knorm", "1.5")]
+    band = L.create_layer("lrn", cfg + [("lrn_impl", "band")])
+    wind = L.create_layer("lrn", cfg + [("lrn_impl", "window")])
+    x = jnp.asarray(np.random.RandomState(7).randn(2, 6, 4, 5), jnp.float32)
+    ctx = L.ApplyContext(train=True, batch_size=2)
+    np.testing.assert_allclose(
+        np.asarray(band.apply({}, [x], ctx)[0]),
+        np.asarray(wind.apply({}, [x], ctx)[0]), rtol=1e-6, atol=1e-7)
+    gb = jax.grad(lambda t: jnp.sum(
+        jnp.sin(band.apply({}, [t], ctx)[0])))(x)
+    gw = jax.grad(lambda t: jnp.sum(
+        jnp.sin(wind.apply({}, [t], ctx)[0])))(x)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(gw),
+                               rtol=1e-5, atol=1e-6)
