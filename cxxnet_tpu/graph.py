@@ -49,9 +49,20 @@ KNOWN_LAYER_TYPES = frozenset([
     # and pipelined transformer stacks (depth-stacked params, scanned on
     # one chip, pipelined over the pipe axis under pipeline_parallel)
     # elewise_add closes residual/skip connections (ResNet-family nets)
-    "lrn_pallas", "attention", "moe_fullc", "transformer_stack",
+    "lrn_pallas", "lrn_band", "attention", "moe_fullc", "transformer_stack",
     "elewise_add", "embed",
 ])
+
+
+def _known_layer_type(t: str) -> bool:
+    """Config-time validation consults the LIVE layer registry so user
+    code extending the framework via @layers.register (docs/extending.md
+    — the reference's op.h/mshadow-expression extension point) can name
+    its types in a netconfig like any built-in."""
+    if t in KNOWN_LAYER_TYPES:
+        return True
+    from .layers import _REGISTRY
+    return t in _REGISTRY
 
 # self-loop loss layers (in == out node); see src/layer/loss/
 LOSS_LAYER_TYPES = frozenset(["softmax", "l2_loss", "multi_logistic"])
@@ -106,11 +117,11 @@ def parse_layer_type(val: str) -> Tuple[str, str, Optional[Tuple[str, str]], str
                 "shared layer must specify tag of layer to share with")
         share_tag = m.group(1)
         ltype = SHARED_LAYER
-    elif ltype not in KNOWN_LAYER_TYPES:
+    elif not _known_layer_type(ltype):
         raise GraphConfigError('unknown layer type: "%s"' % ltype)
     if pair is not None:
         for t in pair:
-            if t not in KNOWN_LAYER_TYPES:
+            if not _known_layer_type(t):
                 raise GraphConfigError('unknown layer type: "%s"' % t)
     return ltype, lname, pair, share_tag
 

@@ -111,11 +111,16 @@ class Network:
         self.input_s2d = 0
         consumers = [li for li, info in enumerate(net_cfg.layers)
                      if 0 in info.nindex_in]
-        for li in consumers:
-            mod = self.modules[li]
+        for li, (info, mod) in enumerate(zip(net_cfg.layers, self.modules)):
             b = getattr(mod, "s2d", 0)
             if not b:
                 continue
+            if 0 not in info.nindex_in:
+                raise ValueError(
+                    "space_to_depth is only supported on a conv reading "
+                    "the input node (layer %d reads nodes %s) — inner "
+                    "nodes are never host-packed, so it would silently "
+                    "be a no-op" % (li, info.nindex_in))
             if len(consumers) != 1:
                 raise ValueError(
                     "space_to_depth conv must be the only consumer of the "

@@ -36,23 +36,36 @@ ConfigEntry = Tuple[str, str]
 REL_ERR_TOL = 1e-5
 
 
+# forced-impl slave suffix -> (key, value) pinning the MASTER to its
+# baseline lowering; None would mean "known suffix, no safe pin"
+_MASTER_PIN = {
+    "_pallas": ("use_pallas", "0"),
+    "_band": ("lrn_impl", "window"),
+}
+
+
 def split_pair_cfg(cfg: Sequence[ConfigEntry],
                    master_type: str = "", slave_type: str = ""
                    ) -> Tuple[List[ConfigEntry], List[ConfigEntry]]:
     """Route config entries: unprefixed to both sides, ``master:``/``slave:``
     prefixes to one (reference pairtest_layer-inl.hpp:127-135).
 
-    When the slave is a forced-implementation variant of the master
-    (``<master>_pallas``, ``<master>_band``), the master is pinned to
-    the baseline XLA lowering: on TPU the base layer's auto mode would
-    otherwise resolve to the same fast implementation on both sides and
-    the differential test would be vacuous."""
+    When the slave is a forced-implementation variant of the master,
+    the master is pinned to its baseline XLA lowering: on TPU the base
+    layer's auto mode would otherwise resolve to the same fast
+    implementation on both sides and the differential test would be
+    vacuous. The pin knob is per master type (_MASTER_PIN) — a new
+    forced-impl dual must add its entry there or the pair raises."""
     mcfg: List[ConfigEntry] = []
     scfg: List[ConfigEntry] = []
-    if slave_type and slave_type == master_type + "_pallas":
-        mcfg.append(("use_pallas", "0"))
-    if slave_type and slave_type == master_type + "_band":
-        mcfg.append(("lrn_impl", "window"))
+    for suffix, knob in _MASTER_PIN.items():
+        if slave_type and slave_type == master_type + suffix:
+            if knob is None:
+                raise ValueError(
+                    "no master-pin knob registered for pair %s-%s; add "
+                    "one to pairtest._MASTER_PIN or the test is vacuous "
+                    "on TPU" % (master_type, slave_type))
+            mcfg.append(knob)
     for name, val in cfg:
         if name.startswith("master:"):
             mcfg.append((name[len("master:"):], val))

@@ -1,0 +1,146 @@
+"""User extension surface (docs/extending.md): register a custom layer
+from OUTSIDE the package and drive it through config text, training,
+checkpointing, and pairtest — the parity target for the reference's
+mshadow-expression extension story (reference: README.md:26,
+src/layer/op.h:1-105)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu import config, layers, pairtest
+from cxxnet_tpu.io import DataBatch
+from cxxnet_tpu.trainer import Trainer
+
+
+# --- "user code": defined here, outside cxxnet_tpu -------------------
+
+@layers.register("test_swish")
+class _SwishLayer(layers.Layer):
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0
+
+    def set_param(self, name, val):
+        if name == "beta":
+            self.beta = float(val)
+        else:
+            super().set_param(name, val)
+
+    def _infer(self, in_shapes):
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        return [x * jax.nn.sigmoid(self.beta * x)]
+
+
+@layers.register("test_scale")
+class _ScaleLayer(layers.Layer):
+    has_params = True
+    param_tags = ("wmat",)
+
+    def _infer(self, in_shapes):
+        self.channel = in_shapes[0][3]
+        return [in_shapes[0]]
+
+    def init_params(self, rng):
+        return {"wmat": jnp.ones((self.channel,), jnp.float32)}
+
+    def apply(self, params, inputs, ctx):
+        return [inputs[0] * params["wmat"].reshape(1, 1, 1, -1)]
+
+
+CONF = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 6
+layer[+1] = test_swish
+  beta = 1.5
+layer[+1:sc] = test_scale:sc
+layer[+1:fc2] = fullc:fc2
+  nhidden = 3
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 8
+dev = cpu
+eta = 0.1
+seed = 2
+"""
+
+
+def _batch():
+    rs = np.random.RandomState(0)
+    return DataBatch(data=rs.randn(8, 1, 1, 8).astype(np.float32),
+                     label=rs.randint(0, 3, (8, 1)).astype(np.float32))
+
+
+def test_custom_layers_train_via_config():
+    tr = Trainer()
+    for k, v in config.parse_string(CONF):
+        tr.set_param(k, v)
+    tr.init_model()
+    b = _batch()
+    w0 = tr.get_weight("sc", "wmat").copy()
+    for _ in range(4):
+        tr.update(b)
+    # the custom parameterized layer actually learned
+    assert not np.allclose(tr.get_weight("sc", "wmat"), w0)
+    # forward matches a by-hand swish/scale composition
+    fc1_w = tr.get_weight("fc1", "wmat")
+    # (just structural: predict runs through the custom layers)
+    assert tr.predict(b).shape == (8,)
+
+
+def test_custom_layer_checkpoint_roundtrip(tmp_path):
+    tr = Trainer()
+    for k, v in config.parse_string(CONF):
+        tr.set_param(k, v)
+    tr.init_model()
+    tr.update(_batch())
+    path = str(tmp_path / "0001.model")
+    tr.save_model(path)
+    tr2 = Trainer()
+    for k, v in config.parse_string(CONF):
+        tr2.set_param(k, v)
+    tr2.load_model(path)
+    np.testing.assert_allclose(tr2.get_weight("sc", "wmat"),
+                               tr.get_weight("sc", "wmat"), rtol=1e-7)
+
+
+def test_custom_layer_tag_scoped_lr():
+    """wmat:lr reaches the user layer's updater like any built-in."""
+    tr = Trainer()
+    for k, v in config.parse_string(
+            CONF + "\nwmat:lr = 0.0\n"):
+        tr.set_param(k, v)
+    tr.init_model()
+    b = _batch()
+    w0 = tr.get_weight("sc", "wmat").copy()
+    for _ in range(3):
+        tr.update(b)
+    # zero LR on the wmat tag freezes the custom layer's weight
+    np.testing.assert_allclose(tr.get_weight("sc", "wmat"), w0, atol=0)
+
+
+def test_custom_pair_differential():
+    """pairtest works on user-registered types."""
+    rep = pairtest.compare_layers("test_swish", "test_swish",
+                                  [("beta", "1.5")], [(2, 1, 1, 8)],
+                                  train=True)
+    pairtest.assert_pair_ok(rep)
+
+
+def test_unregistered_type_still_rejected():
+    from cxxnet_tpu.graph import NetConfig, GraphConfigError
+    net = NetConfig()
+    with pytest.raises(GraphConfigError, match="unknown layer type"):
+        net.configure(config.parse_string("""
+netconfig=start
+layer[+1] = definitely_not_registered
+netconfig=end
+input_shape = 1,1,8
+"""))

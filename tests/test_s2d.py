@@ -156,3 +156,35 @@ def test_s2d_extract_data_node_returns_original_layout():
     f_s2d = tr_s2d.extract_feature(b, "0")
     assert f_ref.shape == f_s2d.shape
     np.testing.assert_allclose(f_s2d, f_ref, rtol=1e-6, atol=1e-7)
+
+
+def test_s2d_rejected_on_inner_conv():
+    """space_to_depth on a conv that does not read the input node must
+    raise (inner nodes are never host-packed — it would be a silent
+    no-op)."""
+    conf = """
+netconfig=start
+layer[0->1] = conv:c0
+  kernel_size = 3
+  stride = 1
+  pad = 1
+  nchannel = 4
+layer[1->2] = conv:c1
+  kernel_size = 8
+  stride = 4
+  nchannel = 8
+  space_to_depth = 4
+layer[2->3] = flatten
+layer[3->4] = fullc:fc
+  nhidden = 5
+layer[4->4] = softmax
+netconfig=end
+input_shape = 3,32,32
+batch_size = 4
+dev = cpu
+"""
+    tr = Trainer()
+    for k, v in config.parse_string(conf):
+        tr.set_param(k, v)
+    with pytest.raises(Exception, match="input node"):
+        tr.init_model()
