@@ -171,3 +171,39 @@ def test_load_rejects_mixed_save_attempts(tmp_path):
     # ...and find_latest_model must skip the torn dir (resume falls back
     # rather than crash-looping on the ValueError above)
     assert checkpoint.find_latest_model(str(tmp_path)) is None
+
+
+def test_elastic_resume_across_device_counts(tmp_path):
+    """VERDICT r1 #5: train on the 8-device mesh with zero=3 (params
+    sharded across all replicas), save sharded, then resume on 4 devices
+    and on 1 device — assembled weights bit-identical, and training
+    continues under the new topology (reshard happens at load-time
+    device_put, the restart-anywhere continue=1 UX)."""
+    tr8 = _mlp(zero="3", save_sharded="1")
+    rs = np.random.RandomState(11)
+    b = _batch(rs)
+    for _ in range(2):
+        tr8.update(b)
+    path = checkpoint.model_path(str(tmp_path), 4)
+    tr8.save_model(path)
+    want = {(l, t): tr8.get_weight(l, t)
+            for l in ("fc1", "fc2") for t in ("wmat", "bias")}
+    # the 8-device run takes one more step: resumed runs on any topology
+    # must reproduce THIS trajectory (catches momentum lost in reshard)
+    tr8.update(b)
+    want_next = tr8.get_weight("fc1", "wmat")
+
+    for devspec, zero in (("cpu:0-3", "3"), ("cpu:0-3", "0"),
+                          ("cpu:0", "0")):
+        tr = _mlp(dev=devspec, zero=zero)
+        tr.load_model(path)
+        for (l, t), w in want.items():
+            got = tr.get_weight(l, t)
+            np.testing.assert_array_equal(got, w, err_msg="%s/%s @ %s"
+                                          % (l, t, devspec))
+        tr.update(b)   # training continues on the new mesh...
+        assert tr.epoch_counter == tr8.epoch_counter
+        # ...along the same trajectory, optimizer state included
+        np.testing.assert_allclose(tr.get_weight("fc1", "wmat"),
+                                   want_next, rtol=1e-4, atol=1e-5,
+                                   err_msg="trajectory @ %s" % devspec)

