@@ -19,10 +19,16 @@ wrapper:
 test:
 	python -m pytest tests/ -q
 
+# dev loop: skips the multi-process spawns, the reference-conf CLI
+# end-to-end runs, and the C-ABI/embedded-interpreter tests (the
+# compile-heavy tail); run `make test` before a PR
+test-fast:
+	python -m pytest tests/ -q --ignore=tests/test_multihost.py 		--ignore=tests/test_reference_configs.py 		--ignore=tests/test_capi.py
+
 bench:
 	python bench.py
 
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native wrapper test bench clean
+.PHONY: all native wrapper test test-fast bench clean

@@ -15,6 +15,20 @@ from cxxnet_tpu.parallel import force_host_cpu
 
 force_host_cpu(8)
 
+# persistent XLA compilation cache: the suite's wall time is dominated
+# by compiles (conv nets, shard_map rings), and identical programs recur
+# across runs and across the suite's subprocess spawns (multihost
+# workers, CLI/capi smoke tests). Set via the ENVIRONMENT so those
+# spawned interpreters inherit it too; .jax-cache is a sibling of
+# .pytest_cache so `pytest --cache-clear` cannot wipe an ~10-minute
+# compile investment. The 1s floor keeps tiny-op cache writes from
+# ADDING overhead.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                 ".jax-cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+
 
 def write_idx(path, arr):
     """Synthesize an MNIST idx(.gz) file: 4-byte magic (0x08=ubyte, low

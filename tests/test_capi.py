@@ -175,7 +175,11 @@ def test_net_train_predict_weights(lib, tmp_path):
 def test_standalone_c_program():
     """A pure C binary embeds the interpreter and trains end to end."""
     _build("demo")
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # PALLAS_AXON_POOL_IPS must be cleared: with it set, the embedded
+    # interpreter's plugin discovery probes the (shared, weather-prone)
+    # tunnel even under JAX_PLATFORMS=cpu — measured +35s wall at 4s
+    # cpu, and the occasional probe hang was this test's recorded flake
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
     env.pop("XLA_FLAGS", None)
     r = subprocess.run([os.path.join(NATIVE, "capi_demo")],
                        capture_output=True, text=True, timeout=600,

@@ -46,25 +46,58 @@ metric = error
 """
 
 
-def run_cli(tmp_path, conf_text, *overrides, check=True):
+def run_cli(tmp_path, conf_text, *overrides, check=True, spawn=False):
+    """Drive the CLI. In-process by default (same argv contract, but a
+    fresh subprocess costs ~5s of jax import + recompiles on this
+    1-core host — across this file that was ~1 min of suite budget);
+    ``spawn=True`` keeps one true `python -m cxxnet_tpu` smoke path."""
     conf = tmp_path / "test.conf"
     conf.write_text(conf_text)
-    env = dict(os.environ,
-               JAX_PLATFORMS="cpu",
-               PALLAS_AXON_POOL_IPS="",
-               XLA_FLAGS="--xla_force_host_platform_device_count=8",
-               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
-    proc = subprocess.run(
-        [sys.executable, "-m", "cxxnet_tpu", str(conf), *overrides],
-        capture_output=True, text=True, cwd=str(tmp_path), check=False,
-        env=env, timeout=600)
-    if check and proc.returncode != 0:
-        raise AssertionError("CLI failed:\n%s\n%s" % (proc.stdout, proc.stderr))
-    return proc
+    if spawn:
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        proc = subprocess.run(
+            [sys.executable, "-m", "cxxnet_tpu", str(conf), *overrides],
+            capture_output=True, text=True, cwd=str(tmp_path), check=False,
+            env=env, timeout=600)
+        if check and proc.returncode != 0:
+            raise AssertionError("CLI failed:\n%s\n%s"
+                                 % (proc.stdout, proc.stderr))
+        return proc
+    import contextlib
+    import io as _io
+    from types import SimpleNamespace
+    from cxxnet_tpu.cli import main
+    out, errbuf = _io.StringIO(), _io.StringIO()
+    cwd = os.getcwd()
+    os.chdir(str(tmp_path))
+    rc = 1
+    try:
+        with contextlib.redirect_stdout(out), \
+                contextlib.redirect_stderr(errbuf):
+            try:
+                rc = main([str(conf), *overrides])
+            except Exception:
+                if check:
+                    raise
+                import traceback
+                traceback.print_exc(file=errbuf)
+    finally:
+        os.chdir(cwd)
+    if check and rc != 0:
+        raise AssertionError("CLI failed:\n%s\n%s"
+                             % (out.getvalue(), errbuf.getvalue()))
+    return SimpleNamespace(returncode=rc, stdout=out.getvalue(),
+                           stderr=errbuf.getvalue())
 
 
 def test_cli_train_and_checkpoints(tmp_path):
-    proc = run_cli(tmp_path, CONF)
+    # the one true `python -m cxxnet_tpu` subprocess smoke test
+    proc = run_cli(tmp_path, CONF, spawn=True)
     # per-round eval lines on stderr, reference format
     lines = [l for l in proc.stderr.splitlines() if l.startswith("[")]
     assert len(lines) == 5

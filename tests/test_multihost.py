@@ -178,9 +178,11 @@ def test_two_process_training_agrees(tmp_path, mode):
         # restored through the reshard, not just the weights)
         ref2.update(DataBatch(data=full[0], label=lab[0]))
         ref.update(DataBatch(data=full[0], label=lab[0]))
+        # 3e-4: the pre-step ref-vs-checkpoint gap is already bounded
+        # at 1e-4 above, so the post-step comparison needs margin on top
         np.testing.assert_allclose(ref2.get_weight("fc1", "wmat"),
                                    ref.get_weight("fc1", "wmat"),
-                                   rtol=1e-4, atol=1e-5)
+                                   rtol=3e-4, atol=3e-5)
 
     # process 0 wrote the checkpoint; process 1 did not
     assert os.path.exists(outs[0] + ".model")
