@@ -134,9 +134,21 @@ class Trainer:
         self.net_cfg.configure(self.cfg)
         self._build_network()
         rng = jax.random.PRNGKey(self.seed)
-        params = self.net.init_params(rng)
         opt = NetUpdater(self.net)
-        opt_state = opt.init_state(params)
+
+        def make(rng):
+            params = self.net.init_params(rng)
+            return params, opt.init_state(params)
+        try:
+            # one compiled program instead of an eager per-op compile
+            # storm (a ~60M-param net pays ~35 tiny compiles ≈ 30s of
+            # startup on a 1-core host when run eagerly)
+            params, opt_state = jax.jit(make)(rng)
+        except (jax.errors.JAXTypeError, TypeError):
+            # a user layer's init may be untraceable (host-side file
+            # reads, tracer->numpy conversions) — eager init is always
+            # correct, just slower
+            params, opt_state = make(rng)
         self._finish_init(params, opt, opt_state)
 
     def _build_network(self) -> None:
@@ -250,8 +262,16 @@ class Trainer:
                 osh.append({tag: {slot: slot_sharding(li, tag)
                                   for slot in slots}
                             for tag, slots in s.items()})
-        self.params = jax.device_put(params, psh)
-        self.opt_state = jax.device_put(opt_state, osh)
+        if self.n_devices == 1 and jax.process_count() == 1:
+            # placement on a 1-device mesh is trivially correct, and the
+            # sharded-commit path costs ~1s per large tensor on the CPU
+            # backend (40s of AlexNet startup measured) — same
+            # optimization as _put_batch's uncommitted put
+            self.params = jax.device_put(params)
+            self.opt_state = jax.device_put(opt_state)
+        else:
+            self.params = jax.device_put(params, psh)
+            self.opt_state = jax.device_put(opt_state, osh)
         self._psh, self._dsh, self._xsh = psh, dsh, xsh
         gsh = [s or {} for s in psh]  # grad tree shardings (None -> {})
         if self.zero >= 2:

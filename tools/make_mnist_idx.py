@@ -41,12 +41,12 @@ def write_idx(path: str, arr: np.ndarray) -> None:
         f.write(head + arr.astype(np.uint8).tobytes())
 
 
-STANDARD = {
-    "train-images-idx3-ubyte.gz": "train-images-idx3-ubyte.gz",
-    "train-labels-idx1-ubyte.gz": "train-labels-idx1-ubyte.gz",
-    "t10k-images-idx3-ubyte.gz": "t10k-images-idx3-ubyte.gz",
-    "t10k-labels-idx1-ubyte.gz": "t10k-labels-idx1-ubyte.gz",
-}
+STANDARD = [
+    "train-images-idx3-ubyte.gz",
+    "train-labels-idx1-ubyte.gz",
+    "t10k-images-idx3-ubyte.gz",
+    "t10k-labels-idx1-ubyte.gz",
+]
 
 
 def from_ubyte(src: str, out: str) -> None:
@@ -57,8 +57,8 @@ def from_ubyte(src: str, out: str) -> None:
         raise SystemExit(
             "missing %s in %s — download the four MNIST .gz files there "
             "first" % (missing, src))
-    for f, dst in STANDARD.items():
-        shutil.copyfile(os.path.join(src, f), os.path.join(out, dst))
+    for f in STANDARD:
+        shutil.copyfile(os.path.join(src, f), os.path.join(out, f))
     print("MNIST idx files ready in %s" % out)
 
 
