@@ -91,3 +91,32 @@ def test_tools_partition_maker(tmp_path):
         assert binp.exists()
         total += len(list(iter_packfile(str(binp))))
     assert total == 10
+
+
+def test_imagenet_rehearsal_tool_smoke(tmp_path):
+    """tools/imagenet_rehearsal.py end to end at toy scale on CPU:
+    synth -> native im2bin multi-part pack -> test_io -> train window."""
+    import json
+    import subprocess
+    import sys
+
+    pytest.importorskip("cv2")
+    if not os.path.exists(os.path.join(REPO, "cxxnet_tpu", "lib",
+                                       "im2bin")):
+        pytest.skip("native im2bin not built")
+    report = tmp_path / "r.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "imagenet_rehearsal.py"),
+         "--images", "96", "--parts", "2", "--batch", "16",
+         "--dev", "cpu", "--train-batches", "2",
+         "--input-shape", "3,67,67",
+         "--out", str(tmp_path / "data"), "--report", str(report)],
+        capture_output=True, text=True, timeout=580,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""})
+    assert r.returncode == 0, r.stderr[-2000:]
+    rep = json.loads(report.read_text())
+    assert rep["parts"] == 2 and rep["pack_gb"] > 0
+    assert rep["test_io_images_per_sec"] > 0
+    assert rep["train_batches"] >= 2
