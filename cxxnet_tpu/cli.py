@@ -119,6 +119,8 @@ class LearnTask:
             self.task_extract()
         elif self.task == "export_model":
             self.task_export()
+        elif self.task == "generate":
+            self.task_generate()
         return 0
 
     # ------------------------------------------------------------------
@@ -194,9 +196,10 @@ class LearnTask:
                 itcfg.append((name, val))
             else:
                 defcfg.append((name, val))
-        # pred uses only its own iterator; export_model uses none at all
-        # (a serving box has the checkpoint, not the training packfiles)
-        no_train_io = self.task in ("pred", "export_model")
+        # pred uses only its own iterator; export_model and generate use
+        # none at all (a serving box has the checkpoint + prompts, not
+        # the training packfiles)
+        no_train_io = self.task in ("pred", "export_model", "generate")
         for flag, evname, itcfg in pending:
             if flag == 1 and not no_train_io:
                 assert self.itr_train is None, "can only have one data"
@@ -386,6 +389,54 @@ class LearnTask:
                 for j in range(sz):
                     fo.write("%g\n" % preds[j])
         print("finished prediction, write into %s" % self.name_pred)
+
+    def task_generate(self) -> None:
+        """task=generate: autoregressive sampling from a causal token
+        net (no reference analogue — cxxnet has no sequence models).
+        Keys: prompts (text file, one prompt of space-separated token
+        ids per line), gen_out (output path, default gen.txt), max_new
+        (tokens to append, default 32), temperature (0 = greedy),
+        gen_seed. Each output line is the prompt plus its completion."""
+        d = dict(self.cfg)
+        if "prompts" not in d:
+            raise RuntimeError("task=generate needs prompts=<file>")
+        out_path = d.get("gen_out", "gen.txt")
+        max_new = int(d.get("max_new", "32"))
+        temperature = float(d.get("temperature", "0"))
+        seed = int(d.get("gen_seed", "0"))
+        S = self.trainer.net.node_shapes[0][2]
+        rows = []
+        with open(d["prompts"]) as f:
+            for line in f:
+                ids = [int(t) for t in line.split()]
+                if not ids:
+                    continue
+                if len(ids) + max_new > S:
+                    raise RuntimeError(
+                        "prompt of %d + max_new %d exceeds seq_len %d"
+                        % (len(ids), max_new, S))
+                rows.append(ids)
+        bs = self.trainer.global_batch
+        with open(out_path, "w") as fo:
+            for lo in range(0, len(rows), bs):
+                chunk = rows[lo:lo + bs]
+                toks = np.zeros((len(chunk), S), np.int32)
+                lens = np.zeros(len(chunk), np.int32)
+                for i, ids in enumerate(chunk):
+                    toks[i, :len(ids)] = ids
+                    lens[i] = len(ids)
+                # distinct seed per chunk: a repeated seed would give
+                # correlated (or identical) sampling streams across
+                # batches of the prompts file
+                out = self.trainer.generate(toks, lens, max_new,
+                                            temperature, seed + lo)
+                for i, ids in enumerate(chunk):
+                    fo.write(" ".join(
+                        str(int(t))
+                        for t in out[i, :len(ids) + max_new]) + "\n")
+        if not self.silent:
+            print("generated %d completions into %s"
+                  % (len(rows), out_path))
 
     def task_export(self) -> None:
         """task=export_model: AOT-serialize the forward pass (weights

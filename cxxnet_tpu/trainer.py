@@ -75,6 +75,7 @@ class Trainer:
         self.grad_accum = None
         self._step_count = 0
         self._step_specs = None
+        self._gen_cache: Dict = {}
 
     # ------------------------------------------------------------------
     def set_param(self, name: str, val: str) -> None:
@@ -654,6 +655,85 @@ class Trainer:
             out = [s2d_unpack(v, s2d, (h, w)) if ni == 0 else v
                    for ni, v in zip(node_ids, out)]
         return out
+
+    def generate(self, tokens: np.ndarray, lens: np.ndarray,
+                 max_new: int, temperature: float = 0.0,
+                 seed: int = 0) -> np.ndarray:
+        """Autoregressive decoding on a causal token net (task=generate).
+
+        No reference counterpart (cxxnet has no sequence models,
+        SURVEY.md §5); this completes the LM story: train ->
+        checkpoint -> generate. ``tokens`` is (B, S) int prompt ids
+        left-aligned with per-row prompt lengths ``lens``; ``max_new``
+        tokens are appended per row (greedy at temperature 0, else
+        softmax sampling). Returns the completed (B, S) array.
+
+        The whole decode loop runs ON DEVICE as one jitted
+        ``fori_loop`` — each step re-runs the causal forward at the
+        net's fixed sequence length and samples the next position, so
+        there are no per-token host round trips (which dominate through
+        a tunneled chip) and any causal config works, attention layers
+        and stacks alike, with no KV-cache plumbing through the graph.
+        Cost is O(max_new) full forwards; at the LM recipes' lengths
+        the forward is a few ms, and correctness holds for every layer
+        the graph interpreter supports.
+        """
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "task=generate is single-process (serve from one host; "
+                "the decode loop does not assemble multi-host batches)")
+        S = self.net.node_shapes[0][2]
+        B = self.global_batch
+        tokens = np.asarray(tokens)
+        lens = np.asarray(lens, np.int32)
+        nrow = tokens.shape[0]
+        if tokens.shape[1] != S:
+            raise ValueError("prompts must be padded to the net's "
+                             "seq_len %d (got %d)" % (S, tokens.shape[1]))
+        if nrow and int(lens.min()) < 1:
+            raise ValueError("every prompt needs at least 1 token "
+                             "(a 0 length would silently corrupt its row)")
+        if int(lens.max()) + max_new > S:
+            raise ValueError(
+                "longest prompt (%d) + max_new (%d) exceeds seq_len %d"
+                % (int(lens.max()), max_new, S))
+        if nrow > B:
+            raise ValueError("at most batch_size=%d prompts per call"
+                             % B)
+        if nrow < B:   # pad rows to the compiled batch
+            tokens = np.concatenate(
+                [tokens, np.zeros((B - nrow, S), tokens.dtype)])
+            lens = np.concatenate([lens, np.ones(B - nrow, np.int32)])
+
+        key = (int(max_new), float(temperature))
+        fn = self._gen_cache.get(key)
+        if fn is None:
+            net, out_node = self.net, self.net.out_node
+
+            def gen(params, toks, lens, rng):
+                def body(i, carry):
+                    toks, rng = carry
+                    data = toks[:, None, :, None].astype(jnp.float32)
+                    values, _ = net.apply(params, data, train=False)
+                    probs = values[out_node].reshape(B, S, -1)
+                    pos = lens - 1 + i               # predict from here
+                    p = jnp.take_along_axis(
+                        probs, pos[:, None, None], axis=1)[:, 0]
+                    if temperature == 0.0:
+                        nxt = jnp.argmax(p, axis=-1)
+                    else:
+                        rng, k = jax.random.split(rng)
+                        nxt = jax.random.categorical(
+                            k, jnp.log(p + 1e-9) / temperature)
+                    toks = toks.at[jnp.arange(B), pos + 1].set(
+                        nxt.astype(toks.dtype))
+                    return toks, rng
+                return jax.lax.fori_loop(0, max_new, body, (toks, rng))[0]
+            fn = jax.jit(gen)
+            self._gen_cache[key] = fn
+        out = fn(self.params, jnp.asarray(tokens, jnp.int32),
+                 jnp.asarray(lens), jax.random.PRNGKey(seed))
+        return np.asarray(out)[:nrow]
 
     def predict(self, batch: DataBatch) -> np.ndarray:
         """Argmax (or raw scalar) of the final node

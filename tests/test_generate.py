@@ -1,0 +1,130 @@
+"""Autoregressive generation (task=generate) on the causal LM path.
+
+No reference counterpart (cxxnet has no sequence models, SURVEY.md §5):
+this pins the train -> checkpoint -> generate loop, greedy determinism,
+prompt preservation, and sampling-temperature behavior.
+"""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config, models
+from cxxnet_tpu.io import DataBatch, create_iterator
+from cxxnet_tpu.trainer import Trainer
+
+VOCAB, SEQ = 16, 24
+
+
+def _lm(seed=0):
+    tr = Trainer()
+    for k, v in config.parse_string(models.tiny_lm(
+            seq_len=SEQ, vocab=VOCAB, embed=32, nlayer=1, nhead=2)):
+        tr.set_param(k, v)
+    for k, v in (("batch_size", "8"), ("dev", "cpu:0"), ("eta", "0.3"),
+                 ("seed", str(seed)), ("metric", "token_error")):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr
+
+
+def _train_cycle(tr, rounds=30):
+    """Teach the LM the deterministic cycle t -> (t+1) % VOCAB."""
+    rs = np.random.RandomState(0)
+    for _ in range(rounds):
+        start = rs.randint(0, VOCAB, size=(8, 1))
+        seq = (start + np.arange(SEQ + 1)) % VOCAB
+        tr.update(DataBatch(
+            data=seq[:, :SEQ, None, None].transpose(0, 2, 1, 3)
+            .astype(np.float32).reshape(8, 1, SEQ, 1),
+            label=seq[:, 1:].astype(np.float32)))
+
+
+def test_generate_learns_cycle():
+    tr = _lm()
+    _train_cycle(tr)
+    toks = np.zeros((3, SEQ), np.int32)
+    prompts = [[3, 4, 5], [10, 11], [0, 1, 2, 3]]
+    lens = np.array([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    out = tr.generate(toks, lens, max_new=6, temperature=0.0)
+    for i, p in enumerate(prompts):
+        # prompt preserved verbatim
+        np.testing.assert_array_equal(out[i, :len(p)], p)
+        # the learned successor rule continues the cycle
+        want = [(p[-1] + 1 + j) % VOCAB for j in range(6)]
+        got = list(out[i, len(p):len(p) + 6])
+        assert got == want, (i, got, want)
+
+
+def test_generate_greedy_is_deterministic_and_sampling_varies():
+    tr = _lm()
+    _train_cycle(tr, rounds=4)
+    toks = np.zeros((2, SEQ), np.int32)
+    toks[:, 0] = [7, 9]
+    lens = np.array([1, 1], np.int32)
+    a = tr.generate(toks, lens, 8, temperature=0.0)
+    b = tr.generate(toks, lens, 8, temperature=0.0, seed=123)
+    np.testing.assert_array_equal(a, b)   # greedy ignores the seed
+    s1 = tr.generate(toks, lens, 8, temperature=2.0, seed=1)
+    s2 = tr.generate(toks, lens, 8, temperature=2.0, seed=2)
+    assert not np.array_equal(s1, s2)     # hot sampling varies by seed
+    assert s1.max() < VOCAB and s1.min() >= 0
+
+
+def test_generate_validates_lengths():
+    tr = _lm()
+    toks = np.zeros((1, SEQ), np.int32)
+    with pytest.raises(ValueError, match="exceeds seq_len"):
+        tr.generate(toks, np.array([SEQ - 2], np.int32), 10)
+    with pytest.raises(ValueError, match="padded"):
+        tr.generate(np.zeros((1, 8), np.int32), np.array([2]), 2)
+
+
+def test_cli_generate(tmp_path, monkeypatch):
+    """Full UX: train via CLI, then task=generate from the checkpoint."""
+    import contextlib
+    import io as _io
+    from cxxnet_tpu.cli import main
+
+    conf = tmp_path / "lm.conf"
+    conf.write_text("""
+data = train
+iter = synth
+    shape = 1,%d,1
+    token_vocab = %d
+    ninst = 64
+    lm_labels = 1
+    batch_size = 8
+iter = end
+%s
+batch_size = 8
+dev = cpu:0
+eta = 0.1
+metric = token_error
+num_round = 2
+save_model = 1
+""" % (SEQ, VOCAB, models.tiny_lm(seq_len=SEQ, vocab=VOCAB, embed=32,
+                                  nlayer=1, nhead=2)))
+    monkeypatch.chdir(tmp_path)
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+        assert main([str(conf), "silent=1"]) == 0
+    (tmp_path / "p.txt").write_text("1 2 3\n7\n")
+    with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+        rc = main([str(conf), "task=generate", "model_in=models/0001.model",
+                   "prompts=p.txt", "gen_out=g.txt", "max_new=4",
+                   "silent=1"])
+    assert rc == 0
+    lines = (tmp_path / "g.txt").read_text().strip().splitlines()
+    assert len(lines) == 2
+    first = [int(t) for t in lines[0].split()]
+    assert first[:3] == [1, 2, 3] and len(first) == 7
+    assert all(0 <= t < VOCAB for t in first)
+
+
+def test_generate_rejects_zero_length_prompt():
+    tr = _lm()
+    toks = np.zeros((2, SEQ), np.int32)
+    with pytest.raises(ValueError, match="at least 1 token"):
+        tr.generate(toks, np.array([3, 0], np.int32), 2)
