@@ -1,0 +1,214 @@
+"""KV-cache decoding for the canonical LM graph.
+
+``Trainer.generate``'s general path re-runs the full causal forward per
+emitted token — correct for ANY causal config, but O(seq^2) FLOPs per
+token. For the canonical token-LM pattern
+
+    embed -> transformer_stack (dense, causal) [-> more stacks]
+          -> fullc(seq=1) head -> softmax
+
+this module decodes with per-layer K/V caches instead: one full-prompt
+prefill, then O(seq) per token — the shape a TPU serving loop wants
+(the whole generation still runs as ONE jitted program, no per-token
+host round trips). No reference analogue (cxxnet has no sequence
+models, SURVEY.md §5).
+
+The decode math mirrors TransformerStackLayer._block_fn (pre-norm
+rmsnorm / qkv / causal attend / wo / relu-MLP residuals) on a single
+query position; tests pin exact greedy agreement with the full-forward
+generate path on the exact (XLA) attend, which is what keeps the two
+implementations locked together. On TPU, where the stack's auto attend
+resolves to the Pallas flash kernel, the decode path's exact attend
+can differ from training in low-order bits (flash's online-softmax
+reduction order) — the usual train/serve numeric gap every flash
+implementation has; greedy output only changes on near-exact logit
+ties.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import layers as L
+from .ops.ring_attention import NEG_INF as NEG
+
+
+def plan(net) -> Optional[dict]:
+    """Return a decode plan if the net matches the canonical LM pattern
+    (a linear chain: embed, dense causal transformer_stack(s), one
+    fullc(seq=1) head, softmax on the last node), else None."""
+    mods = net.modules
+    infos = net.cfg.layers
+    # linear chain: each layer consumes exactly the previous layer's node
+    prev = 0
+    for info in infos:
+        if info.nindex_in != [prev] or len(info.nindex_out) != 1:
+            return None
+        prev = info.nindex_out[0]
+    if len(mods) < 4:
+        return None
+    if not isinstance(mods[0], L.EmbeddingLayer):
+        return None
+    stacks: List[int] = []
+    i = 1
+    while i < len(mods) and isinstance(mods[i], L.TransformerStackLayer):
+        st = mods[i]
+        if not st.causal or st.moe:
+            return None
+        stacks.append(i)
+        i += 1
+    if not stacks or i + 2 != len(mods):
+        return None
+    head, loss = mods[i], mods[i + 1]
+    if not isinstance(head, L.FullConnectLayer) or not head.seq:
+        return None
+    if not isinstance(loss, L.SoftmaxLayer):
+        return None
+    return {"embed": 0, "stacks": stacks, "head": i}
+
+
+def _rmsnorm(x, g, dt):
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(ms + 1e-6)
+            ).astype(dt) * g.astype(dt)
+
+
+def build(net, p, max_new: int, temperature: float, B: int, S: int):
+    """Build the jitted (params, tokens, lens, rng) -> tokens decoder."""
+    emb = net.modules[p["embed"]]
+    stacks = [net.modules[i] for i in p["stacks"]]
+    head = net.modules[p["head"]]
+    dt = net.compute_dtype
+    e = emb.param.num_hidden
+
+    def embed_at(params, ids, pos):
+        """ids (B,), pos (B,) -> (B, e) embedding (+position)."""
+        lp = params[p["embed"]]
+        out = jnp.take(lp["wmat"], ids, axis=0).astype(dt)
+        if emb.learn_pos:
+            out = out + jnp.take(lp["pos"], pos, axis=0).astype(dt)
+        return out
+
+    def head_at(params, h):
+        lp = params[p["head"]]
+        out = jnp.dot(h.astype(dt),
+                      lp["wmat"].T.astype(dt)).astype(jnp.float32)
+        if "bias" in lp:
+            out = out + lp["bias"]
+        return out                                    # (B, V) logits
+
+    def stack_prefill(st, lp, h):
+        """Full-sequence pass that ALSO returns per-layer K/V.
+
+        Mirrors _block_fn's dense block; lax.scan over depth like the
+        training path, carrying the activations and stacking caches."""
+        nh = st.nhead
+        d = e // nh
+
+        def block(carry, layer_p):
+            hh = carry
+            x = _rmsnorm(hh, layer_p["norm1"], dt)
+            qkv = jnp.einsum("bse,fe->bsf", x, layer_p["wqkv"].astype(dt))
+            qkv = qkv.reshape(B, S, 3, nh, d).transpose(2, 0, 3, 1, 4)
+            q, k, v = qkv[0], qkv[1], qkv[2]
+            # f32 score accumulation + d^-0.5 scale, matching
+            # ops.ring_attention.attention (the stack's exact attend)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                                preferred_element_type=jnp.float32)                 * (d ** -0.5)
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            att = jax.nn.softmax(jnp.where(mask, scores, NEG), -1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", att.astype(dt), v)
+            out = out.transpose(0, 2, 1, 3).reshape(B, S, e)
+            hh = hh + jnp.einsum("bse,fe->bsf", out,
+                                 layer_p["wo"].astype(dt))
+            x = _rmsnorm(hh, layer_p["norm2"], dt)
+            y = jax.nn.relu(
+                jnp.einsum("bse,me->bsm", x, layer_p["w1"].astype(dt)))
+            y = jnp.einsum("bsm,em->bse", y, layer_p["w2"].astype(dt))
+            return hh + y, (k, v)
+        h, (ks, vs) = jax.lax.scan(block, h, lp)
+        return h, ks, vs          # caches: (L, B, nh, S, d)
+
+    def stack_decode(st, lp, h, ks, vs, pos):
+        """One-token pass: h (B, e) at position ``pos`` (B,); returns
+        updated h and caches (the token's K/V written at ``pos``)."""
+        nh = st.nhead
+        d = e // nh
+        pos_k = jnp.arange(S)[None, :]                # (1, S)
+        keep = (pos_k <= pos[:, None])                # (B, S) causal
+
+        def block(carry, layer_p_and_cache):
+            hh = carry
+            layer_p, k_c, v_c = layer_p_and_cache
+            x = _rmsnorm(hh, layer_p["norm1"], dt)
+            qkv = jnp.dot(x, layer_p["wqkv"].T.astype(dt))
+            qkv = qkv.reshape(B, 3, nh, d)
+            q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            # write this token's K/V at its position
+            onehot = (pos_k == pos[:, None]).astype(k_c.dtype)  # (B, S)
+            k_c = k_c * (1 - onehot[:, None, :, None]) \
+                + k_new[:, :, None, :] * onehot[:, None, :, None]
+            v_c = v_c * (1 - onehot[:, None, :, None]) \
+                + v_new[:, :, None, :] * onehot[:, None, :, None]
+            scores = jnp.einsum("bhd,bhkd->bhk", q, k_c,
+                                preferred_element_type=jnp.float32)                 * (d ** -0.5)
+            att = jax.nn.softmax(
+                jnp.where(keep[:, None, :], scores, NEG), -1)
+            out = jnp.einsum("bhk,bhkd->bhd", att.astype(dt), v_c)
+            out = out.reshape(B, e)
+            hh = hh + jnp.dot(out, layer_p["wo"].T.astype(dt))
+            x = _rmsnorm(hh, layer_p["norm2"], dt)
+            y = jax.nn.relu(jnp.dot(x, layer_p["w1"].T.astype(dt)))
+            y = jnp.dot(y, layer_p["w2"].T.astype(dt))
+            return hh + y, (k_c, v_c)
+        h, (ks, vs) = jax.lax.scan(block, h, (lp, ks, vs))
+        return h, ks, vs
+
+    def sample(logits, rng):
+        if temperature == 0.0:
+            return jnp.argmax(logits, -1), rng
+        rng, k = jax.random.split(rng)
+        return jax.random.categorical(k, logits / temperature), rng
+
+    def gen(params, toks, lens, rng):
+        # ---- prefill: one full causal forward building the caches ----
+        lp0 = params[p["embed"]]
+        h = jnp.take(lp0["wmat"], toks, axis=0).astype(dt)   # (B, S, e)
+        if emb.learn_pos:
+            h = h + lp0["pos"].astype(dt)[None]
+        caches = []
+        for si, st in zip(p["stacks"], stacks):
+            h, ks, vs = stack_prefill(st, params[si], h)
+            caches.append((ks, vs))
+        last = jnp.take_along_axis(
+            h, (lens - 1)[:, None, None], axis=1)[:, 0]      # (B, e)
+        logits = head_at(params, last)
+        first, rng = sample(logits, rng)
+        toks = toks.at[jnp.arange(B), lens].set(first.astype(toks.dtype))
+
+        # ---- decode: one token per step against the caches ----
+        def body(i, carry):
+            toks, caches, rng = carry
+            pos = lens + i                     # the just-written token
+            ids = toks[jnp.arange(B), pos]
+            h = embed_at(params, ids, pos)
+            new_caches = []
+            for (si, st), (ks, vs) in zip(
+                    zip(p["stacks"], stacks), caches):
+                h, ks, vs = stack_decode(st, params[si], h, ks, vs, pos)
+                new_caches.append((ks, vs))
+            logits = head_at(params, h)
+            nxt, rng = sample(logits, rng)
+            toks = toks.at[jnp.arange(B), pos + 1].set(
+                nxt.astype(toks.dtype))
+            return toks, tuple(new_caches), rng
+
+        toks, _, _ = jax.lax.fori_loop(0, max_new - 1, body,
+                                       (toks, tuple(caches), rng))
+        return toks
+
+    return jax.jit(gen)

@@ -658,7 +658,7 @@ class Trainer:
 
     def generate(self, tokens: np.ndarray, lens: np.ndarray,
                  max_new: int, temperature: float = 0.0,
-                 seed: int = 0) -> np.ndarray:
+                 seed: int = 0, use_cache: str = "auto") -> np.ndarray:
         """Autoregressive decoding on a causal token net (task=generate).
 
         No reference counterpart (cxxnet has no sequence models,
@@ -677,6 +677,13 @@ class Trainer:
         Cost is O(max_new) full forwards; at the LM recipes' lengths
         the forward is a few ms, and correctness holds for every layer
         the graph interpreter supports.
+
+        For the canonical embed -> dense causal transformer_stack ->
+        fullc(seq=1) head -> softmax graph, ``use_cache`` ("auto"
+        default) switches to KV-cache decoding (cxxnet_tpu/generate.py):
+        one prefill then O(seq) per token instead of O(seq^2), still a
+        single jitted program. "never" forces the general path (the
+        tests pin both paths to identical greedy output).
         """
         if jax.process_count() > 1:
             raise NotImplementedError(
@@ -705,8 +712,18 @@ class Trainer:
                 [tokens, np.zeros((B - nrow, S), tokens.dtype)])
             lens = np.concatenate([lens, np.ones(B - nrow, np.int32)])
 
-        key = (int(max_new), float(temperature))
+        if max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        kv_plan = None
+        if use_cache != "never":
+            from . import generate as G
+            kv_plan = G.plan(self.net)
+        key = (int(max_new), float(temperature), kv_plan is not None)
         fn = self._gen_cache.get(key)
+        if fn is None and kv_plan is not None:
+            fn = G.build(self.net, kv_plan, int(max_new),
+                         float(temperature), B, S)
+            self._gen_cache[key] = fn
         if fn is None:
             net, out_node = self.net, self.net.out_node
 

@@ -128,3 +128,41 @@ def test_generate_rejects_zero_length_prompt():
     toks = np.zeros((2, SEQ), np.int32)
     with pytest.raises(ValueError, match="at least 1 token"):
         tr.generate(toks, np.array([3, 0], np.int32), 2)
+
+
+def test_kv_cache_path_matches_full_forward():
+    """The KV-cache decoder (the auto path for the canonical LM graph)
+    must produce byte-identical greedy output to the general
+    full-forward path — this equality is what keeps the dedicated
+    decode math locked to the training stack's."""
+    from cxxnet_tpu import generate as G
+    tr = _lm()
+    _train_cycle(tr)
+    assert G.plan(tr.net) is not None   # the canonical graph is detected
+    toks = np.zeros((3, SEQ), np.int32)
+    prompts = [[3, 4, 5], [10, 11], [0, 1, 2, 3]]
+    lens = np.array([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    fast = tr.generate(toks, lens, 8, temperature=0.0)
+    slow = tr.generate(toks, lens, 8, temperature=0.0, use_cache="never")
+    np.testing.assert_array_equal(fast, slow)
+
+
+def test_kv_plan_rejects_non_canonical_graphs():
+    from cxxnet_tpu import generate as G
+    from cxxnet_tpu import models
+    tr = Trainer()
+    for k, v in config.parse_string(models.seq_classifier()):
+        tr.set_param(k, v)
+    for k, v in (("batch_size", "4"), ("dev", "cpu:0")):
+        tr.set_param(k, v)
+    tr.init_model()
+    assert G.plan(tr.net) is None       # attention-layer classifier
+
+
+def test_generate_rejects_zero_max_new():
+    tr = _lm()
+    toks = np.zeros((1, SEQ), np.int32)
+    with pytest.raises(ValueError, match="max_new"):
+        tr.generate(toks, np.array([2], np.int32), 0)
