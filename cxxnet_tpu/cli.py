@@ -121,6 +121,8 @@ class LearnTask:
             self.task_export()
         elif self.task == "generate":
             self.task_generate()
+        elif self.task == "export_reference":
+            self.task_export_reference()
         return 0
 
     # ------------------------------------------------------------------
@@ -199,7 +201,8 @@ class LearnTask:
         # pred uses only its own iterator; export_model and generate use
         # none at all (a serving box has the checkpoint + prompts, not
         # the training packfiles)
-        no_train_io = self.task in ("pred", "export_model", "generate")
+        no_train_io = self.task in ("pred", "export_model", "generate",
+                                    "export_reference")
         for flag, evname, itcfg in pending:
             if flag == 1 and not no_train_io:
                 assert self.itr_train is None, "can only have one data"
@@ -389,6 +392,28 @@ class LearnTask:
                 for j in range(sz):
                     fo.write("%g\n" % preds[j])
         print("finished prediction, write into %s" % self.name_pred)
+
+    def task_export_reference(self) -> None:
+        """task=export_reference: write the loaded model as an original-
+        framework binary .model (refmodel.write_model) so a migration
+        can also go BACK to the C++ framework. Keys: ref_out (output
+        path, default ref.model)."""
+        import jax
+
+        from . import refmodel
+        d = dict(self.cfg)
+        out = d.get("ref_out", "ref.model")
+        tr = self.trainer
+        # cross-process-sharded weights must be gathered, and only
+        # process 0 may write — the same contract as save_model
+        params_host = [None if p is None else
+                       {t: tr._fetch_global(a) for t, a in p.items()}
+                       for p in tr.params]
+        if jax.process_index() == 0:
+            refmodel.write_model(out, tr.net_cfg, tr.epoch_counter,
+                                 params_host)
+        if not self.silent:
+            print("wrote reference binary model to %s" % out)
 
     def task_generate(self) -> None:
         """task=generate: autoregressive sampling from a causal token

@@ -714,6 +714,8 @@ class Trainer:
 
         if max_new < 1:
             raise ValueError("max_new must be >= 1")
+        if use_cache not in ("auto", "never"):
+            raise ValueError("use_cache must be 'auto' or 'never'")
         kv_plan = None
         if use_cache != "never":
             from . import generate as G

@@ -261,3 +261,43 @@ def test_reference_load_then_own_save_roundtrip(tmp_path, mlp_model,
     assert tr2.epoch_counter == 77
     np.testing.assert_allclose(tr2.get_weight("fc1", "wmat"),
                                mlp_weights[0], rtol=0, atol=0)
+
+
+def test_cli_export_reference_roundtrip(tmp_path, monkeypatch):
+    """task=export_reference: our checkpoint -> reference binary, which
+    then loads back through the binary reader — the full both-ways
+    migration from the CLI."""
+    import contextlib
+    import io as _io
+    from cxxnet_tpu.cli import main
+
+    conf = tmp_path / "m.conf"
+    conf.write_text(MLP_CONF + """
+data = train
+iter = synth
+    shape = 1,1,6
+    nclass = 4
+    ninst = 32
+    batch_size = 8
+iter = end
+metric = error
+num_round = 1
+save_model = 1
+""")
+    monkeypatch.chdir(tmp_path)
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+        assert main([str(conf), "silent=1"]) == 0
+        assert main([str(conf), "task=export_reference",
+                     "model_in=models/0000.model",
+                     "ref_out=exported.model", "silent=1"]) == 0
+    assert refmodel.is_reference_model(str(tmp_path / "exported.model"))
+    net, _, params, _, _ = refmodel.read_model(
+        str(tmp_path / "exported.model"))
+    tr = Trainer()
+    for k, v in config.parse_string(MLP_CONF):
+        tr.set_param(k, v)
+    tr.load_model("models/0000.model")
+    np.testing.assert_allclose(np.asarray(params[0]["wmat"]),
+                               tr.get_weight("fc1", "wmat"),
+                               rtol=1e-6, atol=1e-7)
