@@ -185,6 +185,18 @@ class Net:
             batch = self._as_batch(data)
         return self._net.extract_feature(batch, name)
 
+    def generate(self, tokens, lens, max_new: int,
+                 temperature: float = 0.0, seed: int = 0,
+                 use_cache: str = "auto") -> np.ndarray:
+        """Autoregressive sampling on a causal token net — delegates to
+        Trainer.generate (beyond the reference wrapper, which had no
+        sequence models to sample from). ``tokens`` (B, seq_len) prompt
+        ids, ``lens`` per-row prompt lengths; ``use_cache = "never"``
+        forces the general non-KV-cache decode path."""
+        return self._net.generate(np.asarray(tokens, np.int32),
+                                  np.asarray(lens, np.int32),
+                                  max_new, temperature, seed, use_cache)
+
     # ------------------------------------------------------------------
     def set_weight(self, weight: np.ndarray, layer_name: str,
                    tag: str) -> None:

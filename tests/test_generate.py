@@ -166,3 +166,19 @@ def test_generate_rejects_zero_max_new():
     toks = np.zeros((1, SEQ), np.int32)
     with pytest.raises(ValueError, match="max_new"):
         tr.generate(toks, np.array([2], np.int32), 0)
+
+
+def test_wrapper_generate():
+    """Python-wrapper surface: Net.generate delegates to the trainer."""
+    from cxxnet_tpu import models
+    from cxxnet_tpu.wrapper import Net
+
+    net = Net(cfg=models.tiny_lm(seq_len=SEQ, vocab=VOCAB, embed=32,
+                                 nlayer=1, nhead=2)
+              + "\nbatch_size = 4\ndev = cpu:0\neta = 0.1\n")
+    net.init_model()
+    toks = np.zeros((2, SEQ), np.int32)
+    toks[:, 0] = [5, 6]
+    out = net.generate(toks, [1, 1], max_new=3)
+    assert out.shape == (2, SEQ)
+    assert out.max() < VOCAB
