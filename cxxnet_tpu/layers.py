@@ -1694,6 +1694,21 @@ class TransformerStackLayer(Layer):
         return [h.astype(jnp.float32).reshape(b, 1, s, e)]
 
 
+def _stable_logits(logits: jnp.ndarray) -> jnp.ndarray:
+    """Pre-subtract the row max before softmax/log_softmax.
+
+    jax.nn.softmax is mathematically max-stabilized, but on the TPU
+    backend XLA may reassociate the stabilization into exp(x)/exp(max),
+    which overflows for large-but-FINITE logits (observed: finite
+    logits of ~1.4e6 -> NaN probs, silently killing a converging
+    AlexNet run the moment its margins grew). With the max subtracted
+    up front every exp argument is <= 0, so no reassociation can
+    overflow. stop_gradient keeps the backward pass the standard
+    softmax gradient."""
+    return logits - jax.lax.stop_gradient(
+        jnp.max(logits, axis=-1, keepdims=True))
+
+
 @register("softmax")
 class SoftmaxLayer(_LossLayer):
     """Softmax + cross entropy (reference: src/layer/loss/softmax_layer-inl.hpp:12-36).
@@ -1710,7 +1725,7 @@ class SoftmaxLayer(_LossLayer):
             # an s-wide label field — the language-model objective (no
             # reference analogue; cxxnet's softmax is per-instance only).
             # Loss normalized per token so grad_scale semantics carry over.
-            logits = inputs[0].reshape(n, s, v)
+            logits = _stable_logits(inputs[0].reshape(n, s, v))
             probs = jax.nn.softmax(logits, axis=-1)
             if ctx.labels is not None:
                 y = self._label(ctx).astype(jnp.int32)      # (n, s)
@@ -1727,7 +1742,7 @@ class SoftmaxLayer(_LossLayer):
                                           axis=2).sum()
                 ctx.losses.append(ce * self._scale(ctx) / s)
             return [probs.reshape(inputs[0].shape)]
-        logits = _mat(inputs[0])
+        logits = _stable_logits(_mat(inputs[0]))
         probs = jax.nn.softmax(logits, axis=-1)
         if ctx.labels is not None:
             y = self._label(ctx)[:, 0].astype(jnp.int32)

@@ -208,3 +208,26 @@ def test_insanity_eval_midpoint():
     (out,) = lay.apply({}, [x], ctx(train=False))
     np.testing.assert_allclose(out.reshape(-1), [-1.0, -1 / 6.0, 0.0, 3.0],
                                rtol=1e-6)
+
+
+def test_softmax_stable_at_extreme_logits():
+    """Finite logits of ~1e6 must yield finite probs, CE and grads: on
+    the TPU backend XLA can reassociate softmax's internal max-
+    stabilization into exp(x)/exp(max) and overflow (observed killing a
+    converging AlexNet run); _stable_logits pre-subtracts the max so no
+    rewrite can overflow."""
+    lay = L.create_layer("softmax", [])
+    lay.infer_shape([(8, 1, 1, 5)])
+    big = jnp.asarray(np.random.RandomState(0).uniform(
+        -1.4e6, 1.4e6, (8, 5)).astype(np.float32)).reshape(8, 1, 1, 5)
+    y = jnp.asarray(np.arange(8) % 5, jnp.float32).reshape(8, 1)
+
+    def loss(x):
+        ctx = L.ApplyContext(train=True, batch_size=8, labels=[y])
+        out = lay.apply({}, [x], ctx)[0]
+        return ctx.losses[0], out
+
+    (ce, probs), g = jax.value_and_grad(loss, has_aux=True)(big)
+    assert np.isfinite(float(ce))
+    assert np.isfinite(np.asarray(probs)).all()
+    assert np.isfinite(np.asarray(g)).all()

@@ -34,9 +34,17 @@ sys.path.insert(0, REPO)
 
 
 def synth_jpegs(out_dir: str, lst_path: str, n: int, side: int,
-                nclass: int, seed: int = 0) -> float:
+                nclass: int, seed: int = 0,
+                labels: str = "random") -> float:
     """Write n synthetic JPEGs + the .lst index; returns MB written.
-    Structured noise compresses like natural photos (~30-60 KB each)."""
+    Structured noise compresses like natural photos (~30-60 KB each).
+
+    ``labels = "quadrant"`` makes the task LEARNABLE: the label is the
+    brightest image quadrant (4 classes), so a training run through the
+    full pipeline can show a DECLINING error trajectory — the closest
+    offline stand-in for the reference's "after about 20 round ...
+    reasonable result" AlexNet convergence check
+    (reference: example/ImageNet/README.md:52-56)."""
     import cv2
     os.makedirs(out_dir, exist_ok=True)
     rs = np.random.RandomState(seed)
@@ -51,6 +59,18 @@ def synth_jpegs(out_dir: str, lst_path: str, n: int, side: int,
             img = np.clip(img.astype(np.int16)
                           + rs.randint(-24, 24, img.shape), 0,
                           255).astype(np.uint8)
+            if labels == "quadrant":
+                # brighten one random quadrant so label == content and a
+                # random 227-of-256 crop cannot cut the signal away
+                q = rs.randint(4)
+                h2, w2 = side // 2, side // 2
+                ys, xs = (q // 2) * h2, (q % 2) * w2
+                img[ys:ys + h2, xs:xs + w2] = np.clip(
+                    img[ys:ys + h2, xs:xs + w2].astype(np.int16) + 70,
+                    0, 255).astype(np.uint8)
+                label = q
+            else:
+                label = rs.randint(nclass)
             name = "img%06d.jpg" % i
             ok, enc = cv2.imencode(".jpg", img,
                                    [cv2.IMWRITE_JPEG_QUALITY, 90])
@@ -58,7 +78,7 @@ def synth_jpegs(out_dir: str, lst_path: str, n: int, side: int,
             with open(os.path.join(out_dir, name), "wb") as g:
                 g.write(enc.tobytes())
             total += len(enc)
-            f.write("%d\t%d\t%s\n" % (i, rs.randint(nclass), name))
+            f.write("%d\t%d\t%s\n" % (i, label, name))
     return total / 1e6
 
 
@@ -90,7 +110,8 @@ def pack_parts(img_dir: str, lst_path: str, out_prefix: str,
 
 def write_conf(path: str, out_prefix: str, parts: int, batch: int,
                dev: str, threads: int,
-               input_shape: str = "3,227,227") -> None:
+               input_shape: str = "3,227,227",
+               mirror: bool = True) -> None:
     with open(path, "w") as f:
         f.write("""
 data = train
@@ -98,7 +119,7 @@ iter = imgbinx
     image_conf_prefix = %(prefix)s_part%%d
     image_conf_ids = 0-%(last)d
     rand_crop = 1
-    rand_mirror = 1
+    rand_mirror = %(mirror)d
     native_decode = 1
     decode_thread = %(threads)d
     mean_value = 120,120,120
@@ -106,7 +127,8 @@ iter = imgbinx
 iter = threadbuffer
 iter = end
 netconfig=start
-""" % {"prefix": out_prefix, "last": parts - 1, "threads": threads})
+""" % {"prefix": out_prefix, "last": parts - 1, "threads": threads,
+           "mirror": 1 if mirror else 0})
         from cxxnet_tpu import models
         body = models.alexnet(nclass=1000)
         f.write(body.split("netconfig=start")[1].split("netconfig=end")[0])
@@ -230,6 +252,10 @@ def main() -> None:
                          "256px packs)")
     ap.add_argument("--out", default="/tmp/imagenet_rehearsal")
     ap.add_argument("--report", default="rehearsal.json")
+    ap.add_argument("--labels", default="random",
+                    choices=["random", "quadrant"],
+                    help="quadrant = learnable task (brightest "
+                         "quadrant), for convergence-trajectory runs")
     ap.add_argument("--skip-synth", action="store_true",
                     help="reuse an existing --out tree")
     args = ap.parse_args()
@@ -243,7 +269,8 @@ def main() -> None:
 
     if not args.skip_synth:
         t0 = time.perf_counter()
-        mb = synth_jpegs(img_dir, lst, args.images, args.side, 1000)
+        mb = synth_jpegs(img_dir, lst, args.images, args.side, 1000,
+                         labels=args.labels)
         report["synth_seconds"] = round(time.perf_counter() - t0, 1)
         report["jpeg_mb"] = round(mb, 1)
         stats = pack_parts(img_dir, lst, prefix, args.parts)
@@ -251,8 +278,12 @@ def main() -> None:
         report.update(stats)
 
     conf = os.path.join(args.out, "rehearsal.conf")
+    # the quadrant label is not mirror-invariant: a horizontal flip
+    # moves the bright quadrant but not the label, so the learnable
+    # task must disable rand_mirror or half the labels are noise
     write_conf(conf, prefix, args.parts, args.batch, args.dev,
-               args.threads, args.input_shape)
+               args.threads, args.input_shape,
+               mirror=args.labels != "quadrant")
     io_stats = run_test_io(conf)
     report.update(io_stats)
     report["test_io_images_per_sec"] = round(
