@@ -190,11 +190,12 @@ def _await_all_shards(path: str, process_count: int, nonce,
     This is the cross-process barrier before the meta.json completeness
     marker: without it, rank 0 could stamp the directory complete while
     rank N is still writing, and a crash/concurrent reader in that
-    window would see a "complete" directory that load rejects. When a
-    ``nonce`` is set (the Trainer path broadcasts one per save attempt),
-    a manifest only counts if it carries the same nonce — stale files
-    left in a reused directory by an earlier torn save at the same
-    counter cannot satisfy the barrier."""
+    window would see a "complete" directory that load rejects. A
+    manifest only counts if it carries exactly this save attempt's
+    ``nonce`` (the Trainer path broadcasts a fresh one per attempt;
+    direct callers record None) — stale files left in a reused
+    directory by an earlier torn save at the same counter cannot
+    satisfy the barrier."""
     deadline = time.monotonic() + timeout
     pending = list(range(process_count))
     while pending:
@@ -206,7 +207,12 @@ def _await_all_shards(path: str, process_count: int, nonce,
             except (OSError, ValueError, KeyError):
                 missing.append(r)
                 continue
-            if nonce is not None and got_nonce != nonce:
+            # symmetric, like the load-side checks: this attempt's
+            # manifests carry exactly `nonce` (None included — the write
+            # path always records the key), so under nonce=None a stale
+            # nonce'd manifest from an earlier attempt must not release
+            # the barrier either
+            if got_nonce != nonce:
                 stale.append(r)
         pending = missing + stale
         if not pending:
@@ -300,7 +306,10 @@ def _load_model_sharded(path: str):
                 "checkpoint written on a shared filesystem by all "
                 "processes?" % (path, rank, header.get("process_count")))
         got_nonce, manifest = _read_manifest(jpath)
-        if header.get("nonce") is not None and got_nonce != header["nonce"]:
+        # symmetric comparison: legacy manifests (nonce None) only match
+        # legacy headers (no nonce); a nonce'd shard under a legacy header
+        # (or vice versa) is a mixed-attempt directory and must not load
+        if got_nonce != header.get("nonce"):
             raise ValueError(
                 "%s: shards-p%d.json belongs to a different save attempt "
                 "than meta.json (torn directory reuse) — refusing to "
@@ -348,8 +357,11 @@ def _sharded_dir_complete(path: str) -> bool:
             return False
         # a manifest from a different save attempt (torn re-save over a
         # previously complete directory) makes the dir unloadable — skip
-        # it here so resume falls back instead of crash-looping
-        if header.get("nonce") is not None and got_nonce != header["nonce"]:
+        # it here so resume falls back instead of crash-looping. The
+        # comparison is symmetric: a nonce'd shard under a legacy
+        # no-nonce header (torn re-save by NEW code over a pre-nonce
+        # directory) is just as mixed as the reverse.
+        if got_nonce != header.get("nonce"):
             return False
     return True
 

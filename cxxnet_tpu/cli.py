@@ -217,8 +217,9 @@ class LearnTask:
     # ------------------------------------------------------------------
     def _print_progress(self, sample_counter: int, start: float) -> None:
         """Reference progress line every print_step batches
-        (cxxnet_main.cpp:378-387)."""
-        if sample_counter % self.print_step != 0 or self.silent:
+        (cxxnet_main.cpp:378-387). ``print_step = 0`` disables it."""
+        if self.print_step <= 0 or self.silent \
+                or sample_counter % self.print_step != 0:
             return
         elapsed = int(time.time() - start)
         print("\r%80s\r" % "", end="")

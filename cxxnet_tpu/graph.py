@@ -199,6 +199,11 @@ class NetConfig:
         if name == "updater":
             self.updater_type = val
         if name == "sync":
+            # parsed for config compatibility, intentionally inert: the
+            # reference's sync= picks a PS update strategy (simple/bsp),
+            # which GSPMD subsumes — one jitted SPMD step has exactly one
+            # (synchronous all-reduce) semantics, so there is nothing to
+            # select. Kept so reference confs load unchanged.
             self.sync_type = val
         m = re.match(r"label_vec\[(\d+),(\d+)\)", name)
         if m:

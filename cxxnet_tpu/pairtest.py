@@ -36,12 +36,40 @@ ConfigEntry = Tuple[str, str]
 REL_ERR_TOL = 1e-5
 
 
-# forced-impl slave suffix -> (key, value) pinning the MASTER to its
-# baseline lowering; None would mean "known suffix, no safe pin"
+# forced-impl SLAVE TYPE NAME -> (key, value) pinning the MASTER to its
+# baseline lowering. Keyed by the full slave name, not the bare suffix:
+# pin knobs are master-family-specific (set_param silently ignores
+# unknown keys), so a new family reusing an existing suffix must get its
+# own entry — or the raise in _master_pin — rather than a wrong, inert
+# pin.
 _MASTER_PIN = {
-    "_pallas": ("use_pallas", "0"),
-    "_band": ("lrn_impl", "window"),
+    "lrn_pallas": ("use_pallas", "0"),
+    "lrn_band": ("lrn_impl", "window"),
 }
+
+
+def _master_pin(master_type: str, slave_type: str) -> Optional[ConfigEntry]:
+    """Return the config entry pinning the master's lowering for a
+    forced-impl dual, or None for an ordinary pair.
+
+    Forced-impl slaves are detected structurally — a registered layer
+    class carrying ``_pinned`` whose type name extends the master's —
+    rather than by _MASTER_PIN membership, so a new forced-impl dual
+    cannot silently skip the pin: either it has a _MASTER_PIN entry or
+    the pair raises here."""
+    if not slave_type.startswith(master_type + "_"):
+        return None
+    cls = L._REGISTRY.get(slave_type)
+    if cls is None or not getattr(cls, "_pinned", None):
+        return None
+    knob = _MASTER_PIN.get(slave_type)
+    if knob is None:
+        raise ValueError(
+            "no master-pin knob registered for pair %s-%s; add one to "
+            "pairtest._MASTER_PIN or the test is vacuous on TPU (auto "
+            "would resolve both sides to the same implementation)"
+            % (master_type, slave_type))
+    return knob
 
 
 def split_pair_cfg(cfg: Sequence[ConfigEntry],
@@ -54,17 +82,14 @@ def split_pair_cfg(cfg: Sequence[ConfigEntry],
     the master is pinned to its baseline XLA lowering: on TPU the base
     layer's auto mode would otherwise resolve to the same fast
     implementation on both sides and the differential test would be
-    vacuous. The pin knob is per master type (_MASTER_PIN) — a new
-    forced-impl dual must add its entry there or the pair raises."""
+    vacuous. The pin knob is per slave type name (_MASTER_PIN) — a new
+    forced-impl dual must add its entry there or the pair raises
+    (:func:`_master_pin`)."""
     mcfg: List[ConfigEntry] = []
     scfg: List[ConfigEntry] = []
-    for suffix, knob in _MASTER_PIN.items():
-        if slave_type and slave_type == master_type + suffix:
-            if knob is None:
-                raise ValueError(
-                    "no master-pin knob registered for pair %s-%s; add "
-                    "one to pairtest._MASTER_PIN or the test is vacuous "
-                    "on TPU" % (master_type, slave_type))
+    if master_type and slave_type:
+        knob = _master_pin(master_type, slave_type)
+        if knob is not None:
             mcfg.append(knob)
     for name, val in cfg:
         if name.startswith("master:"):

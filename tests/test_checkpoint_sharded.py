@@ -173,6 +173,52 @@ def test_load_rejects_mixed_save_attempts(tmp_path):
     assert checkpoint.find_latest_model(str(tmp_path)) is None
 
 
+def test_load_rejects_nonced_shards_under_legacy_header(tmp_path):
+    """ADVICE r2: the nonce check is symmetric. A re-save by nonce-aware
+    code over a directory whose pre-nonce meta.json survives (rank 0
+    crashed before unlinking it) leaves nonce'd shards under a no-nonce
+    header — that mixed-attempt directory must be rejected, not loaded."""
+    import json
+    import pytest
+    tr = _mlp(save_sharded="1")
+    tr.update(_batch(np.random.RandomState(7)))
+    path = checkpoint.model_path(str(tmp_path), 1)
+    tr.save_model(path)
+    mpath = os.path.join(path, "meta.json")
+    with open(mpath) as f:
+        header = json.load(f)
+    header.pop("nonce")            # legacy header, nonce'd shards remain
+    with open(mpath, "w") as f:
+        json.dump(header, f)
+    assert not checkpoint._sharded_dir_complete(path)
+    with pytest.raises(ValueError, match="different save attempt"):
+        checkpoint.load_model(path)
+
+
+def test_legacy_dir_without_nonce_still_loads(tmp_path):
+    """Fully pre-nonce directories (no nonce in header OR manifests)
+    must keep loading — the symmetric check only rejects MIXED dirs."""
+    import json
+    tr = _mlp(save_sharded="1")
+    tr.update(_batch(np.random.RandomState(7)))
+    path = checkpoint.model_path(str(tmp_path), 1)
+    tr.save_model(path)
+    mpath = os.path.join(path, "meta.json")
+    with open(mpath) as f:
+        header = json.load(f)
+    header.pop("nonce")
+    with open(mpath, "w") as f:
+        json.dump(header, f)
+    jpath = os.path.join(path, "shards-p0.json")
+    _, entries = checkpoint._read_manifest(jpath)
+    with open(jpath, "w") as f:
+        json.dump(entries, f)      # pre-nonce format: bare entry list
+    assert checkpoint._sharded_dir_complete(path)
+    net_cfg, counter, params, opt_state, net_type = \
+        checkpoint.load_model(path)
+    assert counter == 1
+
+
 def test_elastic_resume_across_device_counts(tmp_path):
     """VERDICT r1 #5: train on the 8-device mesh with zero=3 (params
     sharded across all replicas), save sharded, then resume on 4 devices

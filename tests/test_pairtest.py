@@ -42,6 +42,39 @@ def test_master_slave_param_routing():
     assert not any(k.startswith("master:") for k, _ in mcfg + scfg)
 
 
+def test_forced_impl_dual_pins_master():
+    # lrn_band is a forced-impl variant: the master must be pinned off
+    # the band lowering or the differential test is vacuous on TPU
+    mcfg, scfg = pairtest.split_pair_cfg([("local_size", "5")],
+                                         "lrn", "lrn_band")
+    assert ("lrn_impl", "window") in mcfg
+    assert ("lrn_impl", "window") not in scfg
+
+
+def test_forced_impl_dual_without_pin_entry_raises():
+    """ADVICE r2: a forced-impl dual (slave class carries _pinned) whose
+    suffix has no _MASTER_PIN entry must raise, not silently produce a
+    vacuous pair."""
+    from cxxnet_tpu import layers as L
+
+    @L.register("relu_fakeimpl")
+    class _FakeForced(L._REGISTRY["relu"]):
+        _pinned = "fakeimpl"
+
+    try:
+        with pytest.raises(ValueError, match="master-pin"):
+            pairtest.split_pair_cfg([], "relu", "relu_fakeimpl")
+    finally:
+        del L._REGISTRY["relu_fakeimpl"]
+
+
+def test_plain_suffix_pair_without_pinned_attr_is_ordinary():
+    # a type-name that merely extends another's (no _pinned attribute)
+    # is not a forced-impl dual: no pin, no raise
+    mcfg, scfg = pairtest.split_pair_cfg([], "ch", "ch_concat")
+    assert mcfg == [] and scfg == []
+
+
 def test_shape_mismatch_raises():
     with pytest.raises(ValueError):
         pairtest.compare_layers(
