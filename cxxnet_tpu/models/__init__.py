@@ -491,12 +491,21 @@ input_shape = 1,{seq_len},1
 
 
 def tiny_lm(seq_len: int = 32, vocab: int = 32, embed: int = 32,
-            nlayer: int = 2, nhead: int = 4) -> str:
+            nlayer: int = 2, nhead: int = 4, nexpert: int = 0,
+            moe_topk: int = 2, capacity_factor: float = 1.25) -> str:
     """Causal language model: embed (+positions) -> causal transformer
     stack -> position-wise vocab head -> per-position softmax CE. The
     s-wide label field carries the next token per position (the synth
     iterator's ``lm_labels = 1`` mode generates Markov data for it).
+    ``nexpert > 0`` switches the stack's MLP to mixture-of-experts.
     No reference analogue — the complete token-LM training path."""
+    moe = ""
+    if nexpert > 0:
+        moe = f"""
+  moe = 1
+  nexpert = {nexpert}
+  moe_topk = {moe_topk}
+  capacity_factor = {capacity_factor}"""
     return f"""
 netconfig=start
 layer[0->1] = embed:emb
@@ -508,7 +517,7 @@ layer[1->2] = transformer_stack:ts1
   nhead = {nhead}
   causal = 1
   nhidden_mlp = {4 * embed}
-  random_type = xavier
+  random_type = xavier{moe}
 layer[2->3] = fullc:lm_head
   nhidden = {vocab}
   seq = 1
