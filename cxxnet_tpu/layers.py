@@ -855,10 +855,20 @@ class ConvolutionLayer(Layer):
     def __init__(self):
         super().__init__()
         self.s2d = 0
+        # auto|xla|nhwc|pallas: xla = NCHW conv_general_dilated (XLA
+        # re-lays out internally); nhwc = explicit NHWC/HWIO operands
+        # (layout experiment, docs/performance.md r3); pallas =
+        # hand-written kernel (ops/conv_pallas.py). auto resolves
+        # per-platform from the recorded ablations.
+        self.impl = "auto"
 
     def set_param(self, name, val):
         if name == "space_to_depth":
             self.s2d = int(val)
+        elif name == "conv_impl":
+            if val not in ("auto", "xla", "nhwc", "pallas"):
+                raise ValueError("conv_impl must be auto|xla|nhwc|pallas")
+            self.impl = val
         else:
             super().set_param(name, val)
 
@@ -934,18 +944,61 @@ class ConvolutionLayer(Layer):
             stride, pad_y, pad_x = 1, 0, 0
         else:
             stride, pad_y, pad_x = p.stride, p.pad_y, p.pad_x
+        impl = self.impl
+        if impl == "auto":
+            impl = "xla"
         # no preferred_element_type: with a f32 result dtype the rhs-grad
         # transpose would convolve bf16 activations with a f32 cotangent,
         # which lax rejects; bf16-in/bf16-out still accumulates f32 on MXU
-        out = lax.conv_general_dilated(
-            x, kernel.astype(ctx.compute_dtype),
-            window_strides=(stride, stride),
-            padding=[(pad_y, pad_y), (pad_x, pad_x)],
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            feature_group_count=g).astype(jnp.float32)
+        if impl == "nhwc":
+            # explicit NHWC/HWIO operands: the node contract stays NCHW,
+            # the transposes sit at the conv boundary where XLA's layout
+            # assignment can absorb them into its own relayouts
+            out = lax.conv_general_dilated(
+                x.transpose(0, 2, 3, 1),
+                kernel.transpose(2, 3, 1, 0).astype(ctx.compute_dtype),
+                window_strides=(stride, stride),
+                padding=[(pad_y, pad_y), (pad_x, pad_x)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=g).astype(jnp.float32)
+            out = out.transpose(0, 3, 1, 2)
+        elif impl == "pallas":
+            from .ops.conv_pallas import conv_pallas
+            out = conv_pallas(x, kernel.astype(ctx.compute_dtype),
+                              stride=stride, pad=(pad_y, pad_x),
+                              groups=g,
+                              interpret=ctx.platform != "tpu"
+                              ).astype(jnp.float32)
+        else:
+            out = lax.conv_general_dilated(
+                x, kernel.astype(ctx.compute_dtype),
+                window_strides=(stride, stride),
+                padding=[(pad_y, pad_y), (pad_x, pad_x)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                feature_group_count=g).astype(jnp.float32)
         if p.no_bias == 0:
             out = out + params["bias"].reshape(1, -1, 1, 1)
         return [out]
+
+
+@register("conv_pallas")
+class ConvPallasLayer(ConvolutionLayer):
+    """Convolution forced onto the hand-written Pallas kernel
+    (ops/conv_pallas.py; interpreted off-TPU); exists so
+    ``pairtest-conv-conv_pallas`` differential-tests the kernel against
+    the XLA lowering (the reference ran the same master/slave pattern
+    for cudnn-vs-mshadow convs)."""
+
+    _pinned = "pallas"
+
+    def __init__(self):
+        super().__init__()
+        self.impl = self._pinned
+
+    def set_param(self, name, val):
+        if name == "conv_impl":
+            return  # pinned: this type exists to force one impl
+        super().set_param(name, val)
 
 
 def s2d_pack(data: np.ndarray, block: int) -> np.ndarray:
@@ -1117,6 +1170,11 @@ class LRNLayer(Layer):
         # banded matmul — measured 2026-07 on v5e: band 20.8ms AlexNet
         # step vs 24.4 pallas vs 28.5 reduce_window), window elsewhere
         self.impl = "auto"
+        # f32 | compute: dtype of the normalize/scale math AFTER the
+        # squared-sum (the sum itself always accumulates f32). compute
+        # (bf16 on TPU) halves the layer's HBM traffic; perf experiment
+        # knob, docs/performance.md r3
+        self.dtype_mode = "f32"
 
     def set_param(self, name, val):
         if name == "local_size":
@@ -1131,6 +1189,10 @@ class LRNLayer(Layer):
             if val not in ("auto", "window", "band", "pallas"):
                 raise ValueError("lrn_impl must be auto|window|band|pallas")
             self.impl = val
+        elif name == "lrn_dtype":
+            if val not in ("f32", "compute"):
+                raise ValueError("lrn_dtype must be f32|compute")
+            self.dtype_mode = val
         elif name == "use_pallas":   # legacy knob: -1 auto, 0 never, 1 always
             self.impl = {0: "window", 1: "pallas"}.get(int(val), "auto")
         else:
@@ -1166,12 +1228,19 @@ class LRNLayer(Layer):
             sq = jnp.square(x.astype(ctx.compute_dtype))
             norm = jnp.einsum("nchw,cd->ndhw", sq, band,
                               preferred_element_type=jnp.float32)
+            if self.dtype_mode == "compute":
+                norm = norm.astype(ctx.compute_dtype)
         else:
             # centered cross-channel window, zero-padded (chpool<sum>)
             sq = jnp.square(x)
             norm = lax.reduce_window(
                 sq, 0.0, lax.add, (1, self.nsize, 1, 1), (1, 1, 1, 1),
                 ((0, 0), (lo, hi), (0, 0), (0, 0)))
+            if self.dtype_mode == "compute":
+                # same semantics as the band path: the normalize tail
+                # runs in the compute dtype (the Pallas kernel computes
+                # f32 internally and ignores this knob)
+                norm = norm.astype(ctx.compute_dtype)
         norm = norm * salpha + self.knorm
         return [(x.astype(norm.dtype)
                  * jnp.power(norm, -self.beta)).astype(x.dtype)]

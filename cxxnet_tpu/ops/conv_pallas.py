@@ -1,0 +1,260 @@
+"""Hand-written Pallas TPU convolution (fwd + bwd, jax.custom_vjp).
+
+VERDICT r2 #1: the reference's one hand-tuned hot op is its im2col
+chunked-GEMM conv (reference: src/layer/convolution_layer-inl.hpp:79-152,
+a workspace-budgeted loop feeding cuBLAS). This is the TPU-first
+counterpart — a ROW-im2col GEMM:
+
+* XLA pre-unfolds the input along W only and pads to the TPU tile
+  grid: xf[n, h, x, dx*Ci+ci] = x_padded[n, h, x+dx, ci], with OW
+  padded to the sublane tile and K = kw*Ci to the lane tile (zero
+  columns; the matching kernel rows are zero too). The kw-fold
+  materialises kw x the input (conv2: 5x 24 MB), NOT the kh*kw x of a
+  full im2col (25x). Mosaic cannot concatenate along lanes or reshape
+  across unaligned sublanes in-kernel, so both happen where XLA is
+  good at them; the alignment makes every in-kernel reshape
+  layout-trivial.
+* The Pallas kernel then runs one MXU matmul per kernel ROW over
+  batch blocks resident in VMEM: out += xf[:, dy:dy+OH] . w[dy], f32
+  accumulation, cast once on the way out.
+
+The kw-fold is the part that matters on the MXU: contracting over
+``kw * Cin`` instead of ``Cin`` keeps the 128-deep systolic contraction
+filled for thin-channel convs (AlexNet conv2: Cin/group = 48 -> K =
+240->256 padded, ~94% fill instead of 37%).
+
+* backward dx — the SAME forward path on the cotangent with the
+  spatially-flipped, in/out-transposed kernel (stride-1 transposed
+  conv == conv with pad k-1-p).
+* backward dw — grid over batch blocks accumulating dw[dy] +=
+  patch^T . dout into a VMEM-resident (kh, K, Co) f32 output (safe:
+  the TPU grid is sequential); the cotangent's pad rows are zero so
+  they contribute nothing.
+
+Scope: stride 1 (every AlexNet mid conv, and conv1 once space_to_depth
+packs it), square or rectangular kernels, grouped via per-group
+invocation. Strided convs raise — XLA's lowering keeps them.
+
+Numerics match the XLA path (bf16 operands, f32 accumulation);
+``pairtest-conv-conv_pallas`` differential-tests both (config dual in
+tests/test_pairtest_duals.py). Measured ablation: docs/performance.md
+round 3.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANE = 128       # lane tile: K dim padded to this
+SUBLANE = 16     # sublane tile: OW padded to this (bf16's min tile)
+
+
+def _rup(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _pick_bn(n: int, hp: int, owp: int, kp: int, oh: int,
+             co: int, itemsize: int) -> int:
+    """Largest batch block (divisor of n, power of two <= 32) whose
+    working set stays under the 16 MB scoped-VMEM limit: Pallas
+    DOUBLE-BUFFERS the grid-revolving input and output blocks (fetch
+    k+1 overlaps compute k), the f32 accumulator lives on the stack,
+    and the weight block is grid-constant (fetched once)."""
+    budget = 13 * 2 ** 20
+    for bn in (32, 16, 8, 4, 2, 1):
+        if n % bn:
+            continue
+        m = bn * oh * owp
+        need = (2 * bn * hp * owp * kp * itemsize  # input block, 2x
+                + m * co * 4                       # accumulator
+                + 2 * m * co * itemsize)           # out block, 2x
+        if need <= budget:
+            return bn
+    return 1
+
+
+def _fwd_kernel(kh: int, oh: int, owp: int, x_ref, w_ref, o_ref):
+    """One batch block: out = sum_dy xf[:, dy:dy+OH] @ w[dy]."""
+    bn = x_ref.shape[0]
+    kp = x_ref.shape[3]
+    co = o_ref.shape[1]
+    m = bn * oh * owp
+    acc = jnp.zeros((m, co), jnp.float32)
+    for dy in range(kh):
+        patch = x_ref[:, dy:dy + oh, :, :].reshape(m, kp)
+        acc = acc + jnp.dot(patch, w_ref[dy],
+                            preferred_element_type=jnp.float32)
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+def _wgrad_kernel(kh: int, oh: int, owp: int, x_ref, g_ref, dw_ref):
+    """Accumulate dw[dy] += patch(dy)^T @ dout across the batch grid."""
+    bn = x_ref.shape[0]
+    kp = x_ref.shape[3]
+    m = bn * oh * owp
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+
+    gf = g_ref[:]
+    for dy in range(kh):
+        patch = x_ref[:, dy:dy + oh, :, :].reshape(m, kp)
+        dw_ref[dy, :, :] += jax.lax.dot_general(
+            patch, gf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def _unfold(xp, kw: int, ow: int, owp: int, kp: int):
+    """(N, Hp, Wp, Ci) padded input -> (N, Hp, OWp, KP) W-unfolded and
+    tile-aligned. Column index dx*Ci+ci matches _prep_w."""
+    xf = jnp.concatenate(
+        [xp[:, :, dx:dx + ow, :] for dx in range(kw)], axis=-1)
+    kwci = xf.shape[-1]
+    return jnp.pad(xf, ((0, 0), (0, 0), (0, owp - ow), (0, kp - kwci)))
+
+
+def _prep_w(w, kp: int):
+    """OIHW (Co, Ci, kh, kw) -> (kh, KP, Co), zero rows above kw*Ci."""
+    co, ci, kh, kw = w.shape
+    wr = w.transpose(2, 3, 1, 0).reshape(kh, kw * ci, co)
+    return jnp.pad(wr, ((0, 0), (0, kp - kw * ci), (0, 0)))
+
+
+def _fwd_single(xf, w, oh: int, ow: int, owp: int, interpret: bool):
+    """xf (N, Hp, OWp, KP) unfolded; w OIHW. -> (N*OH*OWp, Co)."""
+    n, hp, _, kp = xf.shape
+    co, _, kh, _ = w.shape
+    wr = _prep_w(w, kp)
+    bn = _pick_bn(n, hp, owp, kp, oh, co, xf.dtype.itemsize)
+    mb = bn * oh * owp
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, kh, oh, owp),
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, hp, owp, kp), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((kh, kp, co), lambda i: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((mb, co), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n * oh * owp, co), xf.dtype),
+        interpret=interpret,
+    )(xf, wr)
+
+
+def _wgrad_single(xf, g2, kh: int, oh: int, owp: int,
+                  interpret: bool):
+    """dw for one group: xf (N, Hp, OWp, KP) unfolded input, g2
+    (N*OH*OWp, Co) flat zero-padded cotangent -> OIHW f32."""
+    n, hp, _, kp = xf.shape
+    co = g2.shape[1]
+    bn = _pick_bn(n, hp, owp, kp, oh, co, xf.dtype.itemsize)
+    mb = bn * oh * owp
+    dw = pl.pallas_call(
+        functools.partial(_wgrad_kernel, kh, oh, owp),
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, hp, owp, kp), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((mb, co), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((kh, kp, co), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((kh, kp, co), jnp.float32),
+        interpret=interpret,
+    )(xf, g2)
+    return dw
+
+
+def _group_slices(arr, groups: int):
+    per = arr.shape[-1] // groups
+    return [arr[..., gi * per:(gi + 1) * per] for gi in range(groups)]
+
+
+def _run_fwd(x, w, pad, groups: int, interpret: bool):
+    n, c, h, wdim = x.shape
+    co, _, kh, kw = w.shape
+    py, px = pad
+    oh = h + 2 * py - kh + 1
+    ow = wdim + 2 * px - kw + 1
+    owp = _rup(ow, SUBLANE)
+    kp = _rup(kw * (c // groups), LANE)
+    xt = jnp.pad(x.transpose(0, 2, 3, 1),
+                 ((0, 0), (py, py), (px, px), (0, 0)))
+    outs = []
+    for gi, xg in enumerate(_group_slices(xt, groups)):
+        wg = w[gi * (co // groups):(gi + 1) * (co // groups)]
+        xf = _unfold(xg, kw, ow, owp, kp)
+        o = _fwd_single(xf, wg, oh, ow, owp, interpret)
+        outs.append(o.reshape(n, oh, owp, co // groups))
+    out = outs[0] if groups == 1 else jnp.concatenate(outs, axis=-1)
+    return out[:, :, :ow, :].transpose(0, 3, 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def conv_pallas(x, w, stride: int = 1, pad=(0, 0), groups: int = 1,
+                interpret: bool = False):
+    """Grouped 2D convolution, NCHW x OIHW -> NCHW, stride 1 only.
+
+    Drop-in for the ConvolutionLayer's ``lax.conv_general_dilated``
+    call (same operand contract, same bf16-operand/f32-accumulate
+    numerics); selected with ``conv_impl = pallas``."""
+    if stride != 1:
+        raise ValueError(
+            "conv_impl=pallas supports stride 1 only (every AlexNet "
+            "mid conv; conv1 becomes stride 1 under space_to_depth) — "
+            "keep conv_impl=auto/xla for strided convs")
+    kh, kw = w.shape[2], w.shape[3]
+    if pad[0] >= kh or pad[1] >= kw:
+        # the backward dx conv uses pad k-1-p, which would go negative
+        raise ValueError(
+            "conv_impl=pallas needs pad < kernel_size (got pad %s for "
+            "kernel %dx%d) — keep conv_impl=auto/xla for wider pads"
+            % (pad, kh, kw))
+    return _run_fwd(x, w, pad, groups, interpret)
+
+
+def _conv_fwd(x, w, stride, pad, groups, interpret):
+    return conv_pallas(x, w, stride, pad, groups, interpret), (x, w)
+
+
+def _conv_bwd(stride, pad, groups, interpret, res, g):
+    x, w = res
+    n, c, h, wdim = x.shape
+    co, _, kh, kw = w.shape
+    py, px = pad
+    oh = h + 2 * py - kh + 1
+    ow = wdim + 2 * px - kw + 1
+    owp = _rup(ow, SUBLANE)
+    kp = _rup(kw * (c // groups), LANE)
+    g = g.astype(x.dtype)
+
+    # dx: transposed conv == conv of the cotangent, pad k-1-p, with the
+    # spatially-flipped kernel, in/out channels swapped
+    wt = w.reshape(groups, co // groups, c // groups, kh, kw)
+    wt = wt[:, :, :, ::-1, ::-1].transpose(0, 2, 1, 3, 4).reshape(
+        c, co // groups, kh, kw)
+    dx = _run_fwd(g, wt, (kh - 1 - py, kw - 1 - px), groups, interpret)
+
+    # dw: per-group patch^T @ cotangent over the same unfolded input;
+    # the cotangent is zero-padded to OWp so pad rows contribute nothing
+    xt = jnp.pad(x.transpose(0, 2, 3, 1),
+                 ((0, 0), (py, py), (px, px), (0, 0)))
+    gt = jnp.pad(g.transpose(0, 2, 3, 1),
+                 ((0, 0), (0, 0), (0, owp - ow), (0, 0)))
+    ci = c // groups
+    dws = []
+    for xg, gg in zip(_group_slices(xt, groups),
+                      _group_slices(gt, groups)):
+        xf = _unfold(xg, kw, ow, owp, kp)
+        g2 = gg.reshape(n * oh * owp, co // groups)
+        dwp = _wgrad_single(xf, g2, kh, oh, owp, interpret)
+        # (kh, KP, Co) -> drop K pad -> OIHW
+        dwp = dwp[:, :kw * ci, :].reshape(kh, kw, ci, co // groups)
+        dws.append(dwp.transpose(3, 2, 0, 1))
+    dw = dws[0] if groups == 1 else jnp.concatenate(dws, axis=0)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+conv_pallas.defvjp(_conv_fwd, _conv_bwd)

@@ -45,6 +45,7 @@ REL_ERR_TOL = 1e-5
 _MASTER_PIN = {
     "lrn_pallas": ("use_pallas", "0"),
     "lrn_band": ("lrn_impl", "window"),
+    "conv_pallas": ("conv_impl", "xla"),
 }
 
 

@@ -166,3 +166,25 @@ def test_degenerate_moe_nexpert1_equals_fullc():
               "gin": float(pairtest.rel_err(gi_m[0], gi_s[0]))}
     report.update(dict(pairtest._tree_rel_errs("gw", gp_m, gp_s)))
     pairtest.assert_pair_ok(report)
+
+
+def test_config_pairtest_conv_vs_pallas():
+    """VERDICT r2 #1: the hand-written Pallas conv differential-tested
+    against the XLA lowering through a real net config (the reference's
+    cudnn-vs-mshadow pattern); the master is pinned to conv_impl=xla by
+    _MASTER_PIN so the pair stays meaningful on TPU."""
+    _train_conf("""
+netconfig=start
+layer[0->1] = pairtest-conv-conv_pallas
+  kernel_size = 5
+  pad = 2
+  nchannel = 4
+  ngroup = 2
+  random_type = xavier
+layer[1->2] = relu
+layer[2->3] = flatten
+layer[3->4] = fullc:fc
+  nhidden = 3
+layer[4->4] = softmax
+netconfig=end
+""", (4, 9, 9))

@@ -128,3 +128,38 @@ def test_lrn_band_matches_window(nsize):
         jnp.sin(wind.apply({}, [t], ctx)[0])))(x)
     np.testing.assert_allclose(np.asarray(gb), np.asarray(gw),
                                rtol=1e-5, atol=1e-6)
+
+
+CONV_CFG = [("kernel_size", "5"), ("pad", "2"), ("nchannel", "8"),
+            ("ngroup", "2"), ("random_type", "xavier")]
+
+
+def test_conv_pallas_pairtest_fwd_bwd():
+    rep = pairtest.compare_layers(
+        "conv", "conv_pallas", CONV_CFG, [(2, 6, 13, 13)], train=True)
+    pairtest.assert_pair_ok(rep)
+
+
+@pytest.mark.parametrize("cfg,shape", [
+    ([("kernel_size", "3"), ("pad", "1"), ("nchannel", "8")],
+     (2, 4, 9, 9)),
+    ([("kernel_size", "3"), ("pad", "1"), ("nchannel", "8"),
+      ("no_bias", "1"), ("ngroup", "2")], (2, 8, 7, 7)),
+    ([("kernel_size", "5"), ("nchannel", "4")], (2, 3, 11, 11)),
+])
+def test_conv_pallas_matches_xla(cfg, shape):
+    rep = pairtest.compare_layers(
+        "conv", "conv_pallas", cfg + [("random_type", "xavier")],
+        [shape], train=True)
+    pairtest.assert_pair_ok(rep)
+
+
+def test_conv_pallas_rejects_stride():
+    from cxxnet_tpu import layers as L
+    layer = L.create_layer("conv_pallas", [
+        ("kernel_size", "3"), ("stride", "2"), ("nchannel", "4")])
+    layer.infer_shape([(2, 3, 9, 9)])
+    params = layer.init_params(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="stride 1"):
+        layer.apply(params, [jnp.zeros((2, 3, 9, 9))],
+                    pairtest.L.ApplyContext(batch_size=2))

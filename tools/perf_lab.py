@@ -1,0 +1,287 @@
+"""On-chip performance lab: ablations + prefix-net marginals (round 3).
+
+Measurement protocol (BASELINE.md, docs/performance.md): the shared
+tunnel in front of the chip swings with other tenants' load and every
+dispatch carries a ~3.5 ms floor, so
+
+* only FULL-STEP times are recorded (standalone op timings are
+  dispatch-bound);
+* every window is fenced by a REAL device->host fetch of the carried
+  epoch counter (`np.asarray(tr._epoch_dev)` — `block_until_ready`
+  does not fence through the tunnel);
+* variants are timed INTERLEAVED best-of-N, so tunnel weather hits
+  every variant equally and the minima are comparable.
+
+Subcommands:
+
+* ``ablate`` — full AlexNet step under layer-impl variants
+  (conv_impl / lrn_dtype / ...), the experiment VERDICT r2 #1 asks for.
+* ``marginals`` — step time of cumulative AlexNet prefixes (each with a
+  tiny fixed head); successive differences attribute the step budget
+  per layer group. Optional ``--conv-impl``/``--lrn-dtype`` rerun the
+  attribution under a variant.
+
+Results print as one JSON line per measurement; paste-ready for
+docs/performance.md.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH = 256
+NCLASS = 10          # tiny head for prefix nets; full net uses 1000
+
+# AlexNet as (type[:name], params, same_node) blocks so cumulative
+# prefixes can be emitted with correct node numbering (mirrors
+# models.alexnet, which stays the single source of truth for real runs)
+ALEX_BLOCKS = [
+    ("conv:conv1", {"kernel_size": 11, "stride": 4, "nchannel": 96,
+                    "space_to_depth": 4}, False),
+    ("relu", {}, False),
+    ("max_pooling", {"kernel_size": 3, "stride": 2}, False),
+    ("lrn", {"local_size": 5, "alpha": 0.001, "beta": 0.75, "knorm": 1},
+     False),
+    ("conv:conv2", {"ngroup": 2, "kernel_size": 5, "pad": 2,
+                    "nchannel": 256}, False),
+    ("relu", {}, False),
+    ("max_pooling", {"kernel_size": 3, "stride": 2}, False),
+    ("lrn", {"local_size": 5, "alpha": 0.001, "beta": 0.75, "knorm": 1},
+     False),
+    ("conv:conv3", {"kernel_size": 3, "pad": 1, "nchannel": 384}, False),
+    ("relu", {}, False),
+    ("conv:conv4", {"ngroup": 2, "kernel_size": 3, "pad": 1,
+                    "nchannel": 384}, False),
+    ("relu", {}, False),
+    ("conv:conv5", {"ngroup": 2, "kernel_size": 3, "pad": 1,
+                    "nchannel": 256, "init_bias": 1.0}, False),
+    ("relu", {}, False),
+    ("max_pooling", {"kernel_size": 3, "stride": 2}, False),
+    ("flatten", {}, False),
+    ("fullc:fc6", {"nhidden": 4096, "init_sigma": 0.005,
+                   "init_bias": 1.0}, False),
+    ("relu", {}, False),
+    ("dropout", {"threshold": 0.5}, True),
+    ("fullc:fc7", {"nhidden": 4096, "init_sigma": 0.005,
+                   "init_bias": 1.0}, False),
+    ("relu", {}, False),
+    ("dropout", {"threshold": 0.5}, True),
+]
+
+# prefix measurement points: (label, #blocks included, spatial dim of
+# the prefix output — sizes the probe head's global avg pool)
+PREFIXES = [
+    ("input+conv1", 2, 55),      # conv1 + relu
+    ("pool1", 3, 27),
+    ("lrn1", 4, 27),
+    ("conv2", 6, 27),            # conv2 + relu
+    ("pool2", 7, 13),
+    ("lrn2", 8, 13),
+    ("conv3", 10, 13),
+    ("conv4", 12, 13),
+    ("conv5", 14, 13),
+    ("pool3", 15, 6),
+    ("fc6+fc7", 22, 1),
+]
+
+
+def emit_net(nblocks, nclass, spatial):
+    """Netconfig text for the first nblocks of AlexNet plus a tiny
+    fixed head (global avg pool -> fullc(32) -> softmax) so successive
+    prefix steps differ only by the appended blocks: the pool costs one
+    read of the prefix output, and the fullc behind it is O(C) — unlike
+    a flatten head, whose weight scales with the prefix's spatial size
+    and distorts the marginals by several ms at 55x55."""
+    lines = ["netconfig=start"]
+    node = 0
+    for btype, params, same in ALEX_BLOCKS[:nblocks]:
+        dst = node if same else node + 1
+        lines.append("layer[%d->%d] = %s" % (node, dst, btype))
+        for k, v in params.items():
+            lines.append("  %s = %s" % (k, v))
+        node = dst
+    if nblocks < len(ALEX_BLOCKS) and spatial > 1:
+        lines.append("layer[%d->%d] = avg_pooling" % (node, node + 1))
+        lines.append("  kernel_size = %d" % spatial)
+        lines.append("  stride = %d" % spatial)
+        node += 1
+    lines.append("layer[%d->%d] = flatten" % (node, node + 1))
+    lines.append("layer[%d->%d] = fullc:probe_head" % (node + 1,
+                                                       node + 2))
+    lines.append("  nhidden = %d" % max(nclass, 32))
+    node += 2
+    lines.append("layer[%d->%d] = softmax" % (node, node))
+    lines.append("netconfig=end")
+    lines.append("input_shape = 3,227,227")
+    return "\n".join(lines) + "\n"
+
+
+def build(overrides, text, nclass, retries=3):
+    """Build + init a trainer, retrying transient tunnel/compile drops
+    (the remote-compile link in front of the chip occasionally closes
+    mid-response under contention)."""
+    for attempt in range(retries):
+        try:
+            return _build_once(overrides, text, nclass)
+        except Exception as e:
+            if attempt == retries - 1 or "remote_compile" not in str(e):
+                raise
+            sys.stderr.write("build retry after tunnel drop: %s\n" % e)
+            time.sleep(5.0)
+
+
+def _build_once(overrides, text, nclass):
+    import jax
+
+    from cxxnet_tpu import config
+    from cxxnet_tpu.trainer import Trainer
+
+    platform = jax.devices()[0].platform
+    tr = Trainer()
+    for k, v in config.parse_string(text):
+        tr.set_param(k, v)
+    tr.set_param("batch_size", str(BATCH))
+    tr.set_param("dev", platform)
+    tr.set_param("dtype", "bfloat16" if platform == "tpu" else "float32")
+    tr.set_param("eta", "0.01")
+    tr.set_param("momentum", "0.9")
+    tr.set_param("metric", "error")
+    tr.set_param("eval_train", "0")
+    for k, v in overrides:
+        tr.set_param(k, str(v))
+    tr.init_model()
+    return tr
+
+
+def staged_batches(tr, nclass, n=4):
+    from cxxnet_tpu.io import DataBatch
+    rs = np.random.RandomState(0)
+    return [tr.stage(DataBatch(
+        data=rs.randint(0, 256, size=(BATCH, 3, 227, 227),
+                        dtype=np.uint8),
+        label=rs.randint(0, nclass, size=(BATCH, 1)).astype(np.float32),
+        norm=(np.full((3, 1, 1), 120.0, np.float32), 1.0)))
+        for _ in range(n)]
+
+
+def time_steps(tr, staged, iters):
+    t0 = time.perf_counter()
+    for i in range(iters):
+        tr.update(staged[i % len(staged)])
+    np.asarray(tr._epoch_dev)            # real D2H fence
+    return (time.perf_counter() - t0) / iters * 1000.0
+
+
+def interleave(entries, iters, trials, warmup):
+    """entries: [(name, trainer, staged)]; returns {name: best_ms}."""
+    for _, tr, st in entries:
+        time_steps(tr, st, warmup)
+    best = {name: float("inf") for name, _, _ in entries}
+    for t in range(trials):
+        for name, tr, st in entries:
+            ms = time_steps(tr, st, iters)
+            best[name] = min(best[name], ms)
+        sys.stderr.write("trial %d: %s\n" % (
+            t, {k: round(v, 2) for k, v in best.items()}))
+    return best
+
+
+def patch_layer(text, layer_name, param, value):
+    """Insert a per-layer param under ``layer[..] = type:NAME`` in a
+    netconfig text (per-layer variants the global defcfg can't express,
+    e.g. pallas on conv2 only)."""
+    needle = ":%s\n" % layer_name
+    at = text.index(needle) + len(needle)
+    return text[:at] + "  %s = %s\n" % (param, value) + text[at:]
+
+
+def cmd_ablate(args):
+    from cxxnet_tpu import models
+    variants = [
+        ("base", []),
+        ("conv_nhwc", [("conv_impl", "nhwc")]),
+        ("lrn_bf16", [("lrn_dtype", "compute")]),
+        ("nhwc+lrn_bf16", [("conv_impl", "nhwc"),
+                           ("lrn_dtype", "compute")]),
+    ]
+    if args.variant:
+        variants = [v for v in variants if v[0] in args.variant]
+    if args.extra:
+        for spec in args.extra:          # name:k=v,k=v
+            name, _, kvs = spec.partition(":")
+            ov = [tuple(kv.split("=", 1)) for kv in kvs.split(",") if kv]
+            variants.append((name, ov))
+    entries = []
+    for name, ov in variants:
+        text = models.alexnet(nclass=1000)
+        globals_ = []
+        for k, v in ov:
+            if "." in k:                 # layer.param=v -> per-layer
+                lname, param = k.split(".", 1)
+                text = patch_layer(text, lname, param, v)
+            else:
+                globals_.append((k, v))
+        tr = build(globals_, text, 1000)
+        entries.append((name, tr, staged_batches(tr, 1000)))
+    best = interleave(entries, args.iters, args.trials, args.warmup)
+    base = best.get("base")
+    for name, ms in best.items():
+        print(json.dumps({
+            "experiment": "ablate", "variant": name,
+            "step_ms": round(ms, 3),
+            "images_per_sec": round(BATCH / ms * 1000.0, 1),
+            "vs_base_ms": round(ms - base, 3) if base else None}))
+
+
+def cmd_marginals(args):
+    ov = []
+    if args.conv_impl:
+        ov.append(("conv_impl", args.conv_impl))
+    if args.lrn_dtype:
+        ov.append(("lrn_dtype", args.lrn_dtype))
+    entries = []
+    for label, nb, spatial in PREFIXES:
+        tr = build(ov, emit_net(nb, NCLASS, spatial), NCLASS)
+        entries.append((label, tr, staged_batches(tr, NCLASS)))
+    best = interleave(entries, args.iters, args.trials, args.warmup)
+    prev = 0.0
+    for label, nb, spatial in PREFIXES:
+        ms = best[label]
+        print(json.dumps({
+            "experiment": "marginals", "prefix": label,
+            "overrides": dict(ov),
+            "step_ms": round(ms, 3),
+            "marginal_ms": round(ms - prev, 3)}))
+        prev = ms
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    a = sub.add_parser("ablate")
+    a.add_argument("--variant", nargs="*", help="subset of variant names")
+    a.add_argument("--extra", nargs="*",
+                   help="extra variants as name:k=v,k=v")
+    a.add_argument("--iters", type=int, default=12)
+    a.add_argument("--trials", type=int, default=6)
+    a.add_argument("--warmup", type=int, default=3)
+    a.set_defaults(fn=cmd_ablate)
+    m = sub.add_parser("marginals")
+    m.add_argument("--conv-impl", default=None)
+    m.add_argument("--lrn-dtype", default=None)
+    m.add_argument("--iters", type=int, default=12)
+    m.add_argument("--trials", type=int, default=5)
+    m.add_argument("--warmup", type=int, default=2)
+    m.set_defaults(fn=cmd_marginals)
+    args = ap.parse_args()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    main()
