@@ -19,6 +19,7 @@ speed, whenever the link is contended.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -37,8 +38,56 @@ BUDGET_S = 210      # keep sampling up to this long while contended
 QUIET_IMAGES_PER_SEC = 2000.0   # a reading above this means a quiet window
 
 
+def _measure_h2d_gbps(n_mb: int = 64, trials: int = 3) -> float:
+    """Raw host->device bandwidth in THIS window: a plain device_put of
+    an n_mb uint8 array, fenced by a real D2H fetch of a device-side
+    reduction (block_until_ready does not fence through the tunnel).
+    Normalizes the staged-feed reading: the link's physical ceiling is
+    what the staging machinery competes against."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    arr = np.random.RandomState(0).randint(
+        0, 256, size=(n_mb << 20,), dtype=np.uint8)
+    red = jax.jit(lambda x: jnp.sum(x, dtype=jnp.int32))
+    float(np.asarray(red(jax.device_put(arr))))   # warm compile + path
+    best = 0.0
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        d = jax.device_put(arr)
+        float(np.asarray(red(d)))
+        dt = time.perf_counter() - t0
+        best = max(best, arr.nbytes / dt / 1e9)
+    return best
+
+
+def _measure_dispatch_floor_ms(iters: int = 12) -> float:
+    """Per-dispatch overhead of this rig's device link: a chain of
+    trivial jitted steps, fenced once. On a tunneled chip this floor
+    (~3.5-5 ms r3) sits under EVERY step time; on a local TPU VM it
+    vanishes — reported so step readings can be weather-corrected."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = jax.device_put(jnp.zeros((8, 128), jnp.float32))
+    y = f(x)
+    float(np.asarray(y[0, 0]))                    # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = f(y)
+    float(np.asarray(y[0, 0]))
+    return (time.perf_counter() - t0) / iters * 1000.0
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    args = _parse_args()
+    if args.devices:
+        return scaling_main(args)
+    iters, n_trials = args.iters, args.trials
     import jax
     import numpy as np
     import jax.numpy as jnp
@@ -89,10 +138,13 @@ def main() -> None:
     staged = [tr.stage(b) for b in batches]
     run_resident(WARMUP, staged)
     resident = 0.0
-    for _ in range(TRIALS):
+    for _ in range(n_trials):
         t0 = time.perf_counter()
-        run_resident(ITERS, staged)
-        resident = max(resident, BATCH * ITERS / (time.perf_counter() - t0))
+        run_resident(iters, staged)
+        resident = max(resident, BATCH * iters / (time.perf_counter() - t0))
+    # floor probe adjacent to the resident windows (same weather), so
+    # the corrected MFU subtracts the floor the resident steps paid
+    dispatch_floor_ms = _measure_dispatch_floor_ms()
 
     # MFU: flops from XLA's own HLO cost model for the whole train step
     # (fwd+bwd+update), against v5e bf16 peak — the honest utilization
@@ -113,19 +165,38 @@ def main() -> None:
     # readings look contended; the budget is authoritative under driver
     # timeouts
     run_pipeline(WARMUP)
-    pipeline = 0.0
+    pipeline, pipeline_link_bound = 0.0, None
     deadline = time.perf_counter() + BUDGET_S
     trials = 0
+    bytes_per_image = sum(
+        a.nbytes for a in jax.tree.leaves(staged[0].device)) / BATCH
     while True:
         t0 = time.perf_counter()
-        run_pipeline(ITERS)
+        run_pipeline(iters)
         dt = time.perf_counter() - t0
-        pipeline = max(pipeline, BATCH * ITERS / dt)
+        rate = BATCH * iters / dt
+        # pair every trial with an ADJACENT small link probe, so the
+        # reported efficiency compares rate and ceiling from the same
+        # weather window (a lone probe after the loop could land in a
+        # different window and push the ratio past 1.0)
+        gbps = _measure_h2d_gbps(n_mb=8, trials=1)
+        if rate > pipeline:
+            pipeline = rate
+            pipeline_link_bound = gbps * 1e9 / bytes_per_image
         trials += 1
         if time.perf_counter() >= deadline:
             break
-        if trials >= TRIALS and pipeline >= QUIET_IMAGES_PER_SEC:
+        if trials >= n_trials and pipeline >= QUIET_IMAGES_PER_SEC:
             break
+
+    # ---- weather-normalized staging efficiency (VERDICT r2 #2) ----
+    # rate / min(device step rate, link-bound rate), both halves from
+    # the winning trial's window. ~1.0 means the staging machinery
+    # (host fields -> one batched put -> two-ahead overlap) loses
+    # nothing — the link, not the framework, sets the number.
+    link_bound = pipeline_link_bound or 0.0
+    feed_ceiling = min(resident, link_bound) if link_bound else 0.0
+    staged_eff = pipeline / feed_ceiling if feed_ceiling else None
 
     # ---- host decode stage, measured in-artifact ----
     # JPEG->crop/mirror rate through the real imgbinx iterator on THIS
@@ -149,10 +220,30 @@ def main() -> None:
         "step_ms": round(step_ms, 2),
         "step_flops": step_flops,
         "mfu_vs_197tflops_bf16": round(mfu, 4) if mfu else None,
+        "mfu_dispatch_corrected": round(
+            step_flops / ((step_ms - dispatch_floor_ms) / 1000.0)
+            / PEAK_FLOPS, 4)
+        if mfu and step_ms > dispatch_floor_ms else None,
+        "mfu_note": "corrected = compute-only MFU after subtracting "
+                    "this rig's per-dispatch tunnel floor "
+                    "(dispatch_floor_ms; ~0 on a local TPU VM)",
         "pipeline_images_per_sec": round(pipeline, 2),
         "pipeline_quiet_window": pipeline >= QUIET_IMAGES_PER_SEC,
         "pipeline_measures": "staged uint8 H2D + step (post-decode); "
                              "swings with shared-tunnel weather",
+        # canonical name (VERDICT r2 #2); pipeline_images_per_sec above
+        # is the r1/r2-continuity alias of the same measurement
+        "staged_feed_images_per_sec": round(pipeline, 2),
+        "h2d_gbps_same_window": round(link_bound * bytes_per_image
+                                      / 1e9, 3),
+        "staged_feed_link_bound_images_per_sec": round(link_bound, 1),
+        "staged_feed_efficiency": round(staged_eff, 3)
+        if staged_eff is not None else None,
+        "staged_feed_note": "efficiency = staged rate / min(device "
+                            "step rate, same-window link ceiling); "
+                            "~1.0 = the staging machinery loses "
+                            "nothing, the link sets the number",
+        "dispatch_floor_ms": round(dispatch_floor_ms, 3),
         "decode_images_per_sec_per_core": round(decode_ips, 1)
         if decode_ips else None,
         "host_cores": cores,
@@ -202,6 +293,102 @@ def _measure_decode_rate(n=240, side=256):
         while it.next():
             seen += 48
         return seen / (time.perf_counter() - t0)
+
+
+def _parse_args():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--devices", default="",
+        help="comma list of data-parallel device counts (e.g. 1,2,4,8):"
+             " emit the DP scaling table instead of the single-chip "
+             "protocol. Uses real devices when enough exist, else a "
+             "virtual CPU mesh (correctness-mode numbers). VERDICT r2 "
+             "#5: on a multi-chip host this flag IS the scaling bench.")
+    ap.add_argument("--iters", type=int, default=ITERS)
+    ap.add_argument("--trials", type=int, default=TRIALS)
+    return ap.parse_args()
+
+
+def scaling_main(args) -> None:
+    """Data-parallel weak-scaling table (per-device batch fixed): one
+    JSON line per device count with per-device throughput, speedup vs
+    1 device, and the DP gradient all-reduce bytes — the reference's
+    'nearly linear speedup' headline (README.md:22), flag-flip ready
+    for real multi-chip hardware."""
+    counts = sorted({int(t) for t in args.devices.split(",") if t})
+    from cxxnet_tpu.parallel import force_host_cpu
+
+    # count real accelerator devices in a SUBPROCESS so this process's
+    # backend stays uninitialized until the mode is chosen (a virtual
+    # CPU mesh cannot be forced after the TPU backend came up)
+    real = False
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        import subprocess
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); "
+                 "print(d[0].platform, len(d))"],
+                capture_output=True, text=True, timeout=300,
+            ).stdout.split()
+            real = out and out[0] == "tpu" and int(out[1]) >= max(counts)
+        except Exception:
+            real = False
+    if not real:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        force_host_cpu(max(counts))
+    import jax
+    import numpy as np
+
+    import __graft_entry__ as ge
+    from cxxnet_tpu.io import DataBatch
+
+    platform = jax.devices()[0].platform
+    per_dev = BATCH if real else 8
+    shape = (3, 227, 227) if real else (3, 63, 63)
+    nclass = 1000 if real else 16
+    dtype = "bfloat16" if real else "float32"
+    base_rate = None
+    for n in counts:
+        gb = per_dev * n
+        dev_str = "%s:%s" % (platform, ",".join(map(str, range(n))))
+        tr = ge._build_trainer(batch_size=gb, nclass=nclass,
+                               dev=dev_str, dtype=dtype,
+                               input_shape=shape, eval_train=0)
+        assert tr.n_devices == n, (tr.n_devices, n)
+        rs = np.random.RandomState(0)
+        staged = [tr.stage(DataBatch(
+            data=rs.randint(0, 256, size=(gb,) + shape, dtype=np.uint8),
+            label=rs.randint(0, nclass, size=(gb, 1)).astype(np.float32),
+            norm=(np.full((3, 1, 1), 120.0, np.float32), 1.0)))
+            for _ in range(2)]
+        for i in range(max(2, args.trials // 2)):
+            tr.update(staged[i % 2])
+        np.asarray(tr._epoch_dev)
+        best = 0.0
+        for _ in range(args.trials):
+            t0 = time.perf_counter()
+            for i in range(args.iters):
+                tr.update(staged[i % 2])
+            np.asarray(tr._epoch_dev)
+            best = max(best, gb * args.iters / (time.perf_counter() - t0))
+        if base_rate is None:
+            base_rate = best
+        params_bytes = sum(a.nbytes for a in jax.tree.leaves(tr.params))
+        print(json.dumps({
+            "metric": "alexnet_dp_scaling",
+            "devices": n,
+            "backend": "tpu" if real else "cpu-virtual (correctness "
+                       "mode: toy shapes, not a perf claim)",
+            "global_batch": gb,
+            "images_per_sec": round(best, 2),
+            "per_device_images_per_sec": round(best / n, 2),
+            "speedup": round(best / base_rate, 3),
+            "speedup_baseline_devices": counts[0],
+            "grad_allreduce_mbytes_per_step": round(
+                2 * (n - 1) / n * params_bytes / 1e6, 2),
+        }))
+        del tr, staged
 
 
 if __name__ == "__main__":

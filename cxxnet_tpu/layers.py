@@ -1044,6 +1044,29 @@ class _PoolingLayer(Layer):
     reducer = "max"
     pre_relu = False  # relu_max_pooling fuses a relu before pooling
 
+    def __init__(self):
+        super().__init__()
+        # auto: window everywhere. The r3 hypothesis that reduce_window
+        # is the pool1 bottleneck (+2.3 ms marginal) was tested with a
+        # k*k-strided-slice elementwise reduce and REJECTED on-chip:
+        # stride-2 slices across the NCHW lane dim each force a
+        # relayout, and the AlexNet step went 21.2 -> 45.1 ms
+        # (docs/performance.md r3 ablation). reduce_window is the
+        # fast path; `slice` stays selectable as the recorded evidence.
+        # Max results are identical either way (same window elements);
+        # gradients at exact ties differ (elementwise max splits ties
+        # per pair, select_and_scatter picks one winner) — both valid
+        # subgradients.
+        self.impl = "auto"
+
+    def set_param(self, name, val):
+        if name == "pool_impl":
+            if val not in ("auto", "window", "slice"):
+                raise ValueError("pool_impl must be auto|window|slice")
+            self.impl = val
+        else:
+            super().set_param(name, val)
+
     def _infer(self, in_shapes):
         p = self.param
         n, c, h, w = in_shapes[0]
@@ -1062,12 +1085,19 @@ class _PoolingLayer(Layer):
                      (ow - 1) * p.stride + p.kernel_width - w2)
         return [(n, c, oh, ow)]
 
+    def _resolve_impl(self, ctx) -> str:
+        if self.impl != "auto":
+            return self.impl
+        return "window"
+
     def apply(self, params, inputs, ctx):
         p = self.param
         x = inputs[0]
         if self.pre_relu:
             x = jnp.maximum(x, 0.0)
         pad_h, pad_w = self._pad
+        if self._resolve_impl(ctx) == "slice":
+            return [self._apply_slice(x, pad_h, pad_w)]
         dims = (1, 1, p.kernel_height, p.kernel_width)
         strides = (1, 1, p.stride, p.stride)
         padding = ((0, 0), (0, 0), (p.pad_y, pad_h + p.pad_y),
@@ -1080,6 +1110,35 @@ class _PoolingLayer(Layer):
             if self.reducer == "avg":
                 out = out * (1.0 / (p.kernel_height * p.kernel_width))
         return [out]
+
+    def _apply_slice(self, x, pad_h, pad_w):
+        """Window reduction as an elementwise reduce over k*k strided
+        slices of the (identity-padded) input — no reduce_window, so
+        nothing crosses the TPU lane dimension serially. Same window
+        membership as the reduce_window path: identical max/sum values
+        up to addition order."""
+        p = self.param
+        n, c, h, w = x.shape
+        kh, kw, s = p.kernel_height, p.kernel_width, p.stride
+        init = -jnp.inf if self.reducer == "max" else 0.0
+        xp = jnp.pad(x, ((0, 0), (0, 0),
+                         (p.pad_y, pad_h + p.pad_y),
+                         (p.pad_x, pad_w + p.pad_x)),
+                     constant_values=init)
+        oh = (xp.shape[2] - kh) // s + 1
+        ow = (xp.shape[3] - kw) // s + 1
+        red = jnp.maximum if self.reducer == "max" else jnp.add
+        out = None
+        for dy in range(kh):
+            for dx in range(kw):
+                part = lax.slice(
+                    xp, (0, 0, dy, dx),
+                    (n, c, dy + (oh - 1) * s + 1, dx + (ow - 1) * s + 1),
+                    (1, 1, s, s))
+                out = part if out is None else red(out, part)
+        if self.reducer == "avg":
+            out = out * (1.0 / (kh * kw))
+        return out
 
 
 @register("max_pooling")
