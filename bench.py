@@ -38,20 +38,29 @@ BUDGET_S = 210      # keep sampling up to this long while contended
 QUIET_IMAGES_PER_SEC = 2000.0   # a reading above this means a quiet window
 
 
+_H2D_CACHE = {}
+
+
 def _measure_h2d_gbps(n_mb: int = 64, trials: int = 3) -> float:
     """Raw host->device bandwidth in THIS window: a plain device_put of
     an n_mb uint8 array, fenced by a real D2H fetch of a device-side
     reduction (block_until_ready does not fence through the tunnel).
     Normalizes the staged-feed reading: the link's physical ceiling is
-    what the staging machinery competes against."""
+    what the staging machinery competes against. The probe array and
+    jitted reducer are cached: this runs once per pipeline trial, and a
+    fresh lambda would miss jax's jit cache and pay a remote compile
+    inside the very window it is measuring."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    arr = np.random.RandomState(0).randint(
-        0, 256, size=(n_mb << 20,), dtype=np.uint8)
-    red = jax.jit(lambda x: jnp.sum(x, dtype=jnp.int32))
-    float(np.asarray(red(jax.device_put(arr))))   # warm compile + path
+    if n_mb not in _H2D_CACHE:
+        arr = np.random.RandomState(0).randint(
+            0, 256, size=(n_mb << 20,), dtype=np.uint8)
+        red = jax.jit(lambda x: jnp.sum(x, dtype=jnp.int32))
+        float(np.asarray(red(jax.device_put(arr))))  # warm compile+path
+        _H2D_CACHE[n_mb] = (arr, red)
+    arr, red = _H2D_CACHE[n_mb]
     best = 0.0
     for _ in range(trials):
         t0 = time.perf_counter()
@@ -137,14 +146,17 @@ def main() -> None:
     # ---- primary metric: device-resident training step throughput ----
     staged = [tr.stage(b) for b in batches]
     run_resident(WARMUP, staged)
-    resident = 0.0
+    # the floor probe runs adjacent to EVERY resident trial; the MIN is
+    # used for the corrected MFU, so a contended-window probe can only
+    # UNDER-correct (a lone probe could subtract a 15 ms contended
+    # floor from a quiet-window step and inflate the corrected MFU)
+    resident, floors = 0.0, []
     for _ in range(n_trials):
         t0 = time.perf_counter()
         run_resident(iters, staged)
         resident = max(resident, BATCH * iters / (time.perf_counter() - t0))
-    # floor probe adjacent to the resident windows (same weather), so
-    # the corrected MFU subtracts the floor the resident steps paid
-    dispatch_floor_ms = _measure_dispatch_floor_ms()
+        floors.append(_measure_dispatch_floor_ms())
+    dispatch_floor_ms = min(floors)
 
     # MFU: flops from XLA's own HLO cost model for the whole train step
     # (fwd+bwd+update), against v5e bf16 peak — the honest utilization

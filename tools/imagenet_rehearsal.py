@@ -33,6 +33,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def brighten_quadrant(img: np.ndarray, rs) -> int:
+    """Brighten one random quadrant of an HWC uint8 image in place and
+    return its index (0-3) — THE definition of the learnable rehearsal
+    task (label == brightest quadrant, survives any crop).
+    tools/convergence_run.py imports this so both artifacts label
+    identically."""
+    q = rs.randint(4)
+    h2, w2 = img.shape[0] // 2, img.shape[1] // 2
+    ys, xs = (q // 2) * h2, (q % 2) * w2
+    img[ys:ys + h2, xs:xs + w2] = np.clip(
+        img[ys:ys + h2, xs:xs + w2].astype(np.int16) + 70,
+        0, 255).astype(np.uint8)
+    return q
+
+
 def synth_jpegs(out_dir: str, lst_path: str, n: int, side: int,
                 nclass: int, seed: int = 0,
                 labels: str = "random") -> float:
@@ -60,15 +75,9 @@ def synth_jpegs(out_dir: str, lst_path: str, n: int, side: int,
                           + rs.randint(-24, 24, img.shape), 0,
                           255).astype(np.uint8)
             if labels == "quadrant":
-                # brighten one random quadrant so label == content and a
-                # random 227-of-256 crop cannot cut the signal away
-                q = rs.randint(4)
-                h2, w2 = side // 2, side // 2
-                ys, xs = (q // 2) * h2, (q % 2) * w2
-                img[ys:ys + h2, xs:xs + w2] = np.clip(
-                    img[ys:ys + h2, xs:xs + w2].astype(np.int16) + 70,
-                    0, 255).astype(np.uint8)
-                label = q
+                # label == content, and a random 227-of-256 crop cannot
+                # cut the signal away
+                label = brighten_quadrant(img, rs)
             else:
                 label = rs.randint(nclass)
             name = "img%06d.jpg" % i

@@ -119,13 +119,13 @@ def emit_net(nblocks, nclass, spatial):
     return "\n".join(lines) + "\n"
 
 
-def build(overrides, text, nclass, retries=3):
+def build(overrides, text, nclass, retries=3, batch=BATCH):
     """Build + init a trainer, retrying transient tunnel/compile drops
     (the remote-compile link in front of the chip occasionally closes
     mid-response under contention)."""
     for attempt in range(retries):
         try:
-            return _build_once(overrides, text, nclass)
+            return _build_once(overrides, text, nclass, batch)
         except Exception as e:
             if attempt == retries - 1 or "remote_compile" not in str(e):
                 raise
@@ -133,7 +133,7 @@ def build(overrides, text, nclass, retries=3):
             time.sleep(5.0)
 
 
-def _build_once(overrides, text, nclass):
+def _build_once(overrides, text, nclass, batch=BATCH):
     import jax
 
     from cxxnet_tpu import config
@@ -143,7 +143,7 @@ def _build_once(overrides, text, nclass):
     tr = Trainer()
     for k, v in config.parse_string(text):
         tr.set_param(k, v)
-    tr.set_param("batch_size", str(BATCH))
+    tr.set_param("batch_size", str(batch))
     tr.set_param("dev", platform)
     tr.set_param("dtype", "bfloat16" if platform == "tpu" else "float32")
     tr.set_param("eta", "0.01")
@@ -258,6 +258,65 @@ def cmd_marginals(args):
         prev = ms
 
 
+def cmd_zoo(args):
+    """Device-resident step benchmark + MFU across the model zoo
+    (VERDICT r2 #3): inception's concat fan-out, VGG's deep 3x3
+    stacks, ResNet's skip DAG and bowl's small-input recipe all have
+    different graph shapes than AlexNet — a hostile one could hide a
+    regression the headline bench never sees."""
+    import jax
+
+    from cxxnet_tpu import models
+    from cxxnet_tpu.io import DataBatch
+
+    PEAK_FLOPS = 197e12
+    platform = jax.devices()[0].platform
+    nets = [
+        ("alexnet", models.alexnet(1000), (3, 227, 227), 256, 1000),
+        ("vgg16", models.vgg(16, nclass=1000), (3, 224, 224), 64, 1000),
+        ("inception", models.inception(nclass=10), (3, 32, 32), 256, 10),
+        ("resnet20", models.resnet(nclass=10, nstage=3, nblock=3),
+         (3, 32, 32), 256, 10),
+        ("bowl", models.bowl_net(121), (3, 40, 40), 64, 121),
+    ]
+    if args.net:
+        known = {n[0] for n in nets}
+        bad = set(args.net) - known
+        if bad:
+            raise SystemExit("zoo: unknown net(s) %s — choose from %s"
+                             % (sorted(bad), sorted(known)))
+        nets = [n for n in nets if n[0] in args.net]
+    rs = np.random.RandomState(0)
+    entries, meta = [], {}
+    for name, text, shape, batch, nclass in nets:
+        tr = build([], text, nclass, batch=batch)
+        staged = [tr.stage(DataBatch(
+            data=rs.randint(0, 256, size=(batch,) + shape,
+                            dtype=np.uint8),
+            label=rs.randint(0, nclass,
+                             size=(batch, 1)).astype(np.float32),
+            norm=(np.full((3, 1, 1), 120.0, np.float32), 1.0)))
+            for _ in range(3)]
+        entries.append((name, tr, staged))
+        meta[name] = batch
+    best = interleave(entries, args.iters, args.trials, args.warmup)
+    for name, tr, _ in entries:
+        batch = meta[name]
+        ms = best[name]
+        try:
+            flops = float(tr.step_cost_analysis().get("flops", 0.0))
+        except Exception:
+            flops = 0.0
+        mfu = (flops / (ms / 1000.0) / PEAK_FLOPS
+               if flops and platform == "tpu" else None)
+        print(json.dumps({
+            "experiment": "zoo", "net": name, "batch": batch,
+            "step_ms": round(ms, 3),
+            "images_per_sec": round(batch / ms * 1000.0, 1),
+            "step_flops": flops,
+            "mfu_vs_197tflops_bf16": round(mfu, 4) if mfu else None}))
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -269,6 +328,12 @@ def main():
     a.add_argument("--trials", type=int, default=6)
     a.add_argument("--warmup", type=int, default=3)
     a.set_defaults(fn=cmd_ablate)
+    z = sub.add_parser("zoo")
+    z.add_argument("--net", nargs="*", help="subset of net names")
+    z.add_argument("--iters", type=int, default=12)
+    z.add_argument("--trials", type=int, default=5)
+    z.add_argument("--warmup", type=int, default=3)
+    z.set_defaults(fn=cmd_zoo)
     m = sub.add_parser("marginals")
     m.add_argument("--conv-impl", default=None)
     m.add_argument("--lrn-dtype", default=None)
