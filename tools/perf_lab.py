@@ -275,9 +275,15 @@ def cmd_zoo(args):
         ("alexnet", models.alexnet(1000), (3, 227, 227), 256, 1000),
         ("vgg16", models.vgg(16, nclass=1000), (3, 224, 224), 64, 1000),
         ("inception", models.inception(nclass=10), (3, 32, 32), 256, 10),
+        ("inception224", models.inception(
+            nclass=1000, input_shape=(3, 224, 224), base=32),
+         (3, 224, 224), 64, 1000),
         ("resnet20", models.resnet(nclass=10, nstage=3, nblock=3),
          (3, 32, 32), 256, 10),
         ("bowl", models.bowl_net(121), (3, 40, 40), 64, 121),
+        # token LM: tokens/sec = images_per_sec * seq_len
+        ("gpt2_small", models.gpt2_small(seq_len=512), (1, 512, 1),
+         16, 32768),
     ]
     if args.net:
         known = {n[0] for n in nets}
@@ -289,19 +295,32 @@ def cmd_zoo(args):
     rs = np.random.RandomState(0)
     entries, meta = [], {}
     for name, text, shape, batch, nclass in nets:
-        tr = build([], text, nclass, batch=batch)
-        staged = [tr.stage(DataBatch(
-            data=rs.randint(0, 256, size=(batch,) + shape,
-                            dtype=np.uint8),
-            label=rs.randint(0, nclass,
-                             size=(batch, 1)).astype(np.float32),
-            norm=(np.full((3, 1, 1), 120.0, np.float32), 1.0)))
-            for _ in range(3)]
+        is_lm = shape[0] == 1 and shape[2] == 1
+        # the LM recipe trains with adam (examples/transformer); the
+        # conv zoo with the reference's sgd+momentum
+        tr = build([("updater", "adam")] if is_lm else [], text,
+                   nclass, batch=batch)
+        if is_lm:
+            seq = shape[1]
+            toks = rs.randint(0, nclass, size=(batch, 1, seq, 1))
+            staged = [tr.stage(DataBatch(
+                data=toks.astype(np.float32),
+                label=rs.randint(0, nclass,
+                                 size=(batch, seq)).astype(np.float32)))
+                for _ in range(3)]
+        else:
+            staged = [tr.stage(DataBatch(
+                data=rs.randint(0, 256, size=(batch,) + shape,
+                                dtype=np.uint8),
+                label=rs.randint(0, nclass,
+                                 size=(batch, 1)).astype(np.float32),
+                norm=(np.full((3, 1, 1), 120.0, np.float32), 1.0)))
+                for _ in range(3)]
         entries.append((name, tr, staged))
-        meta[name] = batch
+        meta[name] = (batch, shape[1] if is_lm else None)
     best = interleave(entries, args.iters, args.trials, args.warmup)
     for name, tr, _ in entries:
-        batch = meta[name]
+        batch, seq = meta[name]
         ms = best[name]
         try:
             flops = float(tr.step_cost_analysis().get("flops", 0.0))
@@ -309,12 +328,15 @@ def cmd_zoo(args):
             flops = 0.0
         mfu = (flops / (ms / 1000.0) / PEAK_FLOPS
                if flops and platform == "tpu" else None)
-        print(json.dumps({
+        row = {
             "experiment": "zoo", "net": name, "batch": batch,
             "step_ms": round(ms, 3),
             "images_per_sec": round(batch / ms * 1000.0, 1),
             "step_flops": flops,
-            "mfu_vs_197tflops_bf16": round(mfu, 4) if mfu else None}))
+            "mfu_vs_197tflops_bf16": round(mfu, 4) if mfu else None}
+        if seq:
+            row["tokens_per_sec"] = round(batch * seq / ms * 1000.0, 1)
+        print(json.dumps(row))
 
 
 def main():
