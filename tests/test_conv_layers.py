@@ -141,3 +141,24 @@ def test_insanity_pooling_eval_weighted_avg():
     (out_t,) = lay.apply({}, [jnp.asarray(x)],
                          ctx(train=True, rng=jax.random.PRNGKey(0)))
     assert float(out_t[0, 0, 0, 0]) in (3.0, 1.0, 0.0)
+
+
+def test_conv_nhwc_matches_xla():
+    """conv_impl=nhwc (a measured-and-rejected r3 layout experiment,
+    docs/performance.md — kept selectable as recorded evidence) must
+    match the default lowering exactly: same math, different operand
+    layout."""
+    from cxxnet_tpu import pairtest
+    for cfg, shape in [
+        ([("kernel_size", "5"), ("pad", "2"), ("nchannel", "8"),
+          ("ngroup", "2")], (2, 4, 13, 13)),
+        ([("kernel_size", "11"), ("stride", "4"), ("nchannel", "6")],
+         (2, 3, 23, 23)),
+    ]:
+        rep = pairtest.compare_layers(
+            "conv", "conv",
+            cfg + [("master:conv_impl", "xla"),
+                   ("slave:conv_impl", "nhwc"),
+                   ("random_type", "xavier")],
+            [shape], train=True)
+        pairtest.assert_pair_ok(rep, tol=2e-5)
