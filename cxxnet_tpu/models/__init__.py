@@ -293,21 +293,52 @@ input_shape = 3,32,32
 
 
 def inception(nclass: int = 10, input_shape=(3, 32, 32),
-              base: int = 16) -> str:
+              base: int = 16, imagenet_stem: bool = False) -> str:
     """GoogLeNet-style net from stacked inception modules (BASELINE.md
     parity target 4): each module runs four branches — 1x1, 1x1->3x3,
     1x1->5x5, pool->1x1 — joined with ch_concat, the reference's
     multi-input concat graph machinery (concat_layer-inl.hpp) at real
-    scale rather than the single-block demo."""
+    scale rather than the single-block demo.
+
+    ``imagenet_stem=True`` prepends GoogLeNet's downsampling stem
+    (7x7/2 conv -> 3x3/2 pool -> 3x3 conv -> 3x3/2 pool, an 8x spatial
+    reduction) so 224² inputs reach the modules at 28² like the real
+    architecture — without it a 224² input runs every module at 224²
+    (measured r3: 212 ms/step, 4.2% MFU — an architecture artifact,
+    not a lowering one)."""
     c, h, w = input_shape
     if h != w or h % 2 != 0:
         raise ValueError(
             "inception: input must be square with even side (one 2x "
             "downsampling + global average pool head), got %dx%d" % (h, w))
-    lines = ["netconfig=start",
-             "layer[0->stem] = conv:conv0",
-             "  kernel_size = 3", "  pad = 1", "  stride = 1",
-             "  nchannel = %d" % (2 * base)]
+    in_h = h
+    if imagenet_stem:
+        if h % 16 != 0:
+            raise ValueError("inception: imagenet_stem needs side "
+                             "divisible by 16, got %d" % h)
+        lines = ["netconfig=start",
+                 "layer[0->s1] = conv:conv0",
+                 "  kernel_size = 7", "  pad = 3", "  stride = 2",
+                 "  nchannel = %d" % (2 * base),
+                 "layer[s1->s2] = relu",
+                 # pad-0 pools: with the reference's partial-edge-window
+                 # output formula they land 224 -> 112 -> 56 -> 28 exact
+                 "layer[s2->s3] = max_pooling",
+                 "  kernel_size = 3", "  stride = 2",
+                 "layer[s3->s4] = conv:conv1",
+                 "  kernel_size = 3", "  pad = 1", "  stride = 1",
+                 "  nchannel = %d" % (6 * base),
+                 "layer[s4->s5] = relu",
+                 "layer[s5->stem] = max_pooling",
+                 "  kernel_size = 3", "  stride = 2"]
+        # modules see the 8x-downsampled map; the head pool below
+        # sizes itself from this h, the input_shape line from in_h
+        h = w = h // 8
+    else:
+        lines = ["netconfig=start",
+                 "layer[0->stem] = conv:conv0",
+                 "  kernel_size = 3", "  pad = 1", "  stride = 1",
+                 "  nchannel = %d" % (2 * base)]
     cur = "stem"
 
     def module(name, cur, c1, c3r, c3, c5r, c5, pp):
@@ -358,7 +389,7 @@ def inception(nclass: int = 10, input_shape=(3, 32, 32),
               "  nhidden = %d" % nclass,
               "layer[head_d->head_d] = softmax",
               "netconfig=end",
-              "input_shape = %d,%d,%d" % (c, h, w),
+              "input_shape = %d,%d,%d" % (c, in_h, in_h),
               "random_type = kaiming"]
     return "\n".join(lines) + "\n"
 

@@ -121,3 +121,21 @@ def test_inception_data_parallel_imgbin(tmp_path):
     it.before_first()
     it.next()
     assert np.isfinite(tr.predict(it.value)).all()
+
+
+def test_inception_imagenet_stem_shapes():
+    """imagenet_stem=True (r3): GoogLeNet's 8x-downsampling stem in
+    front of the modules — 224² inputs reach module i1 at 28² and the
+    global-pool head still lands on (1,1)."""
+    from cxxnet_tpu.graph import NetConfig
+    from cxxnet_tpu.model import Network
+    n = NetConfig()
+    n.configure(config.parse_string(models.inception(
+        nclass=7, input_shape=(3, 224, 224), base=8,
+        imagenet_stem=True)))
+    net = Network(n, batch_size=2)
+    stem = n.node_name_map["stem"]
+    assert net.node_shapes[stem][2:] == (28, 28)
+    assert net.node_shapes[net.out_node] == (2, 1, 1, 7)
+    with pytest.raises(ValueError, match="divisible by 16"):
+        models.inception(input_shape=(3, 40, 40), imagenet_stem=True)

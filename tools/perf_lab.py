@@ -276,8 +276,8 @@ def cmd_zoo(args):
         ("vgg16", models.vgg(16, nclass=1000), (3, 224, 224), 64, 1000),
         ("inception", models.inception(nclass=10), (3, 32, 32), 256, 10),
         ("inception224", models.inception(
-            nclass=1000, input_shape=(3, 224, 224), base=32),
-         (3, 224, 224), 64, 1000),
+            nclass=1000, input_shape=(3, 224, 224), base=32,
+            imagenet_stem=True), (3, 224, 224), 64, 1000),
         ("resnet20", models.resnet(nclass=10, nstage=3, nblock=3),
          (3, 32, 32), 256, 10),
         ("bowl", models.bowl_net(121), (3, 40, 40), 64, 121),
