@@ -36,6 +36,7 @@ BUDGET_S = 210      # keep sampling up to this long while contended
                     # (leave headroom under external runner timeouts —
                     # one fully-contended window can take ~2 minutes)
 QUIET_IMAGES_PER_SEC = 2000.0   # a reading above this means a quiet window
+FUSE = 8            # fused mode: optimizer steps per dispatch (fuse_steps)
 
 
 _H2D_CACHE = {}
@@ -108,7 +109,7 @@ def main() -> None:
     # bfloat16 compute on TPU (MXU-native), float32 elsewhere
     dtype = "bfloat16" if platform == "tpu" else "float32"
     tr = ge._build_trainer(batch_size=BATCH, nclass=1000, dev=platform,
-                           dtype=dtype, eval_train=0)
+                           dtype=dtype, eval_train=0, fuse_steps=FUSE)
 
     # raw uint8 pixels + deferred on-device normalization: exactly what the
     # imgbin pipeline emits with on_device_norm=1 (JPEG decode -> uint8
@@ -143,6 +144,15 @@ def main() -> None:
             tr.update(staged[i % len(staged)])
         np.asarray(tr._epoch_dev)
 
+    def run_fused(groups, staged):
+        # fused mode: ONE dispatch per FUSE optimizer steps (fuse_steps,
+        # Trainer.update_fused) — the XLA-native loop shape; amortizes
+        # the per-dispatch floor FUSE-fold
+        for g in range(groups):
+            tr.update_fused([staged[(g * FUSE + j) % len(staged)]
+                             for j in range(FUSE)])
+        np.asarray(tr._epoch_dev)
+
     # ---- primary metric: device-resident training step throughput ----
     staged = [tr.stage(b) for b in batches]
     run_resident(WARMUP, staged)
@@ -158,6 +168,17 @@ def main() -> None:
         floors.append(_measure_dispatch_floor_ms())
     dispatch_floor_ms = min(floors)
 
+    # same protocol, fused dispatch: both modes measured every run so
+    # the dispatch-amortization gain is an artifact, not an assertion
+    fgroups = max(2, (iters + FUSE - 1) // FUSE)
+    run_fused(1, staged)     # compile the scan program outside the clock
+    fused = 0.0
+    for _ in range(n_trials):
+        t0 = time.perf_counter()
+        run_fused(fgroups, staged)
+        fused = max(fused,
+                    BATCH * FUSE * fgroups / (time.perf_counter() - t0))
+
     # MFU: flops from XLA's own HLO cost model for the whole train step
     # (fwd+bwd+update), against v5e bf16 peak — the honest utilization
     # number VERDICT asked for
@@ -166,7 +187,13 @@ def main() -> None:
         step_flops = float(tr.step_cost_analysis().get("flops", 0.0))
     except Exception:
         step_flops = 0.0
-    step_ms = BATCH / resident * 1000.0
+    best = max(resident, fused)
+    best_mode = "fused%d" % FUSE if fused > resident else "single"
+    # the dispatch floor burdens every single-mode step once, every
+    # fused-mode step 1/FUSE times
+    floor_per_step = (dispatch_floor_ms / FUSE if fused > resident
+                      else dispatch_floor_ms)
+    step_ms = BATCH / best * 1000.0
     mfu = (step_flops / (step_ms / 1000.0) / PEAK_FLOPS
            if step_flops and platform == "tpu" else None)
 
@@ -224,21 +251,26 @@ def main() -> None:
         if decode_ips else pipeline
     print(json.dumps({
         "metric": "alexnet_train_images_per_sec",
-        "value": round(resident, 2),
+        "value": round(best, 2),
         "unit": "images/sec",
-        "vs_baseline": round(resident / BASELINE_IMAGES_PER_SEC, 3),
+        "vs_baseline": round(best / BASELINE_IMAGES_PER_SEC, 3),
         "measured_as": "device-resident fwd+bwd+update, batch 256 "
-                       "(same protocol as the K40 baseline tables)",
+                       "(same protocol as the K40 baseline tables); "
+                       "best of single-dispatch and fuse_steps=%d "
+                       "modes, this run: %s" % (FUSE, best_mode),
+        "images_per_sec_single_dispatch": round(resident, 2),
+        "images_per_sec_fused%d" % FUSE: round(fused, 2),
         "step_ms": round(step_ms, 2),
         "step_flops": step_flops,
         "mfu_vs_197tflops_bf16": round(mfu, 4) if mfu else None,
         "mfu_dispatch_corrected": round(
-            step_flops / ((step_ms - dispatch_floor_ms) / 1000.0)
+            step_flops / ((step_ms - floor_per_step) / 1000.0)
             / PEAK_FLOPS, 4)
-        if mfu and step_ms > dispatch_floor_ms else None,
+        if mfu and step_ms > floor_per_step else None,
         "mfu_note": "corrected = compute-only MFU after subtracting "
                     "this rig's per-dispatch tunnel floor "
-                    "(dispatch_floor_ms; ~0 on a local TPU VM)",
+                    "(dispatch_floor_ms, amortized /%d in fused mode; "
+                    "~0 on a local TPU VM)" % FUSE,
         "pipeline_images_per_sec": round(pipeline, 2),
         "pipeline_quiet_window": pipeline >= QUIET_IMAGES_PER_SEC,
         "pipeline_measures": "staged uint8 H2D + step (post-decode); "

@@ -315,8 +315,29 @@ class LearnTask:
             self.timer.reset_clock()
             self.itr_train.before_first()
             # one-ahead device staging: batch k+1's host->device transfer
-            # is issued on a helper thread while batch k computes
-            pending = None
+            # is issued on a helper thread while batch k computes. With
+            # fuse_steps = K the loop groups K staged batches per
+            # dispatch (Trainer.update_fused): staging continues batch
+            # by batch while the fused K-step program runs, so the
+            # overlap is preserved and the dispatch count drops K-fold.
+            fuse = max(1, self.trainer.fuse_steps)
+
+            def dispatch(group, sample_counter):
+                # dispatch is async: the call returns while the device
+                # computes, so the next batches' transfers (helper
+                # thread) overlap this group's step(s)
+                with self.trace.step(len(group)):
+                    if len(group) == 1:
+                        self.trainer.update(group[0])
+                    else:
+                        self.trainer.update_fused(group)
+                self.timer.tick(len(group))
+                for _ in group:
+                    sample_counter += 1
+                    self._print_progress(sample_counter, start)
+                return sample_counter
+
+            pending = []
             while True:
                 has_next = self.itr_train.next()
                 if self.test_io != 0:
@@ -329,20 +350,18 @@ class LearnTask:
                 if has_next:
                     nxt = self._stager.submit(self.trainer.stage,
                                               self.itr_train.value)
-                if pending is not None:
-                    # dispatch is async: update() returns while the device
-                    # computes, so batch k+1's transfer (helper thread)
-                    # overlaps batch k's step
-                    with self.trace.step():
-                        self.trainer.update(pending)
-                    self.timer.tick()
-                    sample_counter += 1
-                    self._print_progress(sample_counter, start)
+                if len(pending) >= fuse:
+                    sample_counter = dispatch(pending, sample_counter)
+                    pending = []
                 # resolve before touching the iterator again: next() may
                 # reuse the buffers the stager is still reading
-                pending = nxt.result() if nxt is not None else None
+                if nxt is not None:
+                    pending.append(nxt.result())
                 if not has_next:
                     break
+            if self.test_io == 0 and pending:
+                # round tail: a partial group falls back to per-step
+                sample_counter = dispatch(pending, sample_counter)
             if self.test_io == 0:
                 try:
                     sys.stderr.write("[%d]" % self.start_counter)

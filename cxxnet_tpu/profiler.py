@@ -40,22 +40,29 @@ class StepTimer:
         self.total_steps = 0
         self.total_time = 0.0
 
-    def tick(self) -> None:
-        """Mark the end of one step."""
+    def tick(self, n: int = 1) -> None:
+        """Mark the end of ``n`` steps issued as one dispatch (the CLI's
+        fused fuse_steps groups tick once per group): the wall delta is
+        split evenly so per-step stats stay comparable across modes."""
         now = time.perf_counter()
         if self._last is not None:
-            dt = now - self._last
-            self.total_time += dt
-            self._times.append(dt)
-            if len(self._times) > self.window:
+            dt = (now - self._last) / n
+            for _ in range(n):
+                self.total_time += dt
+                self._times.append(dt)
+            while len(self._times) > self.window:
                 self._times.pop(0)
         self._last = now
-        self.total_steps += 1
+        self.total_steps += n
 
     def reset_clock(self) -> None:
-        """Forget the last timestamp (call across round boundaries so
-        eval/checkpoint time is not counted as a step)."""
+        """Forget the last timestamp AND the rolling window (call across
+        round boundaries): eval/checkpoint time is not counted as a
+        step, and the per-round speed line reflects THIS round rather
+        than averaging in earlier rounds' compile outliers. Whole-run
+        totals (total_steps/total_time) are preserved."""
         self._last = None
+        self._times = []
 
     @property
     def mean_step_ms(self) -> float:
@@ -126,11 +133,15 @@ class TraceSession:
             self.stop_batch = int(val)
 
     # ------------------------------------------------------------------
-    def step(self):
-        """Context manager wrapping one train step: starts/stops the trace
-        at the configured batch indices and annotates the step."""
+    def step(self, nbatch: int = 1):
+        """Context manager wrapping one train dispatch covering ``nbatch``
+        batches (1 for a plain step; K for a fused fuse_steps group):
+        starts/stops the trace at the configured BATCH indices, so the
+        profile window stays in batch units whatever the dispatch
+        grouping. The step_num annotation is the dispatch's first batch
+        index."""
         n = self._step
-        self._step += 1
+        self._step += nbatch
         if not self.enabled or self._done:
             return contextlib.nullcontext()
         import jax

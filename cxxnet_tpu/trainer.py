@@ -49,6 +49,7 @@ class Trainer:
         self.cfg: List[ConfigEntry] = []
         self.batch_size = 100
         self.update_period = 1
+        self.fuse_steps = 1
         self.eval_train = 1
         self.seed = 0
         self.silent = 0
@@ -75,6 +76,7 @@ class Trainer:
         self.grad_accum = None
         self._step_count = 0
         self._step_specs = None
+        self._train_multi = None
         self._gen_cache: Dict = {}
 
     # ------------------------------------------------------------------
@@ -86,6 +88,8 @@ class Trainer:
             self.batch_size = int(val)
         elif name == "update_period":
             self.update_period = int(val)
+        elif name == "fuse_steps":
+            self.fuse_steps = int(val)
         elif name == "eval_train":
             self.eval_train = int(val)
         elif name == "seed":
@@ -421,6 +425,44 @@ class Trainer:
             forward_step, in_shardings=(psh, xsh, dsh),
             static_argnums=(3,))
 
+        if self.fuse_steps > 1:
+            if self.update_period != 1:
+                raise ValueError(
+                    "fuse_steps > 1 requires update_period = 1 (gradient "
+                    "accumulation already sets its own dispatch cadence)")
+
+            def train_multi(params, opt_state, rng, epoch, maccum,
+                            datas, extrass, labelss):
+                # stack the K staged batches (one cheap HBM concat) and
+                # lax.scan the SAME train_step over them: K optimizer
+                # steps, metric folds and rng advances — identical math
+                # to K update() calls (test_fuse_steps pins the
+                # trajectories equal) — in ONE host dispatch. Amortizes
+                # the per-dispatch overhead that dominates on a remote/
+                # tunneled chip (docs/performance.md quantifies a 4-10 ms
+                # floor under EVERY dispatch on this rig) and shaves
+                # host-side dispatch work everywhere else.
+                xs = (jnp.stack(datas),
+                      tuple(jnp.stack(col) for col in zip(*extrass)),
+                      [jnp.stack(col) for col in zip(*labelss)])
+
+                def body(carry, x):
+                    p, o, r, e, m = carry
+                    p, o, r, e, m, loss = train_step(p, o, r, e, m, *x)
+                    return (p, o, r, e, m), loss
+
+                (params, opt_state, rng, epoch, maccum), losses = \
+                    jax.lax.scan(
+                        body, (params, opt_state, rng, epoch, maccum), xs)
+                return params, opt_state, rng, epoch, maccum, losses[-1]
+
+            # data args are NOT donated: a caller may legally pass the
+            # same staged batch at several scan slots (bench does)
+            self._train_multi = jax.jit(
+                train_multi, donate_argnums=(0, 1, 2, 3, 4),
+                in_shardings=(psh, osh, rep, rep, rep, xsh, dsh, dsh),
+                out_shardings=(psh, osh, rep, rep, rep, None))
+
     # ------------------------------------------------------------------
     def _put_data(self, arr, sharding=None) -> jnp.ndarray:
         """Host array -> device array under the batch sharding. Multi-host:
@@ -618,6 +660,54 @@ class Trainer:
         if self.sample_counter >= self.update_period:
             self.sample_counter = 0
             self.epoch_counter += 1
+
+    # ------------------------------------------------------------------
+    def update_fused(self, staged) -> None:
+        """Run ``len(staged)`` training steps in ONE jitted dispatch.
+
+        With ``fuse_steps = K`` configured, a full group of K staged
+        batches dispatches the fused lax.scan step compiled in
+        _finish_init; partial groups (a round's tail, or fuse_steps=1)
+        fall back to per-step update() calls. The K-step trajectory is
+        identical to K update() calls — only the host<->device dispatch
+        count changes. The reference has no analogue: its trainer is
+        host-driven batch by batch (cxxnet_main.cpp:344-412); one
+        dispatch per K steps is the XLA-native training-loop shape."""
+        staged = list(staged)
+        if self.fuse_steps <= 1 or len(staged) != self.fuse_steps:
+            for s in staged:
+                self.update(s)
+            return
+        if self._train_multi is None:
+            # fuse_steps was raised AFTER init_model compiled the steps
+            # (set_param alone cannot rebuild the jitted programs, and
+            # the update_period compatibility check lives at init)
+            raise RuntimeError(
+                "fuse_steps=%d was set after init_model(); configure it "
+                "before init so the fused step is compiled"
+                % self.fuse_steps)
+        for s in staged:
+            if not isinstance(s, StagedBatch):
+                raise TypeError("update_fused takes staged batches "
+                                "(Trainer.stage)")
+        datas = tuple(s.device[0] for s in staged)
+        extrass = tuple(tuple(s.device[1]) for s in staged)
+        labelss = tuple(list(s.device[2]) for s in staged)
+        k = len(staged)
+        self._step_count += k
+        if self._step_specs is None:
+            # per-step abstract specs (element 0 of the group), so
+            # step_cost_analysis reports ONE step's flops either path
+            self._step_specs = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                (self.params, self.opt_state, self._rng,
+                 self._epoch_dev, self._maccum,
+                 datas[0], extrass[0], labelss[0]))
+        (self.params, self.opt_state, self._rng, self._epoch_dev,
+         self._maccum, _loss) = self._train_multi(
+            self.params, self.opt_state, self._rng, self._epoch_dev,
+            self._maccum, datas, extrass, labelss)
+        self.epoch_counter += k
 
     # ------------------------------------------------------------------
     def step_cost_analysis(self) -> dict:

@@ -168,11 +168,23 @@ def staged_batches(tr, nclass, n=4):
 
 
 def time_steps(tr, staged, iters):
+    k = getattr(tr, "fuse_steps", 1)
     t0 = time.perf_counter()
-    for i in range(iters):
-        tr.update(staged[i % len(staged)])
+    if k > 1:
+        # fused dispatch (fuse_steps=K): one jitted call per K steps;
+        # >= 2 groups per trial so the one-shot D2H fence and host
+        # jitter never land on a single sample (mirrors bench.py)
+        groups = max(2, (iters + k - 1) // k)
+        for g in range(groups):
+            tr.update_fused([staged[(g * k + j) % len(staged)]
+                             for j in range(k)])
+        n = groups * k
+    else:
+        for i in range(iters):
+            tr.update(staged[i % len(staged)])
+        n = iters
     np.asarray(tr._epoch_dev)            # real D2H fence
-    return (time.perf_counter() - t0) / iters * 1000.0
+    return (time.perf_counter() - t0) / n * 1000.0
 
 
 def interleave(entries, iters, trials, warmup):
@@ -300,8 +312,10 @@ def cmd_zoo(args):
         is_lm = shape[0] == 1 and shape[2] == 1
         # the LM recipe trains with adam (examples/transformer); the
         # conv zoo with the reference's sgd+momentum
-        tr = build([("updater", "adam")] if is_lm else [], text,
-                   nclass, batch=batch)
+        ov = [("updater", "adam")] if is_lm else []
+        if args.fuse > 1:
+            ov.append(("fuse_steps", str(args.fuse)))
+        tr = build(ov, text, nclass, batch=batch)
         if is_lm:
             seq = shape[1]
             toks = rs.randint(0, nclass, size=(batch, 1, seq, 1))
@@ -332,6 +346,7 @@ def cmd_zoo(args):
                if flops and platform == "tpu" else None)
         row = {
             "experiment": "zoo", "net": name, "batch": batch,
+            "fuse_steps": args.fuse,
             "step_ms": round(ms, 3),
             "images_per_sec": round(batch / ms * 1000.0, 1),
             "step_flops": flops,
@@ -354,6 +369,9 @@ def main():
     a.set_defaults(fn=cmd_ablate)
     z = sub.add_parser("zoo")
     z.add_argument("--net", nargs="*", help="subset of net names")
+    z.add_argument("--fuse", type=int, default=1,
+                   help="fuse_steps: optimizer steps per dispatch "
+                        "(amortizes the tunnel's per-dispatch floor)")
     z.add_argument("--iters", type=int, default=12)
     z.add_argument("--trials", type=int, default=5)
     z.add_argument("--warmup", type=int, default=3)
