@@ -144,13 +144,18 @@ def main() -> None:
             tr.update(staged[i % len(staged)])
         np.asarray(tr._epoch_dev)
 
-    def run_fused(groups, staged):
+    # two pre-stacked fused groups (stage_fused: one put per group),
+    # alternated so no dispatch ever reuses the previous one's buffers
+    fused_groups = [tr.stage_fused([batches[(g + j) % 4]
+                                    for j in range(FUSE)])
+                    for g in range(2)]
+
+    def run_fused(groups):
         # fused mode: ONE dispatch per FUSE optimizer steps (fuse_steps,
         # Trainer.update_fused) — the XLA-native loop shape; amortizes
         # the per-dispatch floor FUSE-fold
         for g in range(groups):
-            tr.update_fused([staged[(g * FUSE + j) % len(staged)]
-                             for j in range(FUSE)])
+            tr.update_fused(fused_groups[g % 2])
         np.asarray(tr._epoch_dev)
 
     # ---- primary metric: device-resident training step throughput ----
@@ -171,11 +176,11 @@ def main() -> None:
     # same protocol, fused dispatch: both modes measured every run so
     # the dispatch-amortization gain is an artifact, not an assertion
     fgroups = max(2, (iters + FUSE - 1) // FUSE)
-    run_fused(1, staged)     # compile the scan program outside the clock
+    run_fused(1)     # compile the scan program outside the clock
     fused = 0.0
     for _ in range(n_trials):
         t0 = time.perf_counter()
-        run_fused(fgroups, staged)
+        run_fused(fgroups)
         fused = max(fused,
                     BATCH * FUSE * fgroups / (time.perf_counter() - t0))
 

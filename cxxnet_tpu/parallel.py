@@ -136,6 +136,14 @@ def input_sharding(mesh: Mesh, shape: Tuple[int, ...]) -> NamedSharding:
     return batch_sharding(mesh)
 
 
+def stacked_sharding(sharding: NamedSharding) -> NamedSharding:
+    """The same placement with a leading UNSHARDED group axis — how a
+    fuse_steps group of K batches lays out after stacking: (K, batch,
+    ...) with the batch/seq dims sharded exactly as the per-batch
+    array was."""
+    return NamedSharding(sharding.mesh, P(None, *sharding.spec))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

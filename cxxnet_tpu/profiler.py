@@ -146,11 +146,15 @@ class TraceSession:
             return contextlib.nullcontext()
         import jax
 
-        if not self._active and n >= self.start_batch:
+        if not self._active and n + nbatch > self.start_batch:
+            # this dispatch reaches the window: start, and annotate it
+            # below — stopping is deferred to a LATER call, so a group
+            # spanning both boundaries still records itself instead of
+            # writing an empty trace
             os.makedirs(self.dir, exist_ok=True)
             jax.profiler.start_trace(self.dir)
             self._active = True
-        if self._active and n >= self.stop_batch:
+        elif self._active and n >= self.stop_batch:
             jax.profiler.stop_trace()
             self._active = False
             self._done = True

@@ -32,13 +32,18 @@ ConfigEntry = Tuple[str, str]
 
 
 class StagedBatch:
-    """A batch whose host->device transfer has been issued (Trainer.stage)."""
+    """A batch whose host->device transfer has been issued (Trainer.stage).
 
-    __slots__ = ("device", "host")
+    ``fused`` > 0 marks a STACKED group of that many batches staged as
+    one transfer (Trainer.stage_fused); its device fields carry a
+    leading group axis."""
 
-    def __init__(self, device, host: DataBatch) -> None:
+    __slots__ = ("device", "host", "fused")
+
+    def __init__(self, device, host: DataBatch, fused: int = 0) -> None:
         self.device = device
         self.host = host
+        self.fused = fused
 
 
 class Trainer:
@@ -50,6 +55,10 @@ class Trainer:
         self.batch_size = 100
         self.update_period = 1
         self.fuse_steps = 1
+        # unroll 2 measured as fast as single-dispatch in quiet windows
+        # (unroll 1 pays ~2.5% scan-loop overhead on AlexNet; 8 buys
+        # nothing more and compiles 4x longer) — see docs/performance.md
+        self.fuse_unroll = 2
         self.eval_train = 1
         self.seed = 0
         self.silent = 0
@@ -90,6 +99,8 @@ class Trainer:
             self.update_period = int(val)
         elif name == "fuse_steps":
             self.fuse_steps = int(val)
+        elif name == "fuse_unroll":
+            self.fuse_unroll = int(val)
         elif name == "eval_train":
             self.eval_train = int(val)
         elif name == "seed":
@@ -430,37 +441,49 @@ class Trainer:
                 raise ValueError(
                     "fuse_steps > 1 requires update_period = 1 (gradient "
                     "accumulation already sets its own dispatch cadence)")
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "fuse_steps > 1 is single-process: the stacked group "
+                    "transfer has no multi-host batch assembly (and a "
+                    "local chip has no dispatch floor to amortize)")
 
             def train_multi(params, opt_state, rng, epoch, maccum,
-                            datas, extrass, labelss):
-                # stack the K staged batches (one cheap HBM concat) and
-                # lax.scan the SAME train_step over them: K optimizer
-                # steps, metric folds and rng advances — identical math
-                # to K update() calls (test_fuse_steps pins the
-                # trajectories equal) — in ONE host dispatch. Amortizes
-                # the per-dispatch overhead that dominates on a remote/
-                # tunneled chip (docs/performance.md quantifies a 4-10 ms
-                # floor under EVERY dispatch on this rig) and shaves
-                # host-side dispatch work everywhere else.
-                xs = (jnp.stack(datas),
-                      tuple(jnp.stack(col) for col in zip(*extrass)),
-                      [jnp.stack(col) for col in zip(*labelss)])
-
+                            data_s, extras_s, labels_s):
+                # lax.scan the SAME train_step over a stacked (K, ...)
+                # group: K optimizer steps, metric folds and rng
+                # advances — identical math to K update() calls
+                # (test_fuse_steps pins the trajectories equal) — in
+                # ONE host dispatch. Amortizes the per-dispatch
+                # overhead that dominates on a remote/tunneled chip
+                # (docs/performance.md quantifies a 4-10 ms floor under
+                # EVERY dispatch on this rig) and shaves host-side
+                # dispatch work everywhere else.
                 def body(carry, x):
                     p, o, r, e, m = carry
                     p, o, r, e, m, loss = train_step(p, o, r, e, m, *x)
                     return (p, o, r, e, m), loss
 
+                # fuse_unroll > 1 unrolls the scan body: the group
+                # becomes straight-line XLA, free to overlap one step's
+                # tail with the next one's input convert — a boundary
+                # back-to-back dispatched programs cannot cross.
+                # Costs compile time proportional to the unroll factor.
                 (params, opt_state, rng, epoch, maccum), losses = \
                     jax.lax.scan(
-                        body, (params, opt_state, rng, epoch, maccum), xs)
+                        body, (params, opt_state, rng, epoch, maccum),
+                        (data_s, extras_s, labels_s),
+                        unroll=max(1, min(self.fuse_unroll,
+                                          self.fuse_steps)))
                 return params, opt_state, rng, epoch, maccum, losses[-1]
 
-            # data args are NOT donated: a caller may legally pass the
-            # same staged batch at several scan slots (bench does)
+            xsh_s = parallel.stacked_sharding(xsh)
+            dsh_s = parallel.stacked_sharding(dsh)
+            # data args are NOT donated: a group staged once may legally
+            # be dispatched again (bench cycles a fixed staged set)
             self._train_multi = jax.jit(
                 train_multi, donate_argnums=(0, 1, 2, 3, 4),
-                in_shardings=(psh, osh, rep, rep, rep, xsh, dsh, dsh),
+                in_shardings=(psh, osh, rep, rep, rep, xsh_s, dsh_s,
+                              dsh_s),
                 out_shardings=(psh, osh, rep, rep, rep, None))
 
     # ------------------------------------------------------------------
@@ -561,6 +584,40 @@ class Trainer:
         self._maybe_set_norm(batch)
         return StagedBatch(self._put_batch(batch), batch)
 
+    def stage_fused(self, batches) -> "StagedBatch":
+        """Stage a full fuse_steps group as ONE stacked host->device
+        transfer: (K, batch, ...) arrays, one put. K-fold fewer
+        transfer round trips than per-batch stage() — the difference
+        matters exactly where fuse_steps itself does (remote chips,
+        small batches). The caller must own the batches' host buffers
+        (they are read at call time); iterators that reuse buffers
+        across next() must go through per-batch stage() instead, as the
+        CLI loop does."""
+        batches = list(batches)
+        if self.fuse_steps <= 1 or len(batches) != self.fuse_steps:
+            raise ValueError(
+                "stage_fused needs exactly fuse_steps=%d batches, got %d"
+                % (self.fuse_steps, len(batches)))
+        fields = []
+        for b in batches:
+            self._maybe_set_norm(b)
+            fields.append(self._host_fields(b))
+        data_s = np.stack([f[0] for f in fields])
+        extras_s = tuple(np.stack(col)
+                         for col in zip(*(f[1] for f in fields)))
+        labels_s = [np.stack(col)
+                    for col in zip(*(f[2] for f in fields))]
+        if self.n_devices == 1:
+            dev = jax.device_put((data_s, extras_s, labels_s))
+        else:
+            xsh_s = parallel.stacked_sharding(self._xsh)
+            dsh_s = parallel.stacked_sharding(self._dsh)
+            dev = jax.device_put(
+                (data_s, extras_s, labels_s),
+                (xsh_s, tuple([dsh_s] * len(extras_s)),
+                 [dsh_s] * len(labels_s)))
+        return StagedBatch(dev, batches[0], fused=len(batches))
+
     def start_round(self, round_: int) -> None:
         self.round = round_
         if self.test_on_server:
@@ -628,6 +685,8 @@ class Trainer:
         """One minibatch of training (reference: nnet_impl-inl.hpp:141-185).
         Accepts a DataBatch or a StagedBatch from stage()."""
         if isinstance(batch, StagedBatch):
+            if batch.fused:
+                return self.update_fused(batch)
             data, extras, labels = batch.device
         else:
             self._maybe_set_norm(batch)
@@ -673,40 +732,57 @@ class Trainer:
         count changes. The reference has no analogue: its trainer is
         host-driven batch by batch (cxxnet_main.cpp:344-412); one
         dispatch per K steps is the XLA-native training-loop shape."""
-        staged = list(staged)
-        if self.fuse_steps <= 1 or len(staged) != self.fuse_steps:
+        if isinstance(staged, StagedBatch) and staged.fused:
+            group = staged
+        else:
+            staged = list(staged)
+            if self.fuse_steps <= 1 or len(staged) != self.fuse_steps:
+                for s in staged:
+                    self.update(s)
+                return
+            if self._train_multi is None:
+                # fuse_steps was raised AFTER init_model compiled the
+                # steps (set_param alone cannot rebuild the jitted
+                # programs, and the update_period compatibility check
+                # lives at init)
+                raise RuntimeError(
+                    "fuse_steps=%d was set after init_model(); configure "
+                    "it before init so the fused step is compiled"
+                    % self.fuse_steps)
             for s in staged:
-                self.update(s)
-            return
+                if not isinstance(s, StagedBatch):
+                    raise TypeError("update_fused takes staged batches "
+                                    "(Trainer.stage)")
+            # stack the per-batch device arrays into the (K, ...) group
+            # layout outside the step (one async concat dispatch per
+            # group; stage_fused skips even that by stacking on host)
+            group = StagedBatch(
+                (jnp.stack([s.device[0] for s in staged]),
+                 tuple(jnp.stack(col)
+                       for col in zip(*(s.device[1] for s in staged))),
+                 [jnp.stack(col)
+                  for col in zip(*(s.device[2] for s in staged))]),
+                staged[0].host, fused=len(staged))
         if self._train_multi is None:
-            # fuse_steps was raised AFTER init_model compiled the steps
-            # (set_param alone cannot rebuild the jitted programs, and
-            # the update_period compatibility check lives at init)
             raise RuntimeError(
-                "fuse_steps=%d was set after init_model(); configure it "
-                "before init so the fused step is compiled"
-                % self.fuse_steps)
-        for s in staged:
-            if not isinstance(s, StagedBatch):
-                raise TypeError("update_fused takes staged batches "
-                                "(Trainer.stage)")
-        datas = tuple(s.device[0] for s in staged)
-        extrass = tuple(tuple(s.device[1]) for s in staged)
-        labelss = tuple(list(s.device[2]) for s in staged)
-        k = len(staged)
+                "fuse_steps was not configured before init_model()")
+        data_s, extras_s, labels_s = group.device
+        k = group.fused
         self._step_count += k
         if self._step_specs is None:
-            # per-step abstract specs (element 0 of the group), so
+            # per-step abstract specs (group element 0), so
             # step_cost_analysis reports ONE step's flops either path
+            elem = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype),
+                (data_s, extras_s, labels_s))
             self._step_specs = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
                 (self.params, self.opt_state, self._rng,
-                 self._epoch_dev, self._maccum,
-                 datas[0], extrass[0], labelss[0]))
+                 self._epoch_dev, self._maccum)) + elem
         (self.params, self.opt_state, self._rng, self._epoch_dev,
          self._maccum, _loss) = self._train_multi(
             self.params, self.opt_state, self._rng, self._epoch_dev,
-            self._maccum, datas, extrass, labelss)
+            self._maccum, data_s, extras_s, labels_s)
         self.epoch_counter += k
 
     # ------------------------------------------------------------------

@@ -147,6 +147,56 @@ def test_fused_on_sharded_mesh():
     assert_params_close(params_host(ta), params_host(tb))
 
 
+def test_stage_fused_group_matches_per_step():
+    # stage_fused: the whole K-group ships as ONE stacked transfer;
+    # trajectory must still equal K per-step updates
+    batches = make_batches(6, seed=6)
+    ta = run_per_step(CONF, batches)
+    tb = make_trainer(CONF, fuse_steps=3)
+    for i in range(0, 6, 3):
+        tb.update_fused(tb.stage_fused(batches[i:i + 3]))
+    assert_params_close(params_host(ta), params_host(tb))
+    assert tb.epoch_counter == 6
+    np.testing.assert_allclose(np.asarray(ta._maccum),
+                               np.asarray(tb._maccum), rtol=1e-6)
+
+
+def test_stage_fused_group_through_update():
+    # update() recognizes a fused group and routes it to update_fused
+    batches = make_batches(2, seed=7)
+    ta = run_per_step(CONF, batches)
+    tb = make_trainer(CONF, fuse_steps=2)
+    tb.update(tb.stage_fused(batches))
+    assert_params_close(params_host(ta), params_host(tb))
+
+
+def test_stage_fused_on_sharded_mesh():
+    dev = "cpu:" + ",".join(str(i) for i in range(8))
+    batches = make_batches(4, batch=32, seed=8)
+    ta = run_per_step(CONF, batches, dev=dev, batch_size=32)
+    tb = make_trainer(CONF, fuse_steps=2, dev=dev, batch_size=32)
+    for i in range(0, 4, 2):
+        tb.update_fused(tb.stage_fused(batches[i:i + 2]))
+    assert_params_close(params_host(ta), params_host(tb))
+
+
+def test_fused_unrolled_matches_per_step():
+    # fuse_unroll unrolls the scan body (straight-line XLA); the
+    # trajectory must not change
+    batches = make_batches(4, seed=10)
+    ta = run_per_step(CONF, batches)
+    tb = make_trainer(CONF, fuse_steps=2, fuse_unroll=2)
+    for i in range(0, 4, 2):
+        tb.update_fused(tb.stage_fused(batches[i:i + 2]))
+    assert_params_close(params_host(ta), params_host(tb))
+
+
+def test_stage_fused_wrong_count_raises():
+    tr = make_trainer(CONF, fuse_steps=3)
+    with pytest.raises(ValueError, match="fuse_steps"):
+        tr.stage_fused(make_batches(2, seed=9))
+
+
 def test_fused_rejects_update_period():
     with pytest.raises(ValueError, match="update_period"):
         make_trainer(CONF, fuse_steps=2, update_period=2)

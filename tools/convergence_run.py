@@ -66,7 +66,7 @@ def quadrant_data(n: int, side: int, seed: int):
 
 def run(name: str, text: str, side: int, batch: int, rounds: int,
         n_train: int, n_val: int, eta: float, out_path: str,
-        extra=(), scale: float = 1.0):
+        extra=(), scale: float = 1.0, fuse: int = 1):
     import perf_lab
 
     from cxxnet_tpu.io import DataBatch
@@ -84,6 +84,8 @@ def run(name: str, text: str, side: int, batch: int, rounds: int,
     extra = list(extra)
     if not any(k == "updater" and v == "adam" for k, v in extra):
         extra += [("wmat:wd", "0.0005"), ("bias:wd", "0.0")]
+    if fuse > 1:
+        extra.append(("fuse_steps", str(fuse)))
     tr = perf_lab.build(extra + [("eta", str(eta)),
                                  ("eval_train", "1")], text,
                         nclass=4, batch=batch)
@@ -125,7 +127,8 @@ def run(name: str, text: str, side: int, batch: int, rounds: int,
                     "RAM, two-ahead staged H2D",
             "input_scale": scale,
             "hyperparams": dict(extra),
-            "batch": batch, "rounds": len(curve),
+            "batch": batch, "fuse_steps": fuse,
+            "rounds": len(curve),
             "rounds_requested": rounds, "n_train": n_train,
             "n_val": n_val, "eta": eta,
             "total_wall_s": round(total_wall, 1),
@@ -146,13 +149,32 @@ def run(name: str, text: str, side: int, batch: int, rounds: int,
         order = rs.permutation(n_train)
         tr.start_round(r)
         t0 = time.time()
-        pend = [stager.submit(tr.stage, batch_at(xtr, ytr, order, j))
-                for j in range(min(2, nb))]
-        for j in range(nb):
-            if j + 2 < nb:
-                pend.append(stager.submit(
-                    tr.stage, batch_at(xtr, ytr, order, j + 2)))
-            tr.update(pend.pop(0).result())
+        if fuse > 1:
+            # group staging: each fuse_steps group ships as ONE stacked
+            # put and dispatches as ONE scanned step (batch_at copies,
+            # so groups own their host buffers); round tail per-step
+            ngroups = nb // fuse
+
+            def stage_group(g):
+                return tr.stage_fused(
+                    [batch_at(xtr, ytr, order, g * fuse + j)
+                     for j in range(fuse)])
+            pend = [stager.submit(stage_group, g)
+                    for g in range(min(2, ngroups))]
+            for g in range(ngroups):
+                if g + 2 < ngroups:
+                    pend.append(stager.submit(stage_group, g + 2))
+                tr.update_fused(pend.pop(0).result())
+            for j in range(ngroups * fuse, nb):
+                tr.update(batch_at(xtr, ytr, order, j))
+        else:
+            pend = [stager.submit(tr.stage, batch_at(xtr, ytr, order, j))
+                    for j in range(min(2, nb))]
+            for j in range(nb):
+                if j + 2 < nb:
+                    pend.append(stager.submit(
+                        tr.stage, batch_at(xtr, ytr, order, j + 2)))
+                tr.update(pend.pop(0).result())
         line = tr.evaluate(None, "train")      # fences device metrics
         train_err = float(line.split("train-error:")[1])
         ve = val_error()
@@ -189,6 +211,9 @@ def main():
                          "adam + warmup converges within this "
                          "artifact's 2k-step budget (measured r3).")
     ap.add_argument("--warmup", type=int, default=0)
+    ap.add_argument("--fuse", type=int, default=1,
+                    help="fuse_steps: optimizer steps per dispatch; "
+                         "groups also ship as one stacked transfer")
     ap.add_argument("--scale", type=float, default=1.0 / 60.0,
                     help="on-device input scale after mean subtract")
     ap.add_argument("--out", default=os.path.join(
@@ -205,12 +230,12 @@ def main():
             batch=256, rounds=args.rounds or 40,
             n_train=args.train or 16384, n_val=args.val,
             eta=args.eta or 0.01, out_path=args.out, scale=args.scale,
-            extra=extra)
+            extra=extra, fuse=args.fuse)
     else:
         run("bowl", models.bowl_net(nclass=121), side=40, batch=64,
             rounds=args.rounds or 100, n_train=args.train or 30336,
             n_val=args.val, eta=args.eta or 0.05, out_path=args.out,
-            scale=args.scale, extra=extra)
+            scale=args.scale, extra=extra, fuse=args.fuse)
 
 
 if __name__ == "__main__":

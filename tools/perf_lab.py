@@ -168,16 +168,16 @@ def staged_batches(tr, nclass, n=4):
 
 
 def time_steps(tr, staged, iters):
-    k = getattr(tr, "fuse_steps", 1)
     t0 = time.perf_counter()
-    if k > 1:
-        # fused dispatch (fuse_steps=K): one jitted call per K steps;
-        # >= 2 groups per trial so the one-shot D2H fence and host
-        # jitter never land on a single sample (mirrors bench.py)
+    if staged and getattr(staged[0], "fused", 0):
+        # pre-stacked fuse_steps groups (tr.stage_fused): one jitted
+        # call per K steps; >= 2 groups per trial so the one-shot D2H
+        # fence and host jitter never land on a single sample
+        # (mirrors bench.py)
+        k = staged[0].fused
         groups = max(2, (iters + k - 1) // k)
         for g in range(groups):
-            tr.update_fused([staged[(g * k + j) % len(staged)]
-                             for j in range(k)])
+            tr.update_fused(staged[g % len(staged)])
         n = groups * k
     else:
         for i in range(iters):
@@ -318,20 +318,27 @@ def cmd_zoo(args):
         tr = build(ov, text, nclass, batch=batch)
         if is_lm:
             seq = shape[1]
-            toks = rs.randint(0, nclass, size=(batch, 1, seq, 1))
-            staged = [tr.stage(DataBatch(
-                data=toks.astype(np.float32),
+            hbs = [DataBatch(
+                data=rs.randint(0, nclass, size=(batch, 1, seq, 1)
+                                ).astype(np.float32),
                 label=rs.randint(0, nclass,
-                                 size=(batch, seq)).astype(np.float32)))
+                                 size=(batch, seq)).astype(np.float32))
                 for _ in range(3)]
         else:
-            staged = [tr.stage(DataBatch(
+            hbs = [DataBatch(
                 data=rs.randint(0, 256, size=(batch,) + shape,
                                 dtype=np.uint8),
                 label=rs.randint(0, nclass,
                                  size=(batch, 1)).astype(np.float32),
-                norm=(np.full((3, 1, 1), 120.0, np.float32), 1.0)))
+                norm=(np.full((3, 1, 1), 120.0, np.float32), 1.0))
                 for _ in range(3)]
+        if args.fuse > 1:
+            # two pre-stacked groups (one put each), alternated
+            staged = [tr.stage_fused([hbs[(g + j) % len(hbs)]
+                                      for j in range(args.fuse)])
+                      for g in range(2)]
+        else:
+            staged = [tr.stage(b) for b in hbs]
         entries.append((name, tr, staged))
         meta[name] = (batch, shape[1] if is_lm else None)
     best = interleave(entries, args.iters, args.trials, args.warmup)
