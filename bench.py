@@ -272,10 +272,14 @@ def main() -> None:
             step_flops / ((step_ms - floor_per_step) / 1000.0)
             / PEAK_FLOPS, 4)
         if mfu and step_ms > floor_per_step else None,
-        "mfu_note": "corrected = compute-only MFU after subtracting "
-                    "this rig's per-dispatch tunnel floor "
+        "mfu_note": "corrected = UPPER BOUND on compute MFU after "
+                    "subtracting this rig's per-dispatch tunnel floor "
                     "(dispatch_floor_ms, amortized /%d in fused mode; "
-                    "~0 on a local TPU VM)" % FUSE,
+                    "~0 on a local TPU VM). Upper bound because "
+                    "dispatch partially overlaps compute in steady "
+                    "state — fused-mode parity in quiet windows shows "
+                    "the overlap — so true compute MFU lies between "
+                    "raw and corrected" % FUSE,
         "pipeline_images_per_sec": round(pipeline, 2),
         "pipeline_quiet_window": pipeline >= QUIET_IMAGES_PER_SEC,
         "pipeline_measures": "staged uint8 H2D + step (post-decode); "
