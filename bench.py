@@ -161,28 +161,27 @@ def main() -> None:
     # ---- primary metric: device-resident training step throughput ----
     staged = [tr.stage(b) for b in batches]
     run_resident(WARMUP, staged)
-    # the floor probe runs adjacent to EVERY resident trial; the MIN is
-    # used for the corrected MFU, so a contended-window probe can only
-    # UNDER-correct (a lone probe could subtract a 15 ms contended
-    # floor from a quiet-window step and inflate the corrected MFU)
-    resident, floors = 0.0, []
+    # the floor probe runs once per trial, inside the same
+    # resident+fused window; the MIN across trials is used for the
+    # corrected MFU, so a contended-window probe can only UNDER-correct
+    # (a lone probe could subtract a 15 ms contended floor from a
+    # quiet-window step and inflate the corrected MFU)
+    # both modes measured every run, INTERLEAVED per trial so tunnel
+    # weather hits them equally and the dispatch-amortization gain is
+    # an artifact, not an assertion
+    fgroups = max(2, (iters + FUSE - 1) // FUSE)
+    run_fused(1)     # compile the scan program outside the clock
+    resident, fused, floors = 0.0, 0.0, []
     for _ in range(n_trials):
         t0 = time.perf_counter()
         run_resident(iters, staged)
         resident = max(resident, BATCH * iters / (time.perf_counter() - t0))
-        floors.append(_measure_dispatch_floor_ms())
-    dispatch_floor_ms = min(floors)
-
-    # same protocol, fused dispatch: both modes measured every run so
-    # the dispatch-amortization gain is an artifact, not an assertion
-    fgroups = max(2, (iters + FUSE - 1) // FUSE)
-    run_fused(1)     # compile the scan program outside the clock
-    fused = 0.0
-    for _ in range(n_trials):
         t0 = time.perf_counter()
         run_fused(fgroups)
         fused = max(fused,
                     BATCH * FUSE * fgroups / (time.perf_counter() - t0))
+        floors.append(_measure_dispatch_floor_ms())
+    dispatch_floor_ms = min(floors)
 
     # MFU: flops from XLA's own HLO cost model for the whole train step
     # (fwd+bwd+update), against v5e bf16 peak — the honest utilization

@@ -49,6 +49,37 @@ def test_trace_session_writes_trace(tmp_path):
     assert files, "no trace output written"
 
 
+def test_trace_session_fused_group_spanning_window(tmp_path):
+    # a fused group can cover BOTH the start and stop batch indices in
+    # one step() call; the trace must still capture that group (start
+    # now, stop on a later call) instead of writing an empty profile
+    import jax
+    import jax.numpy as jnp
+
+    sess = TraceSession()
+    sess.set_param("profile", "1")
+    sess.set_param("profile_dir", str(tmp_path / "prof"))
+    sess.set_param("profile_start_batch", "2")
+    sess.set_param("profile_stop_batch", "12")
+
+    f = jax.jit(lambda x: jnp.tanh(x) @ x)
+    x = jnp.ones((32, 32), jnp.float32)
+    annotated = 0
+    for _ in range(3):                       # groups of 16 batches
+        # nullcontext's __enter__ yields None; StepTraceAnnotation
+        # yields itself — so `cm is not None` == "this step is traced"
+        with sess.step(16) as cm:
+            if cm is not None:
+                annotated += 1
+            jax.block_until_ready(f(x))
+    sess.close()
+    assert sess._done
+    assert annotated >= 1, "group spanning the window was not traced"
+    files = glob.glob(str(tmp_path / "prof" / "**" / "*.*"),
+                      recursive=True)
+    assert files, "no trace output written"
+
+
 def test_trace_session_disabled_is_inert(tmp_path):
     sess = TraceSession()  # profile defaults to 0
     for _ in range(3):
