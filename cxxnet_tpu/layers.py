@@ -1648,10 +1648,15 @@ class TransformerStackLayer(Layer):
         self.capacity_factor = 1.25
         self.moe_loss = 0.01
         self.attn_impl = "auto"
+        self.scan_unroll = 1
 
     def set_param(self, name, val):
         if name == "nlayer":
             self.nlayer = int(val)
+        elif name == "scan_unroll":
+            # unroll factor for the layer scan (straight-line XLA can
+            # overlap across block boundaries; costs compile time)
+            self.scan_unroll = int(val)
         elif name == "nhead":
             self.nhead = int(val)
         elif name == "causal":
@@ -1829,7 +1834,8 @@ class TransformerStackLayer(Layer):
                 h2, a = block(lp, hh)
                 return (h2, aux + a), None
             (h, aux_total), _ = jax.lax.scan(
-                body, (h, jnp.zeros((), jnp.float32)), params)
+                body, (h, jnp.zeros((), jnp.float32)), params,
+                unroll=max(1, min(self.scan_unroll, self.nlayer)))
             if self.moe and ctx.train and self.moe_loss > 0.0:
                 ctx.losses.append(self.moe_loss * aux_total / self.nlayer)
         return [h.astype(jnp.float32).reshape(b, 1, s, e)]

@@ -93,6 +93,39 @@ def test_tiny_lm_learns_markov_data():
     assert errs[-1] < errs[0], errs
 
 
+def test_stack_scan_unroll_matches():
+    # scan_unroll unrolls the transformer_stack layer scan; identical
+    # math, only the compiled loop shape changes
+    import numpy as np
+
+    def build(unroll):
+        tr = Trainer()
+        for k, v in config.parse_string(
+                models.tiny_lm(seq_len=16, vocab=16, embed=16,
+                               nlayer=4, nhead=2)):
+            tr.set_param(k, v)
+        for k, v in (("batch_size", "8"), ("dev", "cpu:0"),
+                     ("eta", "0.3"), ("seed", "3"),
+                     ("scan_unroll", str(unroll))):
+            tr.set_param(k, v)
+        tr.init_model()
+        return tr
+
+    rs = np.random.RandomState(0)
+    from cxxnet_tpu.io import DataBatch
+    b = DataBatch(data=rs.randint(0, 16, size=(8, 1, 16, 1)
+                                  ).astype(np.float32),
+                  label=rs.randint(0, 16, size=(8, 16)
+                                   ).astype(np.float32))
+    t1, t4 = build(1), build(4)
+    t1.update(b)
+    t4.update(b)
+    import jax
+    for a, c in zip(jax.tree.leaves(jax.tree.map(np.asarray, t1.params)),
+                    jax.tree.leaves(jax.tree.map(np.asarray, t4.params))):
+        np.testing.assert_allclose(a, c, rtol=2e-5, atol=1e-6)
+
+
 def test_lm_is_causal():
     """Perturbing a future token must not change earlier predictions."""
     tr = _lm_trainer(seq=8, vocab=8)
