@@ -19,7 +19,7 @@ import numpy as np
 from . import checkpoint, config
 from .io import DataIterator, create_iterator
 from .profiler import StepTimer, TraceSession, device_memory_summary
-from .trainer import Trainer
+from .trainer import GroupStager, StagedBatch, Trainer
 
 ConfigEntry = Tuple[str, str]
 
@@ -45,6 +45,7 @@ class LearnTask:
         self.model_in = "NULL"
         self.name_pred = "pred.txt"
         self.print_step = 100
+        self.group_staging = 1
         self.extract_node_name = ""
         self.output_format = 1
         self.trace = TraceSession()
@@ -62,6 +63,8 @@ class LearnTask:
             self.net_type = int(val)
         elif name == "print_step":
             self.print_step = int(val)
+        elif name == "group_staging":
+            self.group_staging = int(val)
         elif name == "continue":
             self.continue_training = int(val)
         elif name == "save_model":
@@ -316,28 +319,47 @@ class LearnTask:
             self.itr_train.before_first()
             # one-ahead device staging: batch k+1's host->device transfer
             # is issued on a helper thread while batch k computes. With
-            # fuse_steps = K the loop groups K staged batches per
-            # dispatch (Trainer.update_fused): staging continues batch
-            # by batch while the fused K-step program runs, so the
-            # overlap is preserved and the dispatch count drops K-fold.
+            # fuse_steps = K the loop groups K batches per dispatch
+            # (Trainer.update_fused). Two staging modes:
+            #  * group_staging = 1 (default with fuse): each group is
+            #    copied incrementally into a preallocated stacked buffer
+            #    (GroupStager) and ships as ONE transfer — K-fold fewer
+            #    put round trips; two stagers rotate so one fills while
+            #    the other's transfer flies.
+            #  * group_staging = 0 (and always for fuse = 1): per-batch
+            #    stage() as before; fused dispatch stacks on device.
             fuse = max(1, self.trainer.fuse_steps)
+            use_groups = fuse > 1 and self.group_staging != 0
 
             def dispatch(group, sample_counter):
-                # dispatch is async: the call returns while the device
-                # computes, so the next batches' transfers (helper
-                # thread) overlap this group's step(s)
-                with self.trace.step(len(group)):
-                    if len(group) == 1:
-                        self.trainer.update(group[0])
-                    else:
+                # group: a list of per-batch StagedBatch, or one fused
+                # StagedBatch group. dispatch is async: the call
+                # returns while the device computes, so the next
+                # batches' transfers (helper thread) overlap this
+                # group's step(s)
+                if isinstance(group, StagedBatch):
+                    n = group.fused or 1
+                    with self.trace.step(n):
                         self.trainer.update_fused(group)
-                self.timer.tick(len(group))
-                for _ in group:
+                else:
+                    n = len(group)
+                    with self.trace.step(n):
+                        if n == 1:
+                            self.trainer.update(group[0])
+                        else:
+                            self.trainer.update_fused(group)
+                self.timer.tick(n)
+                for _ in range(n):
                     sample_counter += 1
                     self._print_progress(sample_counter, start)
                 return sample_counter
 
+            gstagers = None
+            if use_groups:
+                gstagers = [GroupStager(self.trainer),
+                            GroupStager(self.trainer)]
             pending = []
+            cur, infl = 0, None
             while True:
                 has_next = self.itr_train.next()
                 if self.test_io != 0:
@@ -346,6 +368,30 @@ class LearnTask:
                     sample_counter += 1
                     self._print_progress(sample_counter, start)
                     continue
+                if use_groups:
+                    if has_next:
+                        # add() copies the batch NOW, so the iterator
+                        # may reuse its buffers on the next next()
+                        gs = gstagers[cur]
+                        gs.add(self.itr_train.value)
+                        if gs.full:
+                            fut = self._stager.submit(gs.stage)
+                            # dispatch the PREVIOUS group while this
+                            # one's transfer flies on the helper thread
+                            if infl is not None:
+                                sample_counter = dispatch(
+                                    infl.result(), sample_counter)
+                            infl = fut
+                            cur ^= 1
+                        continue
+                    if infl is not None:
+                        sample_counter = dispatch(infl.result(),
+                                                  sample_counter)
+                        infl = None
+                    # round tail: partial group falls back per-step
+                    for s in gstagers[cur].flush():
+                        sample_counter = dispatch([s], sample_counter)
+                    break
                 nxt = None
                 if has_next:
                     nxt = self._stager.submit(self.trainer.stage,

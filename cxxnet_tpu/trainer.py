@@ -46,6 +46,77 @@ class StagedBatch:
         self.fused = fused
 
 
+class GroupStager:
+    """Incrementally assemble a fuse_steps group in preallocated
+    stacked host buffers, then ship it as ONE transfer.
+
+    ``add(batch)`` copies the batch's fields into the next slot AT CALL
+    TIME, so iterators that reuse their buffers across next() are safe
+    (the reason the CLI cannot call stage_fused directly). ``stage()``
+    issues the single put for a full group; ``flush()`` stages a
+    partial tail per-slot for the per-step path. The caller must not
+    refill a stager while its staged transfer may still be reading the
+    buffers — rotate two stagers and consume one's StagedBatch (e.g.
+    dispatch it) before adding to it again, as the CLI loop does."""
+
+    def __init__(self, trainer: "Trainer") -> None:
+        self.tr = trainer
+        self.k = trainer.fuse_steps
+        self.n = 0
+        self._bufs = None
+
+    def add(self, batch: DataBatch) -> None:
+        if self.n >= self.k:
+            raise RuntimeError("GroupStager is full; stage() it first")
+        tr = self.tr
+        tr._maybe_set_norm(batch)
+        data, extras, labels = tr._host_fields(batch)
+        if self._bufs is None:
+            def alloc(a):
+                return np.empty((self.k,) + a.shape, a.dtype)
+            self._bufs = (alloc(data), tuple(alloc(e) for e in extras),
+                          [alloc(l) for l in labels])
+        d, es, ls = self._bufs
+        d[self.n] = data
+        for buf, e in zip(es, extras):
+            buf[self.n] = e
+        for buf, l in zip(ls, labels):
+            buf[self.n] = l
+        self.n += 1
+
+    @property
+    def full(self) -> bool:
+        return self.n >= self.k
+
+    def stage(self) -> "StagedBatch":
+        """One put for the full group; resets the fill counter."""
+        if not self.full:
+            raise RuntimeError(
+                "GroupStager.stage needs %d batches, has %d (use "
+                "flush() for a partial tail)" % (self.k, self.n))
+        d, es, ls = self._bufs
+        out = self.tr._put_group(d, es, ls)
+        # device_put is async: wait for the transfer so the caller may
+        # refill these host buffers the moment this returns (stage runs
+        # on the CLI's helper thread, so blocking here IS the overlap)
+        jax.block_until_ready(out.device)
+        self.n = 0
+        return out
+
+    def flush(self) -> List["StagedBatch"]:
+        """Stage a partial tail: one per-batch StagedBatch per slot."""
+        d, es, ls = self._bufs if self._bufs else (None, (), [])
+        out = []
+        for j in range(self.n):
+            dev = self.tr._put_fields(
+                d[j], tuple(e[j] for e in es), [l[j] for l in ls])
+            out.append(StagedBatch(dev, None))
+        if out:
+            jax.block_until_ready([s.device for s in out])  # reusable
+        self.n = 0
+        return out
+
+
 class Trainer:
     """Config-driven trainer; mirrors the INetTrainer contract
     (reference: src/nnet/nnet.h:18-92)."""
@@ -555,6 +626,12 @@ class Trainer:
         dominates when the chip is remote (tunnel) and is wasted work
         everywhere else."""
         data, extras, labels = self._host_fields(batch)
+        return self._put_fields(data, extras, labels)
+
+    def _put_fields(self, data, extras, labels):
+        """Placement policy for one batch's (data, extras, labels) —
+        the single source shared by stage(), GroupStager.flush and any
+        future ingest path."""
         if jax.process_count() > 1:
             # multi-host assembly needs per-array process-local puts
             return (self._put_data(data, self._xsh),
@@ -607,16 +684,22 @@ class Trainer:
                          for col in zip(*(f[1] for f in fields)))
         labels_s = [np.stack(col)
                     for col in zip(*(f[2] for f in fields))]
+        return self._put_group(data_s, extras_s, labels_s, batches[0])
+
+    def _put_group(self, data_s, extras_s, labels_s,
+                   host=None) -> "StagedBatch":
+        """Ship already-stacked (K, ...) host fields as one transfer."""
         if self.n_devices == 1:
-            dev = jax.device_put((data_s, extras_s, labels_s))
+            dev = jax.device_put((data_s, tuple(extras_s),
+                                  list(labels_s)))
         else:
             xsh_s = parallel.stacked_sharding(self._xsh)
             dsh_s = parallel.stacked_sharding(self._dsh)
             dev = jax.device_put(
-                (data_s, extras_s, labels_s),
+                (data_s, tuple(extras_s), list(labels_s)),
                 (xsh_s, tuple([dsh_s] * len(extras_s)),
                  [dsh_s] * len(labels_s)))
-        return StagedBatch(dev, batches[0], fused=len(batches))
+        return StagedBatch(dev, host, fused=int(data_s.shape[0]))
 
     def start_round(self, round_: int) -> None:
         self.round = round_

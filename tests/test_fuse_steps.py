@@ -197,6 +197,57 @@ def test_stage_fused_wrong_count_raises():
         tr.stage_fused(make_batches(2, seed=9))
 
 
+def test_group_stager_matches_per_step():
+    # GroupStager copies fields at add() time into a preallocated
+    # stacked buffer; staging the full group must match per-step
+    from cxxnet_tpu.trainer import GroupStager
+
+    batches = make_batches(6, seed=11)
+    ta = run_per_step(CONF, batches)
+    tb = make_trainer(CONF, fuse_steps=3)
+    gs = GroupStager(tb)
+    for i, b in enumerate(batches):
+        gs.add(b)
+        if gs.full:
+            tb.update_fused(gs.stage())
+    assert_params_close(params_host(ta), params_host(tb))
+    assert tb.epoch_counter == 6
+
+
+def test_group_stager_copies_at_add_time():
+    # the iterator may clobber its buffers after add(): mutate the
+    # source array post-add and verify the staged group kept the copy
+    from cxxnet_tpu.trainer import GroupStager
+
+    batches = make_batches(2, seed=12)
+    ta = run_per_step(CONF, [DataBatch(data=b.data.copy(),
+                                       label=b.label.copy())
+                             for b in batches])
+    tb = make_trainer(CONF, fuse_steps=2)
+    gs = GroupStager(tb)
+    for b in batches:
+        gs.add(b)
+        b.data[:] = -1.0      # simulated buffer reuse
+        b.label[:] = 0.0
+    tb.update_fused(gs.stage())
+    assert_params_close(params_host(ta), params_host(tb))
+
+
+def test_group_stager_flush_partial():
+    from cxxnet_tpu.trainer import GroupStager
+
+    batches = make_batches(2, seed=13)
+    ta = run_per_step(CONF, batches)
+    tb = make_trainer(CONF, fuse_steps=3)
+    gs = GroupStager(tb)
+    for b in batches:
+        gs.add(b)
+    for s in gs.flush():      # partial tail -> per-batch staged
+        tb.update(s)
+    assert_params_close(params_host(ta), params_host(tb))
+    assert gs.n == 0
+
+
 def test_fused_rejects_update_period():
     with pytest.raises(ValueError, match="update_period"):
         make_trainer(CONF, fuse_steps=2, update_period=2)

@@ -118,6 +118,10 @@ def test_stack_scan_unroll_matches():
                   label=rs.randint(0, 16, size=(8, 16)
                                    ).astype(np.float32))
     t1, t4 = build(1), build(4)
+    # routing check: the knob must actually reach the stack layer,
+    # else both compile at unroll=1 and this test can never fail
+    assert any(getattr(m, "scan_unroll", None) == 4
+               for m in t4.net.modules)
     t1.update(b)
     t4.update(b)
     import jax
