@@ -307,6 +307,46 @@ class LearnTask:
             return
         if self.test_io:
             print("start I/O test")
+        # one-ahead device staging: batch k+1's host->device transfer
+        # is issued on a helper thread while batch k computes. With
+        # fuse_steps = K the loop groups K batches per dispatch
+        # (Trainer.update_fused). Two staging modes:
+        #  * group_staging = 1 (default with fuse): each group is
+        #    copied incrementally into a preallocated stacked buffer
+        #    (GroupStager) and ships as ONE transfer — K-fold fewer
+        #    put round trips; two stagers rotate so one fills while
+        #    the other's transfer flies.
+        #  * group_staging = 0 (and always for fuse = 1): per-batch
+        #    stage() as before; fused dispatch stacks on device.
+        # Built ONCE for the run: the stacked host buffers (~K x batch
+        # bytes each) stay warm across rounds.
+        fuse = max(1, self.trainer.fuse_steps)
+        use_groups = fuse > 1 and self.group_staging != 0
+        gstagers = [GroupStager(self.trainer),
+                    GroupStager(self.trainer)] if use_groups else None
+
+        def dispatch(group, sample_counter):
+            # group: a list of per-batch StagedBatch, or one fused
+            # StagedBatch group. dispatch is async: the call returns
+            # while the device computes, so the next batches'
+            # transfers (helper thread) overlap this group's step(s)
+            if isinstance(group, StagedBatch):
+                n = group.fused or 1
+                with self.trace.step(n):
+                    self.trainer.update_fused(group)
+            else:
+                n = len(group)
+                with self.trace.step(n):
+                    if n == 1:
+                        self.trainer.update(group[0])
+                    else:
+                        self.trainer.update_fused(group)
+            self.timer.tick(n)
+            for _ in range(n):
+                sample_counter += 1
+                self._print_progress(sample_counter, start)
+            return sample_counter
+
         cc = self.max_round
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
@@ -317,47 +357,6 @@ class LearnTask:
             self.trainer.start_round(self.start_counter)
             self.timer.reset_clock()
             self.itr_train.before_first()
-            # one-ahead device staging: batch k+1's host->device transfer
-            # is issued on a helper thread while batch k computes. With
-            # fuse_steps = K the loop groups K batches per dispatch
-            # (Trainer.update_fused). Two staging modes:
-            #  * group_staging = 1 (default with fuse): each group is
-            #    copied incrementally into a preallocated stacked buffer
-            #    (GroupStager) and ships as ONE transfer — K-fold fewer
-            #    put round trips; two stagers rotate so one fills while
-            #    the other's transfer flies.
-            #  * group_staging = 0 (and always for fuse = 1): per-batch
-            #    stage() as before; fused dispatch stacks on device.
-            fuse = max(1, self.trainer.fuse_steps)
-            use_groups = fuse > 1 and self.group_staging != 0
-
-            def dispatch(group, sample_counter):
-                # group: a list of per-batch StagedBatch, or one fused
-                # StagedBatch group. dispatch is async: the call
-                # returns while the device computes, so the next
-                # batches' transfers (helper thread) overlap this
-                # group's step(s)
-                if isinstance(group, StagedBatch):
-                    n = group.fused or 1
-                    with self.trace.step(n):
-                        self.trainer.update_fused(group)
-                else:
-                    n = len(group)
-                    with self.trace.step(n):
-                        if n == 1:
-                            self.trainer.update(group[0])
-                        else:
-                            self.trainer.update_fused(group)
-                self.timer.tick(n)
-                for _ in range(n):
-                    sample_counter += 1
-                    self._print_progress(sample_counter, start)
-                return sample_counter
-
-            gstagers = None
-            if use_groups:
-                gstagers = [GroupStager(self.trainer),
-                            GroupStager(self.trainer)]
             pending = []
             cur, infl = 0, None
             while True:
