@@ -45,7 +45,6 @@ class LearnTask:
         self.model_in = "NULL"
         self.name_pred = "pred.txt"
         self.print_step = 100
-        self.group_staging = 1
         self.extract_node_name = ""
         self.output_format = 1
         self.trace = TraceSession()
@@ -63,8 +62,6 @@ class LearnTask:
             self.net_type = int(val)
         elif name == "print_step":
             self.print_step = int(val)
-        elif name == "group_staging":
-            self.group_staging = int(val)
         elif name == "continue":
             self.continue_training = int(val)
         elif name == "save_model":
@@ -321,7 +318,7 @@ class LearnTask:
         # Built ONCE for the run: the stacked host buffers (~K x batch
         # bytes each) stay warm across rounds.
         fuse = max(1, self.trainer.fuse_steps)
-        use_groups = fuse > 1 and self.group_staging != 0
+        use_groups = fuse > 1 and self.trainer.group_staging != 0
         gstagers = [GroupStager(self.trainer),
                     GroupStager(self.trainer)] if use_groups else None
 

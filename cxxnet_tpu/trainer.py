@@ -130,6 +130,9 @@ class Trainer:
         # (unroll 1 pays ~2.5% scan-loop overhead on AlexNet; 8 buys
         # nothing more and compiles 4x longer) — see docs/performance.md
         self.fuse_unroll = 2
+        # 1: fused groups (train via CLI, eval here) also ship as ONE
+        # stacked transfer per group; 0: per-batch staging everywhere
+        self.group_staging = 1
         self.eval_train = 1
         self.seed = 0
         self.silent = 0
@@ -157,6 +160,8 @@ class Trainer:
         self._step_count = 0
         self._step_specs = None
         self._train_multi = None
+        self._eval_multi = None
+        self._eval_gs = None
         self._gen_cache: Dict = {}
 
     # ------------------------------------------------------------------
@@ -172,6 +177,8 @@ class Trainer:
             self.fuse_steps = int(val)
         elif name == "fuse_unroll":
             self.fuse_unroll = int(val)
+        elif name == "group_staging":
+            self.group_staging = int(val)
         elif name == "eval_train":
             self.eval_train = int(val)
         elif name == "seed":
@@ -556,6 +563,28 @@ class Trainer:
                 in_shardings=(psh, osh, rep, rep, rep, xsh_s, dsh_s,
                               dsh_s),
                 out_shardings=(psh, osh, rep, rep, rep, None))
+
+            def eval_multi(params, eaccum, data_s, extras_s, labels_s,
+                           mask_s):
+                # the eval stream fused the same way: one dispatch per
+                # K eval batches, metric stats folding through the
+                # scan carry (padding masks ride per batch)
+                def body(acc, x):
+                    data, extras, labels, mask = x
+                    return eval_step(params, acc, data, extras,
+                                     labels, mask), None
+
+                eaccum, _ = jax.lax.scan(
+                    body, eaccum,
+                    (data_s, extras_s, labels_s, mask_s),
+                    unroll=max(1, min(self.fuse_unroll,
+                                      self.fuse_steps)))
+                return eaccum
+
+            self._eval_multi = jax.jit(
+                eval_multi, donate_argnums=(1,),
+                in_shardings=(psh, rep, xsh_s, dsh_s, dsh_s, dsh_s),
+                out_shardings=rep)
 
     # ------------------------------------------------------------------
     def _put_data(self, arr, sharding=None) -> jnp.ndarray:
@@ -1101,16 +1130,53 @@ class Trainer:
         self.metric.clear()
         eaccum = jax.device_put(jnp.asarray(self._eaccum_zero), rep)
         iter_eval.before_first()
-        while iter_eval.next():
-            batch = iter_eval.value
-            self._maybe_set_norm(batch)
-            data, extras, labels = self._put_batch(batch)
+        fuse = (self.fuse_steps
+                if self._eval_multi is not None
+                and self.group_staging != 0 else 1)
+        if fuse > 1:
+            # cached across rounds so the stacked host buffers stay
+            # warm, like the CLI's train-side stagers
+            if self._eval_gs is None:
+                self._eval_gs = GroupStager(self)
+            gs = self._eval_gs
+        else:
+            gs = None
+        masks: List[np.ndarray] = []
+
+        def batch_mask(batch):
             nvalid = batch.batch_size - batch.num_batch_padd
             hmask = np.zeros((batch.batch_size,), np.float32)
             hmask[:nvalid] = 1.0
+            return hmask
+
+        def eval_one(data, extras, labels, hmask):
             mask = self._put_data(hmask, self._dsh)
-            eaccum = self._eval_step(self.params, eaccum, data, extras,
-                                     labels, mask)
+            return self._eval_step(self.params, eaccum, data, extras,
+                                   labels, mask)
+
+        while iter_eval.next():
+            batch = iter_eval.value
+            if gs is None:
+                self._maybe_set_norm(batch)  # gs.add runs it itself
+                eaccum = eval_one(*self._put_batch(batch),
+                                  batch_mask(batch))
+                continue
+            # fused eval: groups of K batches ship as one stacked
+            # transfer and fold through one scanned dispatch
+            gs.add(batch)
+            masks.append(batch_mask(batch))
+            if gs.full:
+                staged = gs.stage()
+                mask_s = self._put_data(
+                    np.stack(masks),
+                    parallel.stacked_sharding(self._dsh))
+                eaccum = self._eval_multi(
+                    self.params, eaccum, *staged.device, mask_s)
+                masks = []
+        if gs is not None:
+            # tail: partial group per-batch
+            for s, hmask in zip(gs.flush(), masks):
+                eaccum = eval_one(*s.device, hmask)
         self.metric.add_stats(np.asarray(eaccum))
         ret += self.metric.print(data_name)
         return ret

@@ -248,6 +248,38 @@ def test_group_stager_flush_partial():
     assert gs.n == 0
 
 
+class _ListIter:
+    """Minimal eval iterator over a fixed batch list."""
+
+    def __init__(self, batches):
+        self.b = batches
+        self.i = -1
+
+    def before_first(self):
+        self.i = -1
+
+    def next(self):
+        self.i += 1
+        return self.i < len(self.b)
+
+    @property
+    def value(self):
+        return self.b[self.i]
+
+
+def test_fused_eval_matches_per_batch():
+    # 7 eval batches at K=3 (2 fused groups + 1 per-batch tail), one
+    # MID-GROUP batch carrying padding — the mask must ride the scan
+    batches = make_batches(7, seed=14)
+    batches[1].num_batch_padd = 5
+    ta = make_trainer(CONF)
+    tb = make_trainer(CONF, fuse_steps=3)
+    ea = ta.evaluate(_ListIter(batches), "test")
+    eb = tb.evaluate(_ListIter(batches), "test")
+    assert ea == eb
+    assert "test-error" in ea
+
+
 def test_fused_rejects_update_period():
     with pytest.raises(ValueError, match="update_period"):
         make_trainer(CONF, fuse_steps=2, update_period=2)
