@@ -231,16 +231,43 @@ def train(cfg: str, data, num_round: int,
     for k, v in param:
         net.set_param(k, v)
     net.init_model()
+    # fuse_steps in the config: group K batches per jitted dispatch —
+    # the same fused path the CLI train loop uses (docs/performance.md).
+    # group_staging=1 additionally ships each group as one stacked
+    # transfer; =0 keeps per-batch staging with the fused dispatch.
+    tr = net._net
+    fuse, gs = 1, None
+    if isinstance(data, DataIter) and tr.fuse_steps > 1:
+        fuse = tr.fuse_steps
+        if tr.group_staging:
+            from .trainer import GroupStager
+            gs = GroupStager(tr)
     for r in range(num_round):
         net.start_round(r)
         if isinstance(data, DataIter):
             data.before_first()
             scounter = 0
+            pend = []
             while data.next():
-                net.update(data)
+                if gs is not None:
+                    gs.add(data.value)
+                    if gs.full:
+                        tr.update_fused(gs.stage())
+                elif fuse > 1:
+                    pend.append(tr.stage(data.value))
+                    if len(pend) == fuse:
+                        tr.update_fused(pend)
+                        pend = []
+                else:
+                    net.update(data)
                 scounter += 1
                 if scounter % 100 == 0:
                     print("[%d] %d batch passed" % (r, scounter))
+            if gs is not None:
+                for s in gs.flush():   # round tail, per-step
+                    tr.update(s)
+            for s in pend:             # round tail, per-step
+                tr.update(s)
         else:
             net.update(data=data, label=label)
         if eval_data is not None:

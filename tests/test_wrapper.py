@@ -193,3 +193,40 @@ def test_evaluate_invalidates_iterator_position(trained):
         deval.check_valid()
     deval.before_first()
     assert deval.next()
+
+
+def test_train_fused_matches_per_batch():
+    # wrapper.train with fuse_steps groups batches through the same
+    # fused machinery as the CLI; trajectory must match per-batch
+    import jax
+
+    def run(extra):
+        data = wrapper.DataIter(DATA_CFG)
+        p = dict(PARAM, seed=11, **extra)
+        return wrapper.train(NET_CFG, data, 3, p)
+
+    na = run({})
+    nb = run({"fuse_steps": 3})
+    fa = jax.tree.leaves(jax.tree.map(np.asarray, na._net.params))
+    fb = jax.tree.leaves(jax.tree.map(np.asarray, nb._net.params))
+    for a, b in zip(fa, fb):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+    assert na._net.epoch_counter == nb._net.epoch_counter
+
+
+def test_train_fused_no_group_staging_matches():
+    # group_staging=0 keeps per-batch staging but must STILL fuse the
+    # dispatch (parity with the CLI loop)
+    import jax
+
+    def run(extra):
+        data = wrapper.DataIter(DATA_CFG)
+        p = dict(PARAM, seed=12, **extra)
+        return wrapper.train(NET_CFG, data, 2, p)
+
+    na = run({})
+    nb = run({"fuse_steps": 3, "group_staging": 0})
+    fa = jax.tree.leaves(jax.tree.map(np.asarray, na._net.params))
+    fb = jax.tree.leaves(jax.tree.map(np.asarray, nb._net.params))
+    for a, b in zip(fa, fb):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
