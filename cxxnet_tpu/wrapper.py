@@ -264,10 +264,11 @@ def train(cfg: str, data, num_round: int,
                 if scounter % 100 == 0:
                     print("[%d] %d batch passed" % (r, scounter))
             if gs is not None:
-                for s in gs.flush():   # round tail, per-step
-                    tr.update(s)
-            for s in pend:             # round tail, per-step
-                tr.update(s)
+                # round tail: update_fused's partial-group path falls
+                # back per-step (same as the CLI tail dispatch)
+                tr.update_fused(gs.flush())
+            elif pend:
+                tr.update_fused(pend)
         else:
             net.update(data=data, label=label)
         if eval_data is not None:
