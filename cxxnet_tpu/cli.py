@@ -441,18 +441,57 @@ class LearnTask:
 
     # ------------------------------------------------------------------
     def task_predict(self) -> None:
-        """Reference: cxxnet_main.cpp:266-283."""
+        """Reference: cxxnet_main.cpp:266-283. With fuse_steps the
+        pred stream groups K batches per forward dispatch + fetch
+        (Trainer.predict_fused); per-batch padding is trimmed from the
+        flattened group exactly as the per-batch path trims it."""
         assert self.itr_pred is not None, \
             "must specify a pred iterator to generate predictions"
         print("start predicting...")
+        fuse = max(1, self.trainer.fuse_steps)
+        # same staging modes as the train/eval streams: GroupStager
+        # (one stacked put per group) by default, per-batch staging
+        # with the fused dispatch under group_staging = 0
+        gs = GroupStager(self.trainer) \
+            if fuse > 1 and self.trainer.group_staging != 0 else None
         with open(self.name_pred, "w") as fo:
             self.itr_pred.before_first()
+            pend, sizes = [], []   # per-slot (rows, valid)
+
+            def write_group(preds):
+                base = 0
+                for rows, sz in sizes:
+                    for j in range(sz):
+                        fo.write("%g\n" % preds[base + j])
+                    base += rows
+                sizes.clear()
+
             while self.itr_pred.next():
                 batch = self.itr_pred.value
-                preds = self.trainer.predict(batch)
-                sz = batch.batch_size - batch.num_batch_padd
-                for j in range(sz):
-                    fo.write("%g\n" % preds[j])
+                if fuse > 1:
+                    sizes.append((batch.batch_size,
+                                  batch.batch_size - batch.num_batch_padd))
+                    if gs is not None:
+                        gs.add(batch)   # copies; iterator may reuse
+                        if gs.full:
+                            write_group(
+                                self.trainer.predict_fused(gs.stage()))
+                    else:
+                        # staged put copies to device before next()
+                        pend.append(self.trainer.stage(batch))
+                        if len(pend) == fuse:
+                            write_group(
+                                self.trainer.predict_fused(pend))
+                            pend = []
+                else:
+                    preds = self.trainer.predict(batch)
+                    sz = batch.batch_size - batch.num_batch_padd
+                    for j in range(sz):
+                        fo.write("%g\n" % preds[j])
+            if gs is not None and gs.n:
+                write_group(self.trainer.predict_fused(gs.flush()))
+            elif pend:
+                write_group(self.trainer.predict_fused(pend))
         print("finished prediction, write into %s" % self.name_pred)
 
     def task_export_reference(self) -> None:

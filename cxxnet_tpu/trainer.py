@@ -161,6 +161,7 @@ class Trainer:
         self._step_specs = None
         self._train_multi = None
         self._eval_multi = None
+        self._forward_multi = None
         self._eval_gs = None
         self._gen_cache: Dict = {}
 
@@ -585,6 +586,24 @@ class Trainer:
                 eval_multi, donate_argnums=(1,),
                 in_shardings=(psh, rep, xsh_s, dsh_s, dsh_s, dsh_s),
                 out_shardings=rep)
+
+            def forward_multi(params, data_s, extras_s, node_ids):
+                # the prediction stream fused the same way: one
+                # dispatch (and one D2H fetch) per K batches
+                def body(_, x):
+                    data, extras = x
+                    return None, forward_step(params, data, extras,
+                                              node_ids)
+
+                _, outs = jax.lax.scan(
+                    body, None, (data_s, extras_s),
+                    unroll=max(1, min(self.fuse_unroll,
+                                      self.fuse_steps)))
+                return outs
+
+            self._forward_multi = jax.jit(
+                forward_multi, in_shardings=(psh, xsh_s, dsh_s),
+                static_argnums=(3,))
 
     # ------------------------------------------------------------------
     def _put_data(self, arr, sharding=None) -> jnp.ndarray:
@@ -1057,10 +1076,55 @@ class Trainer:
         """Argmax (or raw scalar) of the final node
         (reference: nnet_impl-inl.hpp:186-199,286-299)."""
         out = self.forward_nodes(batch, [self.net.out_node])[0]
+        return self._pred_values(out)
+
+    @staticmethod
+    def _pred_values(out: np.ndarray) -> np.ndarray:
         mat = out.reshape(out.shape[0], -1)
         if mat.shape[1] != 1:
             return mat.argmax(axis=1).astype(np.float32)
         return mat[:, 0]
+
+    def predict_fused(self, staged) -> np.ndarray:
+        """predict() over a fuse_steps group in ONE dispatch + fetch.
+
+        Accepts a stacked group (stage_fused / GroupStager.stage) or a
+        list of per-batch staged batches: a full list stacks on device
+        (like update_fused); a partial list — the pred stream's tail —
+        runs per batch. Returns the flattened predictions in feed
+        order (callers trim per-batch padding themselves, as the CLI
+        pred writer does)."""
+        node_ids = (self.net.out_node,)
+        if self._forward_multi is None and isinstance(staged, StagedBatch):
+            raise RuntimeError(
+                "fuse_steps was set after init_model(); configure it "
+                "before init so the fused forward is compiled")
+
+        def from_stacked(data_s, extras_s):
+            values = self._forward_multi(self.params, data_s, extras_s,
+                                         node_ids)
+            out = self._fetch_local(values[0])
+            return self._pred_values(
+                out.reshape((-1,) + out.shape[2:]))
+
+        if isinstance(staged, StagedBatch) and staged.fused:
+            data_s, extras_s, _ = staged.device
+            return from_stacked(data_s, extras_s)
+        staged = list(staged)
+        if self._forward_multi is not None \
+                and len(staged) == self.fuse_steps:
+            data_s = jnp.stack([s.device[0] for s in staged])
+            extras_s = tuple(
+                jnp.stack(col)
+                for col in zip(*(s.device[1] for s in staged)))
+            return from_stacked(data_s, extras_s)
+        outs = []
+        for s in staged:
+            data, extras, _ = s.device
+            values = self._forward(self.params, data, extras, node_ids)
+            outs.append(self._pred_values(self._fetch_local(values[0])))
+        return (np.concatenate(outs) if outs
+                else np.zeros((0,), np.float32))
 
     def extract_feature(self, batch: DataBatch, node_name: str) -> np.ndarray:
         """Copy out a named node or top[-k]
