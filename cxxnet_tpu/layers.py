@@ -357,6 +357,68 @@ class EmbeddingLayer(Layer):
             n, 1, s, self.param.num_hidden)]
 
 
+@register("im2seq")
+class Im2SeqLayer(Layer):
+    """(b, c, h, w) feature grid -> (b, 1, h*w, c) patch-token sequence.
+
+    The patchify bridge for vision transformers: a strided conv
+    produces (b, embed, H/p, W/p); this layer lays that grid out as
+    H*W/p² tokens of width embed so the attention / transformer_stack
+    layers apply unchanged. ``learn_pos = 1`` (default) adds a learned
+    positional embedding (tag ``pos`` — the encoder is otherwise
+    permutation-equivariant over patches). No reference analogue
+    (SURVEY.md §5: the reference predates vision transformers; this
+    extends the same config dialect).
+    """
+    has_params = True
+    param_tags = ("pos",)
+
+    def __init__(self):
+        super().__init__()
+        self.learn_pos = 1
+
+    def set_param(self, name, val):
+        if name == "learn_pos":
+            self.learn_pos = int(val)
+        else:
+            super().set_param(name, val)
+
+    def _infer(self, in_shapes):
+        n, c, h, w = in_shapes[0]
+        self.seq_len, self.embed = h * w, c
+        return [(n, 1, h * w, c)]
+
+    def init_params(self, rng) -> Params:
+        if not self.learn_pos:
+            return {}
+        return {"pos": jax.random.normal(
+            rng, (self.seq_len, self.embed), jnp.float32) * 0.02}
+
+    def apply(self, params, inputs, ctx):
+        n, c, h, w = inputs[0].shape
+        out = inputs[0].reshape(n, c, h * w).transpose(0, 2, 1)
+        if self.learn_pos:
+            out = out + params["pos"].astype(out.dtype)[None]
+        return [out.reshape(n, 1, h * w, c)]
+
+
+@register("seq_pool")
+class SeqPoolLayer(Layer):
+    """(b, 1, s, e) -> (b, 1, 1, e): mean over the token axis — the
+    mean-pool classifier head for patch-token encoders (ViT-style);
+    no reference analogue (sequence nodes postdate the reference)."""
+
+    def _infer(self, in_shapes):
+        n, c, s, e = in_shapes[0]
+        if c != 1:
+            raise ValueError(
+                "seq_pool: input must be (batch,1,seq,embed)")
+        return [(n, 1, 1, e)]
+
+    def apply(self, params, inputs, ctx):
+        return [jnp.mean(inputs[0], axis=2, keepdims=True)]
+
+
 def moe_capacity(topk: int, n_tokens: int, nexpert: int,
                  factor: float) -> int:
     """Per-expert slot count for token-choice routing (shared by

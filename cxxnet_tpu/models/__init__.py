@@ -595,3 +595,43 @@ layer[4->4] = softmax
 netconfig=end
 input_shape = 1,{seq_len},{embed}
 """
+
+
+def vit(nclass: int = 1000, input_shape=(3, 224, 224), patch: int = 16,
+        embed: int = 384, nlayer: int = 12, nhead: int = 6,
+        remat: int = 0) -> str:
+    """ViT-S/16-style classifier: conv patchify -> learned-position
+    patch tokens (im2seq) -> pre-norm transformer stack -> token mean
+    pool (seq_pool) -> linear head.
+
+    No reference analogue (SURVEY.md §5: the reference predates vision
+    transformers) — modern-family breadth on the same config dialect;
+    every block reuses existing layers (conv / transformer_stack), so
+    flash attention, remat, fuse_steps and the parallelism axes all
+    apply unchanged."""
+    c, h, w = input_shape
+    if h % patch or w % patch:
+        raise ValueError("vit: input %dx%d not divisible by patch %d"
+                         % (h, w, patch))
+    return f"""
+netconfig=start
+layer[0->1] = conv:patchify
+  kernel_size = {patch}
+  stride = {patch}
+  nchannel = {embed}
+  random_type = xavier
+layer[1->2] = im2seq:tokens
+layer[2->3] = transformer_stack:encoder
+  nlayer = {nlayer}
+  nhead = {nhead}
+  remat = {remat}
+  random_type = xavier
+layer[3->4] = seq_pool
+layer[4->5] = flatten
+layer[5->6] = fullc:head
+  nhidden = {nclass}
+  init_sigma = 0.01
+layer[6->6] = softmax
+netconfig=end
+input_shape = {c},{h},{w}
+"""

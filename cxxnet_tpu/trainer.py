@@ -1095,10 +1095,6 @@ class Trainer:
         order (callers trim per-batch padding themselves, as the CLI
         pred writer does)."""
         node_ids = (self.net.out_node,)
-        if self._forward_multi is None and isinstance(staged, StagedBatch):
-            raise RuntimeError(
-                "fuse_steps was set after init_model(); configure it "
-                "before init so the fused forward is compiled")
 
         def from_stacked(data_s, extras_s):
             values = self._forward_multi(self.params, data_s, extras_s,
@@ -1107,9 +1103,16 @@ class Trainer:
             return self._pred_values(
                 out.reshape((-1,) + out.shape[2:]))
 
-        if isinstance(staged, StagedBatch) and staged.fused:
-            data_s, extras_s, _ = staged.device
-            return from_stacked(data_s, extras_s)
+        if isinstance(staged, StagedBatch):
+            if staged.fused:
+                if self._forward_multi is None:
+                    raise RuntimeError(
+                        "fuse_steps was set after init_model(); "
+                        "configure it before init so the fused forward "
+                        "is compiled")
+                data_s, extras_s, _ = staged.device
+                return from_stacked(data_s, extras_s)
+            staged = [staged]   # a plain staged batch: per-batch path
         staged = list(staged)
         if self._forward_multi is not None \
                 and len(staged) == self.fuse_steps:

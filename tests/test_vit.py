@@ -1,0 +1,90 @@
+"""ViT family: conv patchify -> im2seq tokens -> transformer stack ->
+seq_pool head (models.vit). No reference analogue (SURVEY.md §5);
+built entirely from existing layers plus the im2seq/seq_pool bridges,
+so attention impls, remat, fuse_steps and sharding apply unchanged."""
+import numpy as np
+
+from cxxnet_tpu import config, models
+from cxxnet_tpu.io import DataBatch, create_iterator
+from cxxnet_tpu.trainer import Trainer
+
+
+def make_trainer(**overrides):
+    tr = Trainer()
+    text = models.vit(nclass=4, input_shape=(3, 32, 32), patch=8,
+                      embed=32, nlayer=2, nhead=4)
+    for k, v in config.parse_string(text):
+        tr.set_param(k, v)
+    base = {"dev": "cpu", "batch_size": 32, "eta": 0.003,
+            "updater": "adam", "metric": "error", "seed": 5}
+    base.update(overrides)
+    for k, v in base.items():
+        tr.set_param(k, str(v))
+    tr.init_model()
+    return tr
+
+
+def test_vit_shapes_and_pos_param():
+    tr = make_trainer()
+    # patchify: 32/8 = 4x4 grid -> 16 tokens of width 32
+    li = [i for i, m in enumerate(tr.net.modules)
+          if m.type_name == "im2seq"][0]
+    assert tr.params[li]["pos"].shape == (16, 32)
+    b = DataBatch(
+        data=np.random.RandomState(0).randn(32, 3, 32, 32
+                                            ).astype(np.float32),
+        label=np.zeros((32, 1), np.float32))
+    assert tr.predict(b).shape == (32,)
+
+
+def test_vit_learns_quadrant_task():
+    # label = brightest quadrant: solvable from patch-token statistics,
+    # so a learning encoder must beat chance (0.75) quickly
+    rs = np.random.RandomState(1)
+    n = 256
+    imgs = rs.rand(n, 3, 32, 32).astype(np.float32) * 0.1
+    labels = rs.randint(0, 4, size=(n,)).astype(np.float32)
+    for i, l in enumerate(labels):
+        y, x = divmod(int(l), 2)
+        imgs[i, :, y * 16:(y + 1) * 16, x * 16:(x + 1) * 16] += 1.0
+    tr = make_trainer()
+    errs = []
+    for r in range(6):
+        tr.start_round(r)
+        for j in range(n // 32):
+            tr.update(DataBatch(data=imgs[j * 32:(j + 1) * 32],
+                                label=labels[j * 32:(j + 1) * 32, None]))
+        line = tr.evaluate(None, "train")
+        errs.append(float(line.split("train-error:")[1]))
+    assert errs[-1] < 0.3, errs
+
+
+def test_vit_fused_matches_per_step():
+    import jax
+
+    rs = np.random.RandomState(2)
+    batches = [DataBatch(
+        data=rs.randn(32, 3, 32, 32).astype(np.float32),
+        label=rs.randint(0, 4, size=(32, 1)).astype(np.float32))
+        for _ in range(4)]
+    ta = make_trainer()
+    for b in batches:
+        ta.update(b)
+    tb = make_trainer(fuse_steps=2)
+    for i in range(0, 4, 2):
+        tb.update_fused(tb.stage_fused(batches[i:i + 2]))
+    fa = jax.tree.leaves(jax.tree.map(np.asarray, ta.params))
+    fb = jax.tree.leaves(jax.tree.map(np.asarray, tb.params))
+    for a, b in zip(fa, fb):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+def test_vit_data_parallel_mesh():
+    dev = "cpu:" + ",".join(str(i) for i in range(4))
+    tr = make_trainer(dev=dev, batch_size=32)
+    assert tr.n_devices == 4
+    rs = np.random.RandomState(3)
+    b = DataBatch(data=rs.randn(32, 3, 32, 32).astype(np.float32),
+                  label=rs.randint(0, 4, size=(32, 1)).astype(np.float32))
+    tr.update(b)
+    assert tr.predict(b).shape == (32,)

@@ -283,21 +283,29 @@ def cmd_zoo(args):
 
     PEAK_FLOPS = 197e12
     platform = jax.devices()[0].platform
+    # (name, netconfig, shape, batch, nclass, updater): the conv zoo
+    # trains with the reference's sgd+momentum; LM/ViT recipes with
+    # adam, per their examples
     nets = [
-        ("alexnet", models.alexnet(1000), (3, 227, 227), 256, 1000),
-        ("vgg16", models.vgg(16, nclass=1000), (3, 224, 224), 64, 1000),
-        ("inception", models.inception(nclass=10), (3, 32, 32), 256, 10),
+        ("alexnet", models.alexnet(1000), (3, 227, 227), 256, 1000,
+         "sgd"),
+        ("vgg16", models.vgg(16, nclass=1000), (3, 224, 224), 64, 1000,
+         "sgd"),
+        ("inception", models.inception(nclass=10), (3, 32, 32), 256, 10,
+         "sgd"),
         ("inception224", models.inception(
             nclass=1000, input_shape=(3, 224, 224), base=32,
-            imagenet_stem=True), (3, 224, 224), 64, 1000),
+            imagenet_stem=True), (3, 224, 224), 64, 1000, "sgd"),
         ("resnet20", models.resnet(nclass=10, nstage=3, nblock=3),
-         (3, 32, 32), 256, 10),
-        ("bowl", models.bowl_net(121), (3, 40, 40), 64, 121),
+         (3, 32, 32), 256, 10, "sgd"),
+        ("vit_s16", models.vit(nclass=1000), (3, 224, 224), 64, 1000,
+         "adam"),
+        ("bowl", models.bowl_net(121), (3, 40, 40), 64, 121, "sgd"),
         # token LM: tokens/sec = images_per_sec * seq_len. batch 32
         # measured best (r3: 97.5k tok/s @16, 105.8k @32, remat -4%,
         # 64+remat no gain)
         ("gpt2_small", models.gpt2_small(seq_len=512), (1, 512, 1),
-         32, 32768),
+         32, 32768, "adam"),
     ]
     if args.net:
         known = {n[0] for n in nets}
@@ -308,11 +316,9 @@ def cmd_zoo(args):
         nets = [n for n in nets if n[0] in args.net]
     rs = np.random.RandomState(0)
     entries, meta = [], {}
-    for name, text, shape, batch, nclass in nets:
+    for name, text, shape, batch, nclass, updater in nets:
         is_lm = shape[0] == 1 and shape[2] == 1
-        # the LM recipe trains with adam (examples/transformer); the
-        # conv zoo with the reference's sgd+momentum
-        ov = [("updater", "adam")] if is_lm else []
+        ov = [("updater", updater)] if updater != "sgd" else []
         if args.fuse > 1:
             ov.append(("fuse_steps", str(args.fuse)))
         tr = build(ov, text, nclass, batch=batch)
