@@ -201,14 +201,19 @@ def main() -> None:
         floors.append(_measure_dispatch_floor_ms())
     dispatch_floor_ms = min(floors)
 
-    # MFU: flops from XLA's own HLO cost model for the whole train step
-    # (fwd+bwd+update), against v5e bf16 peak — the honest utilization
-    # number VERDICT asked for
+    # MFU: analytic model flops (MFU basis — matmul terms, bwd at 2x
+    # fwd; Trainer.step_cost_analysis docstring) against v5e bf16 peak.
+    # XLA's own HLO count rides along as the cross-check; it under-
+    # counts scan bodies (counted once) and Pallas kernels (opaque
+    # custom_call) — VERDICT r3 #2.
     PEAK_FLOPS = 197e12
     try:
-        step_flops = float(tr.step_cost_analysis().get("flops", 0.0))
+        ca = tr.step_cost_analysis()
     except Exception:
-        step_flops = 0.0
+        ca = {}
+    step_flops = float(ca.get("model_flops") or 0.0)
+    xla_flops = float(ca.get("flops") or 0.0)
+    invisible = ca.get("pallas_kernels", [])
     best = max(resident, fused)
     best_mode = "fused%d" % FUSE if fused > resident else "single"
     # the dispatch floor burdens every single-mode step once, every
@@ -284,6 +289,10 @@ def main() -> None:
         "images_per_sec_fused%d" % FUSE: round(fused, 2),
         "step_ms": round(step_ms, 2),
         "step_flops": step_flops,
+        "step_flops_basis": "analytic model flops (matmul terms, bwd "
+                            "= 2x fwd — the literature MFU basis)",
+        "step_flops_xla_counted": xla_flops,
+        "xla_invisible_kernels": invisible,
         "mfu_vs_197tflops_bf16": round(mfu, 4) if mfu else None,
         "mfu_dispatch_corrected": round(
             step_flops / ((step_ms - floor_per_step) / 1000.0)

@@ -175,6 +175,23 @@ class ApplyContext:
     # the trainer from its mesh — gates compiled-vs-interpreted Pallas
     # (the process default backend can differ from the jit target)
     platform: str = "cpu"
+    # analytic hardware-flop records for Pallas kernels, appended at
+    # trace time by layers that invoke one (XLA's cost model sees a
+    # pallas_call as an opaque custom_call and counts 0 flops for it —
+    # VERDICT r3 #2). model.py copies the list onto the Network after
+    # each trace so step_cost_analysis can report what XLA missed.
+    pallas_flops: List = field(default_factory=list)
+    # False when no layer strictly upstream holds trainable params, so
+    # XLA dead-code-eliminates this layer's input gradient (set per
+    # layer by model.py; mirrors Network.analytic_model_flops skip_dx)
+    needs_input_grad: bool = True
+
+    def add_pallas_flops(self, kernel: str, fwd: float,
+                         bwd: float = 0.0) -> None:
+        """Record one Pallas kernel's analytic (fwd, bwd) hardware flops
+        for this trace. ``bwd`` should be 0 outside training traces."""
+        self.pallas_flops.append({"kernel": kernel, "fwd": float(fwd),
+                                  "bwd": float(bwd)})
 
 
 def _mat(x: jnp.ndarray) -> jnp.ndarray:
@@ -230,6 +247,28 @@ class Layer:
               ctx: ApplyContext) -> List[jnp.ndarray]:
         raise NotImplementedError
 
+    # -- accounting -----------------------------------------------------
+    def analytic_flops(self, skip_dx: bool = False
+                       ) -> Tuple[float, float]:
+        """Analytic MODEL flops of one apply: ``(fwd, bwd)``.
+
+        MFU basis (the literature definition, e.g. the PaLM paper's
+        appendix): matmul-dominant terms only, each matmul charged 2x
+        forward in the backward pass (dX + dW), causal attention at the
+        useful half — NO rematerialization replays and NO
+        flash-recompute extras (those are hardware flops, HFU).
+        Elementwise / pooling / norm layers return (0, 0): their VPU
+        flops are negligible against the MXU terms an MFU compares to
+        peak, and excluding them keeps the definition implementation-
+        independent.
+
+        ``skip_dx`` — no layer upstream holds trainable parameters, so
+        XLA dead-code-eliminates this layer's input gradient (the
+        classic first-conv case); the dX half of the backward is then
+        not charged. Called after infer_shape (uses in/out_shapes).
+        """
+        return 0.0, 0.0
+
 
 # ======================================================================
 # dense / structural layers
@@ -281,6 +320,11 @@ class FullConnectLayer(Layer):
         if self.param.no_bias == 0:
             p["bias"] = jnp.full((nh,), self.param.init_bias, jnp.float32)
         return p
+
+    def analytic_flops(self, skip_dx=False):
+        n, _, s, e = self.in_shapes[0]
+        f = 2.0 * n * s * e * self.param.num_hidden
+        return f, f if skip_dx else 2.0 * f
 
     def apply(self, params, inputs, ctx):
         n, _, s, e = inputs[0].shape
@@ -553,6 +597,16 @@ class MoEFullConnectLayer(Layer):
             "gate": jax.random.normal(rg, (e, ni), jnp.float32)
             * (ni ** -0.5)}
 
+
+    def analytic_flops(self, skip_dx=False):
+        n = self.in_shapes[0][0]
+        ni, nh, E = self.param.num_input_node, self.param.num_hidden, \
+            self.nexpert
+        C = moe_capacity(self.topk, n, E, self.capacity_factor)
+        # gate + dispatch/combine one-hot einsums + expert matmul
+        fwd = 2.0 * n * E * ni + 2.0 * n * E * C * (ni + nh) \
+            + 2.0 * E * C * ni * nh
+        return fwd, fwd if skip_dx else 2.0 * fwd
 
     def apply(self, params, inputs, ctx):
         x = _mat(inputs[0])                         # (B, ni)
@@ -980,6 +1034,16 @@ class ConvolutionLayer(Layer):
             out["bias"] = jnp.full((p.num_channel,), p.init_bias, jnp.float32)
         return out
 
+    def analytic_flops(self, skip_dx=False):
+        p = self.param
+        n, co, oh, ow = self.out_shapes[0]
+        # logical kernel taps: the s2d pack zero-pads the kernel to a
+        # multiple of b (useful work is unchanged; the padded taps are
+        # hardware flops, not model flops)
+        f = (2.0 * n * oh * ow * co * (p.num_input_channel / p.num_group)
+             * p.kernel_height * p.kernel_width)
+        return f, f if skip_dx else 2.0 * f
+
     def apply(self, params, inputs, ctx):
         p = self.param
         x = inputs[0].astype(ctx.compute_dtype)
@@ -1026,6 +1090,17 @@ class ConvolutionLayer(Layer):
             out = out.transpose(0, 3, 1, 2)
         elif impl == "pallas":
             from .ops.conv_pallas import conv_pallas
+            # hardware flops XLA's cost model cannot see (opaque
+            # custom_call): fwd + the custom-vjp dw conv (+ dx unless
+            # this is a first conv whose input grad is dead code); the
+            # s2d pack's zero-padded taps count here (they are executed)
+            _, co, oh, ow = self.out_shapes[0]
+            n = x.shape[0]
+            khw = kernel.shape[2] * kernel.shape[3]
+            fhw = 2.0 * n * oh * ow * co * kernel.shape[1] * khw
+            bwd_mult = 2.0 if ctx.needs_input_grad else 1.0
+            ctx.add_pallas_flops("conv_pallas", fhw,
+                                 bwd_mult * fhw if ctx.train else 0.0)
             out = conv_pallas(x, kernel.astype(ctx.compute_dtype),
                               stride=stride, pad=(pad_y, pad_x),
                               groups=g,
@@ -1329,6 +1404,13 @@ class LRNLayer(Layer):
         impl = self._resolve_impl(ctx)
         if impl == "pallas":
             from .ops import lrn_pallas
+            # VPU flops invisible to XLA (opaque custom_call): ~2*nsize
+            # window ops + a pow per element; listed for kernel
+            # visibility, negligible against any MXU term
+            elems = float(np.prod(x.shape))
+            fhw = elems * (2.0 * self.nsize + 20.0)
+            ctx.add_pallas_flops("lrn_pallas", fhw,
+                                 2.0 * fhw if ctx.train else 0.0)
             return [lrn_pallas(x, self.nsize, self.alpha, self.beta,
                                self.knorm,
                                interpret=ctx.platform != "tpu")]
@@ -1513,6 +1595,12 @@ class FixConnectLayer(Layer):
         self._wmat = jnp.asarray(wmat)
         return [(n, 1, 1, self.num_hidden)]
 
+    def analytic_flops(self, skip_dx=False):
+        n, _, _, w = self.in_shapes[0]
+        f = 2.0 * n * w * self.num_hidden
+        # the weight is stop_gradient'd: backward is dX only
+        return f, 0.0 if skip_dx else f
+
     def apply(self, params, inputs, ctx):
         x = _mat(inputs[0])
         out = jnp.dot(x, lax.stop_gradient(self._wmat).T)
@@ -1628,6 +1716,18 @@ class AttentionLayer(Layer):
         return {"wqkv": p.rand_init_weight(r1, (3 * e, e), e, 3 * e),
                 "wo": p.rand_init_weight(r2, (e, e), e, e)}
 
+    def analytic_flops(self, skip_dx=False):
+        n, _, s, e = self.in_shapes[0]
+        proj_in = 2.0 * n * s * e * (3 * e)          # wqkv
+        proj_out = 2.0 * n * s * e * e               # wo
+        c = 0.5 if self.causal else 1.0              # useful causal half
+        attend = 4.0 * c * n * s * s * e             # QK^T + PV, all heads
+        fwd = proj_in + proj_out + attend
+        # bwd: 2x per matmul, minus the input-gradient half of the one
+        # matmul touching the layer input when nothing upstream needs it
+        bwd = 2.0 * fwd - (proj_in if skip_dx else 0.0)
+        return fwd, bwd
+
     def apply(self, params, inputs, ctx):
         from .ops import flash_attention as fa
         from .ops import ring_attention as ra
@@ -1635,6 +1735,11 @@ class AttentionLayer(Layer):
         nh, d = self.nhead, e // self.nhead
         dt = ctx.compute_dtype
         impl = fa.resolve_impl(self.attn_impl, ctx.platform, s)
+
+        def record_flash():
+            fhw, bhw = fa.analytic_flops(b, nh, s, d, bool(self.causal))
+            ctx.add_pallas_flops("flash_attention", fhw,
+                                 bhw if ctx.train else 0.0)
         x = inputs[0].reshape(b, s, e).astype(dt)
         qkv = jnp.einsum("bse,fe->bsf", x, params["wqkv"].astype(dt))
         qkv = qkv.reshape(b, s, 3, nh, d).transpose(2, 0, 3, 1, 4)
@@ -1644,6 +1749,8 @@ class AttentionLayer(Layer):
                 and mesh.shape.get(axis, 1) > 1:
             if self.seq_algo in ("alltoall", "ulysses"):
                 from .ops import ulysses
+                if impl == "pallas":
+                    record_flash()   # flash is the local attend
                 out = ulysses.sharded_ulysses(
                     mesh, q, k, v, seq_axis=axis,
                     causal=bool(self.causal), impl=impl,
@@ -1662,6 +1769,7 @@ class AttentionLayer(Layer):
         elif impl == "pallas":
             # flash attention: VMEM-blocked online softmax, O(s*d) memory
             # (cxxnet_tpu/ops/flash_attention.py)
+            record_flash()
             out = fa.flash_attention(q, k, v, bool(self.causal),
                                      interpret=ctx.platform != "tpu")
         else:
@@ -1786,6 +1894,25 @@ class TransformerStackLayer(Layer):
             out["w2"] = p.rand_init_weight(ks[3], (L, e, m), m, e)
         return out
 
+    def analytic_flops(self, skip_dx=False):
+        n, _, s, e = self.in_shapes[0]
+        m = self.nhidden_mlp or 4 * e
+        c = 0.5 if self.causal else 1.0              # useful causal half
+        proj = 2.0 * n * s * e * (3 * e) + 2.0 * n * s * e * e
+        attend = 4.0 * c * n * s * s * e             # QK^T + PV, all heads
+        if self.moe:
+            B, E = float(n * s), self.nexpert
+            C = moe_capacity(self.topk, n * s, E, self.capacity_factor)
+            # gate + one-hot dispatch/combine einsums + expert matmuls
+            mlp = 2.0 * B * E * e + 4.0 * B * E * C * e \
+                + 4.0 * E * C * m * e
+        else:
+            mlp = 4.0 * n * s * e * m
+        fwd = self.nlayer * (proj + attend + mlp)
+        # dX is needed at every inner layer regardless of skip_dx (the
+        # residual stream chains through all nlayer blocks)
+        return fwd, 2.0 * fwd
+
     def _block_fn(self, dt, interpret=True, mesh=None, seq_axis=None,
                   use_flash=False):
         from .ops import ring_attention as ra
@@ -1864,6 +1991,22 @@ class TransformerStackLayer(Layer):
         from .ops import flash_attention as fa
         use_flash = fa.resolve_impl(self.attn_impl, ctx.platform,
                                     s) == "pallas"
+        # analytic hardware flops of the flash kernels XLA cannot count
+        # (opaque custom_call AND a scan body it would count only once):
+        # flash runs in every block unless seq sharding fell back to
+        # ring; remat replays each block's forward kernel in the bwd
+        seq_axis = getattr(ctx, "seq_axis", None)
+        seq_sharded = (pipe == 1 and mesh is not None
+                       and seq_axis is not None
+                       and mesh.shape.get(seq_axis, 1) > 1)
+        if use_flash and (not seq_sharded or self.attn_impl == "pallas"):
+            fhw, bhw = fa.analytic_flops(b, self.nhead, s,
+                                         e // self.nhead,
+                                         bool(self.causal))
+            bwd_hw = bhw + (fhw if self.remat else 0.0)
+            ctx.add_pallas_flops(
+                "flash_attention", fhw * self.nlayer,
+                bwd_hw * self.nlayer if ctx.train else 0.0)
         # the pipeline path reshards x to P(data) in its shard_map
         # in_specs, so only the scan path runs seq-parallel attends
         block = self._block_fn(dt, interpret=ctx.platform != "tpu",

@@ -57,6 +57,10 @@ class Network:
         # uint8 input batches so raw pixels cross host->device as 1 byte
         # (set by the trainer from DataBatch.norm before the first trace)
         self.input_norm: Optional[Tuple] = None
+        # {train_flag: [{kernel, fwd, bwd}, ...]} — analytic hardware
+        # flops of Pallas kernels recorded at trace time (XLA's cost
+        # model counts 0 for a pallas_call); written by apply()
+        self.pallas_flops_record: Dict[bool, list] = {}
 
         c, h, w = net_cfg.input_shape
         self.node_shapes[0] = (batch_size, c, h, w)
@@ -191,9 +195,14 @@ class Network:
         values: Dict[int, jnp.ndarray] = {0: data}
         for i, x in enumerate(extra_data):
             values[i + 1] = x
+        # needs-input-grad propagation (mirrors analytic_model_flops):
+        # lets Pallas layers skip charging a dX their custom-vjp output
+        # XLA will dead-code-eliminate (the classic first-conv case)
+        has_grad = [False] * self.cfg.num_nodes
         for li, (info, mod) in enumerate(zip(self.cfg.layers, self.modules)):
+            upstream = any(has_grad[ni] for ni in info.nindex_in)
             layer_ctx = dataclasses.replace(
-                ctx, layer_index=li,
+                ctx, layer_index=li, needs_input_grad=upstream,
                 rng=(jax.random.fold_in(rng, li)
                      if rng is not None else None))
             inputs = [values[ni] for ni in info.nindex_in]
@@ -201,13 +210,59 @@ class Network:
                                 inputs, layer_ctx)
             for no, v in zip(info.nindex_out, outputs):
                 values[no] = v
+            flag = upstream or mod.has_params
+            for no in info.nindex_out:
+                has_grad[no] = flag
         if ctx.losses:
             loss = sum(ctx.losses[1:], ctx.losses[0])
         else:
             loss = jnp.zeros((), jnp.float32)
         if state_out is not None:
             state_out.update(ctx.state_updates)
+        # trace-time side record (plain Python floats; tracing runs once
+        # per compiled program, so this survives for step_cost_analysis)
+        self.pallas_flops_record[bool(train)] = list(ctx.pallas_flops)
         return values, loss
+
+    # ------------------------------------------------------------------
+    def analytic_model_flops(self, train: bool = True) -> dict:
+        """Analytic MODEL flops of one step over the whole DAG.
+
+        The MFU basis (matmul-dominant terms, backward at the standard
+        2x-forward rate, causal attention at the useful half, no
+        rematerialization replay — the literature definition, PaLM
+        appendix B). This exists because XLA's own cost model
+        (Trainer.step_cost_analysis) under-counts two program shapes,
+        both verified on this tree: a ``lax.scan`` body is counted ONCE
+        regardless of trip count (the transformer_stack scans depth),
+        and a Pallas kernel is an opaque custom_call counted as zero
+        flops. Per-layer formulas live on Layer.analytic_flops.
+
+        Returns {"fwd", "bwd", "total", "per_layer"} where per_layer is
+        a [{layer, type, fwd, bwd}] breakdown of nonzero contributors.
+        """
+        # dX of a layer is dead code unless some layer strictly upstream
+        # holds trainable parameters (the classic first-conv case):
+        # propagate a needs-input-grad flag through the DAG in
+        # connection order (self-loops overwrite, like node values)
+        has_grad = [False] * self.cfg.num_nodes
+        fwd = bwd = 0.0
+        per_layer = []
+        for li, (info, mod) in enumerate(zip(self.cfg.layers,
+                                             self.modules)):
+            upstream = any(has_grad[ni] for ni in info.nindex_in)
+            f, b = mod.analytic_flops(skip_dx=not upstream)
+            fwd += f
+            bwd += b
+            if f or b:
+                per_layer.append({"layer": li, "type": mod.type_name,
+                                  "fwd": f, "bwd": b})
+            flag = upstream or mod.has_params
+            for no in info.nindex_out:
+                has_grad[no] = flag
+        out_bwd = bwd if train else 0.0
+        return {"fwd": fwd, "bwd": out_bwd, "total": fwd + out_bwd,
+                "per_layer": per_layer}
 
     # ------------------------------------------------------------------
     def loss_fn(self, params, data, labels, rng, epoch,

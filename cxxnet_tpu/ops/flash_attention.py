@@ -84,6 +84,29 @@ def _pick_block(s: int, target: int = DEFAULT_BLOCK_TARGET) -> int:
     return s
 
 
+def analytic_flops(b, h, s, d, causal):
+    """Matmul flops one flash_attention call actually executes:
+    ``(fwd, bwd)``.
+
+    XLA's HLO cost model cannot see inside a pallas_call (it lowers to
+    an opaque custom_call), so every net using this kernel under-reports
+    ``lowered.cost_analysis()['flops']`` — these analytic counts are
+    what bench.py/perf_lab add back (VERDICT r3 #2).
+
+    fwd = 2 MXU matmuls per (q, k) block pair (QK^T and PV) = 4*b*h*s²*d.
+    bwd = the dq kernel's 3 (logits recompute, dP, dQ) plus the dk/dv
+    kernel's 4 (logits recompute, dV, dP recompute, dK) = 14*b*h*s²*d —
+    note this exceeds the 2x-fwd *model*-flops rate because the flash
+    recompute trick re-derives P from Q/K instead of storing it; these
+    are HARDWARE flops (HFU basis). The causal schedule visits only the
+    (nb+1)/(2*nb) lower-triangular block pairs at nb blocks per side.
+    """
+    nb = max(s // _pick_block(s), 1)
+    c = (nb + 1) / (2.0 * nb) if causal else 1.0
+    base = float(b) * h * s * s * d * c
+    return 4.0 * base, 14.0 * base
+
+
 def _causal_mask(qi, kb, block_q, block_k):
     rows = qi * block_q + lax.broadcasted_iota(jnp.int32,
                                                (block_q, block_k), 0)

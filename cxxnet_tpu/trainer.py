@@ -918,9 +918,30 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def step_cost_analysis(self) -> dict:
-        """XLA's cost model for one training step (flops, bytes accessed):
-        the honest FLOP count behind a reported MFU. Uses the HLO-level
-        analysis of a fresh lowering from the recorded arg specs — no
+        """Cost model for one training step: XLA's HLO count plus the
+        analytic corrections it needs (VERDICT r3 #2).
+
+        XLA's ``cost_analysis()['flops']`` under-counts two program
+        shapes, both verified on this tree: a ``lax.scan`` body is
+        counted ONCE regardless of trip count (the transformer_stack
+        scans over depth), and a Pallas kernel lowers to an opaque
+        custom_call counted as zero. The returned dict therefore adds:
+
+        * ``model_flops`` — analytic model flops (MFU basis: matmul
+          terms, bwd at 2x fwd, causal half, no remat replay;
+          Network.analytic_model_flops). THE number to divide by step
+          time for a published MFU.
+        * ``model_flops_fwd`` — its forward-only part (eval streams).
+        * ``pallas_hw_flops`` / ``pallas_kernels`` — analytic hardware
+          flops of the Pallas kernels in the last train trace and which
+          kernels XLA could not see (empty = no Pallas kernels ran;
+          the scan undercount can still apply).
+        * ``flops`` — XLA's own count, unchanged, as the cross-check:
+          for scan-free Pallas-free nets it agrees with model_flops to
+          within the elementwise tail (pinned by
+          tests/test_flops_accounting.py).
+
+        Uses a fresh lowering from the recorded arg specs — no
         recompile, no device traffic. Requires one prior update()."""
         if self._step_specs is None:
             raise RuntimeError("run at least one update() first "
@@ -934,7 +955,15 @@ class Trainer:
             ca = lowered.compile().cost_analysis()
             if isinstance(ca, (list, tuple)):
                 ca = ca[0]
-        return dict(ca or {})
+        ca = dict(ca or {})
+        af = self.net.analytic_model_flops(train=True)
+        ca["model_flops"] = af["total"]
+        ca["model_flops_fwd"] = af["fwd"]
+        rec = self.net.pallas_flops_record.get(True, [])
+        ca["pallas_hw_flops"] = float(
+            sum(e["fwd"] + e["bwd"] for e in rec))
+        ca["pallas_kernels"] = sorted({e["kernel"] for e in rec})
+        return ca
 
     # ------------------------------------------------------------------
     def forward_nodes(self, batch: DataBatch,

@@ -351,10 +351,16 @@ def cmd_zoo(args):
     for name, tr, _ in entries:
         batch, seq = meta[name]
         ms = best[name]
+        # MFU = analytic model flops / time / peak (the literature
+        # basis); XLA's count rides along as cross-check — it counts a
+        # scan body once and a Pallas custom_call as zero (VERDICT r3
+        # #2), so it under-reports every transformer row
         try:
-            flops = float(tr.step_cost_analysis().get("flops", 0.0))
+            ca = tr.step_cost_analysis()
         except Exception:
-            flops = 0.0
+            ca = {}
+        flops = float(ca.get("model_flops") or 0.0)
+        xla_flops = float(ca.get("flops") or 0.0)
         mfu = (flops / (ms / 1000.0) / PEAK_FLOPS
                if flops and platform == "tpu" else None)
         row = {
@@ -363,6 +369,8 @@ def cmd_zoo(args):
             "step_ms": round(ms, 3),
             "images_per_sec": round(batch / ms * 1000.0, 1),
             "step_flops": flops,
+            "step_flops_xla_counted": xla_flops,
+            "xla_invisible_kernels": ca.get("pallas_kernels", []),
             "mfu_vs_197tflops_bf16": round(mfu, 4) if mfu else None}
         if seq:
             row["tokens_per_sec"] = round(batch * seq / ms * 1000.0, 1)
