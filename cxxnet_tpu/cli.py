@@ -147,6 +147,7 @@ class LearnTask:
             self.trainer.load_model(path)
             self.start_counter = counter + 1
             self.create_iterators()
+            self._warn_unconsumed()
             return
         self.continue_training = 0
         if self.model_in == "NULL":
@@ -165,6 +166,49 @@ class LearnTask:
                     self.start_counter = int(base)
                 self.start_counter += 1
         self.create_iterators()
+        self._warn_unconsumed()
+
+    # keys the CLI layer itself consumes (set_param above + run())
+    CLI_KEYS = frozenset([
+        "net_type", "print_step", "continue", "save_model",
+        "start_counter", "model_in", "model_dir", "num_round",
+        "max_round", "silent", "task", "test_io", "extract_node_name",
+        "output_format", "data", "eval", "pred", "iter",
+        # TraceSession (profiler.py)
+        "profile", "profile_dir", "profile_start_batch",
+        "profile_stop_batch",
+    ])
+
+    def _iter_section_keys(self) -> set:
+        """Keys appearing inside data/eval/pred iterator sections —
+        claimed by the iterator factory, excluded from the global
+        unconsumed-key audit (same flag walk as create_iterators)."""
+        flag, keys = 0, set()
+        for name, val in self.cfg:
+            if name in ("data", "eval", "pred"):
+                flag = 1
+            elif name == "iter" and val == "end":
+                flag = 0
+            elif flag:
+                keys.add(name)
+        return keys
+
+    def _warn_unconsumed(self) -> None:
+        """Report config keys nothing consumed (VERDICT r3 #5 — the
+        silently no-op'd warmup_epochs class of bug; the reference
+        broadcast-and-ignores). ``strict = 1`` makes it fatal."""
+        if self.trainer is None:
+            return
+        bad = self.trainer.unconsumed_keys(
+            extra_known=self.CLI_KEYS | self._iter_section_keys())
+        if not bad:
+            return
+        msg = ("unconsumed config keys (no component recognized them "
+               "- typo?): %s" % ", ".join(bad))
+        if self.trainer.strict:
+            raise ValueError(msg + " (strict = 1 makes this fatal; "
+                             "fix or remove the keys)")
+        print("Warning: " + msg, file=sys.stderr)
 
     def create_iterators(self) -> None:
         """Order-sensitive iterator sections (reference:

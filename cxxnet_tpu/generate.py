@@ -65,8 +65,8 @@ def plan_or_reason(net):
             return None, ("layer %s is not part of a single linear "
                           "chain" % info.type)
         prev = info.nindex_out[0]
-    if len(mods) < 4:
-        return None, "net shorter than embed -> stack -> head -> softmax"
+    if len(mods) < 3:
+        return None, "net shorter than embed -> stack -> head"
     if not isinstance(mods[0], L.EmbeddingLayer):
         return None, "first layer is %s, not embed" % mods[0].type_name
     stacks: List[int] = []
@@ -79,10 +79,14 @@ def plan_or_reason(net):
         i += 1
     if not stacks:
         return None, "no transformer_stack after embed"
+    if i + 1 == len(mods) and isinstance(mods[i], L.LMHeadLayer):
+        # fused head: projection + CE in one layer; decode only needs
+        # its wmat/bias, which share the fullc layout
+        return {"embed": 0, "stacks": stacks, "head": i}, ""
     if i + 2 != len(mods):
-        return None, ("expected exactly fullc(seq=1) + softmax after "
-                      "the stacks, found %d trailing layers"
-                      % (len(mods) - i))
+        return None, ("expected fullc(seq=1) + softmax (or one "
+                      "lm_head) after the stacks, found %d trailing "
+                      "layers" % (len(mods) - i))
     head, loss = mods[i], mods[i + 1]
     if not isinstance(head, L.FullConnectLayer) or not head.seq:
         return None, "head is %s, not fullc(seq=1)" % head.type_name

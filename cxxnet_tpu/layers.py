@@ -58,6 +58,10 @@ def create_layer(type_name: str, cfg: Sequence[Tuple[str, str]],
     layer.label_name_map = label_name_map or {"label": 0}
     for k, v in cfg:
         layer.set_param(k, v)
+    # keys this layer SAW (globals + its bucket); with
+    # LayerParam.unknown_keys this yields the keys it consumed — the
+    # per-layer half of Trainer.unconsumed_keys
+    layer._cfg_keys = {k for k, _ in cfg}
     return layer
 
 
@@ -81,6 +85,12 @@ class LayerParam:
     silent: int = 0
     num_input_channel: int = 0
     num_input_node: int = 0
+    # keys no set_param branch recognized — the terminal of every
+    # layer's set_param chain records them here so the trainer's
+    # unconsumed-key audit can tell a typo'd knob from a consumed one
+    # (the reference broadcast-and-ignores, neural_net-inl.hpp:252-264;
+    # a silently no-op'd warmup_epochs corrupted a recorded r3 run)
+    unknown_keys: set = field(default_factory=set)
 
     def set_param(self, name: str, val: str) -> bool:
         ok = True
@@ -125,6 +135,7 @@ class LayerParam:
             self.silent = int(val)
         else:
             ok = False
+            self.unknown_keys.add(name)
         return ok
 
     def rand_init_weight(self, rng, shape, in_num: int, out_num: int):
@@ -1926,10 +1937,17 @@ class TransformerStackLayer(Layer):
             use_flash = False
 
         def rmsnorm(x, g):
+            # g=None: the learned gain is folded into the following
+            # weight matrix (_fold_norms — one L*e*f multiply at trace
+            # time instead of a (b, s, e) VPU pass per norm per step);
+            # the MoE branch keeps the explicit gain (its router gates
+            # on the gained activations — folding into w1 alone would
+            # change the routing math and break decode parity)
             ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
                           keepdims=True)
-            return (x.astype(jnp.float32) * jax.lax.rsqrt(ms + 1e-6)
-                    ).astype(dt) * g.astype(dt)
+            xn = (x.astype(jnp.float32)
+                  * jax.lax.rsqrt(ms + 1e-6)).astype(dt)
+            return xn if g is None else xn * g.astype(dt)
 
         moe = self.moe
         topk, cap_f = self.topk, self.capacity_factor
@@ -1952,8 +1970,21 @@ class TransformerStackLayer(Layer):
         def block(lp, h):
             b, s, e = h.shape
             d = e // nh
-            x = rmsnorm(h, lp["norm1"])
+            x = rmsnorm(h, None)          # gain folded into wqkv
             qkv = jnp.einsum("bse,fe->bsf", x, lp["wqkv"].astype(dt))
+            if use_flash and not seq_sharded:
+                from .ops import flash_attention as fa
+                if fa.supports_flat(s, nh, d):
+                    # flat kernels: read the projection's (b, s, 3e)
+                    # output and emit (b, s, e) directly — no
+                    # (3, b, h, s, d) relayouts on either pass
+                    att = fa.flash_attention_flat(
+                        qkv, nh, causal, interpret=interpret)
+                    h = h + jnp.einsum("bse,fe->bsf", att,
+                                       lp["wo"].astype(dt))
+                    x = rmsnorm(h, lp["norm2"] if moe else None)
+                    y, aux = mlp(lp, x)
+                    return h + y, aux
             qkv = qkv.reshape(b, s, 3, nh, d).transpose(2, 0, 3, 1, 4)
             if seq_sharded:
                 # sequence parallelism: the attend must stay sharded —
@@ -1977,10 +2008,29 @@ class TransformerStackLayer(Layer):
                 att = ra.attention(qkv[0], qkv[1], qkv[2], causal=causal)
             att = att.transpose(0, 2, 1, 3).reshape(b, s, e)
             h = h + jnp.einsum("bse,fe->bsf", att, lp["wo"].astype(dt))
-            x = rmsnorm(h, lp["norm2"])
+            x = rmsnorm(h, lp["norm2"] if moe else None)
             y, aux = mlp(lp, x)
             return h + y, aux
         return block
+
+    def _fold_norms(self, params, dt):
+        """Fold the rmsnorm gains into the weight matrices they feed:
+        (g * x) . W^T == x . (W * g)^T, so norm1 rides wqkv and norm2
+        rides the dense w1 — one (L, f, e) multiply at trace time (it
+        fuses into the bf16 weight cast) replaces a (b, s, e)
+        elementwise pass per norm per step. Gradients for the gains
+        flow through the fold automatically (jax.grad of the multiply).
+        The MoE norm2 is NOT folded: the router gates on the gained
+        activations, so folding into w1 alone would change expert
+        selection (and diverge from generate.py's cached decode) —
+        the block applies that gain explicitly instead."""
+        out = dict(params)
+        out["wqkv"] = (params["wqkv"]
+                       * params["norm1"][:, None, :]).astype(dt)
+        if not self.moe:
+            out["w1"] = (params["w1"]
+                         * params["norm2"][:, None, :]).astype(dt)
+        return out
 
     def apply(self, params, inputs, ctx):
         b, _, s, e = inputs[0].shape
@@ -2028,8 +2078,9 @@ class TransformerStackLayer(Layer):
                     "via model_parallel instead")
             from .ops import pipeline
             nmb = self.n_microbatch or pipe
+            folded = self._fold_norms(params, dt)
             cast = {k: v.astype(dt) if v.ndim > 2 else v
-                    for k, v in params.items()}
+                    for k, v in folded.items()}
             h = pipeline.sharded_pipeline(
                 mesh, lambda lp, hh: block(lp, hh)[0], cast, h, nmb,
                 contains_pallas=use_flash)
@@ -2039,7 +2090,8 @@ class TransformerStackLayer(Layer):
                 h2, a = block(lp, hh)
                 return (h2, aux + a), None
             (h, aux_total), _ = jax.lax.scan(
-                body, (h, jnp.zeros((), jnp.float32)), params,
+                body, (h, jnp.zeros((), jnp.float32)),
+                self._fold_norms(params, dt),
                 unroll=max(1, min(self.scan_unroll, self.nlayer)))
             if self.moe and ctx.train and self.moe_loss > 0.0:
                 ctx.losses.append(self.moe_loss * aux_total / self.nlayer)
@@ -2102,6 +2154,133 @@ class SoftmaxLayer(_LossLayer):
             ce = -jnp.take_along_axis(logp, y[:, None], axis=1).sum()
             ctx.losses.append(ce * self._scale(ctx))
         return [probs.reshape(inputs[0].shape)]
+
+
+@register("lm_head")
+class LMHeadLayer(_LossLayer):
+    """Fused vocabulary head: position-wise projection + softmax CE in
+    one layer — trajectory-equivalent to the ``fullc(seq=1)+softmax``
+    pair (pinned by tests/test_lm.py::test_lm_head_matches_pair) with
+    the training loss computed CHUNKED over token rows under
+    ``jax.checkpoint``, so the (tokens, vocab) logits+grad pair is
+    never resident at once. At GPT-2-small scale (16k tokens x 32k
+    vocab) that pair is ~4 GB of f32 HBM; the chunked loss caps it at
+    rows/ce_chunk, measured faster than the unfused head on v5e AND
+    the difference between batch 64 fitting on one chip or OOMing
+    (docs/performance.md r4).
+
+    The node value stays the pair's surface — softmax probabilities —
+    and XLA dead-code-eliminates that full-vocab matmul in training
+    traces where nothing reads the output node (eval_train=0; with a
+    train metric the probs are consumed and both paths run).
+
+    Config: ``nhidden`` (vocab size), ``ce_chunk`` (chunk count over
+    token rows; 0 = auto for ~256 MB logit slabs), ``logit_dtype``
+    (``compute``|``float32``, default compute — the CE upcasts to f32
+    after the bf16 matmul, standard LM practice), plus the loss keys
+    (``target``, ``grad_scale``). Params ``wmat``/``bias`` in fullc
+    layout. No reference analogue (cxxnet has no token models,
+    SURVEY.md §5).
+    """
+    has_params = True
+
+    def __init__(self):
+        super().__init__()
+        self.ce_chunk = 0
+        self.logit_dtype = "compute"
+
+    def set_param(self, name, val):
+        if name == "ce_chunk":
+            self.ce_chunk = int(val)
+        elif name == "logit_dtype":
+            if val not in ("compute", "float32"):
+                raise ValueError(
+                    "lm_head: logit_dtype must be compute|float32")
+            self.logit_dtype = val
+        else:
+            super().set_param(name, val)
+
+    def _infer(self, in_shapes):
+        n, c, s, e = in_shapes[0]
+        if c != 1:
+            raise ValueError("lm_head: input must be (batch,1,seq,embed)")
+        if self.param.num_hidden <= 0:
+            raise ValueError("lm_head: must set nhidden (vocab size)")
+        if self.param.num_input_node == 0:
+            self.param.num_input_node = e
+        elif self.param.num_input_node != e:
+            raise ValueError("lm_head: input hidden nodes inconsistent")
+        super()._infer(in_shapes)       # resolves target_index
+        return [(n, 1, s, self.param.num_hidden)]
+
+    def init_params(self, rng) -> Params:
+        nh, ni = self.param.num_hidden, self.param.num_input_node
+        p = {"wmat": self.param.rand_init_weight(rng, (nh, ni), ni, nh)}
+        if self.param.no_bias == 0:
+            p["bias"] = jnp.full((nh,), self.param.init_bias,
+                                 jnp.float32)
+        return p
+
+    def analytic_flops(self, skip_dx=False):
+        n, _, s, e = self.in_shapes[0]
+        f = 2.0 * n * s * e * self.param.num_hidden
+        return f, f if skip_dx else 2.0 * f
+
+    def _chunks(self, rows: int, v: int) -> int:
+        if self.ce_chunk > 0:
+            c = self.ce_chunk
+        else:
+            c = max(1, int(round(rows * v * 4 / 268e6)))
+        while c < rows and rows % c:
+            c += 1                       # next divisor of rows
+        return min(c, rows)
+
+    def apply(self, params, inputs, ctx):
+        n, _, s, e = inputs[0].shape
+        v = self.param.num_hidden
+        dt = ctx.compute_dtype if self.logit_dtype == "compute" \
+            else jnp.float32
+        x = inputs[0].reshape(n * s, e).astype(dt)
+        w = params["wmat"].astype(dt)
+        bias = params.get("bias")
+
+        def logits_of(rows):
+            lg = jnp.dot(rows, w.T)
+            if bias is not None:
+                lg = lg + bias.astype(lg.dtype)
+            return lg
+
+        # eval/predict surface (dead code in fused-loss train traces)
+        probs = jax.nn.softmax(
+            _stable_logits(logits_of(x).astype(jnp.float32)), axis=-1)
+        if ctx.labels is not None:
+            y = self._label(ctx).astype(jnp.int32)
+            if s > 1 and y.shape[1] != s:
+                raise ValueError(
+                    "lm_head on a %d-position sequence needs an equally "
+                    "wide label field (declare label_vec[0,%d) = %s and "
+                    "set label_width); got width %d"
+                    % (s, s, self.target, y.shape[1]))
+            rows = n * s
+            c = self._chunks(rows, v)
+            xc = x.reshape(c, rows // c, e)
+            yc = y.reshape(c, rows // c)
+
+            def chunk_ce(acc, t):
+                xx, yy = t
+                # max-subtract in the matmul dtype, upcast after: every
+                # exp argument is <= 0 (the r2 TPU softmax hazard)
+                lg = logits_of(xx)
+                lg = (lg - jax.lax.stop_gradient(
+                    lg.max(-1, keepdims=True))).astype(jnp.float32)
+                lp = jax.nn.log_softmax(lg, axis=-1)
+                return acc - jnp.take_along_axis(
+                    lp, yy[:, None], axis=1).sum(), None
+
+            ce, _ = jax.lax.scan(jax.checkpoint(chunk_ce),
+                                 jnp.zeros((), jnp.float32), (xc, yc))
+            ctx.losses.append(ce * self._scale(ctx) / (s if s > 1 else 1))
+        return [probs.reshape(n, 1, s, v)]
 
 
 @register("l2_loss")

@@ -523,13 +523,18 @@ input_shape = 1,{seq_len},1
 
 def tiny_lm(seq_len: int = 32, vocab: int = 32, embed: int = 32,
             nlayer: int = 2, nhead: int = 4, nexpert: int = 0,
-            moe_topk: int = 2, capacity_factor: float = 1.25) -> str:
+            moe_topk: int = 2, capacity_factor: float = 1.25,
+            fused_head: bool = False) -> str:
     """Causal language model: embed (+positions) -> causal transformer
     stack -> position-wise vocab head -> per-position softmax CE. The
     s-wide label field carries the next token per position (the synth
     iterator's ``lm_labels = 1`` mode generates Markov data for it).
     ``nexpert > 0`` switches the stack's MLP to mixture-of-experts.
-    No reference analogue — the complete token-LM training path."""
+    ``fused_head`` replaces the fullc+softmax pair with the fused
+    ``lm_head`` layer (chunked CE, never materializes the full
+    logits+grad pair — the big-vocab memory/speed path, trajectory-
+    equivalent by test). No reference analogue — the complete token-LM
+    training path."""
     moe = ""
     if nexpert > 0:
         moe = f"""
@@ -537,6 +542,16 @@ def tiny_lm(seq_len: int = 32, vocab: int = 32, embed: int = 32,
   nexpert = {nexpert}
   moe_topk = {moe_topk}
   capacity_factor = {capacity_factor}"""
+    if fused_head:
+        head = f"""layer[2->3] = lm_head:lm_head
+  nhidden = {vocab}
+  init_sigma = 0.02"""
+    else:
+        head = f"""layer[2->3] = fullc:lm_head
+  nhidden = {vocab}
+  seq = 1
+  init_sigma = 0.02
+layer[3->3] = softmax"""
     return f"""
 netconfig=start
 layer[0->1] = embed:emb
@@ -549,11 +564,7 @@ layer[1->2] = transformer_stack:ts1
   causal = 1
   nhidden_mlp = {4 * embed}
   random_type = xavier{moe}
-layer[2->3] = fullc:lm_head
-  nhidden = {vocab}
-  seq = 1
-  init_sigma = 0.02
-layer[3->3] = softmax
+{head}
 netconfig=end
 input_shape = 1,{seq_len},1
 label_vec[0,{seq_len}) = label
@@ -561,14 +572,17 @@ label_vec[0,{seq_len}) = label
 
 
 def gpt2_small(seq_len: int = 512, vocab: int = 32768,
-               embed: int = 768, nlayer: int = 12, nhead: int = 12) -> str:
+               embed: int = 768, nlayer: int = 12, nhead: int = 12,
+               fused_head: bool = True) -> str:
     """GPT-2-small-class causal LM NETWORK (embed + causal stack +
-    vocab head) at the shape measured in docs/performance.md (~100k
-    tokens/sec at seq 512 on one v5e chip, bf16, flash attention).
-    Training hyperparameters (adam, decoupled_wd, warmup+cosine,
-    clip_global_norm) live in examples/transformer/gpt2_small.conf."""
+    vocab head) at the shape measured in docs/performance.md (seq 512
+    on one v5e chip, bf16, flash attention). Defaults to the fused
+    ``lm_head`` (chunked CE — at this vocab the unfused logits+grad
+    pair is ~4 GB of HBM). Training hyperparameters (adam,
+    decoupled_wd, warmup+cosine, clip_global_norm) live in
+    examples/transformer/gpt2_small.conf."""
     return tiny_lm(seq_len=seq_len, vocab=vocab, embed=embed,
-                   nlayer=nlayer, nhead=nhead)
+                   nlayer=nlayer, nhead=nhead, fused_head=fused_head)
 
 
 def seq_classifier(seq_len: int = 16, embed: int = 32, nhead: int = 4,

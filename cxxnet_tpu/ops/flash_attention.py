@@ -64,7 +64,7 @@ def resolve_impl(attn_impl: str, platform: str, s: int) -> str:
 DEFAULT_BLOCK_TARGET = 512
 
 
-def _pick_block(s: int, target: int = DEFAULT_BLOCK_TARGET) -> int:
+def _pick_block(s: int, target: int = None) -> int:
     """Block size for sequence length s, honoring the TPU block-tiling
     rule: a block must be a multiple of 128 (the lse lane dimension) or
     equal to s (the equal-to-array-dim escape). Prefers the largest
@@ -76,6 +76,10 @@ def _pick_block(s: int, target: int = DEFAULT_BLOCK_TARGET) -> int:
     50.6k tok/s @128, 72.1k @256, 86.6k @512, 83.8k @1024 at seq 2048 —
     bigger blocks amortize the k-loop and keep the MXU busier, while
     2048-wide blocks blow the VMEM budget and fail to compile."""
+    if target is None:
+        # resolved at call time so experiments / future knobs can
+        # retarget without re-importing (tools/tlab.py block sweep)
+        target = DEFAULT_BLOCK_TARGET
     b = (min(s, target) // 128) * 128
     while b >= 128:
         if s % b == 0:
@@ -94,9 +98,12 @@ def analytic_flops(b, h, s, d, causal):
     what bench.py/perf_lab add back (VERDICT r3 #2).
 
     fwd = 2 MXU matmuls per (q, k) block pair (QK^T and PV) = 4*b*h*s²*d.
-    bwd = the dq kernel's 3 (logits recompute, dP, dQ) plus the dk/dv
-    kernel's 4 (logits recompute, dV, dP recompute, dK) = 14*b*h*s²*d —
-    note this exceeds the 2x-fwd *model*-flops rate because the flash
+    bwd at a single block (s <= 512-class, _pick_block(s) == s): the
+    FUSED backward (_bwd1_kernel / _flat_bwd_kernel) computes
+    logits/p/dp/ds once and runs 5 dots = 10*b*h*s²*d. Multi-block:
+    the split dq kernel's 3 (logits recompute, dP, dQ) plus the dk/dv
+    kernel's 4 (logits recompute, dV, dP recompute, dK) = 14*b*h*s²*d.
+    Both exceed the 2x-fwd *model*-flops rate because the flash
     recompute trick re-derives P from Q/K instead of storing it; these
     are HARDWARE flops (HFU basis). The causal schedule visits only the
     (nb+1)/(2*nb) lower-triangular block pairs at nb blocks per side.
@@ -104,7 +111,33 @@ def analytic_flops(b, h, s, d, causal):
     nb = max(s // _pick_block(s), 1)
     c = (nb + 1) / (2.0 * nb) if causal else 1.0
     base = float(b) * h * s * s * d * c
-    return 4.0 * base, 14.0 * base
+    return 4.0 * base, (10.0 if nb == 1 else 14.0) * base
+
+
+def _pick_group(bh, n_full, n_block, n_f32, s, d, block_q, block_k):
+    """Heads per grid step. A (batch*heads,)-leading grid at small s
+    runs hundreds of sequential micro-programs whose fixed grid/DMA
+    cost dominates the ~0.3 us of MXU work each holds — measured r4 on
+    the GPT-2-small stack: ~4.3 ms/layer at grid (384, 1), ~7x the
+    matmul floor. Grouping g heads per step (batched dot_general — one
+    Mosaic program, g back-to-back MXU issues) amortizes that cost.
+    Picks the largest divisor of bh whose VMEM footprint — n_full
+    whole-sequence operands, n_block block operands, n_f32 f32
+    (block_q, block_k) intermediates — fits the budget. The scoped
+    VMEM limit is 16 MB (v5e compile error text); the estimate here
+    undercounts loop carries / double buffering somewhat (measured r4:
+    fwd at an 18.9 MB estimate allocated 21.9 MB and failed), so the
+    budget leaves a third of headroom."""
+    budget = 12 * 1024 * 1024
+    best = 1
+    for g in range(2, min(bh, 16) + 1):
+        if bh % g:
+            continue
+        est = g * (n_full * s * d * 2 + n_block * block_q * d * 2
+                   + n_f32 * block_q * block_k * 4)
+        if est <= budget:
+            best = g
+    return best
 
 
 def _causal_mask(qi, kb, block_q, block_k):
@@ -118,10 +151,15 @@ def _causal_mask(qi, kb, block_q, block_k):
 # ----------------------------------------------------------------------
 # forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                scale, causal, block_q, block_k, s):
+                causal, block_q, block_k, s):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale            # (bq, d)
-    d = q.shape[-1]
+    # operands stay in their storage dtype (bf16 on TPU): the MXU runs
+    # bf16 inputs at ~4x its f32 rate and accumulates f32 internally
+    # (preferred_element_type). Softmax statistics stay f32. The
+    # leading dim is the head group (_pick_group): g independent
+    # attentions per grid step via batched dot_general.
+    q = q_ref[...]                                      # (g, bq, d)
+    g, _, d = q.shape
     nk = s // block_k
     if causal:
         # skip k blocks entirely above the diagonal (their contribution
@@ -134,54 +172,58 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             # static full slice: Mosaic requires dynamic offsets to be
             # provably 128-aligned, which only multi-block (128-multiple,
             # see _pick_block) layouts satisfy
-            k = k_ref[0].astype(jnp.float32)
-            v = v_ref[0].astype(jnp.float32)
+            k = k_ref[...]
+            v = v_ref[...]
         else:
-            k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-            v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        logits = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+            k = k_ref[:, pl.ds(kb * block_k, block_k), :]
+            v = v_ref[:, pl.ds(kb * block_k, block_k), :]
+        # scale is pre-folded into q by _flash_fwd (an s*d pass outside
+        # the kernel instead of an s^2 VPU pass per block inside it)
+        logits = lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
         if causal:
-            logits = jnp.where(_causal_mask(qi, kb, block_q, block_k),
-                               logits, NEG_INF)
-        mb = jnp.max(logits, axis=-1)
+            logits = jnp.where(
+                _causal_mask(qi, kb, block_q, block_k)[None],
+                logits, NEG_INF)
+        mb = jnp.max(logits, axis=-1)                    # (g, bq)
         m2 = jnp.maximum(m, mb)
-        p = jnp.exp(logits - m2[:, None])
+        p = jnp.exp(logits - m2[..., None])
         corr = jnp.exp(m - m2)
         l2 = l * corr + p.sum(axis=-1)
-        acc2 = acc * corr[:, None] + lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+        acc2 = acc * corr[..., None] + lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         return m2, l2, acc2
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((g, block_q), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g, block_q), jnp.float32)
+    acc0 = jnp.zeros((g, block_q, d), jnp.float32)
     m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
     lsafe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / lsafe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(lsafe)
+    o_ref[...] = (acc / lsafe[..., None]).astype(o_ref.dtype)
+    lse_ref[:, 0, :] = m + jnp.log(lsafe)
 
 
-def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
+def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     bh, s, d = q.shape
-    grid = (bh, s // block_q)
-    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+    g = _pick_group(bh, 2, 2, 2, s, d, block_q, block_k)
+    grid = (bh // g, s // block_q)
+    kern = functools.partial(_fwd_kernel, causal=causal,
                              block_q=block_q, block_k=block_k, s=s)
     return pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((g, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((g, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((g, s, d), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            # stats ride a (bh, 1, s) layout: a (1, 1, block_q) block
+            pl.BlockSpec((g, block_q, d), lambda i, j: (i, j, 0)),
+            # stats ride a (bh, 1, s) layout: a (g, 1, block_q) block
             # satisfies the TPU (8, 128) tiling rule via the
             # equal-to-array-dim escape on the singleton dim
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((g, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
@@ -196,79 +238,151 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                scale, causal, block_q, block_k, s):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
-    d = q.shape[-1]
+    # bf16 MXU operands / f32 accumulation, head-grouped like the
+    # forward kernel
+    q = q_ref[...]                                      # (g, bq, d)
+    do = do_ref[...]
+    lse = lse_ref[:, 0, :]                              # (g, bq)
+    delta = delta_ref[:, 0, :]
+    g, _, d = q.shape
     nk = s // block_k
     if causal:
         nk = jnp.minimum(nk, ((qi + 1) * block_q + block_k - 1) // block_k)
 
     def body(kb, dq):
         if block_k == s:
-            k = k_ref[0].astype(jnp.float32)
-            v = v_ref[0].astype(jnp.float32)
+            k = k_ref[...]
+            v = v_ref[...]
         else:
-            k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-            v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        logits = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32) * scale
+            k = k_ref[:, pl.ds(kb * block_k, block_k), :]
+            v = v_ref[:, pl.ds(kb * block_k, block_k), :]
+        # q arrives pre-scaled (saved so by _flash_fwd): logits need no
+        # further scale; the trailing dq write-out restores the chain
+        # rule's factor
+        logits = lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
         if causal:
-            logits = jnp.where(_causal_mask(qi, kb, block_q, block_k),
-                               logits, NEG_INF)
-        p = jnp.exp(logits - lse[:, None])
-        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+            logits = jnp.where(
+                _causal_mask(qi, kb, block_q, block_k)[None],
+                logits, NEG_INF)
+        p = jnp.exp(logits - lse[..., None])
+        dp = lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        return dq + lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+        ds = (p * (dp - delta[..., None])).astype(k.dtype)
+        return dq + lax.dot_general(ds, k, (((2,), (1,)), ((0,), (0,))),
                                     preferred_element_type=jnp.float32)
 
-    dq = lax.fori_loop(0, nk, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    dq = lax.fori_loop(0, nk, body,
+                       jnp.zeros((g, block_q, d), jnp.float32))
+    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, *, scale, causal, block_q, block_k, s):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                    # (bk, d)
-    v = v_ref[0].astype(jnp.float32)
-    d = k.shape[-1]
+    # bf16 MXU operands / f32 accumulation, head-grouped like the
+    # forward kernel
+    k = k_ref[...]                                      # (g, bk, d)
+    v = v_ref[...]
+    g, _, d = k.shape
     nq = s // block_q
     q_lo = (ki * block_k) // block_q if causal else 0
 
     def body(qb, carry):
         dk, dv = carry
         if block_q == s:
-            q = q_ref[0].astype(jnp.float32)
-            do = do_ref[0].astype(jnp.float32)
-            lse = lse_ref[0, 0]
-            delta = delta_ref[0, 0]
+            q = q_ref[...]
+            do = do_ref[...]
+            lse = lse_ref[:, 0, :]
+            delta = delta_ref[:, 0, :]
         else:
-            q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-            do = do_ref[0, pl.ds(qb * block_q, block_q),
-                        :].astype(jnp.float32)
-            lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
-            delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
-        logits = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32) * scale
+            q = q_ref[:, pl.ds(qb * block_q, block_q), :]
+            do = do_ref[:, pl.ds(qb * block_q, block_q), :]
+            lse = lse_ref[:, 0, pl.ds(qb * block_q, block_q)]
+            delta = delta_ref[:, 0, pl.ds(qb * block_q, block_q)]
+        # q arrives pre-scaled: logits need no further scale, and dk
+        # accumulated against the scaled q already carries the factor
+        logits = lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
         if causal:
-            logits = jnp.where(_causal_mask(qb, ki, block_q, block_k),
-                               logits, NEG_INF)
-        p = jnp.exp(logits - lse[:, None])              # (bq, bk)
-        dv2 = dv + lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+            logits = jnp.where(
+                _causal_mask(qb, ki, block_q, block_k)[None],
+                logits, NEG_INF)
+        p = jnp.exp(logits - lse[..., None])            # (g, bq, bk)
+        pc = p.astype(do.dtype)
+        dv2 = dv + lax.dot_general(pc, do, (((1,), (1,)), ((0,), (0,))),
                                    preferred_element_type=jnp.float32)
-        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        dk2 = dk + lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        ds = (p * (dp - delta[..., None])).astype(q.dtype)
+        dk2 = dk + lax.dot_general(ds, q, (((1,), (1,)), ((0,), (0,))),
                                    preferred_element_type=jnp.float32)
         return dk2, dv2
 
-    z = jnp.zeros((k.shape[0], d), jnp.float32)
+    z = jnp.zeros((g, k.shape[1], d), jnp.float32)
     dk, dv = lax.fori_loop(q_lo, nq, body, (z, z))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _bwd1_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dq_ref, dk_ref, dv_ref, *, scale, causal, s):
+    """Single-block fused backward (block_q == block_k == s, the s<=512
+    regime both GPT-2-small and ViT-S/16 run in): one kernel computes
+    logits/p/dp/ds ONCE and emits dq, dk, dv together. The split
+    dq/dkv pair recomputes the exp(s x s) softmax and the dp matmul in
+    EACH kernel — at small s the kernels are VPU-bound on exactly that
+    work (measured r4: the recompute was ~40% of the stack's attention
+    time), so the fusion is the win, and it drops two MXU products
+    besides (7 dots -> 5)."""
+    q = q_ref[...]                                      # (g, s, d)
+    k = k_ref[...]
+    v = v_ref[...]
+    do = do_ref[...]
+    lse = lse_ref[:, 0, :]                              # (g, s)
+    delta = delta_ref[:, 0, :]
+    # q arrives pre-scaled (saved so by _flash_fwd): logits carry the
+    # factor already, as does dk (accumulated against scaled q); only
+    # dq needs the chain-rule rescale on write-out
+    logits = lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+    if causal:
+        logits = jnp.where(_causal_mask(0, 0, s, s)[None],
+                           logits, NEG_INF)
+    p = jnp.exp(logits - lse[..., None])                # (g, s, s)
+    pc = p.astype(do.dtype)
+    dv = lax.dot_general(pc, do, (((1,), (1,)), ((0,), (0,))),
+                         preferred_element_type=jnp.float32)
+    dp = lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
+                         preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta[..., None])).astype(q.dtype)
+    dq = lax.dot_general(ds, k, (((2,), (1,)), ((0,), (0,))),
+                         preferred_element_type=jnp.float32)
+    dk = lax.dot_general(ds, q, (((1,), (1,)), ((0,), (0,))),
+                         preferred_element_type=jnp.float32)
+    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _bwd1_impl(q, k, v, lse, do, delta, scale, causal, interpret):
+    bh, s, d = q.shape
+    # 7 seq-by-d operands + 4 f32 (s, s) intermediates per group
+    g = _pick_group(bh, 7, 0, 4, s, d, s, s)
+    spec_sd = pl.BlockSpec((g, s, d), lambda i: (i, 0, 0))
+    spec_stat = pl.BlockSpec((g, 1, s), lambda i: (i, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_bwd1_kernel, scale=scale, causal=causal,
+                          s=s),
+        grid=(bh // g,),
+        in_specs=[spec_sd, spec_sd, spec_sd, spec_sd,
+                  spec_stat, spec_stat],
+        out_specs=[spec_sd, spec_sd, spec_sd],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
 
 
 def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q,
@@ -276,37 +390,42 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q,
     bh, s, d = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, None, :]                 # (bh, 1, s)
+    if block_q == s and block_k == s:
+        return _bwd1_impl(q, k, v, lse, do, delta, scale, causal,
+                          interpret)
+    g1 = _pick_group(bh, 2, 3, 4, s, d, block_q, block_k)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, s=s),
-        grid=(bh, s // block_q),
+        grid=(bh // g1, s // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((g1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((g1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((g1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((g1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((g1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((g1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=pl.BlockSpec((g1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
+    g2 = _pick_group(bh, 2, 4, 4, s, d, block_q, block_k)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, s=s),
-        grid=(bh, s // block_k),
+        grid=(bh // g2, s // block_k),
         in_specs=[
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((g2, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((g2, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((g2, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((g2, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((g2, 1, s), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((g2, 1, s), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((g2, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((g2, block_k, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), k.dtype),
@@ -315,6 +434,209 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
+
+
+# ----------------------------------------------------------------------
+# flat-layout entry (single-block sequences): kernels read the QKV
+# projection's raw (b, s, 3e) output and write (b, s, e) — exactly the
+# layouts the surrounding einsums produce/consume — so the
+# (3, b, h, s, d) transpose relayouts (~100 MB+ HBM per layer each way
+# at GPT-2 scale, fwd AND bwd) vanish. One grid step per batch element;
+# a STATIC Python loop over head groups inside the kernel keeps every
+# slice offset a compile-time multiple of g*d (128-aligned by the
+# supports_flat guard), and the backward is the fused single-kernel
+# form (logits/p/dp/ds computed once -> dq, dk, dv in one pass).
+# ----------------------------------------------------------------------
+def supports_flat(s: int, h: int, d: int, e3: int = 0) -> int:
+    """Head-group size for the flat kernels, or 0 when they don't
+    apply. Requires a single-block sequence (the fused bwd holds the
+    (g, s, s) f32 score block in VMEM) and a divisor g of h with
+    g*d a lane-aligned 128 multiple; picks the largest g whose f32
+    intermediates fit the VMEM budget. Empirical anchor: the GPT-2
+    shape (s=512, h=12, d=64 -> g=2, 13.9 MB estimate) compiles and
+    runs; a shape past the real 16 MB scoped limit fails loudly at
+    trace time (escape hatch: attn_impl = xla), never silently."""
+    if _pick_block(s) != s:
+        return 0
+    e3 = e3 or 3 * h * d
+    best = 0
+    for g in range(1, h + 1):
+        if h % g or (g * d) % 128:
+            continue
+        # 4 f32 (g, s, s) intermediates + the qkv/dqkv/do blocks
+        est = 4 * g * s * s * 4 + (2 * e3 + e3 // 3) * s * 2
+        if est <= 15 * 1024 * 1024:
+            best = g
+    return best
+
+
+def _flat_fwd_kernel(qkv_ref, o_ref, lse_ref, *, scale, causal, s, h,
+                     d, g):
+    e = h * d
+    lses = []
+
+    def load_t(col):
+        # (s, g*d) minor slice -> 2D transpose -> split the SUBLANE dim
+        # into (g, d): the lane dim (s) stays whole, which is the only
+        # shape cast Mosaic's layout inference accepts at d < 128;
+        # s*g*d elements of VPU shuffle — nothing next to the HBM
+        # relayouts this path deletes
+        return qkv_ref[0, :, col:col + g * d].T.reshape(g, d, s)
+
+    for ih in range(h // g):
+        lo = ih * g * d
+        qe = load_t(lo) * scale                         # (g, d, s)
+        kt = load_t(e + lo)
+        vt = load_t(2 * e + lo)
+        # contract d (axis 1), batch g at position 0 (Mosaic rule)
+        logits = lax.dot_general(qe, kt, (((1,), (1,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        if causal:
+            logits = jnp.where(_causal_mask(0, 0, s, s)[None],
+                               logits, NEG_INF)
+        m = jnp.max(logits, axis=-1)                    # (g, s)
+        p = jnp.exp(logits - m[..., None])
+        l = jnp.maximum(p.sum(axis=-1), 1e-30)
+        # acc[d, i] = sum_j v[d, j] p[i, j] -> (g, d, s); the 1/l
+        # normalize rides the small (g, d, s) tensor, not p
+        acc = lax.dot_general(vt, p.astype(vt.dtype),
+                              (((2,), (2,)), ((0,), (0,))),
+                              preferred_element_type=jnp.float32)
+        acc = acc / l[:, None, :]
+        o_ref[0, :, lo:lo + g * d] = acc.reshape(
+            g * d, s).T.astype(o_ref.dtype)
+        lses.append(m + jnp.log(l))
+    lse_ref[0] = jnp.concatenate(lses, axis=0)          # (h, s)
+
+
+def _flat_bwd_kernel(qkv_ref, do_ref, lse_ref, delta_ref, dqkv_ref, *,
+                     scale, causal, s, h, d, g):
+    e = h * d
+    lse_all = lse_ref[0]                                # (h//g, g, s)
+    delta_all = delta_ref[0]
+
+    def load_t(ref, col):
+        return ref[0, :, col:col + g * d].T.reshape(g, d, s)
+
+    for ih in range(h // g):
+        lo = ih * g * d
+        qe = load_t(qkv_ref, lo) * scale                # (g, d, s)
+        kt = load_t(qkv_ref, e + lo)
+        vt = load_t(qkv_ref, 2 * e + lo)
+        dot = load_t(do_ref, lo)
+        lse = lse_all[ih]                               # (g, s)
+        delta = delta_all[ih]
+        # logits[i, j] over (g, s_i, s_j); contract d, batch g first
+        logits = lax.dot_general(qe, kt, (((1,), (1,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        if causal:
+            logits = jnp.where(_causal_mask(0, 0, s, s)[None],
+                               logits, NEG_INF)
+        p = jnp.exp(logits - lse[..., None])            # (g, s, s)
+        pc = p.astype(dot.dtype)
+        # dv[d, j] = sum_i do[d, i] p[i, j]
+        dv = lax.dot_general(dot, pc, (((2,), (1,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+        # dp[i, j] = sum_d do[d, i] v[d, j]
+        dp = lax.dot_general(dot, vt, (((1,), (1,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[..., None])).astype(kt.dtype)
+        # dq[d, i] = sum_j k[d, j] ds[i, j] (* scale, chain rule)
+        dq = lax.dot_general(kt, ds, (((2,), (2,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32) * scale
+        # dk[d, j] = sum_i q_eff[d, i] ds[i, j]
+        dk = lax.dot_general(qe, ds, (((2,), (1,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+
+        def put(col, val):
+            dqkv_ref[0, :, col:col + g * d] = val.reshape(
+                g * d, s).T.astype(dqkv_ref.dtype)
+        put(lo, dq)
+        put(e + lo, dk)
+        put(2 * e + lo, dv)
+
+
+def flash_attention_flat(qkv, nhead: int, causal: bool = False,
+                         scale=None, interpret=None):
+    """(b, s, 3e) packed QKV (projection layout: [q|k|v], each h*d
+    head-major) -> (b, s, e) attention. Same math as flash_attention
+    with zero layout changes on either side; caller must check
+    supports_flat first (transformer_stack._block_fn falls back to the
+    generic kernels otherwise)."""
+    if interpret is None:
+        interpret = _interpret()
+    return _flash_flat(qkv, nhead, causal, scale, bool(interpret))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _flash_flat(qkv, nhead, causal, scale, interpret):
+    out, _ = _flash_flat_fwd(qkv, nhead, causal, scale, interpret)
+    return out
+
+
+def _flash_flat_fwd(qkv, nhead, causal, scale, interpret):
+    b, s, e3 = qkv.shape
+    h, d = nhead, e3 // (3 * nhead)
+    if scale is None:
+        scale = d ** -0.5
+    g = supports_flat(s, h, d, e3)
+    if not g:
+        raise ValueError(
+            "flash_attention_flat: unsupported shape s=%d h=%d d=%d "
+            "(callers must consult supports_flat)" % (s, h, d))
+    o, lse = pl.pallas_call(
+        functools.partial(_flat_fwd_kernel, scale=scale, causal=causal,
+                          s=s, h=h, d=d, g=g),
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, s, e3), lambda ib: (ib, 0, 0))],
+        out_specs=[
+            pl.BlockSpec((1, s, h * d), lambda ib: (ib, 0, 0)),
+            pl.BlockSpec((1, h, s), lambda ib: (ib, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, h * d), qkv.dtype),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qkv)
+    return o, (qkv, o, lse)
+
+
+def _flash_flat_bwd(nhead, causal, scale, interpret, res, grad):
+    qkv, o, lse = res
+    b, s, e3 = qkv.shape
+    h, d = nhead, e3 // (3 * nhead)
+    if scale is None:
+        scale = d ** -0.5
+    g = supports_flat(s, h, d, e3)
+    # delta = rowwise(do . o) per head: (b, s, h) -> (b, h, s); tiny
+    # (b*s*h f32) next to the relayouts this path deletes
+    delta = jnp.sum(grad.astype(jnp.float32).reshape(b, s, h, d)
+                    * o.astype(jnp.float32).reshape(b, s, h, d),
+                    axis=-1).transpose(0, 2, 1)
+    # (b, h, s) stats regrouped to (b, h//g, g, s) so the kernel's
+    # per-group read is a supported major-dim index (a sublane slice at
+    # a non-8-multiple offset is not)
+    lse4 = lse.reshape(b, h // g, g, s)
+    delta4 = delta.reshape(b, h // g, g, s)
+    dqkv = pl.pallas_call(
+        functools.partial(_flat_bwd_kernel, scale=scale, causal=causal,
+                          s=s, h=h, d=d, g=g),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, s, e3), lambda ib: (ib, 0, 0)),
+            pl.BlockSpec((1, s, h * d), lambda ib: (ib, 0, 0)),
+            pl.BlockSpec((1, h // g, g, s), lambda ib: (ib, 0, 0, 0)),
+            pl.BlockSpec((1, h // g, g, s), lambda ib: (ib, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, s, e3), lambda ib: (ib, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, s, e3), qkv.dtype),
+        interpret=interpret,
+    )(qkv, grad, lse4, delta4)
+    return (dqkv,)
+
+
+_flash_flat.defvjp(_flash_flat_fwd, _flash_flat_bwd)
 
 
 # ----------------------------------------------------------------------
@@ -349,8 +671,13 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
         scale = d ** -0.5
     block_q = _pick_block(s)
     block_k = _pick_block(s)
-    q3, k3, v3 = _prep(q), _prep(k), _prep(v)
-    o3, lse = _fwd_impl(q3, k3, v3, scale, causal, block_q,
+    # fold the softmax scale into q once (an s*d elementwise pass that
+    # fuses into the caller's layout ops) instead of an s^2 VPU pass
+    # per block inside every kernel; the SCALED q is what the backward
+    # kernels receive (see the chain-rule notes in them)
+    q3 = _prep(q) * jnp.asarray(scale, q.dtype)
+    k3, v3 = _prep(k), _prep(v)
+    o3, lse = _fwd_impl(q3, k3, v3, causal, block_q,
                         block_k, interpret)
     out = o3.reshape(b, h, s, d)
     return out, (q3, k3, v3, o3, lse, out.shape)

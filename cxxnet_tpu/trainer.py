@@ -26,7 +26,7 @@ from .graph import NetConfig
 from .io import DataBatch, DataIterator
 from .metrics import MetricSet
 from .model import Network
-from .updater import NetUpdater
+from .updater import NetUpdater, UpdaterHyperParams
 
 ConfigEntry = Tuple[str, str]
 
@@ -136,6 +136,8 @@ class Trainer:
         self.eval_train = 1
         self.seed = 0
         self.silent = 0
+        # strict=1 turns the unconsumed-config-key report into an error
+        self.strict = 0
         self.dev = "tpu"
         self.compute_dtype = "float32"
         self.model_parallel = 1
@@ -165,11 +167,32 @@ class Trainer:
         self._eval_gs = None
         self._gen_cache: Dict = {}
 
+    # keys the trainer itself consumes (set_param branches below plus
+    # ones read from self.cfg later: dist_*, updater routing); the
+    # unconsumed-key audit subtracts these
+    TRAINER_KEYS = frozenset([
+        "batch_size", "update_period", "fuse_steps", "fuse_unroll",
+        "group_staging", "eval_train", "train_eval", "seed", "silent",
+        "dev", "dtype",
+        "model_parallel", "seq_parallel", "pipeline_parallel", "zero",
+        "test_on_server", "nan_guard", "save_async", "save_sharded",
+        "strict", "metric", "updater", "sync",
+        "dist_coordinator", "dist_num_worker", "dist_worker_rank",
+    ])
+    # structural keys NetConfig.configure consumes (graph.py)
+    STRUCTURAL_KEYS = frozenset([
+        "netconfig", "input_shape", "extra_data_num", "label_width",
+    ])
+    STRUCTURAL_PREFIXES = ("layer[", "label_vec[", "extra_data_shape[",
+                           "metric[")
+
     # ------------------------------------------------------------------
     def set_param(self, name: str, val: str) -> None:
         """Config broadcast (reference: nnet_impl-inl.hpp:31-69)."""
         if val == "default":
             return
+        if name == "strict":
+            self.strict = int(val)
         if name == "batch_size":
             self.batch_size = int(val)
         elif name == "update_period":
@@ -180,7 +203,11 @@ class Trainer:
             self.fuse_unroll = int(val)
         elif name == "group_staging":
             self.group_staging = int(val)
-        elif name == "eval_train":
+        elif name in ("eval_train", "train_eval"):
+            # "train_eval" appears in the reference's own MNIST.conf but
+            # its parser only reads eval_train (nnet_impl-inl.hpp:54) —
+            # a latent upstream typo this rebuild's unconsumed-key audit
+            # surfaced; honored here as the alias the author intended
             self.eval_train = int(val)
         elif name == "seed":
             self.seed = int(val)
@@ -245,6 +272,36 @@ class Trainer:
             # correct, just slower
             params, opt_state = make(rng)
         self._finish_init(params, opt, opt_state)
+
+    # ------------------------------------------------------------------
+    def unconsumed_keys(self, extra_known=()) -> list:
+        """Config keys NO component consumed — the typo detector the
+        reference's broadcast-and-ignore SetParam lacks (reference:
+        neural_net-inl.hpp:252-264; a silently ignored
+        ``warmup_epochs=100`` corrupted a recorded r3 convergence run).
+
+        Call after init_model. A key counts as consumed if the trainer,
+        the updater family (UpdaterParam.claims — tag scoping and the
+        lr:/eta: schedule keys included), the netconfig structure
+        parser, or AT LEAST ONE layer recognized it (per-layer ledger:
+        keys a layer saw minus its LayerParam.unknown_keys terminal).
+        ``extra_known`` extends the claimed set with caller-level keys
+        (the CLI passes its task/io keys). The CLI prints the result
+        once; ``strict = 1`` makes it fatal there."""
+        names = {k for k, _ in self.cfg}
+        claimed = set(self.TRAINER_KEYS) | set(self.STRUCTURAL_KEYS)
+        claimed |= set(extra_known)
+        for mod in getattr(self.net, "modules", []):
+            passed = getattr(mod, "_cfg_keys", set())
+            claimed |= passed - mod.param.unknown_keys
+        out = []
+        for k in sorted(names - claimed):
+            if k.startswith(self.STRUCTURAL_PREFIXES):
+                continue
+            if UpdaterHyperParams.claims(k):
+                continue
+            out.append(k)
+        return out
 
     def _build_network(self) -> None:
         # batch_size is per-process, like the reference's per-worker batch

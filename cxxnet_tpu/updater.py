@@ -65,6 +65,34 @@ class UpdaterHyperParams:
     beta1: float = 0.1
     beta2: float = 0.001
 
+    # flat keys this parameter block recognizes — the trainer's
+    # unconsumed-key audit consults this (plus the lr:/eta: prefixes
+    # and <tag>: scoping) instead of replaying set_param
+    KNOWN_KEYS = frozenset([
+        "lr", "eta", "wd", "decoupled_wd", "momentum", "silent",
+        "momentum_schedule", "clip_gradient", "recovery_lr_scale",
+        "final_momentum", "base_momentum", "saturation_epoch",
+        "beta1", "beta2", "clip_global_norm",
+    ])
+    KNOWN_SUBKEYS = frozenset([
+        "schedule", "warmup", "total", "gamma", "alpha", "step",
+        "factor", "minimum_lr", "start_epoch",
+    ])
+
+    @classmethod
+    def claims(cls, name: str) -> bool:
+        """Would SOME updater parameter block consume this key? Covers
+        tag scoping ("wmat:lr") and the lr:/eta: schedule family."""
+        if name in cls.KNOWN_KEYS:
+            return True
+        if ":" in name:
+            head, sub = name.split(":", 1)
+            if head in ("lr", "eta"):
+                return sub in cls.KNOWN_SUBKEYS
+            # tag-scoped: wmat:lr, bias:wd, wqkv:lr:schedule, ...
+            return cls.claims(sub)
+        return False
+
     def set_param(self, name: str, val: str) -> None:
         # tag scoping: "wmat:lr = ..." applies only when tag == "wmat"
         # (reference param.h:103-105)

@@ -115,14 +115,17 @@ def test_flash_analytic_flops_formula():
     b, h, s, d = 2, 4, 256, 64
     fwd, bwd = fa.analytic_flops(b, h, s, d, causal=False)
     assert fwd == pytest.approx(4.0 * b * h * s * s * d)
-    assert bwd == pytest.approx(14.0 * b * h * s * s * d)
+    # single block -> the FUSED backward (5 dots) = 10x base
+    assert bwd == pytest.approx(10.0 * b * h * s * s * d)
     # single-block sequence (block = s): the causal schedule cannot
     # skip anything, the hardware really does the full block
     cfwd, _ = fa.analytic_flops(b, h, s, d, causal=True)
     assert cfwd == pytest.approx(fwd)
     # multi-block (s=1024, block 512 -> nb=2): causal skips the
-    # above-diagonal block pair -> factor (nb+1)/(2nb) = 0.75
+    # above-diagonal block pair (factor (nb+1)/(2nb) = 0.75) and the
+    # SPLIT dq+dkv backward (7 dots) = 14x base applies
     fwd2, bwd2 = fa.analytic_flops(b, h, 1024, d, causal=False)
+    assert bwd2 == pytest.approx(14.0 * b * h * 1024 * 1024 * d)
     cfwd2, cbwd2 = fa.analytic_flops(b, h, 1024, d, causal=True)
     assert cfwd2 == pytest.approx(0.75 * fwd2)
     assert cbwd2 == pytest.approx(0.75 * bwd2)

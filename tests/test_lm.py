@@ -224,3 +224,110 @@ def test_stack_seq_parallel_matches_single(impl):
                                rtol=2e-4, atol=2e-5)
     np.testing.assert_allclose(outs[1][1], outs[2][1],
                                rtol=2e-4, atol=2e-5)
+
+
+def _lm_pair_trainers(seq=16, vocab=64, **overrides):
+    """Two trainers differing only in head type (fullc+softmax vs
+    fused lm_head), same seed -> same initial weights for the shared
+    layers; the head weight inits draw from the same per-layer-index
+    fold so wmat matches too."""
+    out = []
+    for fused in (False, True):
+        tr = Trainer()
+        for k, v in config.parse_string(
+                models.tiny_lm(seq_len=seq, vocab=vocab, embed=16,
+                               nlayer=1, nhead=2, fused_head=fused)):
+            tr.set_param(k, v)
+        tr.set_param("batch_size", "8")
+        tr.set_param("dev", "cpu:0")
+        tr.set_param("eta", "0.05")
+        tr.set_param("seed", "7")
+        for k, v in overrides.items():
+            tr.set_param(k, str(v))
+        tr.init_model()
+        out.append(tr)
+    return out
+
+
+def _lm_batch8(seq=16, vocab=64, seed=0):
+    rs = np.random.RandomState(seed)
+    return DataBatch(
+        data=rs.randint(0, vocab, (8, 1, seq, 1)).astype(np.float32),
+        label=rs.randint(0, vocab, (8, seq)).astype(np.float32))
+
+
+def test_lm_head_matches_pair():
+    """Fused lm_head trajectory == fullc(seq=1)+softmax trajectory:
+    same loss gradient, same predict surface (probs)."""
+    tr_pair, tr_fused = _lm_pair_trainers()
+    # align the head weights (different layer indices fold different
+    # rng streams; copy instead of relying on index alignment)
+    tr_fused.set_weight(tr_pair.get_weight("lm_head", "wmat"),
+                        "lm_head", "wmat")
+    tr_fused.set_weight(tr_pair.get_weight("lm_head", "bias"),
+                        "lm_head", "bias")
+    for lname in ("emb", "ts1"):
+        for tag in ("wmat", "pos"):
+            try:
+                tr_fused.set_weight(tr_pair.get_weight(lname, tag),
+                                    lname, tag)
+            except Exception:
+                pass
+    b = _lm_batch8()
+    p0 = tr_pair.predict(b)
+    p1 = tr_fused.predict(b)
+    np.testing.assert_allclose(p1, p0, rtol=2e-5, atol=2e-6)
+    for i in range(3):
+        tr_pair.update(_lm_batch8(seed=i))
+        tr_fused.update(_lm_batch8(seed=i))
+    np.testing.assert_allclose(
+        tr_fused.get_weight("lm_head", "wmat"),
+        tr_pair.get_weight("lm_head", "wmat"), rtol=5e-4, atol=1e-6)
+    np.testing.assert_allclose(tr_fused.predict(b), tr_pair.predict(b),
+                               rtol=5e-4, atol=2e-6)
+
+
+def test_lm_head_chunking_invariant():
+    """ce_chunk only changes the schedule, not the math."""
+    tr1, = [t for t in [_lm_pair_trainers()[1]]]
+    tr4 = _lm_pair_trainers(ce_chunk=4)[1]
+    for tag in ("wmat", "bias"):
+        tr4.set_weight(tr1.get_weight("lm_head", tag), "lm_head", tag)
+    for i in range(2):
+        tr1.update(_lm_batch8(seed=i))
+        tr4.update(_lm_batch8(seed=i))
+    np.testing.assert_allclose(
+        tr4.get_weight("lm_head", "wmat"),
+        tr1.get_weight("lm_head", "wmat"), rtol=2e-4, atol=1e-7)
+
+
+def test_lm_head_learns_and_generates():
+    """End-to-end: fused-head LM learns Markov data and the KV-cache
+    decode plan accepts the lm_head tail."""
+    tr = Trainer()
+    for k, v in config.parse_string(
+            models.tiny_lm(seq_len=16, vocab=16, embed=16, nlayer=1,
+                           nhead=2, fused_head=True)):
+        tr.set_param(k, v)
+    tr.set_param("batch_size", "32")
+    tr.set_param("dev", "cpu:0")
+    tr.set_param("eta", "0.3")
+    tr.set_param("momentum", "0.9")
+    tr.set_param("metric", "token_error")
+    tr.init_model()
+    itr = _lm_iter()
+    errs = []
+    for r in range(6):
+        tr.start_round(r)
+        itr.before_first()
+        while itr.next():
+            tr.update(itr.value)
+        errs.append(float(tr.evaluate(itr, "t").split(":")[-1]))
+    assert errs[-1] < 0.7 and errs[-1] < errs[0], errs
+    from cxxnet_tpu import generate
+    p, reason = generate.plan_or_reason(tr.net)
+    assert p is not None, reason
+    prompts = np.zeros((2, 16), np.float32)
+    prompts[:, :4] = 3
+    toks = tr.generate(prompts, np.array([4, 4]), max_new=4)
+    assert toks.shape[0] == 2 and toks.shape[1] >= 8
