@@ -521,7 +521,8 @@ class LearnTask:
                             write_group(
                                 self.trainer.predict_fused(gs.stage()))
                     else:
-                        # staged put copies to device before next()
+                        # stage() blocks until the transfer lands, so
+                        # the iterator may reuse its buffers at next()
                         pend.append(self.trainer.stage(batch))
                         if len(pend) == fuse:
                             write_group(

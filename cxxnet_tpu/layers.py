@@ -993,8 +993,9 @@ class ConvolutionLayer(Layer):
         if name == "space_to_depth":
             self.s2d = int(val)
         elif name == "conv_impl":
-            if val not in ("auto", "xla", "nhwc", "pallas"):
-                raise ValueError("conv_impl must be auto|xla|nhwc|pallas")
+            if val not in ("auto", "xla", "nhwc", "pallas", "split"):
+                raise ValueError(
+                    "conv_impl must be auto|xla|nhwc|pallas|split")
             self.impl = val
         else:
             super().set_param(name, val)
@@ -1083,7 +1084,13 @@ class ConvolutionLayer(Layer):
             stride, pad_y, pad_x = p.stride, p.pad_y, p.pad_x
         impl = self.impl
         if impl == "auto":
-            impl = "xla"
+            # grouped convs: GSPMD cannot batch-partition a
+            # feature_group_count conv (it all-gathers the sharded
+            # batch — measured r4, docs/multichip_r4.json); lowering as
+            # per-group convs + concat shards cleanly and measured
+            # at parity single-chip, so it is the multi-device-safe
+            # default
+            impl = "split" if p.num_group > 1 else "xla"
         # no preferred_element_type: with a f32 result dtype the rhs-grad
         # transpose would convolve bf16 activations with a f32 cotangent,
         # which lax rejects; bf16-in/bf16-out still accumulates f32 on MXU
@@ -1117,6 +1124,22 @@ class ConvolutionLayer(Layer):
                               groups=g,
                               interpret=ctx.platform != "tpu"
                               ).astype(jnp.float32)
+        elif impl == "split" and g > 1:
+            # per-group convs + channel concat: same math as
+            # feature_group_count (the groups are independent), but
+            # GSPMD batch-partitions each plain conv instead of
+            # all-gathering the batch at the grouped one
+            ci_g2 = x.shape[1] // g
+            outs = []
+            for gi in range(g):
+                outs.append(lax.conv_general_dilated(
+                    x[:, gi * ci_g2:(gi + 1) * ci_g2],
+                    kernel[gi * co_g:(gi + 1) * co_g].astype(
+                        ctx.compute_dtype),
+                    window_strides=(stride, stride),
+                    padding=[(pad_y, pad_y), (pad_x, pad_x)],
+                    dimension_numbers=("NCHW", "OIHW", "NCHW")))
+            out = jnp.concatenate(outs, axis=1).astype(jnp.float32)
         else:
             out = lax.conv_general_dilated(
                 x, kernel.astype(ctx.compute_dtype),

@@ -43,7 +43,13 @@ class StepTimer:
     def tick(self, n: int = 1) -> None:
         """Mark the end of ``n`` steps issued as one dispatch (the CLI's
         fused fuse_steps groups tick once per group): the wall delta is
-        split evenly so per-step stats stay comparable across modes."""
+        split evenly so per-step stats stay comparable across modes.
+
+        The first tick after reset_clock only (re)arms the clock — its
+        n steps have no measured wall time, so they are NOT added to
+        total_steps either (counting them inflated whole-run
+        throughput by up to fuse_steps-1 zero-cost steps per round,
+        ADVICE r3)."""
         now = time.perf_counter()
         if self._last is not None:
             dt = (now - self._last) / n
@@ -52,8 +58,8 @@ class StepTimer:
                 self._times.append(dt)
             while len(self._times) > self.window:
                 self._times.pop(0)
+            self.total_steps += n
         self._last = now
-        self.total_steps += n
 
     def reset_clock(self) -> None:
         """Forget the last timestamp AND the rolling window (call across
@@ -144,13 +150,24 @@ class TraceSession:
         self._step += nbatch
         if not self.enabled or self._done:
             return contextlib.nullcontext()
+        if self.stop_batch <= self.start_batch:
+            # validated here, not in set_param: the keys arrive in
+            # config order, so an eager per-key check would reject a
+            # valid config whose stop line comes after its start line
+            # (ADVICE r3 wanted the inverted window caught — an
+            # inverted window would otherwise trace until close())
+            raise ValueError(
+                "profile_stop_batch (%d) must be > profile_start_batch "
+                "(%d)" % (self.stop_batch, self.start_batch))
         import jax
 
-        if not self._active and n + nbatch > self.start_batch:
-            # this dispatch reaches the window: start, and annotate it
-            # below — stopping is deferred to a LATER call, so a group
-            # spanning both boundaries still records itself instead of
-            # writing an empty trace
+        if not self._active and n >= self.start_batch:
+            # start only when the dispatch BEGINS inside the window: a
+            # fused group merely spanning start_batch would otherwise
+            # pull the group's compile dispatch into the profile —
+            # exactly what start_batch exists to skip (ADVICE r3). With
+            # fuse_steps=K the effective start rounds up to the next
+            # group boundary.
             os.makedirs(self.dir, exist_ok=True)
             jax.profiler.start_trace(self.dir)
             self._active = True

@@ -573,15 +573,21 @@ class Trainer:
             static_argnums=(3,))
 
         if self.fuse_steps > 1:
-            if self.update_period != 1:
+            if self.fuse_steps % self.update_period != 0:
                 raise ValueError(
-                    "fuse_steps > 1 requires update_period = 1 (gradient "
-                    "accumulation already sets its own dispatch cadence)")
+                    "fuse_steps (%d) must be a multiple of update_period "
+                    "(%d): each fused dispatch carries whole "
+                    "accumulation windows so the gradient buffer is "
+                    "always zero at group boundaries"
+                    % (self.fuse_steps, self.update_period))
             if jax.process_count() > 1:
                 raise ValueError(
                     "fuse_steps > 1 is single-process: the stacked group "
                     "transfer has no multi-host batch assembly (and a "
                     "local chip has no dispatch floor to amortize)")
+
+            period = self.update_period
+            unroll = max(1, min(self.fuse_unroll, self.fuse_steps))
 
             def train_multi(params, opt_state, rng, epoch, maccum,
                             data_s, extras_s, labels_s):
@@ -607,10 +613,52 @@ class Trainer:
                 (params, opt_state, rng, epoch, maccum), losses = \
                     jax.lax.scan(
                         body, (params, opt_state, rng, epoch, maccum),
-                        (data_s, extras_s, labels_s),
-                        unroll=max(1, min(self.fuse_unroll,
-                                          self.fuse_steps)))
+                        (data_s, extras_s, labels_s), unroll=unroll)
                 return params, opt_state, rng, epoch, maccum, losses[-1]
+
+            def train_multi_accum(params, opt_state, rng, epoch, maccum,
+                                  data_s, extras_s, labels_s):
+                # fuse_steps composed with update_period (VERDICT r3
+                # #6): the (K, ...) group regroups into K/P whole
+                # accumulation windows; each macro iteration runs P
+                # accumulate-only micro-steps (grads summed, BN state
+                # merged, metric folded — the exact _accum_step math)
+                # then one optimizer apply. Static structure: no
+                # traced cond, and the gradient buffer is born zero
+                # inside the trace, so groups stay independent.
+                kp = self.fuse_steps // period
+
+                def regroup(t):
+                    return jax.tree.map(
+                        lambda x: x.reshape((kp, period) + x.shape[1:]),
+                        t)
+
+                def macro(carry, x):
+                    p, o, r, e, m = carry
+                    ga = jax.tree.map(jnp.zeros_like, _strip_nones(p))
+
+                    def micro(c2, x2):
+                        ga2, r2, m2, p2 = c2
+                        ga2, r2, m2, loss, supd = accum_step(
+                            ga2, r2, m2, p2, e, *x2)
+                        return (ga2, r2, m2,
+                                _merge_state(p2, supd)), loss
+
+                    (ga, r, m, p), losses = jax.lax.scan(
+                        micro, (ga, r, m, p), x,
+                        unroll=max(1, min(self.fuse_unroll, period)))
+                    p, o, ga, e = apply_accum(p, o, ga, e)
+                    return (p, o, r, e, m), losses[-1]
+
+                (params, opt_state, rng, epoch, maccum), losses = \
+                    jax.lax.scan(
+                        macro, (params, opt_state, rng, epoch, maccum),
+                        (regroup(data_s), regroup(extras_s),
+                         regroup(labels_s)))
+                return params, opt_state, rng, epoch, maccum, losses[-1]
+
+            if period > 1:
+                train_multi = train_multi_accum
 
             xsh_s = parallel.stacked_sharding(xsh)
             dsh_s = parallel.stacked_sharding(dsh)
@@ -762,9 +810,16 @@ class Trainer:
 
         Everything update() consumes is in the device tuple (metrics
         accumulate on device), so no host field outlives this call and
-        iterators may legally reuse their buffers afterwards."""
+        iterators may legally reuse their buffers afterwards — the
+        wait below is what makes that guarantee backend-independent
+        (device_put is async; an in-flight transfer could still be
+        reading the host buffer on return, ADVICE r3). stage() runs on
+        helper threads in every hot path, so blocking here IS the
+        overlap, as in GroupStager.stage."""
         self._maybe_set_norm(batch)
-        return StagedBatch(self._put_batch(batch), batch)
+        dev = self._put_batch(batch)
+        jax.block_until_ready(dev)
+        return StagedBatch(dev, batch)
 
     def stage_fused(self, batches) -> "StagedBatch":
         """Stage a full fuse_steps group as ONE stacked host->device
@@ -954,6 +1009,13 @@ class Trainer:
         if self._train_multi is None:
             raise RuntimeError(
                 "fuse_steps was not configured before init_model()")
+        if self.update_period > 1 and self.sample_counter != 0:
+            raise RuntimeError(
+                "fused dispatch with update_period=%d needs the "
+                "accumulation window aligned to the group (%d "
+                "micro-batches pending from per-step update() calls); "
+                "feed whole groups or finish the window unfused"
+                % (self.update_period, self.sample_counter))
         data_s, extras_s, labels_s = group.device
         k = group.fused
         self._step_count += k
@@ -971,7 +1033,8 @@ class Trainer:
          self._maccum, _loss) = self._train_multi(
             self.params, self.opt_state, self._rng, self._epoch_dev,
             self._maccum, data_s, extras_s, labels_s)
-        self.epoch_counter += k
+        # one epoch (= optimizer apply) per accumulation window
+        self.epoch_counter += k // self.update_period
 
     # ------------------------------------------------------------------
     def step_cost_analysis(self) -> dict:

@@ -50,8 +50,31 @@ eta = 0.2
 momentum = 0.9
 metric = error
 '''
+SEQ_CONF = '''
+netconfig=start
+layer[0->1] = transformer_stack:ts1
+  nlayer = 2
+  nhead = 2
+  nhidden_mlp = 32
+  random_type = xavier
+%%(moe)s
+layer[1->2] = flatten
+layer[2->3] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,8,16
+batch_size = 8
+dev = cpu
+eta = 0.1
+metric = error
+''' %% {"moe": "  moe = 1\n  nexpert = 2\n  capacity_factor = 2.0"
+        if mode == "ep" else ""}
+
 tr = Trainer()
-for k, v in config.parse_string(CONF):
+for k, v in config.parse_string(SEQ_CONF if mode in ("pp", "ep")
+                                else CONF):
     tr.set_param(k, v)
 if mode == "tp":
     # model axis spans the two processes' devices: dp=2 (= process
@@ -61,17 +84,31 @@ elif mode == "zero3":
     # FSDP across hosts: params + optimizer state shard over the
     # 4-device data axis that spans both processes
     tr.set_param("zero", "3")
+elif mode == "pp":
+    # pipeline axis: the transformer stack's layers split into two
+    # stages; microbatches stream stage-to-stage via ppermute hops
+    # that cross the process boundary
+    tr.set_param("pipeline_parallel", "2")
+elif mode == "ep":
+    # expert parallelism: the MoE experts shard over the model axis
+    # spanning both processes; dispatch/combine ride cross-host
+    # collectives
+    tr.set_param("model_parallel", "2")
 tr.init_model()
 assert tr.global_batch == 16
 
 rs = np.random.RandomState(7)
-full = rs.randn(4, 16, 1, 1, 8).astype(np.float32)
+if mode in ("pp", "ep"):
+    full = rs.randn(4, 16, 1, 8, 16).astype(np.float32)
+else:
+    full = rs.randn(4, 16, 1, 1, 8).astype(np.float32)
 lab = rs.randint(0, 4, size=(4, 16, 1)).astype(np.float32)
 for i in range(4):
     # each process feeds ITS half of the global batch
     lo, hi = rank * 8, rank * 8 + 8
     tr.update(DataBatch(data=full[i, lo:hi], label=lab[i, lo:hi]))
-w = tr.get_weight("fc1", "wmat")
+w = tr.get_weight("ts1", "wqkv") if mode in ("pp", "ep") \
+    else tr.get_weight("fc1", "wmat")
 np.save(out, w)
 if mode == "zero3":
     # sharded checkpoint: BOTH ranks write their own shard files of ONE
@@ -94,7 +131,7 @@ def _free_port() -> int:
     return port
 
 
-@pytest.mark.parametrize("mode", ["dp", "tp", "zero3"])
+@pytest.mark.parametrize("mode", ["dp", "tp", "zero3", "pp", "ep"])
 def test_two_process_training_agrees(tmp_path, mode):
     port = str(_free_port())
     script = tmp_path / "worker.py"
@@ -130,7 +167,13 @@ def test_two_process_training_agrees(tmp_path, mode):
     from cxxnet_tpu import config as _config
     from cxxnet_tpu.io import DataBatch
     from cxxnet_tpu.trainer import Trainer
-    conf = WORKER.split("CONF = '''")[1].split("'''")[0]
+    if mode in ("pp", "ep"):
+        conf = WORKER.split("SEQ_CONF = '''")[1].split("'''")[0]
+        conf = conf % {"moe": "  moe = 1\n  nexpert = 2\n"
+                              "  capacity_factor = 2.0"
+                       if mode == "ep" else ""}
+    else:
+        conf = WORKER.split("CONF = '''")[1].split("'''")[0]
 
     def _single_device_trainer():
         t = Trainer()
@@ -143,12 +186,16 @@ def test_two_process_training_agrees(tmp_path, mode):
     ref = _single_device_trainer()
     ref.init_model()
     rs = np.random.RandomState(7)
-    full = rs.randn(4, 16, 1, 1, 8).astype(np.float32)
+    if mode in ("pp", "ep"):
+        full = rs.randn(4, 16, 1, 8, 16).astype(np.float32)
+    else:
+        full = rs.randn(4, 16, 1, 1, 8).astype(np.float32)
     lab = rs.randint(0, 4, size=(4, 16, 1)).astype(np.float32)
     for i in range(4):
         ref.update(DataBatch(data=full[i], label=lab[i]))
-    np.testing.assert_allclose(w0, ref.get_weight("fc1", "wmat"),
-                               rtol=1e-4, atol=1e-5)
+    wref = ref.get_weight("ts1", "wqkv") if mode in ("pp", "ep") \
+        else ref.get_weight("fc1", "wmat")
+    np.testing.assert_allclose(w0, wref, rtol=1e-4, atol=1e-5)
 
     if mode == "zero3":
         # the per-process sharded checkpoint reassembles to the same
@@ -191,6 +238,7 @@ def test_two_process_training_agrees(tmp_path, mode):
     # the checkpoint loads in a plain single-process trainer and matches
     from cxxnet_tpu import checkpoint
     _, _, params, _, _ = checkpoint.load_model(outs[0] + ".model")
+    tag = "wqkv" if mode in ("pp", "ep") else "wmat"
     np.testing.assert_allclose(
-        np.asarray(params[0]["wmat"]).reshape(w0.shape), w0,
+        np.asarray(params[0][tag]).reshape(w0.shape), w0,
         rtol=1e-6, atol=1e-7)

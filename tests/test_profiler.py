@@ -14,17 +14,23 @@ from cxxnet_tpu.profiler import StepTimer, TraceSession, device_memory_summary
 
 def test_step_timer_rates():
     t = StepTimer(window=4)
-    t.tick()
+    t.tick()                 # arms the clock only: no measured steps
+    assert t.total_steps == 0
     for _ in range(5):
         t.tick()
-    assert t.total_steps == 6
+    assert t.total_steps == 5
     assert t.mean_step_ms >= 0.0
     assert t.images_per_sec(64) > 0.0
     s = t.summary(64)
     assert "ms/step" in s and "images/sec" in s
     t.reset_clock()
-    t.tick()  # first tick after reset records no interval
-    assert t.total_steps == 7
+    # first tick after reset re-arms: its steps carry no wall time so
+    # they do not count toward whole-run throughput (ADVICE r3 — a
+    # fused group here inflated totals by fuse_steps-1 free steps)
+    t.tick(4)
+    assert t.total_steps == 5
+    t.tick(4)
+    assert t.total_steps == 9
 
 
 def test_trace_session_writes_trace(tmp_path):
