@@ -127,3 +127,38 @@ metric = error
 def test_model_parallel_must_divide_devices():
     with pytest.raises(ValueError):
         make_trainer(model_parallel=3)
+
+
+def test_collective_report_parses_partitioned_hlo():
+    """collective_report: per-axis wire bytes from a compiled sharded
+    program (the r4 quantitative multichip evidence path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from cxxnet_tpu import parallel
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("data", "model"))
+    xsh = NamedSharding(mesh, P("data", None))
+    wsh = NamedSharding(mesh, P(None, "model"))
+
+    def f(x, w):
+        y = x @ w                      # (data, model)-sharded result
+        return y.sum()                 # all-reduce over both axes
+
+    x = jax.device_put(jnp.ones((64, 32), jnp.float32), xsh)
+    w = jax.device_put(jnp.ones((32, 16), jnp.float32), wsh)
+    compiled = jax.jit(f, in_shardings=(xsh, wsh),
+                       out_shardings=NamedSharding(mesh, P())
+                       ).lower(x, w).compile()
+    rep = parallel.collective_report(compiled, mesh)
+    assert rep["mesh"] == {"data": 4, "model": 2}
+    assert rep["total_wire_bytes_per_device"] > 0
+    # the scalar reduction must appear as an all-reduce on some axis
+    assert any(k.startswith("all-reduce") for k in
+               rep["collective_wire_bytes_per_device"]), rep
+    assert rep["per_device_memory"] is None or \
+        rep["per_device_memory"]["peak_estimate_bytes"] > 0
+    pred = parallel.scaling_prediction(rep, 1e12, 8, assumed_mfu=0.4)
+    assert 0 < pred["predicted_efficiency_no_overlap"] <= 1.0

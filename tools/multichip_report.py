@@ -93,6 +93,23 @@ def main():
              "batch-independent)"))
     del tr
 
+    # 1b) the same DP config with the grouped-conv
+    # (feature_group_count) lowering forced: GSPMD cannot
+    # batch-partition it and all-gathers the sharded batch at every
+    # grouped conv — the finding that made conv_impl=split the
+    # ngroup>1 default (kept in the artifact as the before/after
+    # evidence)
+    tr = build(models.alexnet(nclass=1000), 2048, dtype="bfloat16",
+               conv_impl="xla")
+    rows.append(analyze(
+        "alexnet_dp8_grouped_conv_baseline", tr, 2048,
+        image=(3, 227, 227), assumed_mfu=0.34,
+        note="conv_impl=xla forces feature_group_count grouped convs: "
+             "GSPMD all-gathers the batch at each of them (the "
+             "activation all-gather[data] bytes below); "
+             "conv_impl=split (default) removes them"))
+    del tr
+
     # 2) DP x TP + ZeRO-3: weights sharded over 'model', params +
     # optimizer state fully sharded over 'data' (FSDP all-gathers)
     tr = build(models.alexnet(nclass=1000), 1024, dtype="bfloat16",
