@@ -124,11 +124,11 @@ def _pick_group(bh, n_full, n_block, n_f32, s, d, block_q, block_k):
     Picks the largest divisor of bh whose VMEM footprint — n_full
     whole-sequence operands, n_block block operands, n_f32 f32
     (block_q, block_k) intermediates — fits the budget. The scoped
-    VMEM limit is 16 MB (v5e compile error text); the estimate here
-    undercounts loop carries / double buffering somewhat (measured r4:
-    fwd at an 18.9 MB estimate allocated 21.9 MB and failed), so the
-    budget leaves a third of headroom."""
-    budget = 12 * 1024 * 1024
+    VMEM limit is 16 MB (v5e compile error text); the estimate
+    undercounts loop carries / double buffering by up to ~50%
+    (measured r4: fwd at s=2048 with an 11 MB estimate allocated
+    16.8 MB and failed), so the budget keeps 2x headroom."""
+    budget = 8 * 1024 * 1024
     best = 1
     for g in range(2, min(bh, 16) + 1):
         if bh % g:
