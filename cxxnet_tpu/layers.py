@@ -2053,6 +2053,15 @@ class TransformerStackLayer(Layer):
         if not self.moe:
             out["w1"] = (params["w1"]
                          * params["norm2"][:, None, :]).astype(dt)
+        # pre-cast the remaining stacked weights outside the scan too:
+        # one pass over (L, ...) instead of a per-iteration cast the
+        # scan body re-does every layer. Covers the MoE stacks' w1
+        # (unfolded — router-gain constraint) and gate as well; the
+        # in-block astype(dt) calls become no-ops, and the routing
+        # math already runs in dt
+        for k in ("wo", "w2", "w1", "gate"):
+            if k in out and out[k].dtype != dt and out[k].ndim > 2:
+                out[k] = out[k].astype(dt)
         return out
 
     def apply(self, params, inputs, ctx):

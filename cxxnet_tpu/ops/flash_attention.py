@@ -114,7 +114,8 @@ def analytic_flops(b, h, s, d, causal):
     return 4.0 * base, (10.0 if nb == 1 else 14.0) * base
 
 
-def _pick_group(bh, n_full, n_block, n_f32, s, d, block_q, block_k):
+def _pick_group(bh, n_full, n_block, n_f32, s, d, block_q, block_k,
+                budget=8 * 1024 * 1024):
     """Heads per grid step. A (batch*heads,)-leading grid at small s
     runs hundreds of sequential micro-programs whose fixed grid/DMA
     cost dominates the ~0.3 us of MXU work each holds — measured r4 on
@@ -124,11 +125,14 @@ def _pick_group(bh, n_full, n_block, n_f32, s, d, block_q, block_k):
     Picks the largest divisor of bh whose VMEM footprint — n_full
     whole-sequence operands, n_block block operands, n_f32 f32
     (block_q, block_k) intermediates — fits the budget. The scoped
-    VMEM limit is 16 MB (v5e compile error text); the estimate
-    undercounts loop carries / double buffering by up to ~50%
-    (measured r4: fwd at s=2048 with an 11 MB estimate allocated
-    16.8 MB and failed), so the budget keeps 2x headroom."""
-    budget = 8 * 1024 * 1024
+    VMEM limit is 16 MB (v5e compile error text). For MULTI-BLOCK
+    kernels the estimate undercounts loop carries / double buffering
+    by up to ~50% (measured r4: fwd at s=2048 with an 11 MB estimate
+    allocated 16.8 MB and failed), so their call sites keep the
+    default 2x headroom; single-block kernels have no loop-carried
+    block slices, their estimates track actual allocation (g=2/4
+    compiled and ran through r3/r4), and their call sites pass 12 MB
+    so the tighter default does not silently de-group them."""
     best = 1
     for g in range(2, min(bh, 16) + 1):
         if bh % g:
@@ -206,7 +210,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
 def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     bh, s, d = q.shape
-    g = _pick_group(bh, 2, 2, 2, s, d, block_q, block_k)
+    g = _pick_group(bh, 2, 2, 2, s, d, block_q, block_k,
+                    budget=12 * 1024 * 1024 if block_k == s
+                    else 8 * 1024 * 1024)
     grid = (bh // g, s // block_q)
     kern = functools.partial(_fwd_kernel, causal=causal,
                              block_q=block_q, block_k=block_k, s=s)
@@ -367,8 +373,10 @@ def _bwd1_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd1_impl(q, k, v, lse, do, delta, scale, causal, interpret):
     bh, s, d = q.shape
-    # 7 seq-by-d operands + 4 f32 (s, s) intermediates per group
-    g = _pick_group(bh, 7, 0, 4, s, d, s, s)
+    # 7 seq-by-d operands + 4 f32 (s, s) intermediates per group;
+    # single-block kernel -> accurate estimate, 12 MB budget
+    g = _pick_group(bh, 7, 0, 4, s, d, s, s,
+                    budget=12 * 1024 * 1024)
     spec_sd = pl.BlockSpec((g, s, d), lambda i: (i, 0, 0))
     spec_stat = pl.BlockSpec((g, 1, s), lambda i: (i, 0, 0))
     return pl.pallas_call(
