@@ -186,7 +186,17 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int):
             qkv = jnp.dot(x, layer_p["wqkv"].T.astype(dt))
             qkv = qkv.reshape(B, 3, nh, d)
             q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-            # write this token's K/V at its position
+            # write this token's K/V at its position as a masked BLEND
+            # over the full cache. Counter-intuitive but measured (r4):
+            # the O(B*e) scatter alternative
+            # (k_c.at[arange(B), :, pos].set(k_new)) is 1.4x SLOWER at
+            # B=32 (16.5 vs 11.4 ms/step) — TPU lowers per-row-index
+            # scatters serially, while the blend is two clean
+            # vectorized passes over the (B, nh, S, d) pair. The blend
+            # traffic (~1.2 GB/step at B=32) is also why decode time
+            # is linear in batch; a faster write needs a cache layout
+            # redesign, not an indexing change
+            # (docs/performance.md decode section).
             onehot = (pos_k == pos[:, None]).astype(k_c.dtype)  # (B, S)
             k_c = k_c * (1 - onehot[:, None, :, None]) \
                 + k_new[:, :, None, :] * onehot[:, None, :, None]
