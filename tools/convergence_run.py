@@ -368,7 +368,7 @@ def main():
     from cxxnet_tpu import models
 
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("net", choices=["alexnet", "bowl", "lm"])
+    ap.add_argument("net", choices=["alexnet", "bowl", "lm", "vit"])
     ap.add_argument("--rounds", type=int, default=0)
     ap.add_argument("--train", type=int, default=0)
     ap.add_argument("--val", type=int, default=1024)
@@ -418,6 +418,17 @@ def main():
                n_train=args.train or 4096, n_val=args.val or 512,
                eta=args.eta or 0.0003, out_path=args.out,
                extra=extra, fuse=args.fuse)
+    elif args.net == "vit":
+        # second modern-family curve (VERDICT r3 #8): the ViT-S/16
+        # encoder through the fused path on the proto oracle
+        if args.updater == "sgd":
+            extra = [("updater", "adam")] + extra[1:]
+        run("vit_s16", models.vit(nclass=1000), side=224,
+            batch=64, rounds=args.rounds or 10,
+            n_train=args.train or 8192, n_val=args.val,
+            eta=args.eta or 0.0005, out_path=args.out,
+            scale=args.scale, extra=extra, fuse=args.fuse,
+            task=args.task, nclass=args.nclass, snr=args.snr)
     elif args.net == "alexnet":
         run("alexnet", models.alexnet(nclass=1000), side=227,
             batch=256, rounds=args.rounds or 40,
