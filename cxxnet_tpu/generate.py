@@ -18,6 +18,29 @@ between B*S prefill tokens and B decode tokens, so recipes that rely
 on dropping see the usual train/serve MoE gap). No reference analogue
 (cxxnet has no sequence models, SURVEY.md §5).
 
+Two cache layouts (``decode_layout`` trainer knob, default "slot"):
+
+* ``slot`` — the r5 layout. The cache has ``P + max_new`` key slots
+  (``P`` = max prompt length rounded up, a static shape): prefill K/V
+  occupy ``[0, P)`` and decode step ``i`` writes slot ``P + i`` — the
+  SAME index for every batch row, so the write is one tiny
+  ``dynamic_update_slice`` instead of a full-cache pass. This works
+  because slot order never has to match token positions: the learned
+  position embedding is added at embed time, so attention is purely
+  mask-driven (valid slots = prompt ``[0, lens)`` plus decode
+  ``[P, P+i]``). The layer loop is unrolled with per-layer caches in
+  the ``fori_loop`` carry — the classic XLA in-place-update pattern —
+  where the old scan-over-layers stacked its cache outputs and
+  therefore re-wrote every byte of cache every step.
+* ``blend`` — the r4 layout (slot == absolute position, masked-blend
+  writes), kept as the measured baseline: per-row write positions
+  differ (``lens + i``), and the two vectorized ways to express that —
+  a masked blend over the whole cache or a per-row scatter — measured
+  11.4 and 16.5 ms/step at B=32 respectively (docs/performance.md).
+  The blend re-reads AND re-writes the full (B, nh, S, d) cache pair
+  every step (~1.2 GB at B=32), which is exactly the traffic the slot
+  layout deletes.
+
 The decode math mirrors TransformerStackLayer._block_fn (pre-norm
 rmsnorm / qkv / causal attend / wo / relu-MLP residuals) on a single
 query position; tests pin exact greedy agreement with the full-forward
@@ -101,13 +124,36 @@ def _rmsnorm(x, g, dt):
             ).astype(dt) * g.astype(dt)
 
 
-def build(net, p, max_new: int, temperature: float, B: int, S: int):
-    """Build the jitted (params, tokens, lens, rng) -> tokens decoder."""
+def prompt_slots(max_len: int, seq_len: int) -> int:
+    """Static prompt-region size P for the slot layout: ``lens.max()``
+    rounded up to 64 (one compile per 64-token bucket, not per prompt
+    set), clamped to the net's seq_len."""
+    return min(seq_len, max(64, -(-max_len // 64) * 64))
+
+
+def build(net, p, max_new: int, temperature: float, B: int, S: int,
+          P: Optional[int] = None, layout: str = "slot",
+          platform: str = "cpu"):
+    """Build the jitted (params, tokens, lens, rng) -> tokens decoder.
+
+    ``P`` (slot layout only) is the static prompt-region slot count —
+    see ``prompt_slots``; ``layout`` picks the cache design documented
+    in the module docstring. ``platform`` routes the prefill attend the
+    same way the training stack routes its own (flash on TPU when the
+    shape supports it, exact XLA attend elsewhere) — on the r5
+    measurement the dense O(S^2) f32 prefill was ~7x the whole decode
+    phase at B=32.
+    """
+    from .ops import flash_attention as fa
     emb = net.modules[p["embed"]]
     stacks = [net.modules[i] for i in p["stacks"]]
     head = net.modules[p["head"]]
     dt = net.compute_dtype
     e = emb.param.num_hidden
+    if layout == "slot":
+        if P is None:
+            P = S
+        Sl = P + max_new                    # total cache slots
 
     def embed_at(params, ids, pos):
         """ids (B,), pos (B,) -> (B, e) embedding (+position)."""
@@ -150,19 +196,29 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int):
         nh = st.nhead
         d = e // nh
 
+        impl = fa.resolve_impl(st.attn_impl, platform, S)
+
         def block(carry, layer_p):
             hh = carry
             x = _rmsnorm(hh, layer_p["norm1"], dt)
             qkv = jnp.einsum("bse,fe->bsf", x, layer_p["wqkv"].astype(dt))
             qkv = qkv.reshape(B, S, 3, nh, d).transpose(2, 0, 3, 1, 4)
             q, k, v = qkv[0], qkv[1], qkv[2]
-            # f32 score accumulation + d^-0.5 scale, matching
-            # ops.ring_attention.attention (the stack's exact attend)
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                                preferred_element_type=jnp.float32)                 * (d ** -0.5)
-            mask = jnp.tril(jnp.ones((S, S), bool))
-            att = jax.nn.softmax(jnp.where(mask, scores, NEG), -1)
-            out = jnp.einsum("bhqk,bhkd->bhqd", att.astype(dt), v)
+            if impl == "pallas":
+                # the training stack's own attend on TPU; prefill K/V
+                # are computed above either way, so only the attend
+                # changes (same low-order-bits caveat as training)
+                out = fa.flash_attention(q, k, v, causal=True,
+                                         interpret=platform != "tpu")
+            else:
+                # f32 score accumulation + d^-0.5 scale, matching
+                # ops.ring_attention.attention (the stack's exact attend)
+                scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                                    preferred_element_type=jnp.float32) \
+                    * (d ** -0.5)
+                mask = jnp.tril(jnp.ones((S, S), bool))
+                att = jax.nn.softmax(jnp.where(mask, scores, NEG), -1)
+                out = jnp.einsum("bhqk,bhkd->bhqd", att.astype(dt), v)
             out = out.transpose(0, 2, 1, 3).reshape(B, S, e)
             hh = hh + jnp.einsum("bse,fe->bsf", out,
                                  layer_p["wo"].astype(dt))
@@ -171,7 +227,8 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int):
         h, (ks, vs) = jax.lax.scan(block, h, lp)
         return h, ks, vs          # caches: (L, B, nh, S, d)
 
-    def stack_decode(st, lp, h, ks, vs, pos):
+    # ------------------------------------------------------ blend (r4)
+    def stack_decode_blend(st, lp, h, ks, vs, pos):
         """One-token pass: h (B, e) at position ``pos`` (B,); returns
         updated h and caches (the token's K/V written at ``pos``)."""
         nh = st.nhead
@@ -186,24 +243,21 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int):
             qkv = jnp.dot(x, layer_p["wqkv"].T.astype(dt))
             qkv = qkv.reshape(B, 3, nh, d)
             q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-            # write this token's K/V at its position as a masked BLEND
-            # over the full cache. Counter-intuitive but measured (r4):
-            # the O(B*e) scatter alternative
-            # (k_c.at[arange(B), :, pos].set(k_new)) is 1.4x SLOWER at
-            # B=32 (16.5 vs 11.4 ms/step) — TPU lowers per-row-index
-            # scatters serially, while the blend is two clean
-            # vectorized passes over the (B, nh, S, d) pair. The blend
-            # traffic (~1.2 GB/step at B=32) is also why decode time
-            # is linear in batch; a faster write needs a cache layout
-            # redesign, not an indexing change
-            # (docs/performance.md decode section).
+            # write this token's K/V at its per-row position as a masked
+            # BLEND over the full cache: the per-row scatter alternative
+            # (k_c.at[arange(B), :, pos].set(k_new)) measured 1.4x
+            # SLOWER at B=32 (16.5 vs 11.4 ms/step; TPU lowers
+            # per-row-index scatters serially). Either way the blend
+            # re-reads and re-writes the whole (B, nh, S, d) pair every
+            # step — the traffic the slot layout removes.
             onehot = (pos_k == pos[:, None]).astype(k_c.dtype)  # (B, S)
             k_c = k_c * (1 - onehot[:, None, :, None]) \
                 + k_new[:, :, None, :] * onehot[:, None, :, None]
             v_c = v_c * (1 - onehot[:, None, :, None]) \
                 + v_new[:, :, None, :] * onehot[:, None, :, None]
             scores = jnp.einsum("bhd,bhkd->bhk", q, k_c,
-                                preferred_element_type=jnp.float32)                 * (d ** -0.5)
+                                preferred_element_type=jnp.float32) \
+                * (d ** -0.5)
             att = jax.nn.softmax(
                 jnp.where(keep[:, None, :], scores, NEG), -1)
             out = jnp.einsum("bhk,bhkd->bhd", att.astype(dt), v_c)
@@ -220,12 +274,16 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int):
         rng, k = jax.random.split(rng)
         return jax.random.categorical(k, logits / temperature), rng
 
-    def gen(params, toks, lens, rng):
-        # ---- prefill: one full causal forward building the caches ----
+    def prefill_h(params, toks):
         lp0 = params[p["embed"]]
         h = jnp.take(lp0["wmat"], toks, axis=0).astype(dt)   # (B, S, e)
         if emb.learn_pos:
             h = h + lp0["pos"].astype(dt)[None]
+        return h
+
+    def gen_blend(params, toks, lens, rng):
+        # ---- prefill: one full causal forward building the caches ----
+        h = prefill_h(params, toks)
         caches = []
         for si, st in zip(p["stacks"], stacks):
             h, ks, vs = stack_prefill(st, params[si], h)
@@ -245,7 +303,8 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int):
             new_caches = []
             for (si, st), (ks, vs) in zip(
                     zip(p["stacks"], stacks), caches):
-                h, ks, vs = stack_decode(st, params[si], h, ks, vs, pos)
+                h, ks, vs = stack_decode_blend(
+                    st, params[si], h, ks, vs, pos)
                 new_caches.append((ks, vs))
             logits = head_at(params, h)
             nxt, rng = sample(logits, rng)
@@ -257,4 +316,101 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int):
                                        (toks, tuple(caches), rng))
         return toks
 
-    return jax.jit(gen)
+    # ------------------------------------------------------- slot (r5)
+    def stack_decode_slot(st, lp, h, cache, keep, slot):
+        """One-token pass on the slot layout. ``cache`` is a tuple over
+        layers of (k, v) each (B, nh, Sl, d); ``keep`` the (B, Sl)
+        valid-slot mask; ``slot`` the (uniform) write index P + i.
+
+        The layer loop is a Python unroll: each layer's cache is its
+        own carried buffer, so the write lowers to one in-place
+        dynamic_update_slice — no scan-stacked cache copies."""
+        nh = st.nhead
+        d = e // nh
+        hh = h
+        out_cache = []
+        for li, (k_c, v_c) in enumerate(cache):
+            layer_p = {kk: vv[li] for kk, vv in lp.items()}
+            x = _rmsnorm(hh, layer_p["norm1"], dt)
+            qkv = jnp.dot(x, layer_p["wqkv"].T.astype(dt))
+            qkv = qkv.reshape(B, 3, nh, d)
+            q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            k_c = jax.lax.dynamic_update_slice(
+                k_c, k_new[:, :, None, :].astype(k_c.dtype),
+                (0, 0, slot, 0))
+            v_c = jax.lax.dynamic_update_slice(
+                v_c, v_new[:, :, None, :].astype(v_c.dtype),
+                (0, 0, slot, 0))
+            scores = jnp.einsum("bhd,bhkd->bhk", q, k_c,
+                                preferred_element_type=jnp.float32) \
+                * (d ** -0.5)
+            att = jax.nn.softmax(
+                jnp.where(keep[:, None, :], scores, NEG), -1)
+            out = jnp.einsum("bhk,bhkd->bhd", att.astype(dt), v_c)
+            out = out.reshape(B, e)
+            hh = hh + jnp.dot(out, layer_p["wo"].T.astype(dt))
+            x = _rmsnorm(hh, layer_p["norm2"], dt)
+            hh = hh + mlp_at(st, layer_p, x)
+            out_cache.append((k_c, v_c))
+        return hh, tuple(out_cache)
+
+    def gen_slot(params, toks, lens, rng):
+        # ---- prefill: one full causal forward building the caches ----
+        h = prefill_h(params, toks)
+        caches = []
+        for si, st in zip(p["stacks"], stacks):
+            h, ks, vs = stack_prefill(st, params[si], h)
+            # unstack to per-layer buffers; keep slots [0, P) and leave
+            # [P, Sl) zero for the decode steps to fill
+            per = []
+            for li in range(ks.shape[0]):
+                pad = ((0, 0), (0, 0), (0, Sl - P), (0, 0))
+                per.append((jnp.pad(ks[li, :, :, :P], pad),
+                            jnp.pad(vs[li, :, :, :P], pad)))
+            caches.append(tuple(per))
+        last = jnp.take_along_axis(
+            h, (lens - 1)[:, None, None], axis=1)[:, 0]      # (B, e)
+        logits = head_at(params, last)
+        first, rng = sample(logits, rng)
+        # decoded ids live in (max_new, B), written at the UNIFORM step
+        # index; merged into toks once at the end (the per-step per-row
+        # toks scatter of the blend path lowers serially on TPU)
+        dec = jnp.zeros((max_new, B), toks.dtype)
+        dec = dec.at[0].set(first.astype(toks.dtype))
+
+        pos_k = jnp.arange(Sl)[None, :]                      # (1, Sl)
+        prompt_keep = pos_k < lens[:, None]                  # (B, Sl)
+
+        def body(i, carry):
+            dec, caches, rng = carry
+            ids = jax.lax.dynamic_index_in_dim(
+                dec, i, axis=0, keepdims=False)
+            pos = lens + i          # absolute position (embed only)
+            h = embed_at(params, ids, pos)
+            slot = P + i
+            keep = prompt_keep | ((pos_k >= P) & (pos_k <= slot))
+            new_caches = []
+            for (si, st), cache in zip(
+                    zip(p["stacks"], stacks), caches):
+                h, cache = stack_decode_slot(
+                    st, params[si], h, cache, keep, slot)
+                new_caches.append(cache)
+            logits = head_at(params, h)
+            nxt, rng = sample(logits, rng)
+            dec = jax.lax.dynamic_update_slice(
+                dec, nxt[None].astype(dec.dtype), (i + 1, 0))
+            return dec, tuple(new_caches), rng
+
+        dec, _, _ = jax.lax.fori_loop(0, max_new - 1, body,
+                                      (dec, tuple(caches), rng))
+        # vectorized merge: toks[b, lens[b] + j] = dec[j, b]
+        col = jnp.arange(S)[None, :]                         # (1, S)
+        idx = col - lens[:, None]                            # (B, S)
+        valid = (idx >= 0) & (idx < max_new)
+        gath = jnp.take_along_axis(
+            dec.T, jnp.clip(idx, 0, max_new - 1), axis=1)
+        return jnp.where(valid, gath, toks)
+
+    if layout == "blend":
+        return jax.jit(gen_blend)
+    return jax.jit(gen_slot)

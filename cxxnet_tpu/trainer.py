@@ -166,6 +166,7 @@ class Trainer:
         self._forward_multi = None
         self._eval_gs = None
         self._gen_cache: Dict = {}
+        self.decode_layout = "auto"
 
     # keys the trainer itself consumes (set_param branches below plus
     # ones read from self.cfg later: dist_*, updater routing); the
@@ -176,7 +177,7 @@ class Trainer:
         "dev", "dtype",
         "model_parallel", "seq_parallel", "pipeline_parallel", "zero",
         "test_on_server", "nan_guard", "save_async", "save_sharded",
-        "strict", "metric", "updater", "sync",
+        "strict", "metric", "updater", "sync", "decode_layout",
         "dist_coordinator", "dist_num_worker", "dist_worker_rank",
     ])
     # structural keys NetConfig.configure consumes (graph.py)
@@ -193,7 +194,7 @@ class Trainer:
             return
         if name == "strict":
             self.strict = int(val)
-        if name == "batch_size":
+        elif name == "batch_size":
             self.batch_size = int(val)
         elif name == "update_period":
             self.update_period = int(val)
@@ -233,6 +234,10 @@ class Trainer:
             self.save_async = int(val)
         elif name == "save_sharded":
             self.save_sharded = int(val)
+        elif name == "decode_layout":
+            if val not in ("auto", "slot", "blend"):
+                raise ValueError("decode_layout must be auto|slot|blend")
+            self.decode_layout = val
         if name.startswith("metric"):
             import re
             m = re.match(r"metric\[([^,\]]+),([^\]]+)\]", name)
@@ -1166,7 +1171,15 @@ class Trainer:
         if use_cache != "never":
             from . import generate as G
             kv_plan, why = G.plan_or_reason(self.net)
-        key = (int(max_new), float(temperature), kv_plan is not None)
+        layout = getattr(self, "decode_layout", "auto")
+        if layout == "auto":
+            layout = "slot"
+        P = None
+        if kv_plan is not None and layout == "slot":
+            from . import generate as G
+            P = G.prompt_slots(int(lens.max()) if nrow else 1, S)
+        key = (int(max_new), float(temperature), kv_plan is not None,
+               layout, P)
         fn = self._gen_cache.get(key)
         if fn is None and kv_plan is not None:
             for si in kv_plan["stacks"]:
@@ -1183,7 +1196,8 @@ class Trainer:
                         "the full-forward path (use_cache=never)\n"
                         % (st.capacity_factor, st.nexpert / st.topk))
             fn = G.build(self.net, kv_plan, int(max_new),
-                         float(temperature), B, S)
+                         float(temperature), B, S, P=P, layout=layout,
+                         platform=getattr(self.net, "platform", "cpu"))
             self._gen_cache[key] = fn
         if fn is None:
             if use_cache != "never":

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""KV-cache decode lab (VERDICT r4 #1): slot vs blend cache layouts.
+
+Measures `Trainer.generate` on the gpt2_small shape (prompt 256,
+max_new 128) across batch sizes, with the r4 (`blend`) and r5 (`slot`)
+cache layouts INTERLEAVED in the same weather window (BASELINE.md
+protocol: shared-tunnel bandwidth swings ~100x, so only interleaved
+best-of-N minima are comparable). Per layout it runs generate at two
+max_new values so the steady-state decode step time can be isolated
+from the prefill:
+
+    step_ms = (t(max_new=128) - t(max_new=8)) / 120
+
+`tr.generate` returns np.asarray output, so every sample carries a
+real D2H fence. One trainer per batch size (gpt2-class trainers are
+~5 GB HBM; built and dropped serially), layouts flipped via the
+`decode_layout` knob on the same trainer so params/compile cache are
+shared.
+
+Usage: python tools/decode_lab.py [--batches 8,32,64] [--trials 5]
+"""
+
+import argparse
+import gc
+import json
+import sys
+import time
+
+import numpy as np
+
+import os
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PROMPT = 256
+MAX_NEW = 128
+SHORT_NEW = 8
+
+
+def build(batch, retries=3):
+    import jax
+
+    from cxxnet_tpu import config, models
+    from cxxnet_tpu.trainer import Trainer
+    for attempt in range(retries):
+        try:
+            platform = jax.devices()[0].platform
+            tr = Trainer()
+            for k, v in config.parse_string(models.gpt2_small()):
+                tr.set_param(k, v)
+            tr.set_param("batch_size", str(batch))
+            tr.set_param("dev", platform)
+            tr.set_param("dtype",
+                         "bfloat16" if platform == "tpu" else "float32")
+            tr.set_param("eta", "0.01")
+            tr.set_param("metric", "token_error")
+            tr.init_model()
+            return tr
+        except Exception as e:
+            if attempt == retries - 1 or "remote_compile" not in str(e):
+                raise
+            sys.stderr.write("build retry after tunnel drop: %s\n" % e)
+            time.sleep(5.0)
+
+
+def prompts(batch, seq):
+    rs = np.random.RandomState(0)
+    toks = np.zeros((batch, seq), np.int32)
+    toks[:, :PROMPT] = rs.randint(1, 32768, size=(batch, PROMPT))
+    lens = np.full(batch, PROMPT, np.int32)
+    return toks, lens
+
+
+def sample_ms(tr, toks, lens, max_new):
+    t0 = time.perf_counter()
+    tr.generate(toks, lens, max_new, temperature=0.0)  # fenced (asarray)
+    return (time.perf_counter() - t0) * 1000.0
+
+
+def resident_fn(tr, toks, lens, max_new):
+    """Device-resident call path: warm via tr.generate (compiles + pads
+    args), then time the cached jitted fn on pre-staged device arrays —
+    the BASELINE.md protocol the conv benches use ('device-resident,
+    fed from RAM'), excluding the tunnel's per-transfer latency floors
+    (3 small H2D uploads + a (B,S) D2H fetch per call, ~100 ms of
+    batch-invariant overhead in contended weather)."""
+    import jax
+    import jax.numpy as jnp
+    tr.generate(toks, lens, max_new, temperature=0.0)      # compile
+    layout = tr.decode_layout if tr.decode_layout != "auto" else "slot"
+    (key, fn), = [(k, v) for k, v in tr._gen_cache.items()
+                  if k[0] == max_new and k[3] == layout]
+    toks_d = jax.device_put(jnp.asarray(toks, jnp.int32))
+    lens_d = jax.device_put(jnp.asarray(lens))
+    rng_d = jax.device_put(jax.random.PRNGKey(0))
+
+    def run():
+        t0 = time.perf_counter()
+        out = fn(tr.params, toks_d, lens_d, rng_d)
+        np.asarray(out[0, :8])          # tiny-slice D2H fence
+        return (time.perf_counter() - t0) * 1000.0
+    return run
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batches", default="8,32,64")
+    ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--layouts", default="slot,blend")
+    args = ap.parse_args()
+    layouts = args.layouts.split(",")
+    rows = []
+    for batch in [int(b) for b in args.batches.split(",")]:
+        tr = build(batch)
+        seq = tr.net.node_shapes[0][2]
+        toks, lens = prompts(batch, seq)
+        # compile warmup + device-resident runners per (layout, max_new)
+        runners = {}
+        for lay in layouts:
+            tr.set_param("decode_layout", lay)
+            for mn in (MAX_NEW, SHORT_NEW):
+                runners[(lay, mn)] = resident_fn(tr, toks, lens, mn)
+        best = {k: float("inf") for k in runners}
+        for t in range(args.trials):
+            for k, run in runners.items():
+                best[k] = min(best[k], run())
+            sys.stderr.write("B=%d trial %d: %s\n" % (batch, t, {
+                "%s@%d" % k: round(v, 1) for k, v in best.items()}))
+        for lay in layouts:
+            t_long, t_short = best[(lay, MAX_NEW)], best[(lay, SHORT_NEW)]
+            step_ms = (t_long - t_short) / (MAX_NEW - SHORT_NEW)
+            row = {
+                "batch": batch, "layout": lay, "prompt": PROMPT,
+                "max_new": MAX_NEW,
+                "total_ms_best": round(t_long, 2),
+                "prefill_plus8_ms_best": round(t_short, 2),
+                "decode_step_ms": round(step_ms, 3),
+                "tokens_per_sec": round(batch * MAX_NEW
+                                        / (t_long / 1000.0), 1),
+                "steady_tokens_per_sec": round(
+                    batch / (step_ms / 1000.0), 1),
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+        runners.clear()       # closures hold tr; drop before the del
+        del tr
+        gc.collect()
+    print(json.dumps({"decode_lab": rows}))
+
+
+if __name__ == "__main__":
+    main()
