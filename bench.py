@@ -79,28 +79,54 @@ def _measure_h2d_gbps(n_mb: int = 64, trials: int = 3) -> float:
     return best
 
 
-def _update_history(entry: dict) -> dict:
+def _git_commit():
+    """Short commit hash stamped into every ledger entry so
+    best_recorded's provenance is auditable (ADVICE r4): a best window
+    surfaced beside a live sample may come from a different build."""
+    try:
+        import subprocess
+        return subprocess.check_output(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stderr=subprocess.DEVNULL).decode().strip()
+    except Exception:
+        return None
+
+
+def _update_history(entry: dict, net: str = "alexnet",
+                    metric: str = "images_per_sec") -> dict:
     """Merge this run into docs/bench_history.json and return the best
-    recorded window (which may be this one). The file is committed with
-    the repo, so the official record accumulates across rounds; the
-    driver sweeps the updated file into its end-of-round commit."""
-    hist = {"best": None, "runs": []}
+    recorded window FOR THIS NET (which may be this one). The file is
+    committed with the repo, so the official record accumulates across
+    rounds; the driver sweeps the updated file into its end-of-round
+    commit. r5: entries carry net + commit, and bests are per net
+    (``best_by_net``) so ViT/gpt2/decode windows are first-class ledger
+    citizens, not just AlexNet (VERDICT r4 #4)."""
+    entry = dict(entry, net=net, commit=_git_commit())
+    hist = {"runs": []}
     try:
         with open(HISTORY_PATH) as f:
             hist = json.load(f)
     except Exception:
         pass
+    best_map = hist.get("best_by_net")
+    if best_map is None:                 # migrate the legacy layout
+        best_map = {}
+        if hist.get("best"):
+            best_map["alexnet"] = dict(hist["best"], net="alexnet")
     hist.setdefault("runs", []).append(entry)
-    hist["runs"] = hist["runs"][-20:]
-    best = hist.get("best")
-    if not best or entry["images_per_sec"] > best["images_per_sec"]:
-        hist["best"] = best = entry
+    hist["runs"] = hist["runs"][-40:]
+    cur = best_map.get(net)
+    if not cur or entry.get(metric, 0) > cur.get(metric, 0):
+        best_map[net] = entry
+    hist["best_by_net"] = best_map
+    hist["best"] = best_map.get("alexnet")   # legacy consumers
     try:
         with open(HISTORY_PATH, "w") as f:
             json.dump(hist, f, indent=1)
     except Exception as e:
         sys.stderr.write("bench history not writable: %s\n" % e)
-    return best
+    return best_map[net]
 
 
 def _measure_dispatch_floor_ms(iters: int = 12) -> float:

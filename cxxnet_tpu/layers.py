@@ -2278,12 +2278,14 @@ class LMHeadLayer(_LossLayer):
         return f, f if skip_dx else 2.0 * f
 
     def _chunks(self, rows: int, v: int) -> int:
+        # chunk COUNT sized so each chunk's f32 logits stay ~64 MB; the
+        # count need not divide rows (apply pads + masks the tail) — a
+        # divisor walk here degenerated to chunk-size-1 scans on
+        # prime-ish row counts (ADVICE r4)
         if self.ce_chunk > 0:
             c = self.ce_chunk
         else:
             c = max(1, int(round(rows * v * 4 / 268e6)))
-        while c < rows and rows % c:
-            c += 1                       # next divisor of rows
         return min(c, rows)
 
     def apply(self, params, inputs, ctx):
@@ -2314,22 +2316,32 @@ class LMHeadLayer(_LossLayer):
                     % (s, s, self.target, y.shape[1]))
             rows = n * s
             c = self._chunks(rows, v)
-            xc = x.reshape(c, rows // c, e)
-            yc = y.reshape(c, rows // c)
+            chunk = -(-rows // c)        # pad + mask the ragged tail
+            yf = y.reshape(rows)
+            wf = jnp.ones((rows,), jnp.float32)
+            if c * chunk != rows:
+                extra = c * chunk - rows
+                x = jnp.pad(x, ((0, extra), (0, 0)))
+                yf = jnp.pad(yf, (0, extra))
+                wf = jnp.pad(wf, (0, extra))
+            xc = x.reshape(c, chunk, e)
+            yc = yf.reshape(c, chunk)
+            wc = wf.reshape(c, chunk)
 
             def chunk_ce(acc, t):
-                xx, yy = t
+                xx, yy, ww = t
                 # max-subtract in the matmul dtype, upcast after: every
                 # exp argument is <= 0 (the r2 TPU softmax hazard)
                 lg = logits_of(xx)
                 lg = (lg - jax.lax.stop_gradient(
                     lg.max(-1, keepdims=True))).astype(jnp.float32)
                 lp = jax.nn.log_softmax(lg, axis=-1)
-                return acc - jnp.take_along_axis(
-                    lp, yy[:, None], axis=1).sum(), None
+                picked = jnp.take_along_axis(lp, yy[:, None], axis=1)
+                return acc - (picked[:, 0] * ww).sum(), None
 
             ce, _ = jax.lax.scan(jax.checkpoint(chunk_ce),
-                                 jnp.zeros((), jnp.float32), (xc, yc))
+                                 jnp.zeros((), jnp.float32),
+                                 (xc, yc, wc))
             ctx.losses.append(ce * self._scale(ctx) / (s if s > 1 else 1))
         return [probs.reshape(n, 1, s, v)]
 

@@ -346,7 +346,29 @@ def collective_report(compiled, mesh: Mesh) -> dict:
     n_dev = int(np.prod(list(mesh.shape.values())))
     per = {}
     counts = {}
+    # collectives inside a while body (lax.scan / while_loop /
+    # fori_loop) execute trip-count times per step, but appear in the
+    # HLO text once; the trip count is not reliably recoverable from
+    # the text, so such hits are counted once and FLAGGED so consumers
+    # know the bytes are a lower bound for scanned programs (ADVICE r4)
+    while_bodies = set()
     for line in txt.splitlines():
+        if " while(" in line:
+            mb = _re.search(r"body=%?([\w.\-]+)", line)
+            if mb:
+                while_bodies.add(mb.group(1))
+    cur_comp = None
+    in_loop = 0
+    for line in txt.splitlines():
+        ls = line.strip()
+        # computation header: "%name (params...) -> ... {" (parameter
+        # lists nest parens, so split on the first one rather than
+        # regex-matching the whole signature)
+        if ls.endswith("{") and "(" in ls:
+            name = ls.split("(", 1)[0].strip()
+            if name.startswith("ENTRY"):
+                name = name[5:].strip()
+            cur_comp = name.lstrip("%").strip()
         # -start suffix: real TPU executables lower collectives to
         # async start/done pairs; counting the start half only keeps
         # each op counted once
@@ -380,6 +402,8 @@ def collective_report(compiled, mesh: Mesh) -> dict:
         key = "%s[%s]" % (kind, axis)
         per[key] = per.get(key, 0.0) + wire
         counts[key] = counts.get(key, 0) + 1
+        if cur_comp in while_bodies:
+            in_loop += 1
     mem = None
     try:
         ma = compiled.memory_analysis()
@@ -394,7 +418,7 @@ def collective_report(compiled, mesh: Mesh) -> dict:
             }
     except Exception:
         pass
-    return {
+    out = {
         "mesh": dict(mesh.shape),
         "collective_wire_bytes_per_device": {
             k: round(v, 1) for k, v in sorted(per.items())},
@@ -402,6 +426,14 @@ def collective_report(compiled, mesh: Mesh) -> dict:
         "total_wire_bytes_per_device": round(sum(per.values()), 1),
         "per_device_memory": mem,
     }
+    if in_loop:
+        out["collectives_in_loop_bodies"] = in_loop
+        out["caveat"] = (
+            "%d collective(s) sit inside while/scan bodies and execute "
+            "trip-count times per step; their wire bytes are counted "
+            "once, so totals are a LOWER BOUND for scanned programs"
+            % in_loop)
+    return out
 
 
 def scaling_prediction(report: dict, model_flops_per_step: float,

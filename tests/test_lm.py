@@ -301,6 +301,24 @@ def test_lm_head_chunking_invariant():
         tr1.get_weight("lm_head", "wmat"), rtol=2e-4, atol=1e-7)
 
 
+def test_lm_head_ragged_chunking_invariant():
+    """A chunk count that does NOT divide rows (here 3 over 128 rows)
+    pads + masks the tail instead of walking to the next divisor —
+    the walk degenerated to chunk-size-1 scans on prime-ish row
+    counts (ADVICE r4). The padded schedule must still be the same
+    math."""
+    tr1 = _lm_pair_trainers()[1]
+    tr3 = _lm_pair_trainers(ce_chunk=3)[1]
+    for tag in ("wmat", "bias"):
+        tr3.set_weight(tr1.get_weight("lm_head", tag), "lm_head", tag)
+    for i in range(2):
+        tr1.update(_lm_batch8(seed=i))
+        tr3.update(_lm_batch8(seed=i))
+    np.testing.assert_allclose(
+        tr3.get_weight("lm_head", "wmat"),
+        tr1.get_weight("lm_head", "wmat"), rtol=2e-4, atol=1e-7)
+
+
 def test_lm_head_learns_and_generates():
     """End-to-end: fused-head LM learns Markov data and the KV-cache
     decode plan accepts the lm_head tail."""

@@ -162,3 +162,34 @@ def test_collective_report_parses_partitioned_hlo():
         rep["per_device_memory"]["peak_estimate_bytes"] > 0
     pred = parallel.scaling_prediction(rep, 1e12, 8, assumed_mfu=0.4)
     assert 0 < pred["predicted_efficiency_no_overlap"] <= 1.0
+
+
+def test_collective_report_flags_loop_body_collectives():
+    """A psum inside a lax.scan body executes trip-count times per
+    step but appears in the HLO once — the report must say its totals
+    are a lower bound (ADVICE r4)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from cxxnet_tpu import parallel
+
+    devs = np.array(jax.devices()[:8])
+    mesh = Mesh(devs, ("data",))
+    xsh = NamedSharding(mesh, P("data"))
+
+    def f(x):
+        def body(c, _):
+            # a carry-dependent cross-device reduction: cannot be
+            # hoisted out of the loop body
+            return (x * c).sum() + 1.0, None
+        out, _ = jax.lax.scan(body, jnp.ones(()), None, length=4)
+        return out
+
+    x = jax.device_put(jnp.ones((64, 32), jnp.float32), xsh)
+    compiled = jax.jit(f, in_shardings=(xsh,),
+                       out_shardings=NamedSharding(mesh, P())
+                       ).lower(x).compile()
+    rep = parallel.collective_report(compiled, mesh)
+    assert rep.get("collectives_in_loop_bodies", 0) >= 1, rep
+    assert "LOWER BOUND" in rep["caveat"]
