@@ -1997,10 +1997,13 @@ class TransformerStackLayer(Layer):
             qkv = jnp.einsum("bse,fe->bsf", x, lp["wqkv"].astype(dt))
             if use_flash and not seq_sharded:
                 from .ops import flash_attention as fa
-                if fa.supports_flat(s, nh, d):
+                if fa.supports_flat(s, nh, d) \
+                        or fa.flat_blocked_plan(s, nh, d):
                     # flat kernels: read the projection's (b, s, 3e)
                     # output and emit (b, s, e) directly — no
-                    # (3, b, h, s, d) relayouts on either pass
+                    # (3, b, h, s, d) relayouts on either pass.
+                    # Single-block s takes the fused backward; longer
+                    # s the r5 blocked flat kernels (flat_blocked_plan)
                     att = fa.flash_attention_flat(
                         qkv, nh, causal, interpret=interpret)
                     h = h + jnp.einsum("bse,fe->bsf", att,

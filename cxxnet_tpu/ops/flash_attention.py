@@ -569,11 +569,19 @@ def flash_attention_flat(qkv, nhead: int, causal: bool = False,
     """(b, s, 3e) packed QKV (projection layout: [q|k|v], each h*d
     head-major) -> (b, s, e) attention. Same math as flash_attention
     with zero layout changes on either side; caller must check
-    supports_flat first (transformer_stack._block_fn falls back to the
-    generic kernels otherwise)."""
+    supports_flat / flat_blocked_plan first
+    (transformer_stack._block_fn falls back to the generic kernels
+    otherwise). Single-block sequences take the fused-backward
+    single-grid-step kernels; longer sequences take the r5 BLOCKED
+    flat kernels (grid over (batch, head group, seq block), column-
+    sliced BlockSpecs — same zero-relayout property, any s)."""
     if interpret is None:
         interpret = _interpret()
-    return _flash_flat(qkv, nhead, causal, scale, bool(interpret))
+    b, s, e3 = qkv.shape
+    h, d = nhead, e3 // (3 * nhead)
+    if supports_flat(s, h, d, e3):
+        return _flash_flat(qkv, nhead, causal, scale, bool(interpret))
+    return _flash_flatb(qkv, nhead, causal, scale, bool(interpret))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
@@ -645,6 +653,313 @@ def _flash_flat_bwd(nhead, causal, scale, interpret, res, grad):
 
 
 _flash_flat.defvjp(_flash_flat_fwd, _flash_flat_bwd)
+
+
+# ----------------------------------------------------------------------
+# flat-layout BLOCKED kernels (multi-block sequences, r5): the same
+# zero-relayout property as the single-block flat path — kernels read
+# the projection's raw (b, s, 3e) output and write (b, s, e) — carried
+# past s = 512 by gridding over (batch, head group, q/k block) with
+# COLUMN-SLICED BlockSpecs: each program sees only its group's
+# (s, g*d) K/V column panel, so VMEM stays bounded for any sequence
+# length (the whole-row (s, 3e) block the single-block kernel holds
+# would be 9.4 MB at s = 2048 alone). The backward is the split
+# dq / dkv pair (the generic path's schedule) in flat I/O; the three
+# (b, s, e) grads concatenate into dqkv at the end — ~1/4 of the
+# relayout traffic this path deletes, and XLA can fuse the concat
+# into the consuming projection-VJP matmuls.
+# ----------------------------------------------------------------------
+def flat_blocked_plan(s: int, h: int, d: int,
+                      budget: int = 12 * 1024 * 1024):
+    """(g, block) for the blocked flat kernels, or None when they
+    don't apply. The VMEM estimate is EXPLICIT per kernel — blocked
+    operands counted twice (Pallas double-buffers revisited blocks),
+    loop carries and f32 intermediates itemized — rather than the
+    generic `_pick_group` heuristic whose undercounting of multi-block
+    carries forced a 2x fudge (ADVICE/VERDICT r4 #6); the 12 MB budget
+    leaves a 4 MB margin under the 16 MB scoped limit for Mosaic's own
+    spills. Prefers the largest head group g (fewer grid programs) and
+    the largest block (k-loop amortization) that fit."""
+    if _pick_block(s) == s:
+        return None                  # single-block: the fused path
+    best = None
+    for g in range(h, 0, -1):
+        if h % g or (g * d) % 128:
+            continue
+        for block in (512, 256, 128):
+            if s % block:
+                continue
+            if max(_flatb_vmem(s, h, d, g, block)) <= budget:
+                best = (g, block)
+                break
+        if best:
+            break
+    return best
+
+
+def _flatb_vmem(s, h, d, g, block):
+    """Explicit per-kernel VMEM estimates (fwd, dq, dkv) in bytes."""
+    gd2 = g * d * 2                       # bf16 column panel row
+    blk = block * gd2                     # one (block, g*d) bf16 block
+    cols = s * gd2                        # one (s, g*d) bf16 panel
+    sq_f32 = g * block * block * 4        # one f32 (g, bq, bk) buffer
+    carry = g * d * block * 4             # one f32 (g, d, block) carry
+    stats = g * s * 4                     # (g, s) f32 lse/delta panel
+    # fwd: q/o blocks + k/v panels (x2 double-buffer each), logits+p
+    # f32, pc bf16, qe/kt/vt transposed working copies, m/l/acc carry
+    fwd = 2 * (2 * blk) + 2 * (2 * cols) + 2 * sq_f32 + sq_f32 // 2 \
+        + 3 * blk + carry + 2 * g * block * 4
+    # dq: q/do/dq blocks + k/v panels, logits/p/dp f32 + ds bf16,
+    # dq carry, stats blocks
+    dq = 2 * (3 * blk) + 2 * (2 * cols) + 3 * sq_f32 + sq_f32 // 2 \
+        + 4 * blk + carry + 2 * 2 * g * block * 4
+    # dkv: k/v/dk/dv blocks + q/do panels + full-s stats, same
+    # intermediates, two carries
+    dkv = 2 * (4 * blk) + 2 * (2 * cols) + 3 * sq_f32 + sq_f32 // 2 \
+        + 4 * blk + 2 * carry + 2 * 2 * stats
+    return fwd, dq, dkv
+
+
+def _t3(mat, g, d):
+    """(n, g*d) minor-sliced panel -> (g, d, n): 2D transpose then a
+    SUBLANE split — the only shape cast Mosaic accepts at d < 128."""
+    n = mat.shape[0]
+    return mat.T.reshape(g, d, n)
+
+
+def _flatb_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      scale, causal, s, d, g, block):
+    qi = pl.program_id(2)
+    qe = _t3(q_ref[0], g, d) * scale                    # (g, d, bq)
+    nk = s // block
+    if causal:
+        nk = jnp.minimum(nk, qi + 1)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        kt = _t3(k_ref[0, pl.ds(kb * block, block), :], g, d)
+        vt = _t3(v_ref[0, pl.ds(kb * block, block), :], g, d)
+        logits = lax.dot_general(qe, kt, (((1,), (1,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        if causal:
+            logits = jnp.where(
+                _causal_mask(qi, kb, block, block)[None],
+                logits, NEG_INF)
+        mb = jnp.max(logits, axis=-1)                   # (g, bq)
+        m2 = jnp.maximum(m, mb)
+        p = jnp.exp(logits - m2[..., None])
+        corr = jnp.exp(m - m2)
+        l2 = l * corr + p.sum(axis=-1)
+        # acc[g, d, i] += sum_j v[g, d, j] p[g, i, j]
+        acc2 = acc * corr[:, None, :] + lax.dot_general(
+            vt, p.astype(vt.dtype), (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        return m2, l2, acc2
+
+    m0 = jnp.full((g, block), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g, block), jnp.float32)
+    acc0 = jnp.zeros((g, d, block), jnp.float32)
+    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    lsafe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / lsafe[:, None, :]).reshape(g * d, block).T \
+        .astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(lsafe)
+
+
+def _flatb_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, *, scale, causal, s, d, g, block):
+    qi = pl.program_id(2)
+    qe = _t3(q_ref[0], g, d) * scale
+    dot = _t3(do_ref[0], g, d)
+    lse = lse_ref[0, 0]                                 # (g, bq)
+    delta = delta_ref[0, 0]
+    nk = s // block
+    if causal:
+        nk = jnp.minimum(nk, qi + 1)
+
+    def body(kb, dq):
+        kt = _t3(k_ref[0, pl.ds(kb * block, block), :], g, d)
+        vt = _t3(v_ref[0, pl.ds(kb * block, block), :], g, d)
+        logits = lax.dot_general(qe, kt, (((1,), (1,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        if causal:
+            logits = jnp.where(
+                _causal_mask(qi, kb, block, block)[None],
+                logits, NEG_INF)
+        p = jnp.exp(logits - lse[..., None])            # (g, bq, bk)
+        dp = lax.dot_general(dot, vt, (((1,), (1,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[..., None])).astype(kt.dtype)
+        # dq[g, d, i] += sum_j k[g, d, j] ds[g, i, j]
+        return dq + lax.dot_general(
+            kt, ds, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, nk, body,
+                       jnp.zeros((g, d, block), jnp.float32))
+    dq_ref[0] = (dq * scale).reshape(g * d, block).T.astype(
+        dq_ref.dtype)
+
+
+def _flatb_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, *, scale, causal, s, d, g, block):
+    ki = pl.program_id(2)
+    kt = _t3(k_ref[0], g, d)                            # (g, d, bk)
+    vt = _t3(v_ref[0], g, d)
+    nq = s // block
+    q_lo = ki if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        qe = _t3(q_ref[0, pl.ds(qb * block, block), :], g, d) * scale
+        dot = _t3(do_ref[0, pl.ds(qb * block, block), :], g, d)
+        lse = lse_ref[0, 0, :, pl.ds(qb * block, block)]
+        delta = delta_ref[0, 0, :, pl.ds(qb * block, block)]
+        logits = lax.dot_general(qe, kt, (((1,), (1,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        if causal:
+            logits = jnp.where(
+                _causal_mask(qb, ki, block, block)[None],
+                logits, NEG_INF)
+        p = jnp.exp(logits - lse[..., None])            # (g, bq, bk)
+        # dv[g, d, j] += sum_i do[g, d, i] p[g, i, j]
+        dv2 = dv + lax.dot_general(
+            dot, p.astype(dot.dtype), (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(dot, vt, (((1,), (1,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[..., None])).astype(qe.dtype)
+        # dk[g, d, j] += sum_i q_eff[g, d, i] ds[g, i, j] (qe carries
+        # the scale, so dk needs no further factor — chain rule note
+        # in _bwd1_kernel)
+        dk2 = dk + lax.dot_general(
+            qe, ds, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        return dk2, dv2
+
+    z = jnp.zeros((g, d, block), jnp.float32)
+    dk, dv = lax.fori_loop(q_lo, nq, body, (z, z))
+    dk_ref[0] = dk.reshape(g * d, block).T.astype(dk_ref.dtype)
+    dv_ref[0] = dv.reshape(g * d, block).T.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _flash_flatb(qkv, nhead, causal, scale, interpret):
+    out, _ = _flash_flatb_fwd(qkv, nhead, causal, scale, interpret)
+    return out
+
+
+def _flash_flatb_fwd(qkv, nhead, causal, scale, interpret):
+    b, s, e3 = qkv.shape
+    h, d = nhead, e3 // (3 * nhead)
+    if scale is None:
+        scale = d ** -0.5
+    plan = flat_blocked_plan(s, h, d)
+    if plan is None:
+        raise ValueError(
+            "flash_attention_flat: unsupported blocked shape s=%d h=%d "
+            "d=%d (callers must consult flat_blocked_plan)" % (s, h, d))
+    g, block = plan
+    hg, e = h // g, h * d
+    # qkv passed three times with column-sliced BlockSpecs: the column
+    # block unit is g*d, so q group ih sits at column block ih, k at
+    # hg + ih, v at 2*hg + ih — e = hg * (g*d) keeps these exact
+    o, lse4 = pl.pallas_call(
+        functools.partial(_flatb_fwd_kernel, scale=scale, causal=causal,
+                          s=s, d=d, g=g, block=block),
+        grid=(b, hg, s // block),
+        in_specs=[
+            pl.BlockSpec((1, block, g * d),
+                         lambda ib, ih, qi: (ib, qi, ih)),
+            pl.BlockSpec((1, s, g * d),
+                         lambda ib, ih, qi: (ib, 0, hg + ih)),
+            pl.BlockSpec((1, s, g * d),
+                         lambda ib, ih, qi: (ib, 0, 2 * hg + ih)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, g * d),
+                         lambda ib, ih, qi: (ib, qi, ih)),
+            pl.BlockSpec((1, 1, g, block),
+                         lambda ib, ih, qi: (ib, ih, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, e), qkv.dtype),
+            jax.ShapeDtypeStruct((b, hg, g, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qkv, qkv, qkv)
+    return o, (qkv, o, lse4)
+
+
+def _flash_flatb_bwd(nhead, causal, scale, interpret, res, grad):
+    qkv, o, lse4 = res
+    b, s, e3 = qkv.shape
+    h, d = nhead, e3 // (3 * nhead)
+    if scale is None:
+        scale = d ** -0.5
+    g, block = flat_blocked_plan(s, h, d)
+    hg, e = h // g, h * d
+    delta4 = jnp.sum(grad.astype(jnp.float32).reshape(b, s, h, d)
+                     * o.astype(jnp.float32).reshape(b, s, h, d),
+                     axis=-1).transpose(0, 2, 1).reshape(b, hg, g, s)
+    qcol = lambda ib, ih, qi: (ib, 0, ih)
+    dq = pl.pallas_call(
+        functools.partial(_flatb_dq_kernel, scale=scale, causal=causal,
+                          s=s, d=d, g=g, block=block),
+        grid=(b, hg, s // block),
+        in_specs=[
+            pl.BlockSpec((1, block, g * d),
+                         lambda ib, ih, qi: (ib, qi, ih)),
+            pl.BlockSpec((1, s, g * d),
+                         lambda ib, ih, qi: (ib, 0, hg + ih)),
+            pl.BlockSpec((1, s, g * d),
+                         lambda ib, ih, qi: (ib, 0, 2 * hg + ih)),
+            pl.BlockSpec((1, block, g * d),
+                         lambda ib, ih, qi: (ib, qi, ih)),
+            pl.BlockSpec((1, 1, g, block),
+                         lambda ib, ih, qi: (ib, ih, 0, qi)),
+            pl.BlockSpec((1, 1, g, block),
+                         lambda ib, ih, qi: (ib, ih, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block, g * d),
+                               lambda ib, ih, qi: (ib, qi, ih)),
+        out_shape=jax.ShapeDtypeStruct((b, s, e), qkv.dtype),
+        interpret=interpret,
+    )(qkv, qkv, qkv, grad, lse4, delta4)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flatb_dkv_kernel, scale=scale,
+                          causal=causal, s=s, d=d, g=g, block=block),
+        grid=(b, hg, s // block),
+        in_specs=[
+            pl.BlockSpec((1, s, g * d), qcol),
+            pl.BlockSpec((1, block, g * d),
+                         lambda ib, ih, ki: (ib, ki, hg + ih)),
+            pl.BlockSpec((1, block, g * d),
+                         lambda ib, ih, ki: (ib, ki, 2 * hg + ih)),
+            pl.BlockSpec((1, s, g * d), qcol),
+            pl.BlockSpec((1, 1, g, s),
+                         lambda ib, ih, ki: (ib, ih, 0, 0)),
+            pl.BlockSpec((1, 1, g, s),
+                         lambda ib, ih, ki: (ib, ih, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, g * d),
+                         lambda ib, ih, ki: (ib, ki, ih)),
+            pl.BlockSpec((1, block, g * d),
+                         lambda ib, ih, ki: (ib, ki, ih)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, e), qkv.dtype),
+            jax.ShapeDtypeStruct((b, s, e), qkv.dtype),
+        ],
+        interpret=interpret,
+    )(qkv, qkv, qkv, grad, lse4, delta4)
+    # column concat back to the projection layout; XLA fuses this into
+    # the consuming dW/dx matmuls when it can
+    return (jnp.concatenate([dq, dk, dv], axis=-1),)
+
+
+_flash_flatb.defvjp(_flash_flatb_fwd, _flash_flatb_bwd)
 
 
 # ----------------------------------------------------------------------

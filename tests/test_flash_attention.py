@@ -198,3 +198,80 @@ def test_resolve_impl_auto_policy():
     assert fa.resolve_impl("auto", "tpu", 3000) == "xla"
     # short sequences run as one block regardless
     assert fa.resolve_impl("auto", "tpu", 96) == "pallas"
+
+
+# ----------------------------------------------------------------------
+# r5 blocked flat kernels: the zero-relayout (b, s, 3e) path past the
+# single-block regime (flat_blocked_plan), vs the XLA reference
+def _pack_flat(q, k, v):
+    b, h, s, d = q.shape
+    f = lambda t: t.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+    return jnp.concatenate([f(q), f(k), f(v)], axis=-1)
+
+
+def test_flat_blocked_plan_gates():
+    # single-block shapes belong to the fused path, not this one
+    assert fa.flat_blocked_plan(512, 12, 64) is None
+    # the gpt2 long-context shapes all get a plan with bounded VMEM
+    for s in (1024, 2048, 4096, 8192):
+        plan = fa.flat_blocked_plan(s, 12, 64)
+        assert plan is not None, s
+        g, block = plan
+        assert 12 % g == 0 and (g * 64) % 128 == 0 and s % block == 0
+        assert max(fa._flatb_vmem(s, 12, 64, g, block)) \
+            <= 12 * 1024 * 1024
+    # lengths with a 128-multiple divisor but no 512 split still plan
+    assert fa.flat_blocked_plan(640, 2, 64) is not None
+    # head/dim layouts that can't 128-align a group: no plan
+    assert fa.flat_blocked_plan(1024, 3, 40) is None
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flat_blocked_forward(causal):
+    q, k, v = _qkv(b=1, h=2, s=1024, d=64, seed=4)
+    assert fa.supports_flat(1024, 2, 64) == 0
+    out = fa.flash_attention_flat(_pack_flat(q, k, v), 2, causal)
+    ref = ra.attention(q, k, v, causal=causal)
+    out4 = out.reshape(1, 1024, 2, 64).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out4), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flat_blocked_gradients(causal):
+    q, k, v = _qkv(b=1, h=2, s=1024, d=64, seed=5)
+    qkv = _pack_flat(q, k, v)
+
+    def loss_flat(x):
+        return jnp.sum(fa.flash_attention_flat(x, 2, causal) ** 2)
+
+    def loss_ref(args):
+        return jnp.sum(ra.attention(*args, causal=causal) ** 2)
+
+    g_flat = jax.grad(loss_flat)(qkv)
+    g_ref = _pack_flat(*jax.grad(loss_ref)((q, k, v)))
+    np.testing.assert_allclose(np.asarray(g_flat), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_flat_blocked_small_blocks(monkeypatch):
+    """Force block 128 at s=256 so several q AND k blocks run per
+    program (the causal skip, the online-softmax merge, and the dkv
+    q_lo start all execute)."""
+    monkeypatch.setattr(fa, "flat_blocked_plan",
+                        lambda s, h, d, budget=0: (2, 128))
+    q, k, v = _qkv(b=2, h=2, s=256, d=64, seed=6)
+    qkv = _pack_flat(q, k, v)
+    for causal in (False, True):
+        out = fa._flash_flatb(qkv, 2, causal, None, True)
+        ref = ra.attention(q, k, v, causal=causal)
+        out4 = out.reshape(2, 256, 2, 64).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(np.asarray(out4), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        g_flat = jax.grad(lambda x: jnp.sum(
+            fa._flash_flatb(x, 2, causal, None, True) ** 2))(qkv)
+        g_ref = _pack_flat(*jax.grad(lambda a: jnp.sum(
+            ra.attention(*a, causal=causal) ** 2))((q, k, v)))
+        np.testing.assert_allclose(np.asarray(g_flat),
+                                   np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-4)
