@@ -595,6 +595,30 @@ def gpt2_small(seq_len: int = 512, vocab: int = 32768,
                    else scan_unroll)
 
 
+def moe_lm(seq_len: int = 512, vocab: int = 32768, embed: int = 768,
+           nlayer: int = 12, nhead: int = 12, nexpert: int = 8,
+           moe_topk: int = 2, capacity_factor: float = 1.25,
+           scan_unroll: int = -1) -> str:
+    """GPT-2-small-class MoE causal LM: every block's dense MLP becomes
+    a top-k mixture of ``nexpert`` experts (GShard-style static-shape
+    dispatch, layers.moe_mlp). On a (data, model) mesh the experts
+    shard over ``model`` — expert parallelism (`model_parallel = N`);
+    single-chip it is the measured MoE perf/convergence shape
+    (docs/performance.md r5 zoo row, docs/convergence_r5.json). The
+    dense one-hot dispatch/combine einsums cost O((b*s)^2 * cf) HBM —
+    the standard GShard trade — so the zoo row runs batch 8. The
+    example conf (examples/transformer/moe_lm.conf) keeps a tiny
+    fully-documented topology; this builder is the benchmarkable
+    scale. No reference analogue (SURVEY.md §2.7: expert parallelism
+    absent upstream)."""
+    return tiny_lm(seq_len=seq_len, vocab=vocab, embed=embed,
+                   nlayer=nlayer, nhead=nhead, nexpert=nexpert,
+                   moe_topk=moe_topk, capacity_factor=capacity_factor,
+                   fused_head=True,
+                   scan_unroll=nlayer if scan_unroll < 0
+                   else scan_unroll)
+
+
 def seq_classifier(seq_len: int = 16, embed: int = 32, nhead: int = 4,
                    nclass: int = 10, causal: int = 0) -> str:
     """Attention-based sequence classifier (no reference equivalent —

@@ -242,7 +242,8 @@ def run(name: str, text: str, side: int, batch: int, rounds: int,
 def run_lm(name: str, rounds: int, n_train: int, n_val: int,
            eta: float, out_path: str, extra=(), fuse: int = 1,
            seq: int = 512, vocab: int = 32768, batch: int = 32,
-           stream: bool = False):
+           stream: bool = False, text: str = None,
+           net_desc: str = "gpt2_small (12L, 768e, 12h, fused lm_head)"):
     """Modern-path convergence artifact (VERDICT r3 #8): the
     GPT-2-small-class LM on synthetic Markov token data (each token has
     4 likely successors), trained through the FUSED dispatch path;
@@ -268,7 +269,8 @@ def run_lm(name: str, rounds: int, n_train: int, n_val: int,
     tr = perf_lab.build(
         extra + [("eta", str(eta)), ("eval_train", "1"),
                  ("metric", "token_error")],
-        models.gpt2_small(seq_len=seq, vocab=vocab), nclass=vocab,
+        text or models.gpt2_small(seq_len=seq, vocab=vocab),
+        nclass=vocab,
         batch=batch)
     rs = np.random.RandomState(3)
     # sparse Markov chain: 4 uniform successors per token
@@ -357,7 +359,7 @@ def run_lm(name: str, rounds: int, n_train: int, n_val: int,
                     "SYNTHETIC): chance token-error ~0.75 against the "
                     "greedy successor, uniform bits/token %.1f"
                     % (vocab, np.log2(vocab)),
-            "net": "gpt2_small (12L, 768e, 12h, fused lm_head)",
+            "net": net_desc,
             "hyperparams": dict(extra), "batch": batch,
             "fuse_steps": fuse, "rounds": len(curve),
             "rounds_requested": rounds, "n_train": n_train,
@@ -379,7 +381,8 @@ def main():
     from cxxnet_tpu import models
 
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("net", choices=["alexnet", "bowl", "lm", "vit"])
+    ap.add_argument("net", choices=["alexnet", "bowl", "lm", "vit",
+                                    "moe_lm"])
     ap.add_argument("--rounds", type=int, default=0)
     ap.add_argument("--train", type=int, default=0)
     ap.add_argument("--val", type=int, default=1024)
@@ -433,6 +436,18 @@ def main():
                n_train=args.train or 4096, n_val=args.val or 512,
                eta=args.eta or 0.0003, out_path=args.out,
                extra=extra, fuse=args.fuse, stream=args.stream)
+    elif args.net == "moe_lm":
+        # MoE-path convergence artifact (VERDICT r4 #3): the Markov
+        # oracle through the routed-expert stack + fused head
+        if args.updater == "sgd":
+            extra = [("updater", "adam")] + extra[1:]
+        run_lm("moe_lm_markov", rounds=args.rounds or 12,
+               n_train=args.train or 4096, n_val=args.val or 512,
+               eta=args.eta or 0.0003, out_path=args.out,
+               extra=extra, fuse=args.fuse, stream=args.stream,
+               batch=8, text=models.moe_lm(),
+               net_desc="moe_lm (12L, 768e, 12h, 8 experts top-2, "
+                        "fused lm_head)")
     elif args.net == "vit":
         # second modern-family curve (VERDICT r3 #8): the ViT-S/16
         # encoder through the fused path on the proto oracle

@@ -306,6 +306,10 @@ def cmd_zoo(args):
         # 64+remat no gain)
         ("gpt2_small", models.gpt2_small(seq_len=512), (1, 512, 1),
          32, 32768, "adam"),
+        # MoE LM (r5): batch 8 keeps the O((b*s)^2) GShard dispatch
+        # tensors in budget; analytic flops include dispatch/combine
+        # (layers.TransformerStackLayer.analytic_flops moe branch)
+        ("moe_lm", models.moe_lm(), (1, 512, 1), 8, 32768, "adam"),
     ]
     if args.net:
         known = {n[0] for n in nets}
@@ -348,6 +352,15 @@ def cmd_zoo(args):
         entries.append((name, tr, staged))
         meta[name] = (batch, shape[1] if is_lm else None)
     best = interleave(entries, args.iters, args.trials, args.warmup)
+    bench = None
+    if getattr(args, "ledger", False) and platform == "tpu":
+        import importlib.util
+        import os as _os
+        spec = importlib.util.spec_from_file_location(
+            "bench", _os.path.join(_os.path.dirname(_os.path.dirname(
+                _os.path.abspath(__file__))), "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
     for name, tr, _ in entries:
         batch, seq = meta[name]
         ms = best[name]
@@ -375,6 +388,23 @@ def cmd_zoo(args):
         if seq:
             row["tokens_per_sec"] = round(batch * seq / ms * 1000.0, 1)
         print(json.dumps(row))
+        if bench is not None:
+            # record this window as a per-net ledger entry
+            # (docs/bench_history.json best_by_net — VERDICT r4 #4)
+            entry = {
+                "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()),
+                "images_per_sec": row["images_per_sec"],
+                "step_ms": row["step_ms"],
+                "mode": "zoo_fuse%d" % args.fuse,
+                "mfu_model_flops": row["mfu_vs_197tflops_bf16"],
+            }
+            if seq:
+                entry["tokens_per_sec"] = row["tokens_per_sec"]
+            best = bench._update_history(entry, net=name)
+            sys.stderr.write("ledger[%s]: best %.1f img/s (this run "
+                             "%.1f)\n" % (name, best["images_per_sec"],
+                                          row["images_per_sec"]))
 
 
 def main():
@@ -390,6 +420,9 @@ def main():
     a.set_defaults(fn=cmd_ablate)
     z = sub.add_parser("zoo")
     z.add_argument("--net", nargs="*", help="subset of net names")
+    z.add_argument("--ledger", action="store_true",
+                   help="record each row into docs/bench_history.json "
+                        "(per-net bests, VERDICT r4 #4)")
     z.add_argument("--fuse", type=int, default=1,
                    help="fuse_steps: optimizer steps per dispatch "
                         "(amortizes the tunnel's per-dispatch floor)")

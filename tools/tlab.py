@@ -164,10 +164,41 @@ def exp_vit_breakdown(args):
     run(ents, args.iters, args.trials, args.warmup)
 
 
+def exp_longseq(args):
+    """Long-sequence training (VERDICT r4 #2): gpt2_small at
+    seq 2048/4096/8192, r5 blocked-flat kernels (base) vs the generic
+    (b,h,s,d) kernels (attn_flat=off), interleaved pairwise per shape.
+    Shapes follow the r3/r4 long-seq table (b=8/2/1, remat at 8192)."""
+    from cxxnet_tpu import models
+    vocab = 32768
+    shapes = [(2048, 8, 0), (4096, 2, 0), (8192, 1, 1)]
+    if args.variant:
+        shapes = [sh for sh in shapes
+                  if str(sh[0]) in args.variant]
+    for seq, batch, remat in shapes:
+        text = models.gpt2_small(seq_len=seq, vocab=vocab)
+        if remat:
+            text = text.replace("causal = 1", "causal = 1\n  remat = 1")
+        ov = [("updater", "adam")]
+        if args.fuse > 1:
+            ov.append(("fuse_steps", str(args.fuse)))
+        tr_f = build(ov, text, vocab, batch=batch)
+        st_f = stage(tr_f, lm_batches(batch, seq, vocab), args.fuse)
+        tr_g = build(ov, text.replace(
+            "causal = 1", "causal = 1\n  attn_flat = off"),
+            vocab, batch=batch)
+        st_g = stage(tr_g, lm_batches(batch, seq, vocab), args.fuse)
+        run([("flatb_s%d" % seq, tr_f, st_f, batch * seq),
+             ("generic_s%d" % seq, tr_g, st_g, batch * seq)],
+            args.iters, args.trials, args.warmup)
+        del tr_f, tr_g, st_f, st_g
+
+
 EXPS = {
     "gpt2_breakdown": exp_gpt2_breakdown,
     "gpt2_variants": exp_gpt2_variants,
     "vit_breakdown": exp_vit_breakdown,
+    "longseq": exp_longseq,
 }
 
 
