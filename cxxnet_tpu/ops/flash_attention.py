@@ -689,127 +689,178 @@ _flash_flat.defvjp(_flash_flat_fwd, _flash_flat_bwd)
 # flat-layout BLOCKED kernels (multi-block sequences, r5): the same
 # zero-relayout property as the single-block flat path — kernels read
 # the projection's raw (b, s, 3e) output and write (b, s, e) — carried
-# past s = 512 by gridding over (batch, head group, q/k block) with
-# COLUMN-SLICED BlockSpecs: each program sees only its group's
-# (s, g*d) K/V column panel, so VMEM stays bounded for any sequence
-# length (the whole-row (s, 3e) block the single-block kernel holds
-# would be 9.4 MB at s = 2048 alone). The backward is the split
-# dq / dkv pair (the generic path's schedule) in flat I/O; the three
+# past s = 512 by gridding over (batch, head group, q block, k block)
+# with COLUMN-SLICED BlockSpecs and SCRATCH accumulators: every
+# operand in VMEM is one (block, g*d) tile, so the footprint is
+# independent of sequence length. (A first design held each group's
+# whole (s, g*d) K/V panel per program and looped k in-kernel; the
+# compile-probe measured its true allocation at ~9.4 MB PER HEAD at
+# s=2048 — 18.75 MB even at the minimum g=2 — so the panel form
+# cannot fit the 16 MB scoped limit past s=1024. The probe log and
+# per-config actuals are recorded in docs/performance.md r5.)
+#
+# Grid order puts the k (or q) block index innermost; the
+# online-softmax / gradient accumulators live in VMEM scratch that
+# persists across those innermost steps, initialized at index 0 and
+# flushed to the output block at the last index — the standard TPU
+# flash schedule. Causal block-skipping uses jnp.minimum/maximum in
+# the INDEX MAPS: a masked-out step re-addresses the previous block,
+# so Pallas re-uses the fetched tile instead of issuing a new DMA.
+# The backward is the split dq / dkv pair in flat I/O; the three
 # (b, s, e) grads concatenate into dqkv at the end — ~1/4 of the
 # relayout traffic this path deletes, and XLA can fuse the concat
 # into the consuming projection-VJP matmuls.
 # ----------------------------------------------------------------------
 def flat_blocked_plan(s: int, h: int, d: int,
-                      budget: int = 12 * 1024 * 1024):
+                      budget: int = 13 * 1024 * 1024):
     """(g, block) for the blocked flat kernels, or None when they
-    don't apply. The VMEM estimate is EXPLICIT per kernel — blocked
-    operands counted twice (Pallas double-buffers revisited blocks),
-    loop carries and f32 intermediates itemized — rather than the
-    generic `_pick_group` heuristic whose undercounting of multi-block
-    carries forced a 2x fudge (ADVICE/VERDICT r4 #6); the 12 MB budget
-    leaves a 4 MB margin under the 16 MB scoped limit for Mosaic's own
-    spills. Prefers the largest head group g (fewer grid programs) and
-    the largest block (k-loop amortization) that fit."""
+    don't apply. The VMEM estimate is EXPLICIT per kernel
+    (_flatb_vmem: tiles double-buffered, f32 intermediates and
+    scratch itemized) and CALIBRATED against on-chip compile-probe
+    actuals (VERDICT r4 #6); the 13 MB budget leaves a 3 MB margin
+    under the 16 MB scoped limit for Mosaic's own spills (the (2,512)
+    gpt2 pick estimates 12.5 MB and compiles). Prefers the largest
+    block (the r3 sweep: 512-wide ~1.7x faster than 128) and then the
+    largest head group that fit.
+
+    Gated to s <= 3072: measured on-chip (r5 longseq, interleaved
+    with generic anchors), the flat blocked kernels win at 2048
+    (102.3k vs 96.2k tok/s) but the nb^2 grid-program overhead of the
+    scratch-accumulator schedule crosses over at 4096 (72.2k vs
+    74.0k) — longer sequences keep the generic in-kernel-loop path."""
     if _pick_block(s) == s:
         return None                  # single-block: the fused path
-    best = None
-    for g in range(h, 0, -1):
-        if h % g or (g * d) % 128:
+    import os
+    ov = os.environ.get("CXXNET_FLATB_PLAN")
+    if ov:
+        # experiment override "g,block" — checked BEFORE the length
+        # gate (its whole point is probing past the crossover), and
+        # validated: an un-checked g would silently skip heads
+        # (hg = h // g truncates) and a non-dividing block only fails
+        # with a cryptic Mosaic grid error
+        g, block = (int(x) for x in ov.split(","))
+        if h % g or (g * d) % 128 or s % block:
+            raise ValueError(
+                "CXXNET_FLATB_PLAN=%s invalid for s=%d h=%d d=%d: "
+                "need h %% g == 0, (g*d) %% 128 == 0, s %% block == 0"
+                % (ov, s, h, d))
+        return (g, block)
+    if s > 3072:
+        return None                  # measured crossover (r5)
+    # block-major preference: the r3 sweep measured 512-wide blocks
+    # ~1.7x faster than 128 on the generic kernels (MXU amortization),
+    # so a big block with a smaller group beats the reverse
+    for block in (512, 256, 128):
+        if s % block:
             continue
-        for block in (512, 256, 128):
-            if s % block:
+        for g in range(h, 0, -1):
+            if h % g or (g * d) % 128:
                 continue
             if max(_flatb_vmem(s, h, d, g, block)) <= budget:
-                best = (g, block)
-                break
-        if best:
-            break
-    return best
+                return (g, block)
+    return None
 
 
 def _flatb_vmem(s, h, d, g, block):
-    """Explicit per-kernel VMEM estimates (fwd, dq, dkv) in bytes."""
-    gd2 = g * d * 2                       # bf16 column panel row
-    blk = block * gd2                     # one (block, g*d) bf16 block
-    cols = s * gd2                        # one (s, g*d) bf16 panel
+    """Explicit per-kernel VMEM estimates (fwd, dq, dkv) in bytes.
+    Every operand is a (block, g*d) tile (sequence-length independent);
+    the probe-measured Mosaic overhead for the transposed (g, d, n)
+    working copies and mask/iota buffers rides the 1.5x factor on the
+    f32 score blocks."""
+    blk = block * g * d * 2               # one (block, g*d) bf16 tile
     sq_f32 = g * block * block * 4        # one f32 (g, bq, bk) buffer
-    carry = g * d * block * 4             # one f32 (g, d, block) carry
-    stats = g * s * 4                     # (g, s) f32 lse/delta panel
-    # fwd: q/o blocks + k/v panels (x2 double-buffer each), logits+p
-    # f32, pc bf16, qe/kt/vt transposed working copies, m/l/acc carry
-    fwd = 2 * (2 * blk) + 2 * (2 * cols) + 2 * sq_f32 + sq_f32 // 2 \
-        + 3 * blk + carry + 2 * g * block * 4
-    # dq: q/do/dq blocks + k/v panels, logits/p/dp f32 + ds bf16,
-    # dq carry, stats blocks
-    dq = 2 * (3 * blk) + 2 * (2 * cols) + 3 * sq_f32 + sq_f32 // 2 \
-        + 4 * blk + carry + 2 * 2 * g * block * 4
-    # dkv: k/v/dk/dv blocks + q/do panels + full-s stats, same
-    # intermediates, two carries
-    dkv = 2 * (4 * blk) + 2 * (2 * cols) + 3 * sq_f32 + sq_f32 // 2 \
-        + 4 * blk + 2 * carry + 2 * 2 * stats
+    carry = g * d * block * 4             # one f32 (g, d, block) scratch
+    stat = g * block * 4
+    # fwd: q/k/v in + o out tiles (x2 double-buffer), logits+p f32 +
+    # pc bf16 (+50% working margin), m/l/acc scratch, lse out
+    fwd = 2 * (4 * blk) + int(2.5 * sq_f32 * 1.5) + carry + 3 * stat
+    # dq: q/k/v/do in + dq out tiles, logits/p/dp f32 + ds bf16,
+    # dq scratch, lse/delta tiles
+    dq = 2 * (5 * blk) + int(3.5 * sq_f32 * 1.5) + carry + 4 * stat
+    # dkv: q/k/v/do in + dk/dv out tiles, same intermediates, two
+    # scratch accumulators
+    dkv = 2 * (6 * blk) + int(3.5 * sq_f32 * 1.5) + 2 * carry + 4 * stat
     return fwd, dq, dkv
 
 
+def _kv_col_idx(col_off, causal):
+    """Index map for a K/V column panel at column block ``col_off``:
+    under the causal schedule a skipped k step (kb > qi) re-addresses
+    block min(kb, qi) — the tile already resident — so no new DMA is
+    issued for masked-out work."""
+    if causal:
+        return lambda ib, ih, qi, kb: (ib, jnp.minimum(kb, qi),
+                                       col_off + ih)
+    return lambda ib, ih, qi, kb: (ib, kb, col_off + ih)
+
+
 def _t3(mat, g, d):
-    """(n, g*d) minor-sliced panel -> (g, d, n): 2D transpose then a
+    """(n, g*d) minor-sliced tile -> (g, d, n): 2D transpose then a
     SUBLANE split — the only shape cast Mosaic accepts at d < 128."""
     n = mat.shape[0]
     return mat.T.reshape(g, d, n)
 
 
-def _flatb_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                      scale, causal, s, d, g, block):
-    qi = pl.program_id(2)
-    qe = _t3(q_ref[0], g, d) * scale                    # (g, d, bq)
-    nk = s // block
-    if causal:
-        nk = jnp.minimum(nk, qi + 1)
+def _flatb_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      m_s, l_s, acc_s, *, scale, causal, s, d, g,
+                      block):
+    qi, kb = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        kt = _t3(k_ref[0, pl.ds(kb * block, block), :], g, d)
-        vt = _t3(v_ref[0, pl.ds(kb * block, block), :], g, d)
+    @pl.when(kb == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    @pl.when(jnp.logical_not(causal) | (kb <= qi))
+    def _work():
+        qe = _t3(q_ref[0], g, d) * scale                # (g, d, bq)
+        kt = _t3(k_ref[0], g, d)
+        vt = _t3(v_ref[0], g, d)
         logits = lax.dot_general(qe, kt, (((1,), (1,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
         if causal:
             logits = jnp.where(
                 _causal_mask(qi, kb, block, block)[None],
                 logits, NEG_INF)
+        m, l = m_s[...], l_s[...]
         mb = jnp.max(logits, axis=-1)                   # (g, bq)
         m2 = jnp.maximum(m, mb)
         p = jnp.exp(logits - m2[..., None])
         corr = jnp.exp(m - m2)
-        l2 = l * corr + p.sum(axis=-1)
+        m_s[...] = m2
+        l_s[...] = l * corr + p.sum(axis=-1)
         # acc[g, d, i] += sum_j v[g, d, j] p[g, i, j]
-        acc2 = acc * corr[:, None, :] + lax.dot_general(
+        acc_s[...] = acc_s[...] * corr[:, None, :] + lax.dot_general(
             vt, p.astype(vt.dtype), (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
-        return m2, l2, acc2
 
-    m0 = jnp.full((g, block), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((g, block), jnp.float32)
-    acc0 = jnp.zeros((g, d, block), jnp.float32)
-    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
-    lsafe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / lsafe[:, None, :]).reshape(g * d, block).T \
-        .astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(lsafe)
+    @pl.when(kb == nk - 1)
+    def _flush():
+        lsafe = jnp.maximum(l_s[...], 1e-30)
+        o_ref[0] = (acc_s[...] / lsafe[:, None, :]).reshape(
+            g * d, block).T.astype(o_ref.dtype)
+        lse_ref[0, 0] = m_s[...] + jnp.log(lsafe)
 
 
 def _flatb_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dq_ref, *, scale, causal, s, d, g, block):
-    qi = pl.program_id(2)
-    qe = _t3(q_ref[0], g, d) * scale
-    dot = _t3(do_ref[0], g, d)
-    lse = lse_ref[0, 0]                                 # (g, bq)
-    delta = delta_ref[0, 0]
-    nk = s // block
-    if causal:
-        nk = jnp.minimum(nk, qi + 1)
+                     dq_ref, dq_s, *, scale, causal, s, d, g, block):
+    qi, kb = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
 
-    def body(kb, dq):
-        kt = _t3(k_ref[0, pl.ds(kb * block, block), :], g, d)
-        vt = _t3(v_ref[0, pl.ds(kb * block, block), :], g, d)
+    @pl.when(kb == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    @pl.when(jnp.logical_not(causal) | (kb <= qi))
+    def _work():
+        qe = _t3(q_ref[0], g, d) * scale
+        kt = _t3(k_ref[0], g, d)
+        vt = _t3(v_ref[0], g, d)
+        dot = _t3(do_ref[0], g, d)
+        lse = lse_ref[0, 0]                             # (g, bq)
+        delta = delta_ref[0, 0]
         logits = lax.dot_general(qe, kt, (((1,), (1,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
         if causal:
@@ -821,30 +872,35 @@ def _flatb_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                              preferred_element_type=jnp.float32)
         ds = (p * (dp - delta[..., None])).astype(kt.dtype)
         # dq[g, d, i] += sum_j k[g, d, j] ds[g, i, j]
-        return dq + lax.dot_general(
+        dq_s[...] = dq_s[...] + lax.dot_general(
             kt, ds, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
 
-    dq = lax.fori_loop(0, nk, body,
-                       jnp.zeros((g, d, block), jnp.float32))
-    dq_ref[0] = (dq * scale).reshape(g * d, block).T.astype(
-        dq_ref.dtype)
+    @pl.when(kb == nk - 1)
+    def _flush():
+        dq_ref[0] = (dq_s[...] * scale).reshape(
+            g * d, block).T.astype(dq_ref.dtype)
 
 
 def _flatb_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, *, scale, causal, s, d, g, block):
-    ki = pl.program_id(2)
-    kt = _t3(k_ref[0], g, d)                            # (g, d, bk)
-    vt = _t3(v_ref[0], g, d)
-    nq = s // block
-    q_lo = ki if causal else 0
+                      dk_ref, dv_ref, dk_s, dv_s, *, scale, causal,
+                      s, d, g, block):
+    ki, qb = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
 
-    def body(qb, carry):
-        dk, dv = carry
-        qe = _t3(q_ref[0, pl.ds(qb * block, block), :], g, d) * scale
-        dot = _t3(do_ref[0, pl.ds(qb * block, block), :], g, d)
-        lse = lse_ref[0, 0, :, pl.ds(qb * block, block)]
-        delta = delta_ref[0, 0, :, pl.ds(qb * block, block)]
+    @pl.when(qb == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    @pl.when(jnp.logical_not(causal) | (qb >= ki))
+    def _work():
+        kt = _t3(k_ref[0], g, d)                        # (g, d, bk)
+        vt = _t3(v_ref[0], g, d)
+        qe = _t3(q_ref[0], g, d) * scale
+        dot = _t3(do_ref[0], g, d)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         logits = lax.dot_general(qe, kt, (((1,), (1,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
         if causal:
@@ -853,24 +909,25 @@ def _flatb_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 logits, NEG_INF)
         p = jnp.exp(logits - lse[..., None])            # (g, bq, bk)
         # dv[g, d, j] += sum_i do[g, d, i] p[g, i, j]
-        dv2 = dv + lax.dot_general(
+        dv_s[...] = dv_s[...] + lax.dot_general(
             dot, p.astype(dot.dtype), (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         dp = lax.dot_general(dot, vt, (((1,), (1,)), ((0,), (0,))),
                              preferred_element_type=jnp.float32)
         ds = (p * (dp - delta[..., None])).astype(qe.dtype)
         # dk[g, d, j] += sum_i q_eff[g, d, i] ds[g, i, j] (qe carries
-        # the scale, so dk needs no further factor — chain rule note
+        # the scale, so dk needs no further factor — chain-rule note
         # in _bwd1_kernel)
-        dk2 = dk + lax.dot_general(
+        dk_s[...] = dk_s[...] + lax.dot_general(
             qe, ds, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
-        return dk2, dv2
 
-    z = jnp.zeros((g, d, block), jnp.float32)
-    dk, dv = lax.fori_loop(q_lo, nq, body, (z, z))
-    dk_ref[0] = dk.reshape(g * d, block).T.astype(dk_ref.dtype)
-    dv_ref[0] = dv.reshape(g * d, block).T.astype(dv_ref.dtype)
+    @pl.when(qb == nq - 1)
+    def _flush():
+        dk_ref[0] = dk_s[...].reshape(g * d, block).T.astype(
+            dk_ref.dtype)
+        dv_ref[0] = dv_s[...].reshape(g * d, block).T.astype(
+            dv_ref.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
@@ -880,6 +937,7 @@ def _flash_flatb(qkv, nhead, causal, scale, interpret):
 
 
 def _flash_flatb_fwd(qkv, nhead, causal, scale, interpret):
+    from jax.experimental.pallas import tpu as pltpu
     b, s, e3 = qkv.shape
     h, d = nhead, e3 // (3 * nhead)
     if scale is None:
@@ -891,30 +949,36 @@ def _flash_flatb_fwd(qkv, nhead, causal, scale, interpret):
             "d=%d (callers must consult flat_blocked_plan)" % (s, h, d))
     g, block = plan
     hg, e = h // g, h * d
+    nb = s // block
     # qkv passed three times with column-sliced BlockSpecs: the column
     # block unit is g*d, so q group ih sits at column block ih, k at
-    # hg + ih, v at 2*hg + ih — e = hg * (g*d) keeps these exact
+    # hg + ih, v at 2*hg + ih (e = hg * g*d keeps these exact); see
+    # _kv_col_idx for the causal DMA-reuse addressing.
+    kidx, vidx = _kv_col_idx(hg, causal), _kv_col_idx(2 * hg, causal)
     o, lse4 = pl.pallas_call(
         functools.partial(_flatb_fwd_kernel, scale=scale, causal=causal,
                           s=s, d=d, g=g, block=block),
-        grid=(b, hg, s // block),
+        grid=(b, hg, nb, nb),
         in_specs=[
             pl.BlockSpec((1, block, g * d),
-                         lambda ib, ih, qi: (ib, qi, ih)),
-            pl.BlockSpec((1, s, g * d),
-                         lambda ib, ih, qi: (ib, 0, hg + ih)),
-            pl.BlockSpec((1, s, g * d),
-                         lambda ib, ih, qi: (ib, 0, 2 * hg + ih)),
+                         lambda ib, ih, qi, kb: (ib, qi, ih)),
+            pl.BlockSpec((1, block, g * d), kidx),
+            pl.BlockSpec((1, block, g * d), vidx),
         ],
         out_specs=[
             pl.BlockSpec((1, block, g * d),
-                         lambda ib, ih, qi: (ib, qi, ih)),
+                         lambda ib, ih, qi, kb: (ib, qi, ih)),
             pl.BlockSpec((1, 1, g, block),
-                         lambda ib, ih, qi: (ib, ih, 0, qi)),
+                         lambda ib, ih, qi, kb: (ib, ih, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, s, e), qkv.dtype),
             jax.ShapeDtypeStruct((b, hg, g, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, block), jnp.float32),
+            pltpu.VMEM((g, block), jnp.float32),
+            pltpu.VMEM((g, d, block), jnp.float32),
         ],
         interpret=interpret,
     )(qkv, qkv, qkv)
@@ -922,6 +986,7 @@ def _flash_flatb_fwd(qkv, nhead, causal, scale, interpret):
 
 
 def _flash_flatb_bwd(nhead, causal, scale, interpret, res, grad):
+    from jax.experimental.pallas import tpu as pltpu
     qkv, o, lse4 = res
     b, s, e3 = qkv.shape
     h, d = nhead, e3 // (3 * nhead)
@@ -929,58 +994,69 @@ def _flash_flatb_bwd(nhead, causal, scale, interpret, res, grad):
         scale = d ** -0.5
     g, block = flat_blocked_plan(s, h, d)
     hg, e = h // g, h * d
+    nb = s // block
     delta4 = jnp.sum(grad.astype(jnp.float32).reshape(b, s, h, d)
                      * o.astype(jnp.float32).reshape(b, s, h, d),
                      axis=-1).transpose(0, 2, 1).reshape(b, hg, g, s)
-    qcol = lambda ib, ih, qi: (ib, 0, ih)
+    kidx, vidx = _kv_col_idx(hg, causal), _kv_col_idx(2 * hg, causal)
     dq = pl.pallas_call(
         functools.partial(_flatb_dq_kernel, scale=scale, causal=causal,
                           s=s, d=d, g=g, block=block),
-        grid=(b, hg, s // block),
+        grid=(b, hg, nb, nb),
         in_specs=[
             pl.BlockSpec((1, block, g * d),
-                         lambda ib, ih, qi: (ib, qi, ih)),
-            pl.BlockSpec((1, s, g * d),
-                         lambda ib, ih, qi: (ib, 0, hg + ih)),
-            pl.BlockSpec((1, s, g * d),
-                         lambda ib, ih, qi: (ib, 0, 2 * hg + ih)),
+                         lambda ib, ih, qi, kb: (ib, qi, ih)),
+            pl.BlockSpec((1, block, g * d), kidx),
+            pl.BlockSpec((1, block, g * d), vidx),
             pl.BlockSpec((1, block, g * d),
-                         lambda ib, ih, qi: (ib, qi, ih)),
+                         lambda ib, ih, qi, kb: (ib, qi, ih)),
             pl.BlockSpec((1, 1, g, block),
-                         lambda ib, ih, qi: (ib, ih, 0, qi)),
+                         lambda ib, ih, qi, kb: (ib, ih, 0, qi)),
             pl.BlockSpec((1, 1, g, block),
-                         lambda ib, ih, qi: (ib, ih, 0, qi)),
+                         lambda ib, ih, qi, kb: (ib, ih, 0, qi)),
         ],
         out_specs=pl.BlockSpec((1, block, g * d),
-                               lambda ib, ih, qi: (ib, qi, ih)),
+                               lambda ib, ih, qi, kb: (ib, qi, ih)),
         out_shape=jax.ShapeDtypeStruct((b, s, e), qkv.dtype),
+        scratch_shapes=[pltpu.VMEM((g, d, block), jnp.float32)],
         interpret=interpret,
     )(qkv, qkv, qkv, grad, lse4, delta4)
+    # dkv grid: q block innermost; a causal-skipped q step (qb < ki)
+    # re-addresses block max(qb, ki) — no new DMA
+    qidx = ((lambda ib, ih, ki, qb: (ib, jnp.maximum(qb, ki), ih))
+            if causal else
+            (lambda ib, ih, ki, qb: (ib, qb, ih)))
+    sidx = ((lambda ib, ih, ki, qb: (ib, ih, 0,
+                                     jnp.maximum(qb, ki)))
+            if causal else
+            (lambda ib, ih, ki, qb: (ib, ih, 0, qb)))
     dk, dv = pl.pallas_call(
         functools.partial(_flatb_dkv_kernel, scale=scale,
                           causal=causal, s=s, d=d, g=g, block=block),
-        grid=(b, hg, s // block),
+        grid=(b, hg, nb, nb),
         in_specs=[
-            pl.BlockSpec((1, s, g * d), qcol),
+            pl.BlockSpec((1, block, g * d), qidx),
             pl.BlockSpec((1, block, g * d),
-                         lambda ib, ih, ki: (ib, ki, hg + ih)),
+                         lambda ib, ih, ki, qb: (ib, ki, hg + ih)),
             pl.BlockSpec((1, block, g * d),
-                         lambda ib, ih, ki: (ib, ki, 2 * hg + ih)),
-            pl.BlockSpec((1, s, g * d), qcol),
-            pl.BlockSpec((1, 1, g, s),
-                         lambda ib, ih, ki: (ib, ih, 0, 0)),
-            pl.BlockSpec((1, 1, g, s),
-                         lambda ib, ih, ki: (ib, ih, 0, 0)),
+                         lambda ib, ih, ki, qb: (ib, ki, 2 * hg + ih)),
+            pl.BlockSpec((1, block, g * d), qidx),
+            pl.BlockSpec((1, 1, g, block), sidx),
+            pl.BlockSpec((1, 1, g, block), sidx),
         ],
         out_specs=[
             pl.BlockSpec((1, block, g * d),
-                         lambda ib, ih, ki: (ib, ki, ih)),
+                         lambda ib, ih, ki, qb: (ib, ki, ih)),
             pl.BlockSpec((1, block, g * d),
-                         lambda ib, ih, ki: (ib, ki, ih)),
+                         lambda ib, ih, ki, qb: (ib, ki, ih)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, s, e), qkv.dtype),
             jax.ShapeDtypeStruct((b, s, e), qkv.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, d, block), jnp.float32),
+            pltpu.VMEM((g, d, block), jnp.float32),
         ],
         interpret=interpret,
     )(qkv, qkv, qkv, grad, lse4, delta4)

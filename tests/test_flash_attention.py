@@ -212,14 +212,18 @@ def _pack_flat(q, k, v):
 def test_flat_blocked_plan_gates():
     # single-block shapes belong to the fused path, not this one
     assert fa.flat_blocked_plan(512, 12, 64) is None
-    # the gpt2 long-context shapes all get a plan with bounded VMEM
-    for s in (1024, 2048, 4096, 8192):
+    # the gpt2 long-context shapes in the flat regime get a plan with
+    # bounded VMEM; past the measured 4096 crossover (r5 longseq) the
+    # generic kernels win, so no plan
+    for s in (1024, 2048):
         plan = fa.flat_blocked_plan(s, 12, 64)
         assert plan is not None, s
         g, block = plan
         assert 12 % g == 0 and (g * 64) % 128 == 0 and s % block == 0
         assert max(fa._flatb_vmem(s, 12, 64, g, block)) \
-            <= 12 * 1024 * 1024
+            <= 13 * 1024 * 1024
+    assert fa.flat_blocked_plan(4096, 12, 64) is None
+    assert fa.flat_blocked_plan(8192, 12, 64) is None
     # lengths with a 128-multiple divisor but no 512 split still plan
     assert fa.flat_blocked_plan(640, 2, 64) is not None
     # head/dim layouts that can't 128-align a group: no plan

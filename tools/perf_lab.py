@@ -401,9 +401,9 @@ def cmd_zoo(args):
             }
             if seq:
                 entry["tokens_per_sec"] = row["tokens_per_sec"]
-            best = bench._update_history(entry, net=name)
+            lbest = bench._update_history(entry, net=name)
             sys.stderr.write("ledger[%s]: best %.1f img/s (this run "
-                             "%.1f)\n" % (name, best["images_per_sec"],
+                             "%.1f)\n" % (name, lbest["images_per_sec"],
                                           row["images_per_sec"]))
 
 
