@@ -306,3 +306,48 @@ def test_pick_group_itemized_budget():
     # a budget too small for any group degrades to g=1, never errors
     assert fa._pick_group(192, "fwd", 512, 64, 512, 512,
                           budget=1024) == 1
+
+
+def test_stack_flat_blocked_matches_generic_trajectory(monkeypatch):
+    """Layer-level dispatch of the blocked flat path: a causal
+    transformer_stack at a forced multi-block plan must train along
+    the generic kernels' trajectory (same math, different schedule).
+    s=256 with a forced (2, 128) plan keeps interpret mode fast."""
+    from cxxnet_tpu import config, models
+    from cxxnet_tpu.io import DataBatch
+    from cxxnet_tpu.trainer import Trainer
+
+    monkeypatch.setattr(fa, "flat_blocked_plan",
+                        lambda s, h, d, budget=0:
+                        (2, 128) if s == 256 else None)
+    monkeypatch.setattr(fa, "supports_flat", lambda *a, **k: 0)
+
+    def build(flat):
+        tr = Trainer()
+        text = models.tiny_lm(seq_len=256, vocab=32, embed=128,
+                              nlayer=1, nhead=2)
+        text = text.replace("causal = 1",
+                            "causal = 1\n  attn_impl = pallas"
+                            + ("" if flat else "\n  attn_flat = off"))
+        for k, v in config.parse_string(text):
+            tr.set_param(k, v)
+        for k, v in (("dev", "cpu:0"), ("batch_size", "4"),
+                     ("eta", "0.1"), ("seed", "3"),
+                     ("metric", "token_error")):
+            tr.set_param(k, v)
+        tr.init_model()
+        return tr
+
+    rs = np.random.RandomState(0)
+    seq = (rs.randint(0, 32, size=(4, 1)) + np.arange(257)) % 32
+    b = DataBatch(
+        data=seq[:, :256, None, None].transpose(0, 2, 1, 3)
+        .astype(np.float32).reshape(4, 1, 256, 1),
+        label=seq[:, 1:].astype(np.float32))
+    t_flat, t_gen = build(True), build(False)
+    for _ in range(2):
+        t_flat.update(b)
+        t_gen.update(b)
+    np.testing.assert_allclose(
+        t_flat.get_weight("ts1", "wqkv"),
+        t_gen.get_weight("ts1", "wqkv"), rtol=2e-4, atol=2e-6)
