@@ -18,7 +18,7 @@ between B*S prefill tokens and B decode tokens, so recipes that rely
 on dropping see the usual train/serve MoE gap). No reference analogue
 (cxxnet has no sequence models, SURVEY.md §5).
 
-Two cache layouts (``decode_layout`` trainer knob, default "slot"):
+Cache layouts (``decode_layout`` trainer knob, default "slot"):
 
 * ``slot`` — the r5 layout. The cache has ``P + max_new`` key slots
   (``P`` = max prompt length rounded up, a static shape): prefill K/V
@@ -32,6 +32,10 @@ Two cache layouts (``decode_layout`` trainer knob, default "slot"):
   the ``fori_loop`` carry — the classic XLA in-place-update pattern —
   where the old scan-over-layers stacked its cache outputs and
   therefore re-wrote every byte of cache every step.
+* ``slott`` — ``slot`` with the per-layer caches transposed to
+  (B, nh, d, Sl); measured equal to ``slot`` (a recorded negative
+  result on the lane-tile-padding hypothesis — see
+  ``stack_decode_slot``), kept selectable.
 * ``blend`` — the r4 layout (slot == absolute position, masked-blend
   writes), kept as the measured baseline: per-row write positions
   differ (``lens + i``), and the two vectorized ways to express that —
@@ -136,7 +140,7 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
           platform: str = "cpu"):
     """Build the jitted (params, tokens, lens, rng) -> tokens decoder.
 
-    ``P`` (slot layout only) is the static prompt-region slot count —
+    ``P`` (slot/slott layouts) is the static prompt-region slot count —
     see ``prompt_slots``; ``layout`` picks the cache design documented
     in the module docstring. ``platform`` routes the prefill attend the
     same way the training stack routes its own (flash on TPU when the
@@ -150,7 +154,7 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
     head = net.modules[p["head"]]
     dt = net.compute_dtype
     e = emb.param.num_hidden
-    if layout == "slot":
+    if layout in ("slot", "slott"):
         if P is None:
             P = S
         Sl = P + max_new                    # total cache slots
@@ -319,12 +323,20 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
     # ------------------------------------------------------- slot (r5)
     def stack_decode_slot(st, lp, h, cache, keep, slot):
         """One-token pass on the slot layout. ``cache`` is a tuple over
-        layers of (k, v) each (B, nh, Sl, d); ``keep`` the (B, Sl)
-        valid-slot mask; ``slot`` the (uniform) write index P + i.
+        layers of (k, v); ``keep`` the (B, Sl) valid-slot mask;
+        ``slot`` the (uniform) write index P + i.
 
         The layer loop is a Python unroll: each layer's cache is its
         own carried buffer, so the write lowers to one in-place
-        dynamic_update_slice — no scan-stacked cache copies."""
+        dynamic_update_slice — no scan-stacked cache copies.
+
+        Cache physical layout by ``layout``: ``slot`` is the natural
+        (B, nh, Sl, d) attend shape; ``slott`` transposes to
+        (B, nh, d, Sl) — tried on the hypothesis that the d = 64-class
+        minor dim under-fills lane tiles, and MEASURED EQUAL
+        (2.015 vs 2.005 ms/step at B=32, docs/performance.md r5):
+        XLA's layout assignment already handles both. Kept selectable
+        as the recorded negative result."""
         nh = st.nhead
         d = e // nh
         hh = h
@@ -335,18 +347,24 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
             qkv = jnp.dot(x, layer_p["wqkv"].T.astype(dt))
             qkv = qkv.reshape(B, 3, nh, d)
             q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            if layout == "slott":
+                upd = (0, 0, 0, slot)
+                kx, vx = k_new[..., None], v_new[..., None]
+                spec_qk, spec_av = "bhd,bhdk->bhk", "bhk,bhdk->bhd"
+            else:
+                upd = (0, 0, slot, 0)
+                kx, vx = k_new[:, :, None, :], v_new[:, :, None, :]
+                spec_qk, spec_av = "bhd,bhkd->bhk", "bhk,bhkd->bhd"
             k_c = jax.lax.dynamic_update_slice(
-                k_c, k_new[:, :, None, :].astype(k_c.dtype),
-                (0, 0, slot, 0))
+                k_c, kx.astype(k_c.dtype), upd)
             v_c = jax.lax.dynamic_update_slice(
-                v_c, v_new[:, :, None, :].astype(v_c.dtype),
-                (0, 0, slot, 0))
-            scores = jnp.einsum("bhd,bhkd->bhk", q, k_c,
-                                preferred_element_type=jnp.float32) \
-                * (d ** -0.5)
+                v_c, vx.astype(v_c.dtype), upd)
+            scores = jnp.einsum(
+                spec_qk, q, k_c,
+                preferred_element_type=jnp.float32) * (d ** -0.5)
             att = jax.nn.softmax(
                 jnp.where(keep[:, None, :], scores, NEG), -1)
-            out = jnp.einsum("bhk,bhkd->bhd", att.astype(dt), v_c)
+            out = jnp.einsum(spec_av, att.astype(dt), v_c)
             out = out.reshape(B, e)
             hh = hh + jnp.dot(out, layer_p["wo"].T.astype(dt))
             x = _rmsnorm(hh, layer_p["norm2"], dt)
@@ -364,9 +382,18 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
             # [P, Sl) zero for the decode steps to fill
             per = []
             for li in range(ks.shape[0]):
-                pad = ((0, 0), (0, 0), (0, Sl - P), (0, 0))
-                per.append((jnp.pad(ks[li, :, :, :P], pad),
-                            jnp.pad(vs[li, :, :, :P], pad)))
+                if layout == "slott":
+                    # (B, nh, S, d) -> (B, nh, d, Sl): Sl minor
+                    pad = ((0, 0), (0, 0), (0, 0), (0, Sl - P))
+                    per.append((
+                        jnp.pad(ks[li, :, :, :P].transpose(0, 1, 3, 2),
+                                pad),
+                        jnp.pad(vs[li, :, :, :P].transpose(0, 1, 3, 2),
+                                pad)))
+                else:
+                    pad = ((0, 0), (0, 0), (0, Sl - P), (0, 0))
+                    per.append((jnp.pad(ks[li, :, :, :P], pad),
+                                jnp.pad(vs[li, :, :, :P], pad)))
             caches.append(tuple(per))
         last = jnp.take_along_axis(
             h, (lens - 1)[:, None, None], axis=1)[:, 0]      # (B, e)

@@ -235,8 +235,9 @@ class Trainer:
         elif name == "save_sharded":
             self.save_sharded = int(val)
         elif name == "decode_layout":
-            if val not in ("auto", "slot", "blend"):
-                raise ValueError("decode_layout must be auto|slot|blend")
+            if val not in ("auto", "slot", "slott", "blend"):
+                raise ValueError(
+                    "decode_layout must be auto|slot|slott|blend")
             self.decode_layout = val
         if name.startswith("metric"):
             import re
@@ -1175,7 +1176,7 @@ class Trainer:
         if layout == "auto":
             layout = "slot"
         P = None
-        if kv_plan is not None and layout == "slot":
+        if kv_plan is not None and layout in ("slot", "slott"):
             from . import generate as G
             P = G.prompt_slots(int(lens.max()) if nrow else 1, S)
         key = (int(max_new), float(temperature), kv_plan is not None,
