@@ -195,41 +195,66 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
     def stack_prefill(st, lp, h):
         """Full-sequence pass that ALSO returns per-layer K/V.
 
-        Mirrors _block_fn's dense block; lax.scan over depth like the
-        training path, carrying the activations and stacking caches."""
+        Mirrors _block_fn's dense block, UNROLLED over depth (the
+        training recipe's own finding: full unroll beats the scan's
+        sliced-stack weight access), with the attend routed the way
+        the training step routes it — the flat zero-relayout flash
+        kernel when the shape supports it, generic flash otherwise,
+        exact XLA attend off-TPU. When the flat kernel runs, K/V for
+        the cache are sliced from the flat projection (one relayout
+        per layer instead of the attend's three)."""
         nh = st.nhead
         d = e // nh
 
         impl = fa.resolve_impl(st.attn_impl, platform, S)
-
-        def block(carry, layer_p):
-            hh = carry
-            x = _rmsnorm(hh, layer_p["norm1"], dt)
-            qkv = jnp.einsum("bse,fe->bsf", x, layer_p["wqkv"].astype(dt))
-            qkv = qkv.reshape(B, S, 3, nh, d).transpose(2, 0, 3, 1, 4)
-            q, k, v = qkv[0], qkv[1], qkv[2]
-            if impl == "pallas":
-                # the training stack's own attend on TPU; prefill K/V
-                # are computed above either way, so only the attend
-                # changes (same low-order-bits caveat as training)
-                out = fa.flash_attention(q, k, v, causal=True,
-                                         interpret=platform != "tpu")
+        # honor the stack's attn_flat=off escape hatch exactly like
+        # the training dispatch (layers._block_fn) does
+        flat = impl == "pallas" \
+            and getattr(st, "attn_flat", "auto") != "off" and bool(
+                fa.supports_flat(S, nh, d)
+                or fa.flat_blocked_plan(S, nh, d))
+        interp = platform != "tpu"
+        L = lp["wqkv"].shape[0]
+        ks, vs = [], []
+        for li in range(L):
+            layer_p = {kk: vv[li] for kk, vv in lp.items()}
+            x = _rmsnorm(h, layer_p["norm1"], dt)
+            qkv = jnp.einsum("bse,fe->bsf", x,
+                             layer_p["wqkv"].astype(dt))
+            if flat:
+                out4 = fa.flash_attention_flat(qkv, nh, causal=True,
+                                               interpret=interp)
+                kv4 = qkv.reshape(B, S, 3, nh, d)
+                k = kv4[:, :, 1].transpose(0, 2, 1, 3)
+                v = kv4[:, :, 2].transpose(0, 2, 1, 3)
+                out = out4
             else:
-                # f32 score accumulation + d^-0.5 scale, matching
-                # ops.ring_attention.attention (the stack's exact attend)
-                scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                                    preferred_element_type=jnp.float32) \
-                    * (d ** -0.5)
-                mask = jnp.tril(jnp.ones((S, S), bool))
-                att = jax.nn.softmax(jnp.where(mask, scores, NEG), -1)
-                out = jnp.einsum("bhqk,bhkd->bhqd", att.astype(dt), v)
-            out = out.transpose(0, 2, 1, 3).reshape(B, S, e)
-            hh = hh + jnp.einsum("bse,fe->bsf", out,
-                                 layer_p["wo"].astype(dt))
-            x = _rmsnorm(hh, layer_p["norm2"], dt)
-            return hh + mlp_at(st, layer_p, x), (k, v)
-        h, (ks, vs) = jax.lax.scan(block, h, lp)
-        return h, ks, vs          # caches: (L, B, nh, S, d)
+                qkv4 = qkv.reshape(B, S, 3, nh, d).transpose(
+                    2, 0, 3, 1, 4)
+                q, k, v = qkv4[0], qkv4[1], qkv4[2]
+                if impl == "pallas":
+                    out = fa.flash_attention(q, k, v, causal=True,
+                                             interpret=interp)
+                else:
+                    # f32 score accumulation + d^-0.5 scale, matching
+                    # ops.ring_attention.attention (the exact attend)
+                    scores = jnp.einsum(
+                        "bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) \
+                        * (d ** -0.5)
+                    mask = jnp.tril(jnp.ones((S, S), bool))
+                    att = jax.nn.softmax(
+                        jnp.where(mask, scores, NEG), -1)
+                    out = jnp.einsum("bhqk,bhkd->bhqd",
+                                     att.astype(dt), v)
+                out = out.transpose(0, 2, 1, 3).reshape(B, S, e)
+            h = h + jnp.einsum("bse,fe->bsf", out,
+                               layer_p["wo"].astype(dt))
+            x = _rmsnorm(h, layer_p["norm2"], dt)
+            h = h + mlp_at(st, layer_p, x)
+            ks.append(k)
+            vs.append(v)
+        return h, jnp.stack(ks), jnp.stack(vs)  # (L, B, nh, S, d)
 
     # ------------------------------------------------------ blend (r4)
     def stack_decode_blend(st, lp, h, ks, vs, pos):

@@ -157,7 +157,6 @@ def test_decode_layouts_agree():
     parity that lets the slot layouts reorder cache slots freely:
     attention is mask-driven (learned positions are added at embed
     time), so slot order is an implementation detail."""
-    outs = {}
     for layout in ("slot", "slott", "blend"):
         tr = _lm()
         _train_cycle(tr)
@@ -167,10 +166,10 @@ def test_decode_layouts_agree():
         lens = np.array([len(p) for p in prompts], np.int32)
         for i, p in enumerate(prompts):
             toks[i, :len(p)] = p
-        outs[layout] = tr.generate(toks, lens, 8, temperature=0.0)
+        out = tr.generate(toks, lens, 8, temperature=0.0)
         ref = tr.generate(toks, lens, 8, temperature=0.0,
                           use_cache="never")
-        np.testing.assert_array_equal(outs[layout], ref)
+        np.testing.assert_array_equal(out, ref)
 
 
 def test_prompt_slots_buckets():
@@ -291,3 +290,34 @@ def test_wrapper_generate():
     out = net.generate(toks, [1, 1], max_new=3)
     assert out.shape == (2, SEQ)
     assert out.max() < VOCAB
+
+
+def test_flat_prefill_matches_full_forward():
+    """The prefill's flat-kernel branch (flash_attention_flat + k/v
+    cache extraction sliced from the packed qkv) runs on CPU in
+    interpret mode via attn_impl=pallas — a wrong slice or axis swap
+    in the cache construction would only surface on TPU otherwise.
+    Pinned against the full-forward path (also pallas, so both sides
+    share the flash numerics)."""
+    from cxxnet_tpu import generate as G
+    from cxxnet_tpu.ops import flash_attention as fa
+    tr = Trainer()
+    text = models.tiny_lm(seq_len=128, vocab=32, embed=256, nlayer=1,
+                          nhead=2)
+    text = text.replace("causal = 1", "causal = 1\n  attn_impl = pallas")
+    for k, v in config.parse_string(text):
+        tr.set_param(k, v)
+    for k, v in (("batch_size", "2"), ("dev", "cpu:0"), ("eta", "0.1"),
+                 ("metric", "token_error")):
+        tr.set_param(k, v)
+    tr.init_model()
+    assert fa.supports_flat(128, 2, 128)     # the flat branch engages
+    rs = np.random.RandomState(5)
+    toks = np.zeros((2, 128), np.int32)
+    lens = np.array([9, 40], np.int32)
+    for i, l in enumerate(lens):
+        toks[i, :l] = rs.randint(1, 32, l)
+    fast = tr.generate(toks, lens, 6, temperature=0.0)
+    slow = tr.generate(toks, lens, 6, temperature=0.0,
+                       use_cache="never")
+    np.testing.assert_array_equal(fast, slow)
