@@ -190,7 +190,18 @@ def time_steps(tr, staged, iters):
 def interleave(entries, iters, trials, warmup):
     """entries: [(name, trainer, staged)]; returns {name: best_ms}."""
     for _, tr, st in entries:
-        time_steps(tr, st, warmup)
+        # warmup triggers the first compile — retry the transient
+        # remote-compile link drops the same way build() does
+        for attempt in range(3):
+            try:
+                time_steps(tr, st, warmup)
+                break
+            except Exception as e:
+                if attempt == 2 or "remote_compile" not in str(e):
+                    raise
+                sys.stderr.write("warmup retry after tunnel drop: "
+                                 "%s\n" % e)
+                time.sleep(5.0)
     best = {name: float("inf") for name, _, _ in entries}
     for t in range(trials):
         for name, tr, st in entries:
