@@ -119,18 +119,26 @@ def emit_net(nblocks, nclass, spatial):
     return "\n".join(lines) + "\n"
 
 
-def build(overrides, text, nclass, retries=3, batch=BATCH):
-    """Build + init a trainer, retrying transient tunnel/compile drops
-    (the remote-compile link in front of the chip occasionally closes
+def _retry_tunnel(fn, what, retries=3):
+    """Run fn(), retrying transient tunnel/compile drops (the
+    remote-compile link in front of the chip occasionally closes
     mid-response under contention)."""
     for attempt in range(retries):
         try:
-            return _build_once(overrides, text, nclass, batch)
+            return fn()
         except Exception as e:
             if attempt == retries - 1 or "remote_compile" not in str(e):
                 raise
-            sys.stderr.write("build retry after tunnel drop: %s\n" % e)
+            sys.stderr.write("%s retry after tunnel drop: %s\n"
+                             % (what, e))
             time.sleep(5.0)
+
+
+def build(overrides, text, nclass, retries=3, batch=BATCH):
+    """Build + init a trainer (first compiles ride _retry_tunnel)."""
+    return _retry_tunnel(
+        lambda: _build_once(overrides, text, nclass, batch), "build",
+        retries)
 
 
 def _build_once(overrides, text, nclass, batch=BATCH):
@@ -190,18 +198,8 @@ def time_steps(tr, staged, iters):
 def interleave(entries, iters, trials, warmup):
     """entries: [(name, trainer, staged)]; returns {name: best_ms}."""
     for _, tr, st in entries:
-        # warmup triggers the first compile — retry the transient
-        # remote-compile link drops the same way build() does
-        for attempt in range(3):
-            try:
-                time_steps(tr, st, warmup)
-                break
-            except Exception as e:
-                if attempt == 2 or "remote_compile" not in str(e):
-                    raise
-                sys.stderr.write("warmup retry after tunnel drop: "
-                                 "%s\n" % e)
-                time.sleep(5.0)
+        # warmup triggers the first compile
+        _retry_tunnel(lambda: time_steps(tr, st, warmup), "warmup")
     best = {name: float("inf") for name, _, _ in entries}
     for t in range(trials):
         for name, tr, st in entries:
