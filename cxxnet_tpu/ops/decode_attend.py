@@ -1,0 +1,102 @@
+"""Pallas decode-attend: one-token attention against the KV cache.
+
+The decode step's cost is ~87% KV-cache streaming (measured r5: step
+time at B=32 is 1.154 ms at 192 cache slots vs 2.033 ms at 384 — the
+weights/fixed intercept is only ~0.27 ms), yet the XLA lowering of the
+two batched matvec einsums moves the cache at only ~257 GB/s effective
+(~31% of HBM): 1-row dot_generals leave the MXU issue-bound. This
+kernel fuses the whole per-token attend — scores, masked softmax, PV —
+into one pass over K and V per (batch-group, head) with everything in
+VMEM, so the cache is read exactly once at streaming rate.
+
+Used by cxxnet_tpu/generate.py's ``slotk`` decode layout (the ``slot``
+cache layout with this kernel as the attend; parity pinned against the
+XLA attend by tests/test_generate.py). No reference analogue (cxxnet
+has no sequence models, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    from . import pallas_env
+    return pallas_env.interpret()
+
+
+def _pick_rows(B, nh, Sl, d, itemsize, budget=10 * 1024 * 1024):
+    """Batch rows per grid step: largest divisor of B whose K+V block
+    (double-buffered, in the cache's actual dtype) fits the budget.
+    Raises when even one row cannot fit — callers chose this kernel
+    explicitly (decode_layout=slotk), so the failure must be loud."""
+    per_row = 2 * (2 * nh * Sl * d * itemsize)   # K+V, x2 pipeline
+    if per_row > budget:
+        raise ValueError(
+            "decode_attend: one row's K+V block (%d bytes at Sl=%d, "
+            "itemsize=%d) exceeds the %d-byte VMEM budget; use "
+            "decode_layout=slot (the XLA attend) for this shape"
+            % (per_row, Sl, itemsize, budget))
+    best = 1
+    for gb in range(2, min(B, 8) + 1):
+        if B % gb == 0 and gb * per_row <= budget:
+            best = gb
+    return best
+
+
+def _kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *, scale):
+    # single-query attends are matvecs — bandwidth work, so everything
+    # here is VPU multiply-reduce (a 1-row dot_general form of this
+    # kernel crashed the Mosaic backend; there is no MXU win to lose)
+    q = q_ref[...]                           # (gb, nh, d)
+    k = k_ref[...]                           # (gb, nh, Sl, d)
+    v = v_ref[...]
+    bias = b_ref[...][:, 0, :]               # (gb, 1, Sl) -> (gb, Sl)
+    gb, nh, Sl, d = k.shape
+    qe = (q * scale).astype(jnp.float32)[:, :, None, :]  # (gb,nh,1,d)
+    scores = (k.astype(jnp.float32) * qe).sum(-1)        # (gb,nh,Sl)
+    scores = scores + bias[:, None, :]
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    l = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
+    w = (p / l)[..., None]                               # (gb,nh,Sl,1)
+    out = (v.astype(jnp.float32) * w).sum(2)             # (gb,nh,d)
+    o_ref[...] = out.astype(o_ref.dtype)
+
+
+def decode_attend(q, k_c, v_c, bias, scale=None, interpret=None):
+    """q (B, nh, d) x cache (B, nh, Sl, d) -> (B, nh, d).
+
+    ``bias`` is the (B, Sl) additive mask (0 for valid slots, a large
+    negative for invalid) — computed once per decode step and shared
+    by every layer's call."""
+    if interpret is None:
+        interpret = _interpret()
+    B, nh, d = q.shape
+    Sl = k_c.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+    gb = _pick_rows(B, nh, Sl, d, jnp.dtype(k_c.dtype).itemsize)
+    return pl.pallas_call(
+        functools.partial(_kernel, scale=scale),
+        grid=(B // gb,),
+        in_specs=[
+            pl.BlockSpec((gb, nh, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((gb, nh, Sl, d), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((gb, nh, Sl, d), lambda i: (i, 0, 0, 0)),
+            # (B, 1, Sl) with a singleton sublane dim: the block's
+            # last two dims ride the equal-to-array-dim escape for any
+            # Sl, where a (gb, Sl) block would violate the (8, 128)
+            # tiling rule at gb < 8
+            pl.BlockSpec((gb, 1, Sl), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((gb, nh, d), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, nh, d), q.dtype),
+        interpret=bool(interpret),
+    )(q, k_c, v_c, bias[:, None, :])

@@ -235,9 +235,10 @@ class Trainer:
         elif name == "save_sharded":
             self.save_sharded = int(val)
         elif name == "decode_layout":
-            if val not in ("auto", "slot", "slott", "blend"):
-                raise ValueError(
-                    "decode_layout must be auto|slot|slott|blend")
+            if val not in ("auto", "slot", "slott", "slotk",
+                           "blend"):
+                raise ValueError("decode_layout must be "
+                                 "auto|slot|slott|slotk|blend")
             self.decode_layout = val
         if name.startswith("metric"):
             import re
@@ -1176,7 +1177,8 @@ class Trainer:
         if layout == "auto":
             layout = "slot"
         P = None
-        if kv_plan is not None and layout in ("slot", "slott"):
+        if kv_plan is not None and layout in ("slot", "slott",
+                                              "slotk"):
             from . import generate as G
             P = G.prompt_slots(int(lens.max()) if nrow else 1, S)
         key = (int(max_new), float(temperature), kv_plan is not None,

@@ -107,7 +107,12 @@ def main():
     ap.add_argument("--batches", default="8,32,64")
     ap.add_argument("--trials", type=int, default=5)
     ap.add_argument("--layouts", default="slot,blend")
+    ap.add_argument("--prompt", type=int, default=256,
+                    help="prompt length (drives the cache slot count "
+                         "P+max_new; a KV-traffic decomposition lever)")
     args = ap.parse_args()
+    global PROMPT
+    PROMPT = args.prompt
     layouts = args.layouts.split(",")
     rows = []
     for batch in [int(b) for b in args.batches.split(",")]:
