@@ -8,7 +8,7 @@ weak-scaling efficiency against the ICI roofline
 Multi-chip hardware is not available on this rig (BASELINE.md); these
 are the numbers that CAN be produced honestly without it — measured
 from the compiled programs, not asserted. Writes
-docs/multichip_r4.json and prints one JSON line per config.
+docs/multichip_r5.json and prints one JSON line per config.
 
 Run: JAX_PLATFORMS=cpu python tools/multichip_report.py
 """
@@ -143,8 +143,20 @@ def main():
              "sequence shards; nlayer=4 of 12"))
     del tr
 
+    # 5) expert parallelism: the MoE LM slice with experts over model
+    tr = build(models.moe_lm(seq_len=512, nlayer=2, nexpert=4), 16,
+               dtype="bfloat16", updater="adam", model_parallel=2)
+    rows.append(analyze(
+        "moe_lm_dp4_ep2_b4_per_chip", tr, 16, lm=(512, 32768),
+        assumed_mfu=0.59,
+        note="experts sharded over model (EP): GSPMD lowers the dense "
+             "one-hot dispatch/combine as model-axis gather/reduce "
+             "(the combine contracts the sharded expert dim), not "
+             "all-to-all — docs/parallel.md; nlayer=2 of 12"))
+    del tr
+
     out = {
-        "generated": "round 4",
+        "generated": "round 5",
         "method": "collectives parsed from the GSPMD-partitioned HLO "
                   "of the REAL jitted train step on an 8-device "
                   "virtual mesh (cxxnet_tpu.parallel.collective_report)"
@@ -155,7 +167,7 @@ def main():
         "configs": rows,
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "..", "docs", "multichip_r4.json")
+                        "..", "docs", "multichip_r5.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print("wrote %s" % os.path.normpath(path))
