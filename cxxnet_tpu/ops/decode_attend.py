@@ -21,6 +21,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
@@ -31,11 +32,15 @@ def _interpret() -> bool:
     return pallas_env.interpret()
 
 
-def _pick_rows(B, nh, Sl, d, itemsize, budget=10 * 1024 * 1024):
+def _pick_rows(B, nh, Sl, d, itemsize, budget=5 * 1024 * 1024):
     """Batch rows per grid step: largest divisor of B whose K+V block
     (double-buffered, in the cache's actual dtype) fits the budget.
     Raises when even one row cannot fit — callers chose this kernel
-    explicitly (decode_layout=slotk), so the failure must be loud."""
+    explicitly (decode_layout=slotk), so the failure must be loud.
+    The 5 MB default is deliberately conservative: with 12 kernel
+    instances inside the decode fori_loop body, larger groups pushed
+    the program past the scoped limit (and crashed the compile helper
+    rather than erroring cleanly)."""
     per_row = 2 * (2 * nh * Sl * d * itemsize)   # K+V, x2 pipeline
     if per_row > budget:
         raise ValueError(
@@ -51,23 +56,30 @@ def _pick_rows(B, nh, Sl, d, itemsize, budget=10 * 1024 * 1024):
 
 
 def _kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *, scale):
-    # single-query attends are matvecs — bandwidth work, so everything
-    # here is VPU multiply-reduce (a 1-row dot_general form of this
-    # kernel crashed the Mosaic backend; there is no MXU win to lose)
+    # dot_general matvecs with bf16 operands / f32 accumulation: the
+    # products never materialize f32 copies of the K/V blocks (a VPU
+    # multiply-reduce variant upcast K and V wholesale and measured
+    # 18 MB of scoped VMEM — over the limit)
     q = q_ref[...]                           # (gb, nh, d)
     k = k_ref[...]                           # (gb, nh, Sl, d)
     v = v_ref[...]
     bias = b_ref[...][:, 0, :]               # (gb, 1, Sl) -> (gb, Sl)
     gb, nh, Sl, d = k.shape
-    qe = (q * scale).astype(jnp.float32)[:, :, None, :]  # (gb,nh,1,d)
-    scores = (k.astype(jnp.float32) * qe).sum(-1)        # (gb,nh,Sl)
-    scores = scores + bias[:, None, :]
+    q2 = (q * scale).astype(k.dtype).reshape(gb * nh, 1, d)
+    k3 = k.reshape(gb * nh, Sl, d)
+    v3 = v.reshape(gb * nh, Sl, d)
+    scores = lax.dot_general(
+        q2, k3, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)  # (gb*nh, 1, Sl)
+    scores = scores + jnp.broadcast_to(
+        bias[:, None, :], (gb, nh, Sl)).reshape(gb * nh, 1, Sl)
     m = jnp.max(scores, axis=-1, keepdims=True)
     p = jnp.exp(scores - m)
     l = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
-    w = (p / l)[..., None]                               # (gb,nh,Sl,1)
-    out = (v.astype(jnp.float32) * w).sum(2)             # (gb,nh,d)
-    o_ref[...] = out.astype(o_ref.dtype)
+    out = lax.dot_general(
+        (p / l).astype(v3.dtype), v3, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)  # (gb*nh, 1, d)
+    o_ref[...] = out.reshape(gb, nh, d).astype(o_ref.dtype)
 
 
 def decode_attend(q, k_c, v_c, bias, scale=None, interpret=None):
