@@ -56,30 +56,37 @@ def _pick_rows(B, nh, Sl, d, itemsize, budget=5 * 1024 * 1024):
 
 
 def _kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *, scale):
-    # dot_general matvecs with bf16 operands / f32 accumulation: the
-    # products never materialize f32 copies of the K/V blocks (a VPU
-    # multiply-reduce variant upcast K and V wholesale and measured
-    # 18 MB of scoped VMEM — over the limit)
-    q = q_ref[...]                           # (gb, nh, d)
-    k = k_ref[...]                           # (gb, nh, Sl, d)
-    v = v_ref[...]
+    # a STATIC Python loop over heads with major-dim ref indexing and
+    # rank-2/3 dot_generals: no reshapes, no 1-sized dims — Mosaic's
+    # vector-layout inference rejected both a (gb*nh, 1, d) matvec
+    # form ("unsupported shape cast") and wholesale f32 upcasts
+    # (18 MB of VMEM); per-head (gb, Sl, d) x (gb, d) contractions
+    # with f32 accumulation sidestep both
     bias = b_ref[...][:, 0, :]               # (gb, 1, Sl) -> (gb, Sl)
-    gb, nh, Sl, d = k.shape
-    q2 = (q * scale).astype(k.dtype).reshape(gb * nh, 1, d)
-    k3 = k.reshape(gb * nh, Sl, d)
-    v3 = v.reshape(gb * nh, Sl, d)
-    scores = lax.dot_general(
-        q2, k3, (((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)  # (gb*nh, 1, Sl)
-    scores = scores + jnp.broadcast_to(
-        bias[:, None, :], (gb, nh, Sl)).reshape(gb * nh, 1, Sl)
-    m = jnp.max(scores, axis=-1, keepdims=True)
-    p = jnp.exp(scores - m)
-    l = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
-    out = lax.dot_general(
-        (p / l).astype(v3.dtype), v3, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)  # (gb*nh, 1, d)
-    o_ref[...] = out.reshape(gb, nh, d).astype(o_ref.dtype)
+    nh = q_ref.shape[1]
+    for h in range(nh):
+        # rank-3 dots with the singleton on the MAJOR side: Mosaic
+        # rejects true batched matvecs in both orientations (empty
+        # lhs non-contracting dims fail to parse; rhs-free-dims must
+        # be an infix) and the (gb*nh, ...) head-merged form dies in
+        # vector-layout inference ("unsupported shape cast") — a
+        # (gb, 1, d) x (gb, Sl, d) contraction keeps every vector
+        # layout 2D in (sublane, lane) and lowers cleanly
+        q3 = (q_ref[:, h] * scale).astype(k_ref.dtype)[:, None, :]
+        k_h = k_ref[:, h]                                 # (gb, Sl, d)
+        v_h = v_ref[:, h]
+        scores = lax.dot_general(
+            q3, k_h, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # (gb, 1, Sl)
+        scores = scores + bias[:, None, :]
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        p = jnp.exp(scores - m)
+        l = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
+        out = lax.dot_general(
+            (p / l).astype(v_h.dtype), v_h,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # (gb, 1, d)
+        o_ref[:, h] = out[:, 0].astype(o_ref.dtype)
 
 
 def decode_attend(q, k_c, v_c, bias, scale=None, interpret=None):

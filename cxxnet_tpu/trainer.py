@@ -1173,14 +1173,35 @@ class Trainer:
         if use_cache != "never":
             from . import generate as G
             kv_plan, why = G.plan_or_reason(self.net)
-        layout = getattr(self, "decode_layout", "auto")
-        if layout == "auto":
-            layout = "slot"
         P = None
-        if kv_plan is not None and layout in ("slot", "slott",
-                                              "slotk"):
+        if kv_plan is not None:
             from . import generate as G
             P = G.prompt_slots(int(lens.max()) if nrow else 1, S)
+        layout = getattr(self, "decode_layout", "auto")
+        if layout == "auto":
+            # slotk (the fused Pallas decode-attend) on TPU when the
+            # kernel's VMEM row budget fits; the plain slot layout
+            # otherwise. Measured crossover (docs/performance.md r5):
+            # the kernel's per-program fixed cost loses at B=8 (-6%),
+            # wins +27% at B=32 and +54% at B=64.
+            layout = "slot"
+            if kv_plan is not None and B >= 16 \
+                    and getattr(self.net, "platform", "cpu") == "tpu":
+                try:
+                    from .ops import decode_attend as da
+                    st0 = self.net.modules[kv_plan["stacks"][0]]
+                    e = self.net.modules[
+                        kv_plan["embed"]].param.num_hidden
+                    da._pick_rows(
+                        B, st0.nhead, P + int(max_new),
+                        e // st0.nhead,
+                        jnp.dtype(self.net.compute_dtype).itemsize)
+                    layout = "slotk"
+                except ValueError:
+                    # the intended over-budget fallback; anything else
+                    # (a real bug) must surface, not silently pin the
+                    # slower path
+                    pass
         key = (int(max_new), float(temperature), kv_plan is not None,
                layout, P)
         fn = self._gen_cache.get(key)

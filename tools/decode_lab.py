@@ -37,7 +37,7 @@ MAX_NEW = 128
 SHORT_NEW = 8
 
 
-def build(batch, retries=3):
+def build(batch, retries=3, nlayer=12):
     import jax
 
     from cxxnet_tpu import config, models
@@ -46,7 +46,8 @@ def build(batch, retries=3):
         try:
             platform = jax.devices()[0].platform
             tr = Trainer()
-            for k, v in config.parse_string(models.gpt2_small()):
+            for k, v in config.parse_string(
+                    models.gpt2_small(nlayer=nlayer)):
                 tr.set_param(k, v)
             tr.set_param("batch_size", str(batch))
             tr.set_param("dev", platform)
@@ -110,13 +111,16 @@ def main():
     ap.add_argument("--prompt", type=int, default=256,
                     help="prompt length (drives the cache slot count "
                          "P+max_new; a KV-traffic decomposition lever)")
+    ap.add_argument("--nlayer", type=int, default=12,
+                    help="stack depth (smaller = simpler compiled "
+                         "program; a compile-fault workaround lever)")
     args = ap.parse_args()
     global PROMPT
     PROMPT = args.prompt
     layouts = args.layouts.split(",")
     rows = []
     for batch in [int(b) for b in args.batches.split(",")]:
-        tr = build(batch)
+        tr = build(batch, nlayer=args.nlayer)
         seq = tr.net.node_shapes[0][2]
         toks, lens = prompts(batch, seq)
         # compile warmup + device-resident runners per (layout, max_new)
@@ -136,7 +140,7 @@ def main():
             step_ms = (t_long - t_short) / (MAX_NEW - SHORT_NEW)
             row = {
                 "batch": batch, "layout": lay, "prompt": PROMPT,
-                "max_new": MAX_NEW,
+                "max_new": MAX_NEW, "nlayer": args.nlayer,
                 "total_ms_best": round(t_long, 2),
                 "prefill_plus8_ms_best": round(t_short, 2),
                 "decode_step_ms": round(step_ms, 3),
