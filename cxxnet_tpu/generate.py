@@ -18,7 +18,9 @@ between B*S prefill tokens and B decode tokens, so recipes that rely
 on dropping see the usual train/serve MoE gap). No reference analogue
 (cxxnet has no sequence models, SURVEY.md §5).
 
-Cache layouts (``decode_layout`` trainer knob, default "slot"):
+Cache layouts (``decode_layout`` trainer knob; ``auto`` resolves to
+``slotk`` on TPU at B >= 16 where the fused kernel measured +27-54%,
+``slot`` otherwise):
 
 * ``slot`` — the r5 layout. The cache has ``P + max_new`` key slots
   (``P`` = max prompt length rounded up, a static shape): prefill K/V
@@ -32,6 +34,12 @@ Cache layouts (``decode_layout`` trainer knob, default "slot"):
   the ``fori_loop`` carry — the classic XLA in-place-update pattern —
   where the old scan-over-layers stacked its cache outputs and
   therefore re-wrote every byte of cache every step.
+* ``slotk`` — the ``slot`` cache with the attend routed through the
+  fused Pallas decode-attend kernel (``ops/decode_attend.py``): one
+  streaming pass over K+V per (batch-group, head), measured
+  1.596 vs 2.026 ms/step at B=32 and 3.056 vs 4.701 at B=64 against
+  the XLA attend (docs/performance.md r5); loses ~6% at B=8 to the
+  kernel's fixed cost, hence the auto gate.
 * ``slott`` — ``slot`` with the per-layer caches transposed to
   (B, nh, d, Sl); measured equal to ``slot`` (a recorded negative
   result on the lane-tile-padding hypothesis — see
