@@ -178,6 +178,16 @@ class LearnTask:
         "profile", "profile_dir", "profile_start_batch",
         "profile_stop_batch",
     ])
+    # keys consumed only by a specific task's run() — claimed for the
+    # audit ONLY when that task is active, so a stray 'temperature='
+    # in a training config still trips strict=1
+    TASK_KEYS = {
+        "generate": frozenset(["prompts", "gen_out", "max_new",
+                               "temperature", "gen_seed"]),
+        "export_reference": frozenset(["ref_out"]),
+        "export_model": frozenset(["export_out", "export_batch",
+                                   "export_platform"]),
+    }
 
     def _iter_section_keys(self) -> set:
         """Keys appearing inside data/eval/pred iterator sections —
@@ -200,7 +210,8 @@ class LearnTask:
         if self.trainer is None:
             return
         bad = self.trainer.unconsumed_keys(
-            extra_known=self.CLI_KEYS | self._iter_section_keys())
+            extra_known=self.CLI_KEYS | self._iter_section_keys()
+            | self.TASK_KEYS.get(self.task, frozenset()))
         if not bad:
             return
         msg = ("unconsumed config keys (no component recognized them "

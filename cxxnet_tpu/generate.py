@@ -53,6 +53,15 @@ Cache layouts (``decode_layout`` trainer knob; ``auto`` resolves to
   every step (~1.2 GB at B=32), which is exactly the traffic the slot
   layout deletes.
 
+Orthogonally, ``decode_kv = int8`` (trainer knob; ``kv`` arg of
+``build``) stores the cache as int8 with per-(token, head) absmax
+scales (``_quant8``) on the ``slot``/``slotk`` layouts — half the KV
+bytes for the ~87%-streaming step, double the context per HBM byte —
+with algebraic dequant inside the attend (scales factor out of both
+d-contractions; ``ops/decode_attend.decode_attend_q8`` is the fused
+kernel form). Greedy parity vs the exact path is approximate (~1%
+relative K/V error, 0.9% measured at the gpt2 shape).
+
 The decode math mirrors TransformerStackLayer._block_fn (pre-norm
 rmsnorm / qkv / causal attend / wo / relu-MLP residuals) on a single
 query position; tests pin exact greedy agreement with the full-forward
@@ -136,6 +145,21 @@ def _rmsnorm(x, g, dt):
             ).astype(dt) * g.astype(dt)
 
 
+def _quant8(x):
+    """Per-vector int8 absmax quantization over the last axis:
+    (..., d) -> (int8 (..., d), f32 scale (...,)). The decode step is
+    ~87% KV streaming (docs/performance.md r5), so halving the cache's
+    bytes halves what the step must move; per-(token, head) scales
+    keep the dequant algebraic (they factor out of the d-contractions
+    in both attend dots)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) * (1.0 / 127.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def prompt_slots(max_len: int, seq_len: int) -> int:
     """Static prompt-region size P for the slot layout: ``lens.max()``
     rounded up to 64 (one compile per 64-token bucket, not per prompt
@@ -145,7 +169,7 @@ def prompt_slots(max_len: int, seq_len: int) -> int:
 
 def build(net, p, max_new: int, temperature: float, B: int, S: int,
           P: Optional[int] = None, layout: str = "slot",
-          platform: str = "cpu"):
+          platform: str = "cpu", kv: str = "native"):
     """Build the jitted (params, tokens, lens, rng) -> tokens decoder.
 
     ``P`` (slot/slott layouts) is the static prompt-region slot count —
@@ -155,7 +179,23 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
     shape supports it, exact XLA attend elsewhere) — on the r5
     measurement the dense O(S^2) f32 prefill was ~7x the whole decode
     phase at B=32.
+
+    ``kv`` picks the cache storage dtype: ``native`` stores the
+    compute dtype (bf16 on TPU); ``int8`` stores per-(token, head)
+    absmax-quantized K/V plus f32 scales (``_quant8``) — halving the
+    KV bytes the ~87%-streaming decode step moves — and dequantizes
+    algebraically inside the attend (scales factor out of both
+    d-contractions). int8 is supported on the ``slot`` (XLA attend)
+    and ``slotk`` (fused kernel, ``decode_attend_q8``) layouts;
+    greedy parity vs the exact path is approximate by construction
+    (~1% relative K/V error), tested on a trained net.
     """
+    if kv not in ("native", "int8"):
+        raise ValueError("kv must be 'native' or 'int8', got %r" % kv)
+    if kv == "int8" and layout not in ("slot", "slotk"):
+        raise ValueError(
+            "decode_kv=int8 requires decode_layout slot or slotk "
+            "(got %s)" % layout)
     from .ops import flash_attention as fa
     emb = net.modules[p["embed"]]
     stacks = [net.modules[i] for i in p["stacks"]]
@@ -379,12 +419,55 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
             # ``keep``, so it is built once and shared by every layer
             from .ops import decode_attend as da
             bias = jnp.where(keep, 0.0, NEG).astype(jnp.float32)
-        for li, (k_c, v_c) in enumerate(cache):
+        for li, cache_li in enumerate(cache):
             layer_p = {kk: vv[li] for kk, vv in lp.items()}
             x = _rmsnorm(hh, layer_p["norm1"], dt)
             qkv = jnp.dot(x, layer_p["wqkv"].T.astype(dt))
             qkv = qkv.reshape(B, 3, nh, d)
             q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            if kv == "int8":
+                # quantized cache: int8 K/V + per-(row, head, slot)
+                # f32 scales; the new token's heads are quantized the
+                # same way the prefill quantized the prompt's
+                k_q, v_q, k_s, v_s = cache_li
+                kq_new, ks_new = _quant8(k_new)
+                vq_new, vs_new = _quant8(v_new)
+                k_q = jax.lax.dynamic_update_slice(
+                    k_q, kq_new[:, :, None, :], (0, 0, slot, 0))
+                v_q = jax.lax.dynamic_update_slice(
+                    v_q, vq_new[:, :, None, :], (0, 0, slot, 0))
+                k_s = jax.lax.dynamic_update_slice(
+                    k_s, ks_new[:, :, None], (0, 0, slot))
+                v_s = jax.lax.dynamic_update_slice(
+                    v_s, vs_new[:, :, None], (0, 0, slot))
+                if layout == "slotk":
+                    out = da.decode_attend_q8(
+                        q, k_q, v_q, k_s, v_s, bias,
+                        interpret=platform != "tpu")
+                else:
+                    # XLA attend on the quantized cache — a recorded
+                    # NEGATIVE (docs/decode_lab_r5.json int8_campaign):
+                    # XLA materializes the dequantized operands instead
+                    # of keeping the convert in registers, so this path
+                    # measures SLOWER than bf16 at B=32 (2.136 vs
+                    # 2.026 ms). Kept for CPU tests and as the recorded
+                    # mechanism for why the win needs the fused kernel
+                    scores = jnp.einsum(
+                        "bhd,bhkd->bhk", q, k_q.astype(dt),
+                        preferred_element_type=jnp.float32) \
+                        * (d ** -0.5) * k_s
+                    att = jax.nn.softmax(
+                        jnp.where(keep[:, None, :], scores, NEG), -1)
+                    out = jnp.einsum("bhk,bhkd->bhd",
+                                     (att * v_s).astype(dt),
+                                     v_q.astype(dt))
+                out = out.reshape(B, e)
+                hh = hh + jnp.dot(out, layer_p["wo"].T.astype(dt))
+                x = _rmsnorm(hh, layer_p["norm2"], dt)
+                hh = hh + mlp_at(st, layer_p, x)
+                out_cache.append((k_q, v_q, k_s, v_s))
+                continue
+            k_c, v_c = cache_li
             if layout == "slott":
                 upd = (0, 0, 0, slot)
                 kx, vx = k_new[..., None], v_new[..., None]
@@ -428,6 +511,20 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
             # [P, Sl) zero for the decode steps to fill
             per = []
             for li in range(ks.shape[0]):
+                if kv == "int8":
+                    # quantize the prompt region, pad decode slots with
+                    # zeros (K/V) and ones (scales — a zero scale would
+                    # be fine numerically since q=0 contributes nothing,
+                    # but 1.0 keeps the buffer trivially safe to read)
+                    kq, ks_s = _quant8(ks[li, :, :, :P])
+                    vq, vs_s = _quant8(vs[li, :, :, :P])
+                    pad4 = ((0, 0), (0, 0), (0, Sl - P), (0, 0))
+                    pad3 = ((0, 0), (0, 0), (0, Sl - P))
+                    per.append((
+                        jnp.pad(kq, pad4), jnp.pad(vq, pad4),
+                        jnp.pad(ks_s, pad3, constant_values=1.0),
+                        jnp.pad(vs_s, pad3, constant_values=1.0)))
+                    continue
                 if layout == "slott":
                     # (B, nh, S, d) -> (B, nh, d, Sl): Sl minor
                     pad = ((0, 0), (0, 0), (0, 0), (0, Sl - P))

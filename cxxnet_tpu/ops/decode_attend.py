@@ -32,7 +32,8 @@ def _interpret() -> bool:
     return pallas_env.interpret()
 
 
-def _pick_rows(B, nh, Sl, d, itemsize, budget=5 * 1024 * 1024):
+def _pick_rows(B, nh, Sl, d, itemsize, budget=5 * 1024 * 1024,
+               scale_bytes_per_slot=0):
     """Batch rows per grid step: largest divisor of B whose K+V block
     (double-buffered, in the cache's actual dtype) fits the budget.
     Raises when even one row cannot fit — callers chose this kernel
@@ -40,8 +41,9 @@ def _pick_rows(B, nh, Sl, d, itemsize, budget=5 * 1024 * 1024):
     The 5 MB default is deliberately conservative: with 12 kernel
     instances inside the decode fori_loop body, larger groups pushed
     the program past the scoped limit (and crashed the compile helper
-    rather than erroring cleanly)."""
-    per_row = 2 * (2 * nh * Sl * d * itemsize)   # K+V, x2 pipeline
+    rather than erroring cleanly). ``scale_bytes_per_slot`` adds the
+    quantized path's per-(head, slot) scale buffers to the estimate."""
+    per_row = 2 * (2 * nh * Sl * (d * itemsize + scale_bytes_per_slot))
     if per_row > budget:
         raise ValueError(
             "decode_attend: one row's K+V block (%d bytes at Sl=%d, "
@@ -89,6 +91,36 @@ def _kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *, scale):
         o_ref[:, h] = out[:, 0].astype(o_ref.dtype)
 
 
+def _kernel_q8(q_ref, k_ref, v_ref, ks_ref, vs_ref, b_ref, o_ref, *,
+               scale):
+    # int8 K/V with per-(row, head, slot) absmax scales. The scales
+    # factor OUT of both contractions (they are per-slot, the dots
+    # contract over d), so the dot shapes are identical to the bf16
+    # kernel — K's scale multiplies the scores row, V's scale folds
+    # into the softmax weights before PV. Only the streamed K/V bytes
+    # change (2 -> 1 per element); the int8 -> bf16 convert happens in
+    # VMEM after the DMA, which is the entire point.
+    bias = b_ref[...][:, 0, :]               # (gb, 1, Sl) -> (gb, Sl)
+    nh = q_ref.shape[1]
+    for h in range(nh):
+        q3 = (q_ref[:, h] * scale).astype(jnp.bfloat16)[:, None, :]
+        k_h = k_ref[:, h].astype(jnp.bfloat16)            # (gb, Sl, d)
+        v_h = v_ref[:, h].astype(jnp.bfloat16)
+        scores = lax.dot_general(
+            q3, k_h, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # (gb, 1, Sl)
+        scores = scores * ks_ref[:, h][:, None, :] + bias[:, None, :]
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        p = jnp.exp(scores - m)
+        l = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
+        pw = (p / l) * vs_ref[:, h][:, None, :]           # fold V scale
+        out = lax.dot_general(
+            pw.astype(jnp.bfloat16), v_h,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # (gb, 1, d)
+        o_ref[:, h] = out[:, 0].astype(o_ref.dtype)
+
+
 def decode_attend(q, k_c, v_c, bias, scale=None, interpret=None):
     """q (B, nh, d) x cache (B, nh, Sl, d) -> (B, nh, d).
 
@@ -119,3 +151,38 @@ def decode_attend(q, k_c, v_c, bias, scale=None, interpret=None):
         out_shape=jax.ShapeDtypeStruct((B, nh, d), q.dtype),
         interpret=bool(interpret),
     )(q, k_c, v_c, bias[:, None, :])
+
+
+def decode_attend_q8(q, k_q, v_q, k_s, v_s, bias, scale=None,
+                     interpret=None):
+    """q (B, nh, d) x int8 cache (B, nh, Sl, d) with per-(row, head,
+    slot) f32 absmax scales (B, nh, Sl) -> (B, nh, d).
+
+    Same contract as ``decode_attend`` on a quantized cache: the
+    decode step is ~87% KV streaming, so storing K/V as int8 halves
+    the bytes the step moves (scales add ~3% back at d=64). Dequant
+    is algebraic — per-slot scales factor out of both d-contractions —
+    so the kernel's dot shapes match the bf16 one exactly."""
+    if interpret is None:
+        interpret = _interpret()
+    B, nh, d = q.shape
+    Sl = k_q.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+    gb = _pick_rows(B, nh, Sl, d, 1,
+                    scale_bytes_per_slot=jnp.dtype(k_s.dtype).itemsize)
+    return pl.pallas_call(
+        functools.partial(_kernel_q8, scale=scale),
+        grid=(B // gb,),
+        in_specs=[
+            pl.BlockSpec((gb, nh, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((gb, nh, Sl, d), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((gb, nh, Sl, d), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((gb, nh, Sl), lambda i: (i, 0, 0)),
+            pl.BlockSpec((gb, nh, Sl), lambda i: (i, 0, 0)),
+            pl.BlockSpec((gb, 1, Sl), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((gb, nh, d), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, nh, d), q.dtype),
+        interpret=bool(interpret),
+    )(q, k_q, v_q, k_s, v_s, bias[:, None, :])

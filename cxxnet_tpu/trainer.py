@@ -167,6 +167,7 @@ class Trainer:
         self._eval_gs = None
         self._gen_cache: Dict = {}
         self.decode_layout = "auto"
+        self.decode_kv = "native"
 
     # keys the trainer itself consumes (set_param branches below plus
     # ones read from self.cfg later: dist_*, updater routing); the
@@ -178,6 +179,7 @@ class Trainer:
         "model_parallel", "seq_parallel", "pipeline_parallel", "zero",
         "test_on_server", "nan_guard", "save_async", "save_sharded",
         "strict", "metric", "updater", "sync", "decode_layout",
+        "decode_kv",
         "dist_coordinator", "dist_num_worker", "dist_worker_rank",
     ])
     # structural keys NetConfig.configure consumes (graph.py)
@@ -240,6 +242,10 @@ class Trainer:
                 raise ValueError("decode_layout must be "
                                  "auto|slot|slott|slotk|blend")
             self.decode_layout = val
+        elif name == "decode_kv":
+            if val not in ("native", "int8"):
+                raise ValueError("decode_kv must be native|int8")
+            self.decode_kv = val
         if name.startswith("metric"):
             import re
             m = re.match(r"metric\[([^,\]]+),([^\]]+)\]", name)
@@ -1178,6 +1184,11 @@ class Trainer:
             from . import generate as G
             P = G.prompt_slots(int(lens.max()) if nrow else 1, S)
         layout = getattr(self, "decode_layout", "auto")
+        kv = getattr(self, "decode_kv", "native")
+        if kv == "int8" and layout in ("slott", "blend"):
+            raise ValueError(
+                "decode_kv=int8 requires decode_layout auto|slot|slotk"
+                " (got %s)" % layout)
         if layout == "auto":
             # slotk (the fused Pallas decode-attend) on TPU when the
             # kernel's VMEM row budget fits; the plain slot layout
@@ -1195,7 +1206,9 @@ class Trainer:
                     da._pick_rows(
                         B, st0.nhead, P + int(max_new),
                         e // st0.nhead,
-                        jnp.dtype(self.net.compute_dtype).itemsize)
+                        1 if kv == "int8" else
+                        jnp.dtype(self.net.compute_dtype).itemsize,
+                        scale_bytes_per_slot=4 if kv == "int8" else 0)
                     layout = "slotk"
                 except ValueError:
                     # the intended over-budget fallback; anything else
@@ -1203,7 +1216,7 @@ class Trainer:
                     # slower path
                     pass
         key = (int(max_new), float(temperature), kv_plan is not None,
-               layout, P)
+               layout, P, kv)
         fn = self._gen_cache.get(key)
         if fn is None and kv_plan is not None:
             for si in kv_plan["stacks"]:
@@ -1221,7 +1234,8 @@ class Trainer:
                         % (st.capacity_factor, st.nexpert / st.topk))
             fn = G.build(self.net, kv_plan, int(max_new),
                          float(temperature), B, S, P=P, layout=layout,
-                         platform=getattr(self.net, "platform", "cpu"))
+                         platform=getattr(self.net, "platform", "cpu"),
+                         kv=kv)
             self._gen_cache[key] = fn
         if fn is None:
             if use_cache != "never":

@@ -112,9 +112,13 @@ save_model = 1
         assert main([str(conf), "silent=1"]) == 0
     (tmp_path / "p.txt").write_text("1 2 3\n7\n")
     with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+        # strict=1 pins that the generate task's own keys (prompts,
+        # gen_out, max_new, ...) are declared consumed — the
+        # unconsumed-key audit once rejected them (found by an e2e
+        # drive in r5)
         rc = main([str(conf), "task=generate", "model_in=models/0001.model",
                    "prompts=p.txt", "gen_out=g.txt", "max_new=4",
-                   "silent=1"])
+                   "silent=1", "strict=1"])
     assert rc == 0
     lines = (tmp_path / "g.txt").read_text().strip().splitlines()
     assert len(lines) == 2

@@ -74,6 +74,31 @@ warmup_epochs = 100
         app.run([str(conf)])
 
 
+def test_task_keys_not_claimed_for_training(tmp_path):
+    """Generate-task keys (temperature, max_new, ...) are claimed for
+    the audit ONLY under task=generate — a stray 'temperature=' in a
+    TRAINING config is exactly the silently-no-op'd class of bug the
+    audit exists to catch."""
+    conf = tmp_path / "stray.conf"
+    conf.write_text(models.mnist_mlp() + """
+data = train
+iter = synth
+  shape = 1,1,784
+  nclass = 10
+  ninst = 32
+iter = end
+batch_size = 8
+dev = cpu
+eta = 0.1
+num_round = 1
+strict = 1
+temperature = 0.7
+""")
+    app = LearnTask()
+    with pytest.raises(ValueError, match="temperature"):
+        app.run([str(conf)])
+
+
 def test_cli_warns_not_fatal(tmp_path, capfd):
     conf = tmp_path / "warn.conf"
     conf.write_text(models.mnist_mlp() + """

@@ -1,0 +1,119 @@
+"""Quiet-window capture daemon (round 5).
+
+The shared tunnel in front of the chip (BASELINE.md) swings the
+per-dispatch floor from ~3.5 ms (quiet) to 50-100 ms (contended), and
+the two headline reproductions the ledger still wants — AlexNet at the
+r3-best 16.2k img/s / 34.0% and ViT at the projected ~3,000 img/s —
+are only measurable in the quiet class.  Rather than hand-poll, this
+daemon probes the dispatch floor on a period, logs the series to
+``docs/floor_series_r5.json`` (the honest record of the weather), and
+when the floor drops under the quiet threshold it fires the real
+captures:
+
+* ``python bench.py`` — the AlexNet headline protocol; appends its
+  window to docs/bench_history.json with floor + commit stamps.
+* ``python tools/perf_lab.py zoo --net vit_s16 gpt2_small --ledger``
+  — the interleaved zoo rows, ledger-recorded.
+
+Every capture is throttled (at most one per ``--capture-cooldown``
+seconds) so a long quiet stretch doesn't spam the ledger, and the
+daemon exits after ``--max-hours`` so it cannot outlive the session
+and contend with the driver's own round-end bench run.
+
+Usage:  python tools/capture_daemon.py --period 1200 --max-hours 10
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERIES = os.path.join(REPO, "docs", "floor_series_r5.json")
+
+
+def _probe_floor() -> float:
+    """Measure the dispatch floor in a subprocess so each probe sees a
+    fresh runtime (a wedged tunnel connection in a long-lived process
+    would poison every later reading)."""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import bench; print(bench._measure_dispatch_floor_ms())"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            return float(line)
+        except ValueError:
+            continue
+    raise RuntimeError(f"floor probe failed: {out.stderr[-500:]}")
+
+
+def _append_series(entry: dict) -> None:
+    series = []
+    if os.path.exists(SERIES):
+        with open(SERIES) as f:
+            series = json.load(f)
+    series.append(entry)
+    tmp = SERIES + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(series, f, indent=1)
+    os.replace(tmp, SERIES)
+
+
+def _capture(log) -> None:
+    for cmd in (
+        [sys.executable, "bench.py"],
+        [sys.executable, "tools/perf_lab.py", "zoo",
+         "--net", "vit_s16", "gpt2_small", "--ledger", "--fuse", "8"],
+    ):
+        log(f"capture: {' '.join(cmd[1:])}")
+        r = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                           text=True, timeout=2400)
+        tail = (r.stdout.strip().splitlines() or ["<no output>"])[-1]
+        log(f"  -> rc={r.returncode} {tail[:300]}")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--period", type=float, default=1200.0,
+                   help="seconds between floor probes")
+    p.add_argument("--quiet-ms", type=float, default=6.0,
+                   help="floor below this triggers a capture")
+    p.add_argument("--capture-cooldown", type=float, default=3600.0,
+                   help="min seconds between captures")
+    p.add_argument("--max-hours", type=float, default=10.0)
+    args = p.parse_args()
+
+    def log(msg: str) -> None:
+        print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+    deadline = time.time() + args.max_hours * 3600
+    last_capture = 0.0
+    while time.time() < deadline:
+        try:
+            floor = _probe_floor()
+        except Exception as e:  # tunnel drop: log and keep probing
+            log(f"probe error: {e}")
+            time.sleep(min(args.period, 300))
+            continue
+        quiet = floor < args.quiet_ms
+        _append_series({"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                        "floor_ms": round(floor, 3), "quiet": quiet})
+        log(f"floor {floor:.2f} ms{' QUIET' if quiet else ''}")
+        if quiet and time.time() - last_capture > args.capture_cooldown:
+            try:
+                _capture(log)
+                last_capture = time.time()
+            except Exception as e:
+                log(f"capture error: {e}")
+        # near-quiet: probe faster so a closing window isn't missed
+        time.sleep(args.period if floor > 2 * args.quiet_ms
+                   else args.period / 4)
+    log("deadline reached, exiting")
+
+
+if __name__ == "__main__":
+    main()

@@ -89,8 +89,10 @@ def resident_fn(tr, toks, lens, max_new):
     import jax.numpy as jnp
     tr.generate(toks, lens, max_new, temperature=0.0)      # compile
     layout = tr.decode_layout if tr.decode_layout != "auto" else "slot"
+    kv = getattr(tr, "decode_kv", "native")
     (key, fn), = [(k, v) for k, v in tr._gen_cache.items()
-                  if k[0] == max_new and k[3] == layout]
+                  if k[0] == max_new and k[3] == layout
+                  and (len(k) < 6 or k[5] == kv)]
     toks_d = jax.device_put(jnp.asarray(toks, jnp.int32))
     lens_d = jax.device_put(jnp.asarray(lens))
     rng_d = jax.device_put(jax.random.PRNGKey(0))
@@ -123,12 +125,17 @@ def main():
         tr = build(batch, nlayer=args.nlayer)
         seq = tr.net.node_shapes[0][2]
         toks, lens = prompts(batch, seq)
-        # compile warmup + device-resident runners per (layout, max_new)
+        # compile warmup + device-resident runners per (layout, max_new);
+        # a ":int8" suffix on a layout name (e.g. "slotk:int8") selects
+        # the quantized KV cache for that variant
         runners = {}
         for lay in layouts:
-            tr.set_param("decode_layout", lay)
+            base, _, kv = lay.partition(":")
+            tr.set_param("decode_layout", base)
+            tr.set_param("decode_kv", kv or "native")
             for mn in (MAX_NEW, SHORT_NEW):
                 runners[(lay, mn)] = resident_fn(tr, toks, lens, mn)
+        tr.set_param("decode_kv", "native")
         best = {k: float("inf") for k in runners}
         for t in range(args.trials):
             for k, run in runners.items():
