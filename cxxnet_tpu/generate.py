@@ -240,8 +240,8 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
                          st.nexpert, st.capacity_factor, dt)
         return y.reshape(shape)
 
-    def stack_prefill(st, lp, h):
-        """Full-sequence pass that ALSO returns per-layer K/V.
+    def stack_prefill(st, lp, h, sl=S):
+        """Prompt-wide pass that ALSO returns per-layer K/V.
 
         Mirrors _block_fn's dense block, UNROLLED over depth (the
         training recipe's own finding: full unroll beats the scan's
@@ -250,17 +250,25 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
         kernel when the shape supports it, generic flash otherwise,
         exact XLA attend off-TPU. When the flat kernel runs, K/V for
         the cache are sliced from the flat projection (one relayout
-        per layer instead of the attend's three)."""
+        per layer instead of the attend's three).
+
+        ``sl`` is the sequence width of ``h``: the slot layouts run
+        prefill on just the P prompt slots instead of the net's full
+        seq_len (only [0, P) ever enters the cache, and rows past a
+        prompt's ``lens`` are masked out of attention either way) —
+        at P = S/2 that halves the prefill matmul FLOPs and quarters
+        the attend. ``blend`` passes the full S (its cache is indexed
+        by absolute position)."""
         nh = st.nhead
         d = e // nh
 
-        impl = fa.resolve_impl(st.attn_impl, platform, S)
+        impl = fa.resolve_impl(st.attn_impl, platform, sl)
         # honor the stack's attn_flat=off escape hatch exactly like
         # the training dispatch (layers._block_fn) does
         flat = impl == "pallas" \
             and getattr(st, "attn_flat", "auto") != "off" and bool(
-                fa.supports_flat(S, nh, d)
-                or fa.flat_blocked_plan(S, nh, d))
+                fa.supports_flat(sl, nh, d)
+                or fa.flat_blocked_plan(sl, nh, d))
         interp = platform != "tpu"
         L = lp["wqkv"].shape[0]
         ks, vs = [], []
@@ -272,12 +280,12 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
             if flat:
                 out4 = fa.flash_attention_flat(qkv, nh, causal=True,
                                                interpret=interp)
-                kv4 = qkv.reshape(B, S, 3, nh, d)
+                kv4 = qkv.reshape(B, sl, 3, nh, d)
                 k = kv4[:, :, 1].transpose(0, 2, 1, 3)
                 v = kv4[:, :, 2].transpose(0, 2, 1, 3)
                 out = out4
             else:
-                qkv4 = qkv.reshape(B, S, 3, nh, d).transpose(
+                qkv4 = qkv.reshape(B, sl, 3, nh, d).transpose(
                     2, 0, 3, 1, 4)
                 q, k, v = qkv4[0], qkv4[1], qkv4[2]
                 if impl == "pallas":
@@ -290,19 +298,19 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
                         "bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) \
                         * (d ** -0.5)
-                    mask = jnp.tril(jnp.ones((S, S), bool))
+                    mask = jnp.tril(jnp.ones((sl, sl), bool))
                     att = jax.nn.softmax(
                         jnp.where(mask, scores, NEG), -1)
                     out = jnp.einsum("bhqk,bhkd->bhqd",
                                      att.astype(dt), v)
-                out = out.transpose(0, 2, 1, 3).reshape(B, S, e)
+                out = out.transpose(0, 2, 1, 3).reshape(B, sl, e)
             h = h + jnp.einsum("bse,fe->bsf", out,
                                layer_p["wo"].astype(dt))
             x = _rmsnorm(h, layer_p["norm2"], dt)
             h = h + mlp_at(st, layer_p, x)
             ks.append(k)
             vs.append(v)
-        return h, jnp.stack(ks), jnp.stack(vs)  # (L, B, nh, S, d)
+        return h, jnp.stack(ks), jnp.stack(vs)  # (L, B, nh, sl, d)
 
     # ------------------------------------------------------ blend (r4)
     def stack_decode_blend(st, lp, h, ks, vs, pos):
@@ -351,11 +359,12 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
         rng, k = jax.random.split(rng)
         return jax.random.categorical(k, logits / temperature), rng
 
-    def prefill_h(params, toks):
+    def prefill_h(params, toks, width=S):
         lp0 = params[p["embed"]]
-        h = jnp.take(lp0["wmat"], toks, axis=0).astype(dt)   # (B, S, e)
+        h = jnp.take(lp0["wmat"], toks[:, :width],
+                     axis=0).astype(dt)                # (B, width, e)
         if emb.learn_pos:
-            h = h + lp0["pos"].astype(dt)[None]
+            h = h + lp0["pos"][:width].astype(dt)[None]
         return h
 
     def gen_blend(params, toks, lens, rng):
@@ -461,54 +470,58 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
                     out = jnp.einsum("bhk,bhkd->bhd",
                                      (att * v_s).astype(dt),
                                      v_q.astype(dt))
-                out = out.reshape(B, e)
-                hh = hh + jnp.dot(out, layer_p["wo"].T.astype(dt))
-                x = _rmsnorm(hh, layer_p["norm2"], dt)
-                hh = hh + mlp_at(st, layer_p, x)
-                out_cache.append((k_q, v_q, k_s, v_s))
-                continue
-            k_c, v_c = cache_li
-            if layout == "slott":
-                upd = (0, 0, 0, slot)
-                kx, vx = k_new[..., None], v_new[..., None]
-                spec_qk, spec_av = "bhd,bhdk->bhk", "bhk,bhdk->bhd"
+                new_cache = (k_q, v_q, k_s, v_s)
             else:
-                upd = (0, 0, slot, 0)
-                kx, vx = k_new[:, :, None, :], v_new[:, :, None, :]
-                spec_qk, spec_av = "bhd,bhkd->bhk", "bhk,bhkd->bhd"
-            k_c = jax.lax.dynamic_update_slice(
-                k_c, kx.astype(k_c.dtype), upd)
-            v_c = jax.lax.dynamic_update_slice(
-                v_c, vx.astype(v_c.dtype), upd)
-            if layout == "slotk":
-                # fused Pallas attend: one streaming pass over K+V per
-                # (batch-group, head) — the XLA batched-matvec lowering
-                # reads the cache at ~31% of HBM rate (measured r5,
-                # ops/decode_attend.py)
-                out = da.decode_attend(q, k_c, v_c, bias,
-                                       interpret=platform != "tpu")
-            else:
-                scores = jnp.einsum(
-                    spec_qk, q, k_c,
-                    preferred_element_type=jnp.float32) * (d ** -0.5)
-                att = jax.nn.softmax(
-                    jnp.where(keep[:, None, :], scores, NEG), -1)
-                out = jnp.einsum(spec_av, att.astype(dt), v_c)
+                k_c, v_c = cache_li
+                if layout == "slott":
+                    upd = (0, 0, 0, slot)
+                    kx, vx = k_new[..., None], v_new[..., None]
+                    spec_qk = "bhd,bhdk->bhk"
+                    spec_av = "bhk,bhdk->bhd"
+                else:
+                    upd = (0, 0, slot, 0)
+                    kx = k_new[:, :, None, :]
+                    vx = v_new[:, :, None, :]
+                    spec_qk = "bhd,bhkd->bhk"
+                    spec_av = "bhk,bhkd->bhd"
+                k_c = jax.lax.dynamic_update_slice(
+                    k_c, kx.astype(k_c.dtype), upd)
+                v_c = jax.lax.dynamic_update_slice(
+                    v_c, vx.astype(v_c.dtype), upd)
+                if layout == "slotk":
+                    # fused Pallas attend: one streaming pass over K+V
+                    # per (batch-group, head) — the XLA batched-matvec
+                    # lowering reads the cache at ~31% of HBM rate
+                    # (measured r5, ops/decode_attend.py)
+                    out = da.decode_attend(q, k_c, v_c, bias,
+                                           interpret=platform != "tpu")
+                else:
+                    scores = jnp.einsum(
+                        spec_qk, q, k_c,
+                        preferred_element_type=jnp.float32) \
+                        * (d ** -0.5)
+                    att = jax.nn.softmax(
+                        jnp.where(keep[:, None, :], scores, NEG), -1)
+                    out = jnp.einsum(spec_av, att.astype(dt), v_c)
+                new_cache = (k_c, v_c)
+            # shared per-layer epilogue: wo projection + MLP residual
             out = out.reshape(B, e)
             hh = hh + jnp.dot(out, layer_p["wo"].T.astype(dt))
             x = _rmsnorm(hh, layer_p["norm2"], dt)
             hh = hh + mlp_at(st, layer_p, x)
-            out_cache.append((k_c, v_c))
+            out_cache.append(new_cache)
         return hh, tuple(out_cache)
 
     def gen_slot(params, toks, lens, rng):
-        # ---- prefill: one full causal forward building the caches ----
-        h = prefill_h(params, toks)
+        # ---- prefill: one causal forward over just the P prompt
+        # slots (not the net's full seq_len) building the caches ----
+        h = prefill_h(params, toks, P)
         caches = []
         for si, st in zip(p["stacks"], stacks):
-            h, ks, vs = stack_prefill(st, params[si], h)
-            # unstack to per-layer buffers; keep slots [0, P) and leave
-            # [P, Sl) zero for the decode steps to fill
+            # prefill ran at width P, so ks/vs are (L, B, nh, P, d):
+            # unstack to per-layer buffers occupying slots [0, P) and
+            # pad [P, Sl) for the decode steps to fill
+            h, ks, vs = stack_prefill(st, params[si], h, P)
             per = []
             for li in range(ks.shape[0]):
                 if kv == "int8":
@@ -516,8 +529,8 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
                     # zeros (K/V) and ones (scales — a zero scale would
                     # be fine numerically since q=0 contributes nothing,
                     # but 1.0 keeps the buffer trivially safe to read)
-                    kq, ks_s = _quant8(ks[li, :, :, :P])
-                    vq, vs_s = _quant8(vs[li, :, :, :P])
+                    kq, ks_s = _quant8(ks[li])
+                    vq, vs_s = _quant8(vs[li])
                     pad4 = ((0, 0), (0, 0), (0, Sl - P), (0, 0))
                     pad3 = ((0, 0), (0, 0), (0, Sl - P))
                     per.append((
@@ -526,17 +539,15 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
                         jnp.pad(vs_s, pad3, constant_values=1.0)))
                     continue
                 if layout == "slott":
-                    # (B, nh, S, d) -> (B, nh, d, Sl): Sl minor
+                    # (B, nh, P, d) -> (B, nh, d, Sl): Sl minor
                     pad = ((0, 0), (0, 0), (0, 0), (0, Sl - P))
                     per.append((
-                        jnp.pad(ks[li, :, :, :P].transpose(0, 1, 3, 2),
-                                pad),
-                        jnp.pad(vs[li, :, :, :P].transpose(0, 1, 3, 2),
-                                pad)))
+                        jnp.pad(ks[li].transpose(0, 1, 3, 2), pad),
+                        jnp.pad(vs[li].transpose(0, 1, 3, 2), pad)))
                 else:
                     pad = ((0, 0), (0, 0), (0, Sl - P), (0, 0))
-                    per.append((jnp.pad(ks[li, :, :, :P], pad),
-                                jnp.pad(vs[li, :, :, :P], pad)))
+                    per.append((jnp.pad(ks[li], pad),
+                                jnp.pad(vs[li], pad)))
             caches.append(tuple(per))
         last = jnp.take_along_axis(
             h, (lens - 1)[:, None, None], axis=1)[:, 0]      # (B, e)

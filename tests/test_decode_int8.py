@@ -143,6 +143,32 @@ def test_generate_int8_matches_full_forward(layout):
         np.testing.assert_array_equal(out[i, :len(p)], p)
 
 
+def test_int8_covers_moe_stack():
+    """decode_kv=int8 composes with the MoE decode route (the routed
+    MLP is per-token math, untouched by cache quantization)."""
+    tr = Trainer()
+    for k, v in config.parse_string(models.tiny_lm(
+            seq_len=SEQ, vocab=VOCAB, embed=32, nlayer=2, nhead=2,
+            nexpert=4, moe_topk=2, capacity_factor=2.0)):
+        tr.set_param(k, v)
+    for k, v in (("batch_size", "8"), ("dev", "cpu:0"), ("eta", "0.3"),
+                 ("seed", "0"), ("metric", "token_error")):
+        tr.set_param(k, v)
+    tr.init_model()
+    _train_cycle(tr, rounds=6)
+    tr.set_param("decode_kv", "int8")
+    toks = np.zeros((3, SEQ), np.int32)
+    prompts = [[3, 4, 5], [10, 11], [0, 1, 2, 3]]
+    lens = np.array([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    out = tr.generate(toks, lens, 8, temperature=0.0)
+    ref = tr.generate(toks, lens, 8, temperature=0.0,
+                      use_cache="never")
+    agree = (np.asarray(out) == np.asarray(ref)).mean()
+    assert agree >= 0.98, (agree, out, ref)
+
+
 def test_decode_kv_rejects_unsupported_layouts():
     tr = _lm()
     with pytest.raises(ValueError):

@@ -176,6 +176,42 @@ def test_decode_layouts_agree():
         np.testing.assert_array_equal(out, ref)
 
 
+def test_slot_prefill_sliced_to_prompt_region():
+    """The slot layouts run prefill over just the P prompt slots, not
+    the net's full seq_len (generate.py stack_prefill ``sl``). With
+    seq_len > 64 the P < S case is real (prompt_slots floors at 64):
+    greedy output must still match the full-forward path exactly."""
+    tr = Trainer()
+    for k, v in config.parse_string(models.tiny_lm(
+            seq_len=80, vocab=VOCAB, embed=32, nlayer=1, nhead=2)):
+        tr.set_param(k, v)
+    for k, v in (("batch_size", "8"), ("dev", "cpu:0"), ("eta", "0.3"),
+                 ("seed", "0"), ("metric", "token_error")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    for _ in range(30):
+        start = rs.randint(0, VOCAB, size=(8, 1))
+        seq = (start + np.arange(81)) % VOCAB
+        tr.update(DataBatch(
+            data=seq[:, :80, None, None].transpose(0, 2, 1, 3)
+            .astype(np.float32).reshape(8, 1, 80, 1),
+            label=seq[:, 1:].astype(np.float32)))
+    toks = np.zeros((3, 80), np.int32)
+    prompts = [[3, 4, 5], [10, 11], [0, 1, 2, 3]]
+    lens = np.array([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    from cxxnet_tpu import generate as G
+    assert G.prompt_slots(int(lens.max()), 80) == 64  # P < S is real
+    for layout in ("slot", "slott"):
+        tr.set_param("decode_layout", layout)
+        out = tr.generate(toks, lens, 8, temperature=0.0)
+        ref = tr.generate(toks, lens, 8, temperature=0.0,
+                          use_cache="never")
+        np.testing.assert_array_equal(out, ref)
+
+
 def test_prompt_slots_buckets():
     from cxxnet_tpu import generate as G
     assert G.prompt_slots(1, 512) == 64      # floor bucket

@@ -104,9 +104,12 @@ def main() -> None:
                         "floor_ms": round(floor, 3), "quiet": quiet})
         log(f"floor {floor:.2f} ms{' QUIET' if quiet else ''}")
         if quiet and time.time() - last_capture > args.capture_cooldown:
+            # start the cooldown even if the capture fails mid-way —
+            # a hung perf_lab run must not re-fire (and re-append
+            # bench rows) every probe cycle
+            last_capture = time.time()
             try:
                 _capture(log)
-                last_capture = time.time()
             except Exception as e:
                 log(f"capture error: {e}")
         # near-quiet: probe faster so a closing window isn't missed
