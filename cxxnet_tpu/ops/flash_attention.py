@@ -128,9 +128,14 @@ def _group_vmem(g, kind, s, d, block_q, block_k):
       (g, bq, d) acc, dq's accumulator, dkv's dk+dv pair).
 
     Calibration anchors (v5e, 16 MB scoped limit): fwd s=2048 g=4
-    allocated 16.8 MB and failed — this estimate gives 15.6 MB,
-    correctly over a 14 MB budget; fwd g=4 and bwd1 g=2 at s=512
-    compiled and ran through r3/r4 — 12.6 MB and 11.8 MB here, kept."""
+    allocated 16.8 MB and failed — this estimate gives 15.5 MB
+    (actual/est 1.08), correctly over a 14 MB budget; fwd g=4 and
+    bwd1 g=2 at s=512 compiled and ran through r3/r4 — 12.5 MB and
+    11.8 MB here, kept; fwd s=8192 g=2 allocated 17.04 MB and failed
+    under remat (r5) against a 13.76 MB estimate (actual/est 1.24).
+    The estimate's error GROWS with s — Mosaic holds per-panel
+    bookkeeping this itemization can't see — so ``_pick_group``
+    applies an s-scaled correction on top (see there)."""
     bq2, bk2 = block_q * d * 2, block_k * d * 2      # bf16 block rows
     sd2 = s * d * 2                                  # bf16 seq panel
     sq4 = block_q * block_k * 4                      # f32 score block
@@ -167,12 +172,24 @@ def _pick_group(bh, kind, s, d, block_q, block_k,
     Mosaic program, g back-to-back MXU issues) amortizes that cost.
     Picks the largest divisor of bh whose itemized _group_vmem estimate
     fits the budget (default 14 MB: a 2 MB margin under the 16 MB
-    scoped limit for Mosaic's own spills, not a 2x fudge)."""
+    scoped limit for Mosaic's own spills, not a 2x fudge).
+
+    The itemized estimate undercounts by a factor that grows with s
+    (the _group_vmem calibration anchors: actual/est ~1.0 at s=512,
+    1.08 at 2048, 1.24 at 8192 — whole-seq panel bookkeeping Mosaic
+    holds per kernel that the per-item sum can't see). The measured
+    growth is well fit by ``1 + s/24576`` (1.02 / 1.083 / 1.33 at the
+    anchors), applied here so long-s shapes de-group instead of
+    failing to compile — the failure mode r5 hit at s=8192 under
+    remat, where the uncorrected picker chose g=2 (est 13.76 MB) and
+    the real allocation was 17.04 MB."""
+    factor = 1.0 + s / 24576.0
     best = 1
     for g in range(2, min(bh, 16) + 1):
         if bh % g:
             continue
-        if _group_vmem(g, kind, s, d, block_q, block_k) <= budget:
+        if _group_vmem(g, kind, s, d, block_q, block_k) * factor \
+                <= budget:
             best = g
     return best
 

@@ -306,6 +306,12 @@ def test_pick_group_itemized_budget():
     # a budget too small for any group degrades to g=1, never errors
     assert fa._pick_group(192, "fwd", 512, 64, 512, 512,
                           budget=1024) == 1
+    # r5 anchor 3: fwd s=8192 g=2 estimated 13.76 MB but allocated
+    # 17.04 MB under remat (actual/est 1.24) — the s-scaled correction
+    # must reject g=2 there while keeping the tuned g=4 at s=512
+    b8 = fa._pick_block(8192)
+    assert fa._pick_group(12, "fwd", 8192, 64, b8, b8) == 1
+    assert fa._pick_group(12, "fwd", 512, 64, 512, 512) == 4
 
 
 def test_stack_flat_blocked_matches_generic_trajectory(monkeypatch):
