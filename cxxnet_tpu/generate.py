@@ -80,7 +80,6 @@ from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from . import layers as L
 from .ops.ring_attention import NEG_INF as NEG

@@ -17,7 +17,7 @@ unchanged, and files written here load in the reference.
 from __future__ import annotations
 
 import os
-from typing import Iterator, List, Optional
+from typing import Iterator, Optional
 
 import numpy as np
 

@@ -16,7 +16,7 @@ Two execution paths with identical math and the identical
 from __future__ import annotations
 
 import re
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 

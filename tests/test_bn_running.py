@@ -6,12 +6,10 @@ standard-ML improvement: EMA running mean/var maintained during training
 as non-trainable state, used at eval, checkpointed with the model.
 """
 import numpy as np
-import pytest
 
-import jax.numpy as jnp
 
 from cxxnet_tpu import config
-from cxxnet_tpu.io import DataBatch, create_iterator
+from cxxnet_tpu.io import DataBatch
 from cxxnet_tpu.trainer import Trainer
 
 CONF = """

@@ -6,7 +6,6 @@ cross host->device at 1 byte/pixel — the TPU-native input path (the
 reference always normalizes on the host, iter_augment_proc-inl.hpp:98-162,
 and ships float32). These tests pin the numerics against the host path.
 """
-import os
 
 import cv2
 import numpy as np

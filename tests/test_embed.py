@@ -1,6 +1,5 @@
 """Embedding layer + token-model path (embed -> transformer_stack)."""
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 

@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from cxxnet_tpu import config, models
-from cxxnet_tpu.io import DataBatch, create_iterator
+from cxxnet_tpu.io import DataBatch
 from cxxnet_tpu.trainer import Trainer
 
 VOCAB, SEQ = 16, 24

@@ -123,7 +123,6 @@ def test_nan_guard_2_recovers_via_cli(tmp_path, monkeypatch):
     newest checkpoint, halves eta, rewinds the round counter, and keeps
     going — consuming max_round budget so a hopeless run still exits."""
     import io as _io
-    import sys
     import contextlib
     from cxxnet_tpu.cli import main
 

@@ -9,7 +9,7 @@ import jax.numpy as jnp
 from cxxnet_tpu import config, models
 from cxxnet_tpu.io import DataBatch, create_iterator
 from cxxnet_tpu.layers import ApplyContext, create_layer
-from cxxnet_tpu.metrics import MetricSet, create_metric
+from cxxnet_tpu.metrics import create_metric
 from cxxnet_tpu.trainer import Trainer
 
 

@@ -7,7 +7,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from cxxnet_tpu import config, models, parallel
+from cxxnet_tpu import config, parallel
 from cxxnet_tpu.io import create_iterator
 from cxxnet_tpu.trainer import Trainer
 

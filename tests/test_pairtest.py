@@ -3,7 +3,6 @@
 The real consumers are alternative implementations of the same op (XLA vs
 Pallas); here the harness itself is validated with identical pairs (must
 agree to 1e-5) and deliberately-different pairs (must be flagged)."""
-import numpy as np
 import pytest
 
 from cxxnet_tpu import config, pairtest

@@ -8,8 +8,6 @@ in-net divergence log checked against the 1e-5 gate.
 """
 
 import jax
-import numpy as np
-import pytest
 
 from cxxnet_tpu import config, pairtest
 from cxxnet_tpu.io import create_iterator

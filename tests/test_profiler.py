@@ -7,7 +7,6 @@ run the real trace path on the CPU backend.
 import glob
 import os
 
-import numpy as np
 
 from cxxnet_tpu.profiler import StepTimer, TraceSession, device_memory_summary
 

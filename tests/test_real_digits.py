@@ -15,7 +15,6 @@ networked box (documented in examples/mnist/README.md).
 import contextlib
 import io as _io
 import re
-import sys
 
 import pytest
 

@@ -5,7 +5,6 @@ metrics improve per round (SURVEY.md §4.4). We reproduce that as pytest
 with the synthetic iterator.
 """
 import numpy as np
-import pytest
 
 from cxxnet_tpu import config
 from cxxnet_tpu.io import create_iterator

@@ -10,7 +10,6 @@ fatal. The reference example configs must stay warning-clean.
 
 import os
 
-import numpy as np
 import pytest
 
 from cxxnet_tpu import config, models

@@ -5,7 +5,7 @@ so attention impls, remat, fuse_steps and sharding apply unchanged."""
 import numpy as np
 
 from cxxnet_tpu import config, models
-from cxxnet_tpu.io import DataBatch, create_iterator
+from cxxnet_tpu.io import DataBatch
 from cxxnet_tpu.trainer import Trainer
 
 

@@ -14,7 +14,6 @@ Usage: python tools/tlab.py <exp> [--iters N] [--trials N]
 import argparse
 import json
 import sys
-import time
 
 import numpy as np
 
