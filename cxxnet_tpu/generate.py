@@ -20,7 +20,8 @@ on dropping see the usual train/serve MoE gap). No reference analogue
 
 Cache layouts (``decode_layout`` trainer knob; ``auto`` resolves to
 ``slotk`` on TPU at B >= 16 where the fused kernel measured +27-54%,
-``slot`` otherwise):
+``slot`` otherwise — the same crossover measured for both cache
+dtypes, see the B=8 table in docs/performance.md):
 
 * ``slot`` — the r5 layout. The cache has ``P + max_new`` key slots
   (``P`` = max prompt length rounded up, a static shape): prefill K/V

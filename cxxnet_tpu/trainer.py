@@ -1194,7 +1194,12 @@ class Trainer:
             # kernel's VMEM row budget fits; the plain slot layout
             # otherwise. Measured crossover (docs/performance.md r5):
             # the kernel's per-program fixed cost loses at B=8 (-6%),
-            # wins +27% at B=32 and +54% at B=64.
+            # wins +27% at B=32 and +54% at B=64. The same B>=16
+            # crossover holds for decode_kv=int8 — measured B=8: the
+            # XLA attend is bandwidth-limited there (not MXU-issue-
+            # bound like B>=32), so int8 helps it directly (15.5k vs
+            # the kernel's 13.2k steady tok/s), while at B>=32 int8
+            # through XLA is the recorded negative.
             layout = "slot"
             if kv_plan is not None and B >= 16 \
                     and getattr(self.net, "platform", "cpu") == "tpu":
