@@ -153,8 +153,46 @@ def decode_attend(q, k_c, v_c, bias, scale=None, interpret=None):
     )(q, k_c, v_c, bias[:, None, :])
 
 
+def _kernel_q8mxu(q_ref, k_ref, v_ref, ks_ref, vs_ref, b_ref, o_ref):
+    # fully-int8 MXU form: BOTH dots run on int8 operands with int32
+    # accumulation (the MXU's native int8 path) — no bulk int8->bf16
+    # converts of the K/V blocks at all, which is what bounds the
+    # bf16-operand q8 kernel. Query rows arrive pre-quantized per
+    # (row, head), with their scale AND the d^-0.5 softmax scale
+    # pre-folded into ks_ref outside the kernel (all per-(row, head)
+    # factors commute past the d-contraction); the PV dot quantizes
+    # the V-scale-folded softmax weights per row in-kernel (a
+    # (gb, 1, Sl) VPU pass, tiny next to a (gb, Sl, d) block
+    # convert). The only approximation added over q8 is the int8
+    # rounding of q and of the softmax weights (~0.4% each, bounded
+    # in tests).
+    bias = b_ref[...][:, 0, :]               # (gb, 1, Sl) -> (gb, Sl)
+    nh = q_ref.shape[1]
+    for h in range(nh):
+        q3 = q_ref[:, h][:, None, :]                      # int8
+        k_h = k_ref[:, h]                                 # int8
+        v_h = v_ref[:, h]
+        si = lax.dot_general(
+            q3, k_h, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32)             # (gb, 1, Sl)
+        scores = si.astype(jnp.float32) \
+            * ks_ref[:, h][:, None, :] + bias[:, None, :]
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        p = jnp.exp(scores - m)
+        l = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
+        pw = (p / l) * vs_ref[:, h][:, None, :]           # fold V scale
+        pmax = jnp.maximum(jnp.max(pw, axis=-1, keepdims=True), 1e-30)
+        ps = pmax * (1.0 / 127.0)
+        p_q = jnp.clip(jnp.round(pw / ps), -127, 127).astype(jnp.int8)
+        oi = lax.dot_general(
+            p_q, v_h, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32)             # (gb, 1, d)
+        o_ref[:, h] = (oi.astype(jnp.float32) * ps)[:, 0] \
+            .astype(o_ref.dtype)
+
+
 def decode_attend_q8(q, k_q, v_q, k_s, v_s, bias, scale=None,
-                     interpret=None):
+                     interpret=None, mxu=False):
     """q (B, nh, d) x int8 cache (B, nh, Sl, d) with per-(row, head,
     slot) f32 absmax scales (B, nh, Sl) -> (B, nh, d).
 
@@ -162,7 +200,19 @@ def decode_attend_q8(q, k_q, v_q, k_s, v_s, bias, scale=None,
     decode step is ~87% KV streaming, so storing K/V as int8 halves
     the bytes the step moves (scales add ~3% back at d=64). Dequant
     is algebraic — per-slot scales factor out of both d-contractions —
-    so the kernel's dot shapes match the bf16 one exactly."""
+    so the kernel's dot shapes match the bf16 one exactly.
+
+    ``mxu=True`` selects the fully-int8 form (``_kernel_q8mxu``):
+    both dots run int8 x int8 -> int32 on the MXU's native int8 path
+    with no bulk K/V converts, at the cost of additionally rounding
+    the query rows and the softmax weights to int8. A recorded
+    NEGATIVE (r5): measured 9% SLOWER than the bf16-operand form at
+    the gpt2 B=64 shape (24-call interleaved chain, 109.8 vs
+    100.4 ms) with 2.2% vs 0.9% relative error — the bulk converts
+    this form removes were not the bound, and the int8 dots gain
+    nothing over bf16 dots at matvec-like shapes. Kept selectable as
+    the recorded mechanism; the generate path always uses the
+    default."""
     if interpret is None:
         interpret = _interpret()
     B, nh, d = q.shape
@@ -171,6 +221,32 @@ def decode_attend_q8(q, k_q, v_q, k_s, v_s, bias, scale=None,
         scale = d ** -0.5
     gb = _pick_rows(B, nh, Sl, d, 1,
                     scale_bytes_per_slot=jnp.dtype(k_s.dtype).itemsize)
+    if mxu:
+        # quantize the query rows per (row, head) so both in-kernel
+        # dots run on int8 operands; fold q's scale and the d^-0.5
+        # into the per-slot K scales (everything commutes past the
+        # d-contraction), so the kernel sees one combined score scale
+        qf = q.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(qf), axis=-1)
+        q_s = jnp.maximum(amax, 1e-8) * (1.0 / 127.0)
+        q_q = jnp.clip(jnp.round(qf / q_s[..., None]),
+                       -127, 127).astype(jnp.int8)
+        ks2 = k_s * (q_s * scale)[..., None]              # (B, nh, Sl)
+        return pl.pallas_call(
+            _kernel_q8mxu,
+            grid=(B // gb,),
+            in_specs=[
+                pl.BlockSpec((gb, nh, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((gb, nh, Sl, d), lambda i: (i, 0, 0, 0)),
+                pl.BlockSpec((gb, nh, Sl, d), lambda i: (i, 0, 0, 0)),
+                pl.BlockSpec((gb, nh, Sl), lambda i: (i, 0, 0)),
+                pl.BlockSpec((gb, nh, Sl), lambda i: (i, 0, 0)),
+                pl.BlockSpec((gb, 1, Sl), lambda i: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((gb, nh, d), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, nh, d), q.dtype),
+            interpret=bool(interpret),
+        )(q_q, k_q, v_q, ks2, v_s, bias[:, None, :])
     return pl.pallas_call(
         functools.partial(_kernel_q8, scale=scale),
         grid=(B // gb,),

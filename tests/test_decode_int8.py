@@ -102,6 +102,27 @@ def test_decode_attend_q8_matches_xla_quantized_attend():
                                rtol=2e-2, atol=2e-2)
 
 
+def test_decode_attend_q8_mxu_form_tracks_reference():
+    """The fully-int8 MXU form (mxu=True) — a recorded perf NEGATIVE
+    kept selectable (see the module docstring) — must still be
+    numerically sound: its extra q/softmax-weight rounding stays
+    within a few percent of the unquantized attend."""
+    B, nh, Sl, d = 2, 2, 64, 64
+    rs = np.random.RandomState(5)
+    q = jnp.asarray(rs.randn(B, nh, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(B, nh, Sl, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(B, nh, Sl, d).astype(np.float32))
+    k_q, k_s = _quant8(k)
+    v_q, v_s = _quant8(v)
+    bias = jnp.zeros((B, Sl), jnp.float32)
+    out = da.decode_attend_q8(q, k_q, v_q, k_s, v_s, bias,
+                              interpret=True, mxu=True)
+    exact = da.decode_attend(q, k, v, bias, interpret=True)
+    rel = (np.linalg.norm(np.asarray(out - exact))
+           / np.linalg.norm(np.asarray(exact)))
+    assert rel < 0.08, rel
+
+
 def test_decode_attend_q8_tracks_unquantized():
     """Quantization error at d=64 absmax int8 stays ~1% relative."""
     B, nh, Sl, d = 2, 2, 64, 64
