@@ -185,7 +185,9 @@ class LearnTask:
         "generate": frozenset(["prompts", "gen_out", "max_new",
                                "temperature", "gen_seed"]),
         "export_reference": frozenset(["ref_out"]),
-        "export_model": frozenset(["export_out", "export_batch",
+        "export_model": frozenset(["export_decode", "max_new",
+                                   "temperature", "export_prompt_len",
+                                   "export_out", "export_batch",
                                    "export_platform"]),
     }
 
@@ -626,14 +628,28 @@ class LearnTask:
         — no reference analogue (its only deployment was task=pred in
         the training binary). Keys: export_out (path), export_batch
         (serving batch size, default batch_size), export_platform
-        (comma list, default the training platform)."""
+        (comma list, default the training platform). With
+        export_decode=1 the KV-cache DECODER is exported instead
+        (serving.export_generate): max_new / temperature /
+        export_prompt_len shape the artifact; the decode_layout and
+        decode_kv knobs resolve exactly as task=generate would."""
         from . import serving
         d = dict(self.cfg)
         out = d.get("export_out", "model.export")
-        bs = int(d.get("export_batch", "0")) or None
         plats = d.get("export_platform", "")
         platforms = [p.strip() for p in plats.split(",") if p.strip()] \
             or None
+        bs = int(d.get("export_batch", "0")) or None
+        if int(d.get("export_decode", "0")):
+            serving.export_generate(
+                self.trainer, out,
+                max_new=int(d.get("max_new", "32")),
+                temperature=float(d.get("temperature", "0")),
+                prompt_len=int(d.get("export_prompt_len", "0")) or None,
+                batch_size=bs,
+                platforms=platforms)
+            print("exported decoder to %s (+.meta)" % out)
+            return
         serving.export_model(self.trainer, out, batch_size=bs,
                              platforms=platforms)
         print("exported model to %s (+.meta)" % out)

@@ -83,21 +83,136 @@ def export_model(trainer, path: str,
         }, f)
 
 
+def export_generate(trainer, path: str, max_new: int = 32,
+                    temperature: float = 0.0,
+                    prompt_len: Optional[int] = None,
+                    batch_size: Optional[int] = None,
+                    platforms: Optional[Sequence[str]] = None) -> None:
+    """Serialize the KV-cache DECODER (weights baked in) to ``path``.
+
+    The exported function maps ``(tokens (B, S) int32, lens (B,)
+    int32, key (2,) uint32)`` to the completed token matrix — the
+    whole prefill + decode loop as one AOT program, no framework or
+    checkpoint needed at serving time. ``prompt_len`` bounds the
+    prompts the artifact accepts (sets the cache's static prompt
+    region via ``generate.prompt_slots``; default ``seq_len -
+    max_new``); the trainer's ``decode_layout``/``decode_kv`` knobs
+    (including the int8 cache) resolve exactly as ``task=generate``
+    would via ``Trainer._resolve_decode``. Requires the canonical LM
+    graph (``generate.plan``). Multi-host: collective, process 0
+    writes, like ``export_model``."""
+    import jax
+    from jax import export as jexport
+
+    from . import generate as G
+
+    plan, why = G.plan_or_reason(trainer.net)
+    if plan is None:
+        raise ValueError(
+            "export_generate needs the canonical LM graph "
+            "(embed -> causal stack(s) -> head): " + why)
+    net = trainer.net
+    S = int(net.node_shapes[0][2])
+    B = int(batch_size or trainer.batch_size)
+    max_new = int(max_new)
+    if max_new < 1:
+        raise ValueError("max_new must be >= 1, got %d" % max_new)
+    if prompt_len is None:
+        prompt_len = max(1, S - max_new)
+    prompt_len = int(prompt_len)
+    if prompt_len < 1:
+        raise ValueError("prompt_len must be >= 1")
+    if prompt_len + max_new > S:
+        raise ValueError(
+            "prompt_len %d + max_new %d exceeds seq_len %d"
+            % (prompt_len, max_new, S))
+    P = G.prompt_slots(prompt_len, S)
+    params = jax.tree.map(
+        lambda w: trainer._fetch_global(w) if w is not None else None,
+        trainer.params)
+    if jax.process_index() != 0:
+        return
+    layout, kv = trainer._resolve_decode(plan, B, P, max_new)
+    trainer._warn_moe_capacity(plan, "export_generate")
+    platform = trainer.mesh.devices.flat[0].platform
+    fn = G.build(net, plan, max_new, float(temperature), B, S, P=P,
+                 layout=layout, platform=platform, kv=kv)
+    if platforms is None:
+        platforms = [platform]
+
+    def decode(toks, lens, key):
+        return fn(params, toks, lens, key)
+
+    exp = jexport.export(jax.jit(decode), platforms=list(platforms))(
+        jax.ShapeDtypeStruct((B, S), np.int32),
+        jax.ShapeDtypeStruct((B,), np.int32),
+        jax.ShapeDtypeStruct((2,), np.uint32))
+    with open(path, "wb") as f:
+        f.write(exp.serialize())
+    with open(path + ".meta", "w") as f:
+        json.dump({
+            "magic": MAGIC,
+            "kind": "generate",
+            "batch": B, "seq_len": S, "max_new": max_new,
+            "max_prompt_len": prompt_len, "prompt_slots": P,
+            "temperature": float(temperature),
+            "decode_layout": layout, "decode_kv": kv,
+            "platforms": list(platforms),
+        }, f)
+
+
+class ExportedDecoder:
+    """A deserialized ``export_generate`` artifact: ``__call__`` takes
+    ``(tokens (B, S), lens (B,))`` int arrays (+ optional ``seed``)
+    and returns the completed token matrix."""
+
+    def __init__(self, path: str, meta: dict):
+        from jax import export as jexport
+        with open(path, "rb") as f:
+            self._exp = jexport.deserialize(f.read())
+        self.meta = meta
+
+    def __call__(self, tokens: np.ndarray, lens: np.ndarray,
+                 seed: int = 0) -> np.ndarray:
+        import jax
+        m = self.meta
+        toks = np.asarray(tokens, np.int32)
+        lens = np.asarray(lens, np.int32)
+        if toks.shape != (m["batch"], m["seq_len"]):
+            raise ValueError(
+                "tokens must be (%d, %d), got %s"
+                % (m["batch"], m["seq_len"], toks.shape))
+        if int(lens.max(initial=0)) > m["max_prompt_len"]:
+            raise ValueError(
+                "a prompt exceeds the exported max_prompt_len %d"
+                % m["max_prompt_len"])
+        if lens.shape != (m["batch"],) or int(lens.min(initial=1)) < 1:
+            # same invariant Trainer.generate enforces: a 0-length row
+            # would silently corrupt its output
+            raise ValueError(
+                "lens must be (%d,) with every prompt >= 1 token"
+                % m["batch"])
+        key = np.asarray(jax.random.PRNGKey(seed), np.uint32)
+        return np.asarray(self._exp.call(toks, lens, key))
+
+
 class ExportedModel:
     """A deserialized export: ``__call__`` runs the forward, ``predict``
     adds the argmax-per-row convention of ``task=pred``."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, meta: Optional[dict] = None):
         from jax import export as jexport
         with open(path, "rb") as f:
             self._exp = jexport.deserialize(f.read())
-        meta_path = path + ".meta"
-        self.meta = None
-        if os.path.exists(meta_path):
-            with open(meta_path) as f:
-                self.meta = json.load(f)
-            if self.meta.get("magic") != MAGIC:
-                raise ValueError("%s: not a cxxnet_tpu export" % path)
+        self.meta = meta
+        if meta is None:
+            meta_path = path + ".meta"
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    self.meta = json.load(f)
+                if self.meta.get("magic") != MAGIC:
+                    raise ValueError("%s: not a cxxnet_tpu export"
+                                     % path)
 
     def __call__(self, data: np.ndarray) -> np.ndarray:
         dt = np.dtype((self.meta or {}).get("input_dtype", "float32"))
@@ -111,5 +226,16 @@ class ExportedModel:
         return np.argmax(out, axis=1).astype(np.float32)
 
 
-def load_exported(path: str) -> ExportedModel:
+def load_exported(path: str):
+    """Load an export artifact; dispatches on the meta ``kind``
+    (forward -> ``ExportedModel``, generate -> ``ExportedDecoder``)."""
+    meta_path = path + ".meta"
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if meta.get("magic") != MAGIC:
+            raise ValueError("%s: not a cxxnet_tpu export" % path)
+        if meta.get("kind") == "generate":
+            return ExportedDecoder(path, meta)
+        return ExportedModel(path, meta)
     return ExportedModel(path)

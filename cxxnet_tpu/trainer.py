@@ -1115,6 +1115,68 @@ class Trainer:
                    for ni, v in zip(node_ids, out)]
         return out
 
+    def _resolve_decode(self, kv_plan, B, P, max_new):
+        """Resolve the (decode_layout, decode_kv) knobs for a decode
+        build — shared by ``generate`` and ``serving.export_generate``
+        so both ship the same measured policy.
+
+        ``auto`` layout: slotk (the fused Pallas decode-attend) on TPU
+        at B >= 16 when the kernel's VMEM row budget fits; the plain
+        slot layout otherwise. Measured crossover
+        (docs/performance.md r5): the kernel's per-program fixed cost
+        loses at B=8 (-6%), wins +27% at B=32 and +54% at B=64. The
+        same B >= 16 crossover holds for decode_kv=int8 — measured
+        B=8: the XLA attend is bandwidth-limited there (not
+        MXU-issue-bound like B >= 32), so int8 helps it directly
+        (15.5k vs the kernel's 13.2k steady tok/s), while at B >= 32
+        int8 through XLA is the recorded negative."""
+        layout = getattr(self, "decode_layout", "auto")
+        kv = getattr(self, "decode_kv", "native")
+        if kv == "int8" and layout in ("slott", "blend"):
+            raise ValueError(
+                "decode_kv=int8 requires decode_layout auto|slot|slotk"
+                " (got %s)" % layout)
+        if layout == "auto":
+            layout = "slot"
+            if kv_plan is not None and B >= 16 \
+                    and getattr(self.net, "platform", "cpu") == "tpu":
+                try:
+                    from .ops import decode_attend as da
+                    st0 = self.net.modules[kv_plan["stacks"][0]]
+                    e = self.net.modules[
+                        kv_plan["embed"]].param.num_hidden
+                    da._pick_rows(
+                        B, st0.nhead, P + int(max_new),
+                        e // st0.nhead,
+                        1 if kv == "int8" else
+                        jnp.dtype(self.net.compute_dtype).itemsize,
+                        scale_bytes_per_slot=4 if kv == "int8" else 0)
+                    layout = "slotk"
+                except ValueError:
+                    # the intended over-budget fallback; anything else
+                    # (a real bug) must surface, not silently pin the
+                    # slower path
+                    pass
+        return layout, kv
+
+    def _warn_moe_capacity(self, kv_plan, who: str) -> None:
+        """Cached decode routes only the B new tokens per step; under
+        capacity pressure (factor below nexpert/topk no longer
+        guarantees zero drops) the cached and full-forward paths can
+        drop DIFFERENT tokens — warn once per build. Shared by
+        ``generate`` and ``serving.export_generate`` (the exported
+        decoder bakes the behavior in with no use_cache=never
+        fallback, so the warning matters MORE there)."""
+        for si in kv_plan["stacks"]:
+            st = self.net.modules[si]
+            if st.moe and st.capacity_factor < st.nexpert / st.topk:
+                sys.stderr.write(
+                    "%s: MoE capacity_factor %g < nexpert/moe_topk = "
+                    "%g — under capacity pressure the cached decode "
+                    "can drop different tokens than the full-forward "
+                    "path\n"
+                    % (who, st.capacity_factor, st.nexpert / st.topk))
+
     def generate(self, tokens: np.ndarray, lens: np.ndarray,
                  max_new: int, temperature: float = 0.0,
                  seed: int = 0, use_cache: str = "auto") -> np.ndarray:
@@ -1183,60 +1245,12 @@ class Trainer:
         if kv_plan is not None:
             from . import generate as G
             P = G.prompt_slots(int(lens.max()) if nrow else 1, S)
-        layout = getattr(self, "decode_layout", "auto")
-        kv = getattr(self, "decode_kv", "native")
-        if kv == "int8" and layout in ("slott", "blend"):
-            raise ValueError(
-                "decode_kv=int8 requires decode_layout auto|slot|slotk"
-                " (got %s)" % layout)
-        if layout == "auto":
-            # slotk (the fused Pallas decode-attend) on TPU when the
-            # kernel's VMEM row budget fits; the plain slot layout
-            # otherwise. Measured crossover (docs/performance.md r5):
-            # the kernel's per-program fixed cost loses at B=8 (-6%),
-            # wins +27% at B=32 and +54% at B=64. The same B>=16
-            # crossover holds for decode_kv=int8 — measured B=8: the
-            # XLA attend is bandwidth-limited there (not MXU-issue-
-            # bound like B>=32), so int8 helps it directly (15.5k vs
-            # the kernel's 13.2k steady tok/s), while at B>=32 int8
-            # through XLA is the recorded negative.
-            layout = "slot"
-            if kv_plan is not None and B >= 16 \
-                    and getattr(self.net, "platform", "cpu") == "tpu":
-                try:
-                    from .ops import decode_attend as da
-                    st0 = self.net.modules[kv_plan["stacks"][0]]
-                    e = self.net.modules[
-                        kv_plan["embed"]].param.num_hidden
-                    da._pick_rows(
-                        B, st0.nhead, P + int(max_new),
-                        e // st0.nhead,
-                        1 if kv == "int8" else
-                        jnp.dtype(self.net.compute_dtype).itemsize,
-                        scale_bytes_per_slot=4 if kv == "int8" else 0)
-                    layout = "slotk"
-                except ValueError:
-                    # the intended over-budget fallback; anything else
-                    # (a real bug) must surface, not silently pin the
-                    # slower path
-                    pass
+        layout, kv = self._resolve_decode(kv_plan, B, P, max_new)
         key = (int(max_new), float(temperature), kv_plan is not None,
                layout, P, kv)
         fn = self._gen_cache.get(key)
         if fn is None and kv_plan is not None:
-            for si in kv_plan["stacks"]:
-                st = self.net.modules[si]
-                if st.moe and st.capacity_factor < st.nexpert / st.topk:
-                    # cached decode routes only the B new tokens per
-                    # step; under capacity pressure (factor below
-                    # nexpert/topk no longer guarantees zero drops) the
-                    # two paths can drop DIFFERENT tokens — say so once
-                    sys.stderr.write(
-                        "generate: MoE capacity_factor %g < nexpert/"
-                        "moe_topk = %g — under capacity pressure the "
-                        "cached decode can drop different tokens than "
-                        "the full-forward path (use_cache=never)\n"
-                        % (st.capacity_factor, st.nexpert / st.topk))
+            self._warn_moe_capacity(kv_plan, "generate")
             fn = G.build(self.net, kv_plan, int(max_new),
                          float(temperature), B, S, P=P, layout=layout,
                          platform=getattr(self.net, "platform", "cpu"),

@@ -195,3 +195,116 @@ max_round = 1
                  "model_in=models/0000.model", "export_out=o.bin",
                  "export_platform=cpu", "silent=1"]) == 0
     assert serving.load_exported("o.bin").meta["input_dtype"] == "float32"
+
+
+def _trained_lm():
+    tr = Trainer()
+    for k, v in config.parse_string(models.tiny_lm(
+            seq_len=24, vocab=16, embed=32, nlayer=1, nhead=2)):
+        tr.set_param(k, v)
+    for k, v in (("batch_size", "4"), ("dev", "cpu:0"), ("eta", "0.3"),
+                 ("seed", "0"), ("metric", "token_error")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    for _ in range(30):
+        start = rs.randint(0, 16, size=(4, 1))
+        seq = (start + np.arange(25)) % 16
+        tr.update(DataBatch(
+            data=seq[:, :24, None, None].transpose(0, 2, 1, 3)
+            .astype(np.float32).reshape(4, 1, 24, 1),
+            label=seq[:, 1:].astype(np.float32)))
+    return tr
+
+
+def test_export_generate_roundtrip(tmp_path):
+    """The exported KV-cache decoder must reproduce tr.generate's
+    greedy output standalone (weights baked in, same decode build)."""
+    tr = _trained_lm()
+    path = str(tmp_path / "d.export")
+    serving.export_generate(tr, path, max_new=6, temperature=0.0,
+                            prompt_len=8, platforms=["cpu"])
+    dec = serving.load_exported(path)
+    assert isinstance(dec, serving.ExportedDecoder)
+    assert dec.meta["kind"] == "generate" and dec.meta["max_new"] == 6
+
+    toks = np.zeros((4, 24), np.int32)
+    prompts = [[3, 4, 5], [10, 11], [0, 1, 2, 3], [7]]
+    lens = np.array([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    out = dec(toks, lens)
+    ref = np.asarray(tr.generate(toks, lens, 6, temperature=0.0))
+    np.testing.assert_array_equal(out, ref)
+    # prompt bound enforced from the meta
+    with pytest.raises(ValueError, match="max_prompt_len"):
+        dec(toks, np.full(4, 9, np.int32))
+
+
+def test_export_generate_rejects_non_lm(tmp_path):
+    tr, _ = _trained(tmp_path)
+    with pytest.raises(ValueError, match="canonical LM graph"):
+        serving.export_generate(tr, str(tmp_path / "x.export"))
+
+
+def test_export_decode_via_cli(tmp_path, monkeypatch):
+    """task=export_model export_decode=1 exports the decoder."""
+    import contextlib
+    import io as _io
+    from cxxnet_tpu.cli import main
+
+    conf = tmp_path / "lm.conf"
+    conf.write_text("""
+data = train
+iter = synth
+    shape = 1,24,1
+    token_vocab = 16
+    ninst = 32
+    lm_labels = 1
+    batch_size = 4
+iter = end
+%s
+batch_size = 4
+dev = cpu:0
+eta = 0.1
+metric = token_error
+num_round = 1
+save_model = 1
+""" % models.tiny_lm(seq_len=24, vocab=16, embed=32, nlayer=1,
+                     nhead=2))
+    monkeypatch.chdir(tmp_path)
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+        assert main([str(conf), "silent=1"]) == 0
+        assert main([str(conf), "task=export_model", "export_decode=1",
+                     "model_in=models/0000.model", "export_out=d.bin",
+                     "max_new=4", "export_prompt_len=8",
+                     "export_platform=cpu", "silent=1", "strict=1"]) == 0
+    dec = serving.load_exported("d.bin")
+    assert dec.meta["kind"] == "generate"
+    toks = np.zeros((4, 24), np.int32)
+    toks[:, 0] = [1, 2, 3, 4]
+    out = dec(toks, np.ones(4, np.int32))
+    assert out.shape == (4, 24) and (out[:, 0] == [1, 2, 3, 4]).all()
+
+
+def test_export_generate_validations(tmp_path):
+    tr = _trained_lm()
+    with pytest.raises(ValueError, match="max_new"):
+        serving.export_generate(tr, str(tmp_path / "a"), max_new=0)
+    with pytest.raises(ValueError, match="exceeds seq_len"):
+        serving.export_generate(tr, str(tmp_path / "b"), max_new=4,
+                                prompt_len=24)
+    # export_batch overrides the decoder batch
+    path = str(tmp_path / "c.export")
+    serving.export_generate(tr, path, max_new=4, prompt_len=8,
+                            batch_size=2, platforms=["cpu"])
+    dec = serving.load_exported(path)
+    assert dec.meta["batch"] == 2
+    toks = np.zeros((2, 24), np.int32)
+    toks[:, 0] = [1, 2]
+    out = dec(toks, np.ones(2, np.int32))
+    assert out.shape == (2, 24)
+    # the 0-length-row invariant the in-framework path enforces
+    with pytest.raises(ValueError, match=">= 1 token"):
+        dec(toks, np.array([1, 0], np.int32))
