@@ -37,17 +37,17 @@ MAX_NEW = 128
 SHORT_NEW = 8
 
 
-def build(batch, retries=3, nlayer=12):
+def build(batch, retries=3, nlayer=12, net="gpt2"):
     import jax
 
     from cxxnet_tpu import config, models
     from cxxnet_tpu.trainer import Trainer
+    maker = models.moe_lm if net == "moe" else models.gpt2_small
     for attempt in range(retries):
         try:
             platform = jax.devices()[0].platform
             tr = Trainer()
-            for k, v in config.parse_string(
-                    models.gpt2_small(nlayer=nlayer)):
+            for k, v in config.parse_string(maker(nlayer=nlayer)):
                 tr.set_param(k, v)
             tr.set_param("batch_size", str(batch))
             tr.set_param("dev", platform)
@@ -113,6 +113,9 @@ def main():
     ap.add_argument("--prompt", type=int, default=256,
                     help="prompt length (drives the cache slot count "
                          "P+max_new; a KV-traffic decomposition lever)")
+    ap.add_argument("--net", default="gpt2", choices=("gpt2", "moe"),
+                    help="decoder under test: gpt2_small or moe_lm "
+                         "(the routed-expert MLP decodes per-token)")
     ap.add_argument("--nlayer", type=int, default=12,
                     help="stack depth (smaller = simpler compiled "
                          "program; a compile-fault workaround lever)")
@@ -122,7 +125,7 @@ def main():
     layouts = args.layouts.split(",")
     rows = []
     for batch in [int(b) for b in args.batches.split(",")]:
-        tr = build(batch, nlayer=args.nlayer)
+        tr = build(batch, nlayer=args.nlayer, net=args.net)
         seq = tr.net.node_shapes[0][2]
         toks, lens = prompts(batch, seq)
         # compile warmup + device-resident runners per (layout, max_new);
@@ -146,7 +149,8 @@ def main():
             t_long, t_short = best[(lay, MAX_NEW)], best[(lay, SHORT_NEW)]
             step_ms = (t_long - t_short) / (MAX_NEW - SHORT_NEW)
             row = {
-                "batch": batch, "layout": lay, "prompt": PROMPT,
+                "batch": batch, "layout": lay, "net": args.net,
+                "prompt": PROMPT,
                 "max_new": MAX_NEW, "nlayer": args.nlayer,
                 "total_ms_best": round(t_long, 2),
                 "prefill_plus8_ms_best": round(t_short, 2),
