@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""End-task quality of the int8 KV cache (decode_kv=int8).
+
+The int8 cache is an APPROXIMATE decode (0.9% relative attend error,
+docs/performance.md) — this tool measures what that costs on-task,
+not just in operand norms. Recipe: train gpt2-small on the streamed
+Markov oracle (the convergence_r5 recipe — every token has 4 uniform
+successors, so a trained model's greedy continuations should walk the
+chain), then decode the SAME prompts through the exact (bf16) and
+int8 cache paths and report:
+
+* ``agreement`` — fraction of generated tokens identical between the
+  two paths (greedy; ties are the only legitimate divergence source);
+* ``validity`` — per path, the fraction of generated transitions that
+  are TRUE chain successors (token[t+1] in succ[token[t]]): the
+  end-task metric. If int8 validity matches exact validity, the
+  quantization costs nothing a user of the model can observe.
+
+One JSON line per run; paste-ready for docs/performance.md.
+
+Usage: python tools/decode_quality.py [--rounds 4] [--batch 32]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SEQ, VOCAB = 512, 32768
+PROMPT, MAX_NEW = 256, 128
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", type=int, default=4,
+                    help="training rounds on the streamed Markov "
+                         "corpus before measuring")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--n-train", type=int, default=8192)
+    args = ap.parse_args()
+
+    import perf_lab
+
+    from cxxnet_tpu import models
+    from cxxnet_tpu.io import DataBatch
+
+    tr = perf_lab.build(
+        [("eta", "0.0003"), ("metric", "token_error"),
+         ("fuse_steps", "8"), ("updater", "adam")],
+        models.gpt2_small(seq_len=SEQ, vocab=VOCAB),
+        nclass=VOCAB, batch=args.batch)
+
+    rs = np.random.RandomState(3)
+    succ = rs.randint(0, VOCAB, size=(VOCAB, 4))
+
+    def gen(n, seed):
+        g = np.random.RandomState(seed)
+        toks = np.empty((n, SEQ + 1), np.int32)
+        toks[:, 0] = g.randint(0, VOCAB, n)
+        for t in range(SEQ):
+            toks[:, t + 1] = succ[toks[:, t], g.randint(0, 4, n)]
+        return toks
+
+    t0 = time.time()
+    for r in range(1, args.rounds + 1):
+        x = gen(args.n_train, 100 + r)
+        tr.start_round(r)
+        for j in range(args.n_train // args.batch):
+            rows = x[j * args.batch:(j + 1) * args.batch]
+            tr.update(DataBatch(
+                data=rows[:, :SEQ, None, None].transpose(0, 2, 1, 3)
+                .astype(np.float32),
+                label=rows[:, 1:].astype(np.float32)))
+        sys.stderr.write("round %d done (%.0fs)\n"
+                         % (r, time.time() - t0))
+
+    # prompts drawn from the same chain, truncated to PROMPT tokens
+    xp = gen(args.batch, 999)
+    toks = np.zeros((args.batch, SEQ), np.int32)
+    toks[:, :PROMPT] = xp[:, :PROMPT]
+    lens = np.full(args.batch, PROMPT, np.int32)
+
+    outs = {}
+    for kv in ("native", "int8"):
+        tr.set_param("decode_kv", kv)
+        tr.set_param("decode_layout", "slotk")
+        outs[kv] = np.asarray(
+            tr.generate(toks, lens, MAX_NEW, temperature=0.0))
+
+    gen_slice = slice(PROMPT, PROMPT + MAX_NEW)
+    a, b = outs["native"][:, gen_slice], outs["int8"][:, gen_slice]
+    agreement = float((a == b).mean())
+
+    def validity(o):
+        # every generated transition (incl. prompt->first token) must
+        # land on a true successor of its predecessor
+        prev = o[:, PROMPT - 1:PROMPT + MAX_NEW - 1]
+        nxt = o[:, PROMPT:PROMPT + MAX_NEW]
+        ok = (succ[prev] == nxt[..., None]).any(-1)
+        return float(ok.mean())
+
+    print(json.dumps({
+        "experiment": "decode_quality_int8",
+        "net": "gpt2_small", "rounds_trained": args.rounds,
+        "batch": args.batch, "prompt": PROMPT, "max_new": MAX_NEW,
+        "greedy_agreement_int8_vs_exact": round(agreement, 5),
+        "chain_validity_exact": round(validity(outs["native"]), 5),
+        "chain_validity_int8": round(validity(outs["int8"]), 5),
+        "train_wall_s": round(time.time() - t0, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
