@@ -205,7 +205,18 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
     if layout in ("slot", "slott", "slotk"):
         if P is None:
             P = S
-        Sl = P + max_new                    # total cache slots
+        if layout == "slotk":
+            # slotk caches round to a 128-multiple (ops.decode_attend.
+            # cache_slots — the single source of the rule) so the
+            # blocked kernel's chunks divide evenly; pad slots are
+            # invalid under the keep-mask (never written, outside both
+            # the prompt and decode ranges). The XLA-attend layouts
+            # keep the exact size — rounding would only inflate their
+            # streamed bytes
+            from .ops.decode_attend import cache_slots
+            Sl = cache_slots(P, max_new)
+        else:
+            Sl = P + max_new
 
     def embed_at(params, ids, pos):
         """ids (B,), pos (B,) -> (B, e) embedding (+position)."""

@@ -57,6 +57,162 @@ def _pick_rows(B, nh, Sl, d, itemsize, budget=5 * 1024 * 1024,
     return best
 
 
+def cache_slots(P, max_new):
+    """Slot count for a slotk cache: P + max_new rounded to the next
+    128-multiple so the blocked kernel's chunk sizes divide evenly.
+    THE single source of the alignment rule — generate.build sizes
+    the cache with it and Trainer._resolve_decode preflights _plan
+    with it; pad slots are excluded by the keep-mask either way."""
+    return -(-(P + max_new) // 128) * 128
+
+
+def _plan(B, nh, Sl, d, itemsize, budget=5 * 1024 * 1024,
+          scale_bytes_per_slot=0):
+    """Kernel schedule for a cache shape: ``("single", gb)`` when a
+    whole row's K+V fits the VMEM budget (the original one-pass
+    kernel), else ``("blocked", gb, blk)`` streaming the slot axis in
+    ``blk``-sized chunks with online-softmax scratch accumulators —
+    the long-context form (a 2176-slot bf16 row is 13.4 MB, far past
+    any budget). Raises only when even (gb=1, blk=128) cannot fit.
+    The first (largest) feasible blk wins, with gb maximized for it."""
+    try:
+        return ("single", _pick_rows(B, nh, Sl, d, itemsize, budget,
+                                     scale_bytes_per_slot))
+    except ValueError:
+        pass
+    for blk in (1024, 512, 256, 128):
+        if Sl % blk:
+            continue
+        per_row = 2 * (2 * nh * blk * (d * itemsize
+                                       + scale_bytes_per_slot))
+        if per_row > budget:
+            continue
+        gb = 1
+        for g in range(2, min(B, 8) + 1):
+            if B % g == 0 and g * per_row <= budget:
+                gb = g
+        return ("blocked", gb, blk)
+    raise ValueError(
+        "decode_attend: no (rows, block) schedule fits the "
+        "%d-byte VMEM budget at Sl=%d (need 128 | Sl)"
+        % (budget, Sl))
+
+
+def _blocked_update(h, scores, v_h, acc_ref, m_ref, l_ref, vs=None):
+    """One head's online-softmax accumulator update for a slot block:
+    scores (gb, blk) f32 (mask already added), v_h the block's V rows
+    in a dot-able dtype. Scratch rows are broadcast-stored at lane
+    width so every operand stays >= 2-D for Mosaic; ``vs`` (int8
+    path) folds V's per-slot scale into the weights pre-cast."""
+    m_old = m_ref[:, h][:, :1]                         # (gb, 1)
+    s_max = jnp.max(scores, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_old, s_max)
+    corr = jnp.exp(m_old - m_new)                      # (gb, 1)
+    p = jnp.exp(scores - m_new)                        # (gb, blk)
+    l_new = l_ref[:, h][:, :1] * corr \
+        + p.sum(axis=-1, keepdims=True)
+    if vs is not None:
+        p = p * vs
+    pv = lax.dot_general(
+        p.astype(v_h.dtype)[:, None, :], v_h,
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)            # (gb, 1, d)
+    acc_ref[:, h] = acc_ref[:, h] * corr + pv[:, 0]
+    m_ref[:, h] = jnp.broadcast_to(m_new, m_ref[:, h].shape)
+    l_ref[:, h] = jnp.broadcast_to(l_new, l_ref[:, h].shape)
+
+
+def _blocked_prologue(j, acc_ref, m_ref, l_ref):
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+
+def _blocked_epilogue(j, nblk, nh, o_ref, acc_ref, l_ref):
+    @pl.when(j == nblk - 1)
+    def _emit():
+        for h in range(nh):
+            o_ref[:, h] = (acc_ref[:, h]
+                           / jnp.maximum(l_ref[:, h][:, :1], 1e-30)
+                           ).astype(o_ref.dtype)
+
+
+def _call_blocked(kernel, gb, blk, q, mid, bias, interpret):
+    """Shared pallas_call setup for the blocked kernels: grid
+    (B/gb, Sl/blk), q and out blocked by rows only, every ``mid``
+    operand blocked along the slot axis (4-D K/V-likes as
+    (gb, nh, blk, d), 3-D scale rows as (gb, nh, blk)), bias as
+    (gb, 1, blk), and the three (gb, nh, d) f32 scratch
+    accumulators."""
+    import jax.experimental.pallas.tpu as pltpu
+    B, nh, d = q.shape
+    Sl = mid[0].shape[2]
+    nblk = Sl // blk
+    mid_specs = [
+        pl.BlockSpec((gb, nh, blk, d), lambda i, j: (i, 0, j, 0))
+        if a.ndim == 4 else
+        pl.BlockSpec((gb, nh, blk), lambda i, j: (i, 0, j))
+        for a in mid]
+    return pl.pallas_call(
+        functools.partial(kernel, nblk=nblk),
+        grid=(B // gb, nblk),
+        in_specs=[pl.BlockSpec((gb, nh, d), lambda i, j: (i, 0, 0))]
+        + mid_specs
+        + [pl.BlockSpec((gb, 1, blk), lambda i, j: (i, 0, j))],
+        out_specs=pl.BlockSpec((gb, nh, d), lambda i, j: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, nh, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((gb, nh, d), jnp.float32)] * 3,
+        interpret=bool(interpret),
+    )(q, *mid, bias[:, None, :])
+
+
+def _kernel_blocked(q_ref, k_ref, v_ref, b_ref, o_ref,
+                    acc_ref, m_ref, l_ref, *, scale, nblk):
+    # sequence-blocked online-softmax attend: grid (B/gb, Sl/blk),
+    # slot-axis innermost; scratch carries the (gb, nh, d) f32
+    # accumulator plus running max/sum. Block 0 initializes, the
+    # last block normalizes and emits — the long-context form of the
+    # one-pass kernel (a 2176-slot bf16 row is 13.4 MB, past any
+    # VMEM budget).
+    j = pl.program_id(1)
+    nh = q_ref.shape[1]
+    _blocked_prologue(j, acc_ref, m_ref, l_ref)
+    bias = b_ref[...][:, 0, :]                         # (gb, blk)
+    for h in range(nh):
+        q3 = (q_ref[:, h] * scale).astype(k_ref.dtype)[:, None, :]
+        scores = lax.dot_general(
+            q3, k_ref[:, h], (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)[:, 0, :] + bias
+        _blocked_update(h, scores, v_ref[:, h],
+                        acc_ref, m_ref, l_ref)
+    _blocked_epilogue(j, nblk, nh, o_ref, acc_ref, l_ref)
+
+
+def _kernel_blocked_q8(q_ref, k_ref, v_ref, ks_ref, vs_ref, b_ref,
+                       o_ref, acc_ref, m_ref, l_ref, *, scale, nblk):
+    # int8 form of the blocked kernel: K/V stream as int8 (converted
+    # per block in VMEM), per-(row, head, slot) scales ride their own
+    # blocked refs; K's scale multiplies the f32 scores, V's folds
+    # into the softmax weights before the bf16 PV cast — identical
+    # algebra to the single-pass q8 kernel.
+    j = pl.program_id(1)
+    nh = q_ref.shape[1]
+    _blocked_prologue(j, acc_ref, m_ref, l_ref)
+    bias = b_ref[...][:, 0, :]                         # (gb, blk)
+    for h in range(nh):
+        q3 = (q_ref[:, h] * scale).astype(jnp.bfloat16)[:, None, :]
+        scores = lax.dot_general(
+            q3, k_ref[:, h].astype(jnp.bfloat16),
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)[:, 0, :]
+        scores = scores * ks_ref[:, h] + bias
+        _blocked_update(h, scores, v_ref[:, h].astype(jnp.bfloat16),
+                        acc_ref, m_ref, l_ref, vs=vs_ref[:, h])
+    _blocked_epilogue(j, nblk, nh, o_ref, acc_ref, l_ref)
+
+
 def _kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *, scale):
     # a STATIC Python loop over heads with major-dim ref indexing and
     # rank-2/3 dot_generals: no reshapes, no 1-sized dims — Mosaic's
@@ -133,7 +289,13 @@ def decode_attend(q, k_c, v_c, bias, scale=None, interpret=None):
     Sl = k_c.shape[2]
     if scale is None:
         scale = d ** -0.5
-    gb = _pick_rows(B, nh, Sl, d, jnp.dtype(k_c.dtype).itemsize)
+    plan = _plan(B, nh, Sl, d, jnp.dtype(k_c.dtype).itemsize)
+    if plan[0] == "blocked":
+        _, gb, blk = plan
+        return _call_blocked(
+            functools.partial(_kernel_blocked, scale=scale),
+            gb, blk, q, [k_c, v_c], bias, interpret)
+    gb = plan[1]
     return pl.pallas_call(
         functools.partial(_kernel, scale=scale),
         grid=(B // gb,),
@@ -219,8 +381,18 @@ def decode_attend_q8(q, k_q, v_q, k_s, v_s, bias, scale=None,
     Sl = k_q.shape[2]
     if scale is None:
         scale = d ** -0.5
-    gb = _pick_rows(B, nh, Sl, d, 1,
-                    scale_bytes_per_slot=jnp.dtype(k_s.dtype).itemsize)
+    plan = _plan(B, nh, Sl, d, 1,
+                 scale_bytes_per_slot=jnp.dtype(k_s.dtype).itemsize)
+    if plan[0] == "blocked" and not mxu:
+        _, gb, blk = plan
+        return _call_blocked(
+            functools.partial(_kernel_blocked_q8, scale=scale),
+            gb, blk, q, [k_q, v_q, k_s, v_s], bias, interpret)
+    if plan[0] == "blocked":
+        raise ValueError(
+            "decode_attend_q8(mxu=True) has no blocked form (the mxu "
+            "variant is a recorded perf negative; use the default)")
+    gb = plan[1]
     if mxu:
         # quantize the query rows per (row, head) so both in-kernel
         # dots run on int8 operands; fold q's scale and the d^-0.5

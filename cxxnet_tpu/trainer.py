@@ -1145,8 +1145,9 @@ class Trainer:
                     st0 = self.net.modules[kv_plan["stacks"][0]]
                     e = self.net.modules[
                         kv_plan["embed"]].param.num_hidden
-                    da._pick_rows(
-                        B, st0.nhead, P + int(max_new),
+                    da._plan(
+                        B, st0.nhead,
+                        da.cache_slots(P, int(max_new)),
                         e // st0.nhead,
                         1 if kv == "int8" else
                         jnp.dtype(self.net.compute_dtype).itemsize,

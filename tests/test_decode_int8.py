@@ -190,6 +190,44 @@ def test_int8_covers_moe_stack():
     assert agree >= 0.98, (agree, out, ref)
 
 
+def test_blocked_plan_and_kernels_long_context():
+    """Long caches (one row's K+V past the VMEM budget) take the
+    sequence-blocked online-softmax schedule instead of failing:
+    _plan flips to ('blocked', gb, blk) and both kernel forms stay
+    numerically tight vs the exact attend (interpret mode)."""
+    B, nh, Sl, d = 4, 12, 2304, 64
+    assert da._plan(B, nh, Sl, d, 2)[0] == "blocked"
+    assert da._plan(B, nh, Sl, d, 1,
+                    scale_bytes_per_slot=4)[0] == "blocked"
+    # short caches keep the single-pass schedule (the tuned path)
+    assert da._plan(32, nh, 384, d, 2)[0] == "single"
+    rs = np.random.RandomState(2)
+    q = jnp.asarray(rs.randn(B, nh, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(B, nh, Sl, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(B, nh, Sl, d).astype(np.float32))
+    valid = jnp.arange(Sl)[None, :] < jnp.asarray(
+        rs.randint(100, Sl, size=(B,)))[:, None]
+    bias = jnp.where(valid, 0.0, da.NEG_INF).astype(jnp.float32)
+    scores = jnp.einsum("bhd,bhkd->bhk", q, k) * (d ** -0.5) \
+        + bias[:, None, :]
+    ref = jnp.einsum("bhk,bhkd->bhd", jax.nn.softmax(scores, -1), v)
+    out = da.decode_attend(q, k, v, bias, interpret=True)
+    rel = (np.linalg.norm(np.asarray(out - ref))
+           / np.linalg.norm(np.asarray(ref)))
+    assert rel < 0.01, rel
+    k_q, k_s = _quant8(k)
+    v_q, v_s = _quant8(v)
+    out8 = da.decode_attend_q8(q, k_q, v_q, k_s, v_s, bias,
+                               interpret=True)
+    rel8 = (np.linalg.norm(np.asarray(out8 - ref))
+            / np.linalg.norm(np.asarray(ref)))
+    assert rel8 < 0.05, rel8
+    # the mxu variant has no blocked form and must say so
+    with pytest.raises(ValueError, match="no blocked form"):
+        da.decode_attend_q8(q, k_q, v_q, k_s, v_s, bias,
+                            interpret=True, mxu=True)
+
+
 def test_decode_kv_rejects_unsupported_layouts():
     tr = _lm()
     with pytest.raises(ValueError):

@@ -37,7 +37,7 @@ MAX_NEW = 128
 SHORT_NEW = 8
 
 
-def build(batch, retries=3, nlayer=12, net="gpt2"):
+def build(batch, retries=3, nlayer=12, net="gpt2", seq=512):
     import jax
 
     from cxxnet_tpu import config, models
@@ -47,7 +47,8 @@ def build(batch, retries=3, nlayer=12, net="gpt2"):
         try:
             platform = jax.devices()[0].platform
             tr = Trainer()
-            for k, v in config.parse_string(maker(nlayer=nlayer)):
+            for k, v in config.parse_string(
+                    maker(nlayer=nlayer, seq_len=seq)):
                 tr.set_param(k, v)
             tr.set_param("batch_size", str(batch))
             tr.set_param("dev", platform)
@@ -116,6 +117,9 @@ def main():
     ap.add_argument("--net", default="gpt2", choices=("gpt2", "moe"),
                     help="decoder under test: gpt2_small or moe_lm "
                          "(the routed-expert MLP decodes per-token)")
+    ap.add_argument("--seq", type=int, default=512,
+                    help="net seq_len (must cover prompt + max_new; "
+                         "raise for long-context decode rows)")
     ap.add_argument("--nlayer", type=int, default=12,
                     help="stack depth (smaller = simpler compiled "
                          "program; a compile-fault workaround lever)")
@@ -125,7 +129,8 @@ def main():
     layouts = args.layouts.split(",")
     rows = []
     for batch in [int(b) for b in args.batches.split(",")]:
-        tr = build(batch, nlayer=args.nlayer, net=args.net)
+        tr = build(batch, nlayer=args.nlayer, net=args.net,
+                   seq=args.seq)
         seq = tr.net.node_shapes[0][2]
         toks, lens = prompts(batch, seq)
         # compile warmup + device-resident runners per (layout, max_new);
