@@ -80,7 +80,11 @@ def _plan(B, nh, Sl, d, itemsize, budget=5 * 1024 * 1024,
                                      scale_bytes_per_slot))
     except ValueError:
         pass
-    for blk in (1024, 512, 256, 128):
+    # any 128-multiple chunk tiles cleanly ((blk, d) blocks are
+    # 8-aligned on the sublane dim); descending, so the largest
+    # divisor of Sl that fits wins — e.g. Sl=1152 takes blk=384, not
+    # a 9-step 128-chunk grid
+    for blk in range(min(Sl, 1024), 127, -128):
         if Sl % blk:
             continue
         per_row = 2 * (2 * nh * blk * (d * itemsize
