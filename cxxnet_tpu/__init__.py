@@ -34,7 +34,7 @@ def __getattr__(name):
         return Trainer
     if name in ("models", "wrapper", "trainer", "io", "parallel",
                 "metrics", "checkpoint", "profiler", "layers", "model",
-                "updater"):
+                "updater", "serving", "serve"):
         import importlib
         return importlib.import_module("." + name, __name__)
     raise AttributeError(name)

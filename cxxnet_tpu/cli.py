@@ -123,6 +123,8 @@ class LearnTask:
             self.task_generate()
         elif self.task == "export_reference":
             self.task_export_reference()
+        elif self.task == "serve":
+            self.task_serve()
         return 0
 
     # ------------------------------------------------------------------
@@ -134,6 +136,10 @@ class LearnTask:
 
     def init(self) -> None:
         """Reference: cxxnet_main.cpp:108-133."""
+        if self.task == "serve" and dict(self.cfg).get("export_in"):
+            # serving an exported artifact: self-contained (weights
+            # baked in) — no trainer, no params, no iterators to build
+            return
         if self.task == "train" and self.continue_training:
             found = checkpoint.find_latest_model(
                 self.model_dir, self.start_counter)
@@ -189,6 +195,9 @@ class LearnTask:
                                    "temperature", "export_prompt_len",
                                    "export_out", "export_batch",
                                    "export_platform"]),
+        "serve": frozenset(["export_in", "serve_host", "serve_port",
+                            "serve_max_wait_ms", "serve_max_batch",
+                            "serve_queue_limit", "serve_timeout_ms"]),
     }
 
     def _iter_section_keys(self) -> set:
@@ -255,11 +264,11 @@ class LearnTask:
                 itcfg.append((name, val))
             else:
                 defcfg.append((name, val))
-        # pred uses only its own iterator; export_model and generate use
-        # none at all (a serving box has the checkpoint + prompts, not
-        # the training packfiles)
+        # pred uses only its own iterator; export_model, generate, and
+        # serve use none at all (a serving box has the checkpoint +
+        # prompts, not the training packfiles)
         no_train_io = self.task in ("pred", "export_model", "generate",
-                                    "export_reference")
+                                    "export_reference", "serve")
         for flag, evname, itcfg in pending:
             if flag == 1 and not no_train_io:
                 assert self.itr_train is None, "can only have one data"
@@ -653,6 +662,58 @@ class LearnTask:
         serving.export_model(self.trainer, out, batch_size=bs,
                              platforms=platforms)
         print("exported model to %s (+.meta)" % out)
+
+    def task_serve(self) -> None:
+        """task=serve: dynamic-batching HTTP inference server
+        (docs/serving.md). Serves either an exported artifact
+        (``export_in = served.bin`` — forward or decoder, no trainer
+        is built) or the live loaded model (``model_in = ...``). Keys:
+        serve_host (default 127.0.0.1), serve_port (default 8080; 0
+        binds a free port), serve_max_wait_ms (batching window,
+        default 5), serve_max_batch (rows per dispatch, default the
+        exported batch), serve_queue_limit (pending requests before
+        429, default 64), serve_timeout_ms (per-request deadline,
+        default 30000). Blocks until interrupted."""
+        from . import serving
+        from .serve import ServingEngine
+        from .serve.server import build_server
+        d = dict(self.cfg)
+        if "export_in" in d:
+            callee = serving.load_exported(d["export_in"])
+        elif self.trainer is not None:
+            callee = self.trainer
+        else:
+            raise RuntimeError(
+                "task=serve needs export_in=<artifact> or model_in=<ckpt>")
+        timeout_ms = float(d.get("serve_timeout_ms", "30000"))
+        engine = ServingEngine(
+            callee,
+            max_wait_ms=float(d.get("serve_max_wait_ms", "5")),
+            max_batch=int(d.get("serve_max_batch", "0")) or None,
+            queue_limit=int(d.get("serve_queue_limit", "64")),
+            timeout_ms=timeout_ms)
+        srv = build_server(
+            engine, d.get("serve_host", "127.0.0.1"),
+            int(d.get("serve_port", "8080")),
+            # 0 disables the deadline engine-side; the handler's result
+            # wait must then be unbounded too, not an instant 504
+            request_timeout=(timeout_ms / 1000.0 if timeout_ms > 0
+                             else None),
+            verbose=not self.silent)
+        host, port = srv.server_address[:2]
+        if not self.silent:
+            print("serving %s on http://%s:%d (exported batch %d, "
+                  "max_wait %gms, queue %d)"
+                  % (engine.kind, host, port, engine.batch,
+                     1000.0 * engine.max_wait, engine.queue_limit))
+            sys.stdout.flush()
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            srv.server_close()
+            engine.close()
 
     def task_extract(self) -> None:
         """Reference: cxxnet_main.cpp:284-343."""

@@ -11,6 +11,10 @@ Two execution paths with identical math and the identical
   fetches it ONCE per round instead of copying every batch's scores
   off-device (a per-step D2H round trip the reference pays by design,
   nnet_impl-inl.hpp:174-180).
+
+``StreamingQuantile`` (bounded-window p50/p90/p99) lives here too: the
+serving telemetry (serve/stats.py) shares this module's statistics
+conventions rather than growing its own.
 """
 
 from __future__ import annotations
@@ -193,6 +197,56 @@ class MetricRecall(Metric):
                ).any(axis=2).sum(axis=1).astype(jnp.float32)
         rec = hit / label.shape[1]
         return jnp.sum(jnp.where(mask > 0, rec, 0.0)), jnp.sum(mask)
+
+
+class StreamingQuantile:
+    """Bounded-window streaming quantile estimator (p50/p90/p99 ...).
+
+    Keeps the most recent ``window`` observations in a ring buffer and
+    answers any quantile exactly over that window via ``np.percentile``
+    — O(window) memory, O(1) add, no approximation sketch. Recency is
+    the point for serving telemetry (serve/stats.py): the /metrics
+    latency percentiles describe current behaviour, not a whole-uptime
+    average that a warmup spike would poison forever. Not thread-safe;
+    callers that share one instance across threads hold their own lock
+    (ServeStats does)."""
+
+    def __init__(self, window: int = 1024) -> None:
+        if window < 1:
+            raise ValueError("window must be >= 1, got %d" % window)
+        self.window = window
+        self._buf = np.empty(window, np.float64)
+        self._n = 0          # observations ever seen
+
+    def add(self, x: float) -> None:
+        self._buf[self._n % self.window] = float(x)
+        self._n += 1
+
+    def __len__(self) -> int:
+        return min(self._n, self.window)
+
+    @property
+    def count(self) -> int:
+        """Total observations ever added (window overflow included)."""
+        return self._n
+
+    def quantile(self, q: float) -> float:
+        """Exact q-quantile (0 <= q <= 1) of the retained window; nan
+        when no observation has been added yet."""
+        k = len(self)
+        if k == 0:
+            return float("nan")
+        return float(np.percentile(self._buf[:k], 100.0 * q))
+
+    def quantiles(self, qs: List[float]) -> List[float]:
+        k = len(self)
+        if k == 0:
+            return [float("nan")] * len(qs)
+        vals = np.percentile(self._buf[:k], [100.0 * q for q in qs])
+        return [float(v) for v in vals]
+
+    def clear(self) -> None:
+        self._n = 0
 
 
 def create_metric(name: str) -> Optional[Metric]:

@@ -1,0 +1,36 @@
+"""cxxnet_tpu.serve — dynamic-batching inference serving over exported
+artifacts (or a live trainer).
+
+The deployment story past ``task=export_model``: ``serving.py`` turns a
+trained net into a self-contained AOT artifact, and this package turns
+that artifact into a trafficable service —
+
+* :mod:`.engine` — :class:`ServingEngine`: bounded admission queue +
+  one dispatch thread coalescing arbitrary per-request batch sizes
+  into padded exported-shape batches (max_wait_ms / max_batch /
+  queue_limit / timeout_ms knobs), with slot-granular continuous
+  admission for exported decoders;
+* :mod:`.server` — stdlib ThreadingHTTPServer exposing /predict,
+  /generate, /healthz, /metrics with JSON bodies, per-request
+  timeouts, and 429 backpressure;
+* :mod:`.stats` — streaming latency/occupancy telemetry
+  (p50/p90/p99, throughput, queue depth, batch occupancy) built on
+  ``metrics.StreamingQuantile``.
+
+CLI: ``task = serve`` (docs/serving.md, docs/tasks.md).
+"""
+
+from .engine import QueueFullError, Request, ServingEngine
+from .stats import ServeStats
+
+__all__ = ["QueueFullError", "Request", "ServingEngine", "ServeStats",
+           "ServeHTTPServer", "build_server"]
+
+
+def __getattr__(name):
+    # server.py pulls in http.server; lazy so engine-only users (and
+    # the package docstring import) stay light
+    if name in ("ServeHTTPServer", "build_server"):
+        from . import server
+        return getattr(server, name)
+    raise AttributeError(name)

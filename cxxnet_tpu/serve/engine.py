@@ -1,0 +1,436 @@
+"""Dynamic-batching serving engine over one compiled callee.
+
+The reference framework solved host-side TRAINING throughput with its
+threadbuffer/prefetch iterator chain (reference: src/utils/
+thread_buffer.h — decouple the producer from the consumer, keep the
+device busy). This module is the serving-side dual: many small
+producers (request threads) in front of ONE consumer — an AOT-exported
+forward/decoder that only accepts its exported batch shape — with a
+bounded admission queue and a single dispatch thread between them.
+
+Mechanics:
+
+* ``submit`` / ``submit_tokens`` enqueue a :class:`Request` (any
+  per-request row count) and return immediately; ``Request.result``
+  blocks the caller. At ``queue_limit`` pending requests admission
+  raises :class:`QueueFullError` — load sheds at the door (HTTP 429 in
+  serve/server.py) instead of growing an unbounded backlog.
+* The dispatch thread takes the oldest request, then coalesces further
+  whole requests FIFO until the exported batch is row-full or
+  ``max_wait_ms`` passes — the classic dynamic-batching latency/
+  occupancy knob. Rows from all taken requests are packed into one
+  zero-padded exported-shape buffer, the callee runs once, and each
+  request gets its row slice back (pad-and-trim; row independence of
+  the forward/decode keeps real rows exact).
+* Decoder callees batch at SLOT granularity, continuous-batching
+  style: the exported decode loop owns B sequence slots, and every
+  dispatch refills all free slots from the queue (unused slots run a
+  1-token dummy prompt). Admission is continuous — slots rebind to new
+  requests every dispatch — though a dispatch in flight completes all
+  its slots before they free (the monolithic AOT decode loop cannot
+  release a finished slot mid-program).
+* A request carries a deadline (``timeout_ms``): expired requests are
+  failed with :class:`TimeoutError` at dispatch time rather than
+  burning callee time on an answer nobody is waiting for.
+
+Callees are duck-typed: a ``serving.ExportedModel`` (or anything with
+``meta["input_shape"]``), a ``serving.ExportedDecoder`` (anything with
+``meta["kind"] == "generate"``), or a live ``Trainer`` (its forward is
+served in-process — the dev-box path, no export step).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .stats import ServeStats
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at queue_limit — shed load (maps to HTTP 429)."""
+
+
+class Request:
+    """One in-flight request, completed by the dispatch thread."""
+
+    __slots__ = ("rows", "payload", "t_submit", "deadline",
+                 "_event", "_value", "_error")
+
+    def __init__(self, rows: int, payload, timeout_s: Optional[float]):
+        self.rows = rows
+        self.payload = payload
+        self.t_submit = time.monotonic()
+        self.deadline = (self.t_submit + timeout_s
+                         if timeout_s and timeout_s > 0 else None)
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+
+    def _finish(self, value=None, error: Optional[BaseException] = None):
+        self._value = value
+        self._error = error
+        self._event.set()
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until the dispatch thread answers; raises the callee's
+        error, TimeoutError on expiry, or TimeoutError if ``timeout``
+        seconds pass with no answer."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not answered within %.3fs"
+                               % (timeout if timeout is not None else -1))
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+# ----------------------------------------------------------------------
+# callee adapters: one uniform (batch, run) surface over the three
+# things the engine can serve
+
+class _ForwardCallee:
+    """An ExportedModel (meta sidecar required: it is the io contract
+    the batcher packs against)."""
+    kind = "forward"
+
+    def __init__(self, model):
+        meta = getattr(model, "meta", None) or {}
+        if "input_shape" not in meta:
+            raise ValueError(
+                "ServingEngine needs the .meta sidecar (input_shape) "
+                "to batch requests against an exported model")
+        self.batch = int(meta["input_shape"][0])
+        self.item_shape = tuple(int(d) for d in meta["input_shape"][1:])
+        self.dtype = np.dtype(meta.get("input_dtype", "float32"))
+        self._model = model
+
+    def run(self, data: np.ndarray) -> np.ndarray:
+        return np.asarray(self._model(data))
+
+
+class _TrainerCallee:
+    """A live Trainer's forward — same answer an export of it would
+    give (the output node's values), served in-process."""
+    kind = "forward"
+
+    def __init__(self, trainer):
+        self.batch = int(trainer.batch_size)
+        net = trainer.net
+        self.item_shape = tuple(int(d) for d in net.node_shapes[0][1:])
+        self.dtype = (np.dtype(np.uint8) if net.input_norm is not None
+                      else np.dtype(np.float32))
+        self._tr = trainer
+        self._lw = max(hi for _, hi in trainer.net_cfg.label_range)
+
+    def run(self, data: np.ndarray) -> np.ndarray:
+        from ..io import DataBatch
+        n, B = data.shape[0], self.batch
+        outs = []
+        for lo in range(0, n, B):
+            chunk = data[lo:lo + B]
+            if chunk.shape[0] < B:
+                pad = np.zeros((B - chunk.shape[0],) + self.item_shape,
+                               data.dtype)
+                chunk = np.concatenate([chunk, pad])
+            b = DataBatch(data=chunk,
+                          label=np.zeros((B, self._lw), np.float32))
+            out = self._tr.forward_nodes(b, [self._tr.net.out_node])[0]
+            outs.append(np.asarray(out))
+        out = outs[0] if len(outs) == 1 else np.concatenate(outs)
+        return out[:n]
+
+
+class _DecodeCallee:
+    """An ExportedDecoder: B sequence slots, (tokens, lens, seed) in,
+    completed token matrix out."""
+    kind = "decode"
+
+    def __init__(self, dec):
+        m = dec.meta
+        self.batch = int(m["batch"])
+        self.seq_len = int(m["seq_len"])
+        self.max_prompt_len = int(m["max_prompt_len"])
+        self.max_new = int(m["max_new"])
+        self._dec = dec
+
+    def run(self, toks: np.ndarray, lens: np.ndarray,
+            seed: int) -> np.ndarray:
+        return np.asarray(self._dec(toks, lens, seed=seed))
+
+
+def _wrap_callee(callee):
+    meta = getattr(callee, "meta", None)
+    if isinstance(meta, dict) and meta.get("kind") == "generate":
+        return _DecodeCallee(callee)
+    if isinstance(meta, dict) and "input_shape" in meta:
+        return _ForwardCallee(callee)
+    if hasattr(callee, "net") and hasattr(callee, "forward_nodes"):
+        return _TrainerCallee(callee)
+    if meta is not None or hasattr(callee, "_exp"):
+        # a meta-less (bare blob) or odd-meta export: _ForwardCallee
+        # raises the informative "needs the .meta sidecar" error
+        return _ForwardCallee(callee)
+    raise TypeError(
+        "cannot serve %r: expected an ExportedModel/ExportedDecoder "
+        "(load_exported) or a live Trainer" % (callee,))
+
+
+# ----------------------------------------------------------------------
+
+class ServingEngine:
+    """Admission queue + dispatch thread + pad-and-trim batcher in
+    front of one compiled callee.
+
+    Knobs:
+      max_wait_ms    how long the batcher holds a non-full batch open
+                     for more requests (latency floor vs occupancy)
+      max_batch      cap on coalesced rows per dispatch (default and
+                     ceiling: the exported batch size)
+      queue_limit    pending requests before admission sheds
+      timeout_ms     per-request deadline (0 disables); expired
+                     requests fail with TimeoutError, unserved
+      start=False    leaves the dispatch thread stopped (tests use it
+                     to saturate the queue deterministically)
+    """
+
+    def __init__(self, callee, max_wait_ms: float = 5.0,
+                 max_batch: Optional[int] = None, queue_limit: int = 64,
+                 timeout_ms: float = 30000.0,
+                 stats: Optional[ServeStats] = None, seed: int = 0,
+                 start: bool = True):
+        self.callee = _wrap_callee(callee)
+        self.batch = self.callee.batch
+        self.kind = self.callee.kind
+        self.max_batch = min(int(max_batch), self.batch) if max_batch \
+            else self.batch
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.max_wait = max(float(max_wait_ms), 0.0) / 1000.0
+        self.queue_limit = int(queue_limit)
+        self.timeout_s = float(timeout_ms) / 1000.0
+        self.stats = stats or ServeStats()
+        self._seed = int(seed)
+        self._ndispatch = 0
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._started = False
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-dispatch", daemon=True)
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self._thread.start()
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    def metrics(self) -> dict:
+        """stats snapshot + live gauges + the engine's configuration —
+        the /metrics payload."""
+        snap = self.stats.snapshot()
+        snap["queue_depth"] = self.queue_depth
+        snap["kind"] = self.kind
+        snap["exported_batch"] = self.batch
+        snap["max_batch"] = self.max_batch
+        snap["max_wait_ms"] = 1000.0 * self.max_wait
+        snap["queue_limit"] = self.queue_limit
+        return snap
+
+    # ------------------------------------------------------------------
+    def submit(self, data: np.ndarray) -> Request:
+        """Enqueue a forward request of any row count ``n >= 1``:
+        ``data`` is ``(n, *item_shape)`` (a bare ``item_shape`` array
+        is promoted to one row). Returns a :class:`Request`."""
+        if self.callee.kind != "forward":
+            raise RuntimeError(
+                "this engine serves a decoder; use submit_tokens")
+        arr = np.asarray(data, self.callee.dtype)
+        item = self.callee.item_shape
+        if arr.shape == item:
+            arr = arr[None]
+        if arr.ndim != 1 + len(item) or tuple(arr.shape[1:]) != item:
+            raise ValueError(
+                "data must be (n, %s), got %s"
+                % (", ".join(map(str, item)), arr.shape))
+        if arr.shape[0] < 1:
+            raise ValueError("empty request")
+        req = Request(arr.shape[0], arr, self.timeout_s)
+        self._admit(req)
+        return req
+
+    def submit_tokens(self, tokens: np.ndarray, lens: Sequence[int],
+                      seed: Optional[int] = None) -> Request:
+        """Enqueue a generate request: ``tokens (n, seq_len)`` int32
+        (prompt left-aligned per row, rest zeros), ``lens (n,)`` with
+        ``1 <= len <= max_prompt_len``. ``seed`` seeds the sampling
+        key of the dispatch this request lands in (one key per
+        compiled decode call — requests sharing a dispatch share it;
+        irrelevant for greedy temperature-0 artifacts)."""
+        if self.callee.kind != "decode":
+            raise RuntimeError(
+                "this engine serves a forward model; use submit")
+        toks = np.asarray(tokens, np.int32)
+        lens = np.asarray(lens, np.int32)
+        S = self.callee.seq_len
+        if toks.ndim != 2 or toks.shape[1] != S:
+            raise ValueError("tokens must be (n, %d), got %s"
+                             % (S, toks.shape))
+        n = toks.shape[0]
+        if n < 1:
+            raise ValueError("empty request")
+        if lens.shape != (n,) or int(lens.min(initial=1)) < 1:
+            raise ValueError(
+                "lens must be (%d,) with every prompt >= 1 token" % n)
+        if int(lens.max(initial=0)) > self.callee.max_prompt_len:
+            raise ValueError(
+                "a prompt exceeds the exported max_prompt_len %d"
+                % self.callee.max_prompt_len)
+        req = Request(n, (toks, lens, seed), self.timeout_s)
+        self._admit(req)
+        return req
+
+    def _admit(self, req: Request) -> None:
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            if len(self._q) >= self.queue_limit:
+                self.stats.on_reject()
+                raise QueueFullError(
+                    "admission queue full (%d pending)" % len(self._q))
+            self._q.append(req)
+            self._cond.notify()
+
+    # ------------------------------------------------------------------
+    def _gather(self) -> Optional[List[Request]]:
+        """Take the oldest request, coalesce whole follow-ups FIFO until
+        row-full or max_wait elapses. None = closed and drained."""
+        with self._cond:
+            while not self._q:
+                if self._closed:
+                    return None
+                self._cond.wait(0.05)
+            first = self._q.popleft()
+            taken, rows = [first], first.rows
+            deadline = time.monotonic() + self.max_wait
+            while rows < self.max_batch:
+                if self._q:
+                    if rows + self._q[0].rows > self.max_batch:
+                        break   # head doesn't fit whole; next dispatch
+                    r = self._q.popleft()
+                    taken.append(r)
+                    rows += r.rows
+                    continue
+                left = deadline - time.monotonic()
+                if left <= 0 or self._closed:
+                    break
+                self._cond.wait(left)
+            return taken
+
+    def _dispatch(self, reqs: List[Request]) -> None:
+        now = time.monotonic()
+        live = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                self.stats.on_timeout()
+                r._finish(error=TimeoutError(
+                    "request expired after %.0f ms in queue"
+                    % (1000.0 * (now - r.t_submit))))
+            else:
+                live.append(r)
+        if not live:
+            return
+        rows = sum(r.rows for r in live)
+        try:
+            if self.callee.kind == "forward":
+                out = self._run_forward(live, rows)
+            else:
+                out = self._run_decode(live, rows)
+        except Exception as e:   # callee failure fails the whole batch
+            self.stats.on_error(len(live))
+            for r in live:
+                r._finish(error=e)
+            return
+        self.stats.on_dispatch(len(live), min(rows, self.batch),
+                               self.batch)
+        done = time.monotonic()
+        lo = 0
+        for r in live:
+            r._finish(value=out[lo:lo + r.rows])
+            self.stats.on_complete(done - r.t_submit, r.rows)
+            lo += r.rows
+
+    def _run_forward(self, live: List[Request], rows: int) -> np.ndarray:
+        c = self.callee
+        if len(live) == 1:
+            # single request: the callee pads/chunks itself (an
+            # oversize request can exceed the exported batch)
+            return c.run(live[0].payload)
+        buf = np.zeros((self.batch,) + c.item_shape, c.dtype)
+        lo = 0
+        for r in live:
+            buf[lo:lo + r.rows] = r.payload
+            lo += r.rows
+        return c.run(buf)[:rows]
+
+    def _run_decode(self, live: List[Request], rows: int) -> np.ndarray:
+        c = self.callee
+        self._ndispatch += 1
+        seed = next((r.payload[2] for r in live
+                     if r.payload[2] is not None),
+                    self._seed + self._ndispatch)
+        if len(live) == 1:
+            toks, lens, _ = live[0].payload
+            return c.run(toks, lens, int(seed))
+        # slot assembly: pack every request's prompt rows into the B
+        # decode slots; unused slots run a 1-token dummy prompt
+        toks = np.zeros((self.batch, c.seq_len), np.int32)
+        lens = np.ones((self.batch,), np.int32)
+        lo = 0
+        for r in live:
+            t, l, _ = r.payload
+            toks[lo:lo + r.rows] = t
+            lens[lo:lo + r.rows] = l
+            lo += r.rows
+        return c.run(toks, lens, int(seed))[:rows]
+
+    def _loop(self) -> None:
+        while True:
+            reqs = self._gather()
+            if reqs is None:
+                return
+            self._dispatch(reqs)
+
+    # ------------------------------------------------------------------
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop admission, drain what's queued, join the dispatch
+        thread; anything still pending afterwards fails."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._started:
+            self._thread.join(timeout)
+        with self._cond:
+            while self._q:
+                self._q.popleft()._finish(
+                    error=RuntimeError("engine closed"))
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,235 @@
+"""HTTP front end for :class:`~cxxnet_tpu.serve.engine.ServingEngine`.
+
+Stdlib-only (``http.server.ThreadingHTTPServer`` — no framework dep,
+matching the repo's no-new-dependency rule): one handler thread per
+connection blocks on its request's :class:`Request` while the engine's
+dispatch thread batches across all of them. JSON in, JSON out.
+
+Endpoints:
+  POST /predict    {"data": nested list (n, *item_shape)} ->
+                   {"output": probs, "pred": task=pred convention}
+  POST /generate   {"prompts": [[token ids] ...], "seed": optional} ->
+                   {"tokens": [[prompt + completion] ...]}
+  GET  /healthz    liveness + the artifact contract
+  GET  /metrics    engine.metrics() (see serve/stats.py for schema)
+
+Error mapping: malformed body/shape -> 400, wrong endpoint for the
+artifact kind -> 409, queue full -> 429 (with Retry-After), request
+deadline exceeded -> 504, callee failure -> 500. A saturated server
+answers 429 immediately — it never hangs the client.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .engine import QueueFullError, ServingEngine
+
+
+def _pred_convention(out: np.ndarray):
+    """task=pred's answer shape: argmax per row for multi-way outputs,
+    the raw scalar for 1-wide (regression) outputs — the same
+    convention as ExportedModel.predict."""
+    mat = out.reshape(out.shape[0], -1)
+    if mat.shape[1] == 1:
+        return [float(v) for v in mat[:, 0]]
+    return [int(v) for v in mat.argmax(axis=1)]
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    server_version = "cxxnet-tpu-serve/0.1"
+    protocol_version = "HTTP/1.1"
+
+    # ------------------------------------------------------------------
+    def log_message(self, fmt, *args):   # default spams stderr per hit
+        if self.server.verbose:
+            sys.stderr.write("%s - %s\n"
+                             % (self.address_string(), fmt % args))
+
+    def _send(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if code == 429:
+            self.send_header("Retry-After", "1")
+        if self.close_connection:
+            self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> Optional[dict]:
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            # unparseable length: the body can't be drained, so the
+            # keep-alive connection can't be reused either
+            self.close_connection = True
+            self._send(400, {"error": "bad Content-Length"})
+            return None
+        if n <= 0:
+            self._send(400, {"error": "missing request body"})
+            return None
+        if n > self.server.max_body:
+            # answering without draining the n body bytes would leave
+            # them to be parsed as the NEXT request on this keep-alive
+            # connection — close instead of reading an oversize body
+            self.close_connection = True
+            self._send(413, {"error": "body exceeds %d bytes"
+                             % self.server.max_body})
+            return None
+        raw = self.rfile.read(n)
+        try:
+            obj = json.loads(raw)
+        except ValueError:
+            self._send(400, {"error": "body is not valid JSON"})
+            return None
+        if not isinstance(obj, dict):
+            self._send(400, {"error": "body must be a JSON object"})
+            return None
+        return obj
+
+    # ------------------------------------------------------------------
+    def do_GET(self):
+        eng: ServingEngine = self.server.engine
+        if self.path == "/healthz":
+            info = {"ok": True, "kind": eng.kind, "batch": eng.batch,
+                    "queue_depth": eng.queue_depth}
+            if eng.kind == "decode":
+                info["seq_len"] = eng.callee.seq_len
+                info["max_prompt_len"] = eng.callee.max_prompt_len
+                info["max_new"] = eng.callee.max_new
+            self._send(200, info)
+        elif self.path == "/metrics":
+            self._send(200, eng.metrics())
+        else:
+            self._send(404, {"error": "no such path %s" % self.path})
+
+    def do_POST(self):
+        if self.path == "/predict":
+            self._post_predict()
+        elif self.path == "/generate":
+            self._post_generate()
+        else:
+            self._send(404, {"error": "no such path %s" % self.path})
+
+    # ------------------------------------------------------------------
+    def _wait(self, req) -> Optional[np.ndarray]:
+        try:
+            return req.result(self.server.request_timeout)
+        except TimeoutError as e:
+            self._send(504, {"error": str(e)})
+        except Exception as e:
+            self._send(500, {"error": "%s: %s" % (type(e).__name__, e)})
+        return None
+
+    def _post_predict(self):
+        eng: ServingEngine = self.server.engine
+        if eng.kind != "forward":
+            self._send(409, {"error":
+                             "this server hosts a decoder; POST /generate"})
+            return
+        payload = self._read_json()
+        if payload is None:
+            return
+        if "data" not in payload:
+            self._send(400, {"error": 'body needs a "data" field'})
+            return
+        try:
+            req = eng.submit(np.asarray(payload["data"]))
+        except QueueFullError as e:
+            self._send(429, {"error": str(e)})
+            return
+        except (ValueError, TypeError) as e:
+            self._send(400, {"error": str(e)})
+            return
+        out = self._wait(req)
+        if out is None:
+            return
+        self._send(200, {"output": out.tolist(),
+                         "pred": _pred_convention(out)})
+
+    def _post_generate(self):
+        eng: ServingEngine = self.server.engine
+        if eng.kind != "decode":
+            self._send(409, {"error":
+                             "this server hosts a forward model; "
+                             "POST /predict"})
+            return
+        payload = self._read_json()
+        if payload is None:
+            return
+        prompts = payload.get("prompts")
+        if (not isinstance(prompts, list) or not prompts
+                or not all(isinstance(p, list) and p for p in prompts)):
+            self._send(400, {"error": 'body needs "prompts": '
+                             '[[token ids, >= 1 each] ...]'})
+            return
+        c = eng.callee
+        toks = np.zeros((len(prompts), c.seq_len), np.int32)
+        lens = np.zeros((len(prompts),), np.int32)
+        for i, p in enumerate(prompts):
+            if len(p) > c.max_prompt_len:
+                self._send(400, {"error":
+                                 "prompt %d has %d tokens; the artifact "
+                                 "accepts at most %d"
+                                 % (i, len(p), c.max_prompt_len)})
+                return
+            try:
+                toks[i, :len(p)] = p
+            except (ValueError, TypeError, OverflowError):
+                self._send(400, {"error":
+                                 "prompt %d is not a flat int list" % i})
+                return
+            lens[i] = len(p)
+        seed = payload.get("seed")
+        try:
+            req = eng.submit_tokens(
+                toks, lens, None if seed is None else int(seed))
+        except QueueFullError as e:
+            self._send(429, {"error": str(e)})
+            return
+        except (ValueError, TypeError) as e:
+            self._send(400, {"error": str(e)})
+            return
+        out = self._wait(req)
+        if out is None:
+            return
+        self._send(200, {"tokens": [
+            [int(t) for t in out[i, :int(lens[i]) + c.max_new]]
+            for i in range(len(prompts))]})
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer bound to one engine. ``port=0`` binds a free
+    port (read it back from ``server_address[1]``)."""
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 8080,
+                 request_timeout: Optional[float] = 30.0,
+                 max_body: int = 64 << 20, verbose: bool = False):
+        self.engine = engine
+        self.request_timeout = request_timeout
+        self.max_body = max_body
+        self.verbose = verbose
+        super().__init__((host, port), ServeHandler)
+
+    def start_background(self) -> threading.Thread:
+        """serve_forever on a daemon thread (tests / smoke tool)."""
+        t = threading.Thread(target=self.serve_forever,
+                             name="serve-http", daemon=True)
+        t.start()
+        return t
+
+
+def build_server(engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 8080, **kw) -> ServeHTTPServer:
+    return ServeHTTPServer(engine, host, port, **kw)

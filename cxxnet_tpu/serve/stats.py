@@ -1,0 +1,106 @@
+"""Streaming serving telemetry: latency quantiles, throughput, batch
+occupancy, shed/timeout counters — the numbers behind ``/metrics``.
+
+Conventions follow the training-side observability modules: the
+quantile machinery is ``metrics.StreamingQuantile`` (bounded recency
+window, exact over the window) and the latency philosophy matches
+``profiler.StepTimer`` — host wall clock including queueing, which is
+what a caller experiences, not just device time.
+
+Occupancy is reported two ways because they answer different
+questions:
+
+* ``batch_occupancy`` — mean REQUESTS coalesced per dispatch. > 1
+  means the dynamic batcher is actually merging traffic (the number
+  the acceptance check watches).
+* ``batch_fill`` — mean fraction of the exported batch's rows carrying
+  real data. Low fill with high occupancy says requests are tiny;
+  high fill says the exported batch size matches the traffic.
+
+All counters are totals since construction; latency percentiles are
+over the last ``window`` completed requests. Thread-safe (one lock —
+the dispatch thread and every HTTP handler thread report here).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from ..metrics import StreamingQuantile
+
+
+class ServeStats:
+    def __init__(self, window: int = 1024) -> None:
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._lat = StreamingQuantile(window)
+        self._lat_sum = 0.0
+        self.requests = 0        # completed successfully
+        self.rows = 0            # rows in completed requests
+        self.dispatches = 0
+        self.dispatched_requests = 0
+        self.rejected = 0        # shed at admission (queue full)
+        self.timeouts = 0        # expired before / while dispatching
+        self.errors = 0          # failed inside the callee
+        self._fill_sum = 0.0
+
+    # ------------------------------------------------------------------
+    def on_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def on_timeout(self, n: int = 1) -> None:
+        with self._lock:
+            self.timeouts += n
+
+    def on_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.errors += n
+
+    def on_dispatch(self, nreq: int, rows: int, capacity: int) -> None:
+        """One callee invocation coalescing ``nreq`` requests totalling
+        ``rows`` rows against a ``capacity``-row exported batch."""
+        with self._lock:
+            self.dispatches += 1
+            self.dispatched_requests += nreq
+            self._fill_sum += rows / float(capacity) if capacity else 0.0
+
+    def on_complete(self, latency_s: float, rows: int) -> None:
+        """One request answered (dispatch + result handed back)."""
+        with self._lock:
+            self.requests += 1
+            self.rows += rows
+            self._lat.add(latency_s)
+            self._lat_sum += latency_s
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> Dict:
+        """The /metrics payload (JSON-ready)."""
+        with self._lock:
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            p50, p90, p99 = self._lat.quantiles([0.5, 0.9, 0.99])
+            n = self.requests
+            return {
+                "uptime_sec": elapsed,
+                "requests": n,
+                "rows": self.rows,
+                "dispatches": self.dispatches,
+                "rejected": self.rejected,
+                "timeouts": self.timeouts,
+                "errors": self.errors,
+                "batch_occupancy": (
+                    self.dispatched_requests / self.dispatches
+                    if self.dispatches else 0.0),
+                "batch_fill": (self._fill_sum / self.dispatches
+                               if self.dispatches else 0.0),
+                "rows_per_sec": self.rows / elapsed,
+                "requests_per_sec": n / elapsed,
+                "latency_ms": {
+                    "mean": 1000.0 * self._lat_sum / n if n else 0.0,
+                    "p50": 1000.0 * p50 if n else 0.0,
+                    "p90": 1000.0 * p90 if n else 0.0,
+                    "p99": 1000.0 * p99 if n else 0.0,
+                },
+            }

@@ -163,8 +163,13 @@ def export_generate(trainer, path: str, max_new: int = 32,
 
 class ExportedDecoder:
     """A deserialized ``export_generate`` artifact: ``__call__`` takes
-    ``(tokens (B, S), lens (B,))`` int arrays (+ optional ``seed``)
-    and returns the completed token matrix."""
+    ``(tokens (n, S), lens (n,))`` int arrays (+ optional ``seed``)
+    and returns the completed (n, S) token matrix. ``n`` need not equal
+    the exported batch: short batches are padded with 1-token dummy
+    rows up to the exported shape (the artifact's only legal shape) and
+    the padding rows trimmed from the output; long batches run in
+    exported-batch chunks. Row independence of the decode (per-sequence
+    causal attention) keeps real rows byte-identical either way."""
 
     def __init__(self, path: str, meta: dict):
         from jax import export as jexport
@@ -172,51 +177,113 @@ class ExportedDecoder:
             self._exp = jexport.deserialize(f.read())
         self.meta = meta
 
+    @property
+    def batch(self) -> int:
+        return int(self.meta["batch"])
+
+    @property
+    def seq_len(self) -> int:
+        return int(self.meta["seq_len"])
+
     def __call__(self, tokens: np.ndarray, lens: np.ndarray,
                  seed: int = 0) -> np.ndarray:
         import jax
         m = self.meta
+        B, S = int(m["batch"]), int(m["seq_len"])
         toks = np.asarray(tokens, np.int32)
         lens = np.asarray(lens, np.int32)
-        if toks.shape != (m["batch"], m["seq_len"]):
+        if toks.ndim != 2 or toks.shape[1] != S:
             raise ValueError(
-                "tokens must be (%d, %d), got %s"
-                % (m["batch"], m["seq_len"], toks.shape))
+                "tokens must be (n, %d), got %s" % (S, toks.shape))
+        n = toks.shape[0]
+        if n == 0:
+            raise ValueError("tokens must carry at least one row")
         if int(lens.max(initial=0)) > m["max_prompt_len"]:
             raise ValueError(
                 "a prompt exceeds the exported max_prompt_len %d"
                 % m["max_prompt_len"])
-        if lens.shape != (m["batch"],) or int(lens.min(initial=1)) < 1:
+        if lens.shape != (n,) or int(lens.min(initial=1)) < 1:
             # same invariant Trainer.generate enforces: a 0-length row
             # would silently corrupt its output
             raise ValueError(
-                "lens must be (%d,) with every prompt >= 1 token"
-                % m["batch"])
-        key = np.asarray(jax.random.PRNGKey(seed), np.uint32)
-        return np.asarray(self._exp.call(toks, lens, key))
+                "lens must be (%d,) with every prompt >= 1 token" % n)
+        base = jax.random.PRNGKey(seed)
+        outs = []
+        for lo in range(0, n, B):
+            t, l = toks[lo:lo + B], lens[lo:lo + B]
+            if t.shape[0] < B:
+                pad = B - t.shape[0]
+                t = np.concatenate([t, np.zeros((pad, S), np.int32)])
+                l = np.concatenate([l, np.ones((pad,), np.int32)])
+            # distinct key per chunk past the first: reusing one key
+            # would make rows i and B+i (same slot, same key) sample
+            # identically at temperature>0; chunk 0 keeps the base key
+            # so n <= B calls match tr.generate(seed) byte-exact
+            key = np.asarray(
+                base if lo == 0 else jax.random.fold_in(base, lo // B),
+                np.uint32)
+            outs.append(np.asarray(self._exp.call(t, l, key)))
+        out = outs[0] if len(outs) == 1 else np.concatenate(outs)
+        return out[:n]
 
 
 class ExportedModel:
     """A deserialized export: ``__call__`` runs the forward, ``predict``
-    adds the argmax-per-row convention of ``task=pred``."""
+    adds the argmax-per-row convention of ``task=pred``.
+
+    The exported program accepts exactly the exported batch shape, but
+    callers rarely arrive with it: ``__call__`` pads a short batch with
+    zero rows up to the exported batch and trims the padding from the
+    output, and runs a long batch in exported-batch chunks — row
+    independence of the forward keeps real rows unchanged. The .meta
+    sidecar supplies the contract; without it (bare blob) only the
+    exact exported shape works."""
 
     def __init__(self, path: str, meta: Optional[dict] = None):
         from jax import export as jexport
-        with open(path, "rb") as f:
-            self._exp = jexport.deserialize(f.read())
         self.meta = meta
         if meta is None:
             meta_path = path + ".meta"
             if os.path.exists(meta_path):
                 with open(meta_path) as f:
                     self.meta = json.load(f)
+                # reject a foreign sidecar before deserializing the
+                # blob: flatbuffers errors on garbage are inscrutable
                 if self.meta.get("magic") != MAGIC:
                     raise ValueError("%s: not a cxxnet_tpu export"
                                      % path)
+        with open(path, "rb") as f:
+            self._exp = jexport.deserialize(f.read())
+
+    @property
+    def batch(self) -> Optional[int]:
+        shape = (self.meta or {}).get("input_shape")
+        return int(shape[0]) if shape else None
 
     def __call__(self, data: np.ndarray) -> np.ndarray:
         dt = np.dtype((self.meta or {}).get("input_dtype", "float32"))
-        return np.asarray(self._exp.call(np.asarray(data, dt)))
+        arr = np.asarray(data, dt)
+        shape = (self.meta or {}).get("input_shape")
+        if shape is None or arr.shape == tuple(shape):
+            return np.asarray(self._exp.call(arr))
+        B = int(shape[0])
+        item = tuple(shape[1:])
+        if arr.ndim != 1 + len(item) or tuple(arr.shape[1:]) != item:
+            raise ValueError(
+                "data must be (n, %s), got %s"
+                % (", ".join(map(str, item)), arr.shape))
+        n = arr.shape[0]
+        if n == 0:
+            raise ValueError("data must carry at least one row")
+        outs = []
+        for lo in range(0, n, B):
+            chunk = arr[lo:lo + B]
+            if chunk.shape[0] < B:
+                pad = np.zeros((B - chunk.shape[0],) + item, dt)
+                chunk = np.concatenate([chunk, pad])
+            outs.append(np.asarray(self._exp.call(chunk)))
+        out = outs[0] if len(outs) == 1 else np.concatenate(outs)
+        return out[:n]
 
     def predict(self, data: np.ndarray) -> np.ndarray:
         out = self(data)

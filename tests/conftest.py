@@ -33,6 +33,14 @@ jax.config.update(
     "jax_compilation_cache_dir",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
                  ".jax-cache"))
+# the cache dir ALSO enables XLA-level caches (kernel / per-fusion
+# autotune) by default, and those are not keyed by device assignment:
+# an entry written under the 8-device mesh silently corrupts programs
+# compiled for a submesh (test_checkpoint_sharded elastic-resume loads
+# went numerically wrong, then the poisoned state segfaulted later CLI
+# tests). Keep only jax's own key-value cache, whose key includes the
+# device assignment.
+jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
 
 
 def write_idx(path, arr):

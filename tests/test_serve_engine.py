@@ -1,0 +1,284 @@
+"""ServingEngine (cxxnet_tpu/serve/engine.py): dynamic batching over an
+exported artifact — coalescing correctness (every response must match
+the direct ExportedModel/ExportedDecoder answer), occupancy, queue
+backpressure, timeouts, and error propagation.
+
+Logic-only tests (batching, queue, deadlines) run against fake callees
+so they cost no compiles; the acceptance-path tests run against real
+exported artifacts."""
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config, models, serving
+from cxxnet_tpu.io import DataBatch
+from cxxnet_tpu.serve import QueueFullError, ServeStats, ServingEngine
+from cxxnet_tpu.trainer import Trainer
+
+
+# ----------------------------------------------------------------------
+# fake callees: the engine duck-types on .meta, so batching logic is
+# testable without touching jax
+
+class FakeModel:
+    meta = {"input_shape": [8, 3], "input_dtype": "float32"}
+
+    def __init__(self, delay=0.0, fail=False):
+        self.delay = delay
+        self.fail = fail
+        self.calls = 0
+
+    def __call__(self, data):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError("callee exploded")
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(data) * 2.0
+
+
+class FakeDecoder:
+    meta = {"kind": "generate", "batch": 4, "seq_len": 12,
+            "max_prompt_len": 8, "max_new": 3}
+
+    def __call__(self, toks, lens, seed=0):
+        out = np.array(toks, np.int32)
+        for i, n in enumerate(np.asarray(lens)):
+            out[i, n:n + 3] = 99
+        return out
+
+
+# ----------------------------------------------------------------------
+# real artifacts (module-scoped: one export, many tests)
+
+@pytest.fixture(scope="module")
+def exported_mlp(tmp_path_factory):
+    tr = Trainer()
+    for k, v in config.parse_string(models.mnist_mlp(nhidden=16,
+                                                     nclass=4)):
+        tr.set_param(k, v)
+    for k, v in (("dev", "cpu:0"), ("batch_size", "16"), ("eta", "0.2"),
+                 ("input_shape", "1,1,32"), ("seed", "5")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    b = DataBatch(data=rs.randn(16, 1, 1, 32).astype(np.float32),
+                  label=rs.randint(0, 4, size=(16, 1)).astype(np.float32))
+    for _ in range(3):
+        tr.update(b)
+    path = str(tmp_path_factory.mktemp("serve") / "m.export")
+    serving.export_model(tr, path, platforms=["cpu"])
+    return serving.load_exported(path), b, tr
+
+
+@pytest.fixture(scope="module")
+def exported_decoder(tmp_path_factory):
+    tr = Trainer()
+    for k, v in config.parse_string(models.tiny_lm(
+            seq_len=16, vocab=16, embed=16, nlayer=1, nhead=2)):
+        tr.set_param(k, v)
+    for k, v in (("batch_size", "4"), ("dev", "cpu:0"), ("eta", "0.3"),
+                 ("seed", "0"), ("metric", "token_error")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    for _ in range(5):
+        start = rs.randint(0, 16, size=(4, 1))
+        seq = (start + np.arange(17)) % 16
+        tr.update(DataBatch(
+            data=seq[:, :16].astype(np.float32).reshape(4, 1, 16, 1),
+            label=seq[:, 1:].astype(np.float32)))
+    path = str(tmp_path_factory.mktemp("serve") / "d.export")
+    serving.export_generate(tr, path, max_new=4, temperature=0.0,
+                            prompt_len=8, platforms=["cpu"])
+    return serving.load_exported(path)
+
+
+# ----------------------------------------------------------------------
+
+def test_concurrent_mixed_sizes_match_direct(exported_mlp):
+    """The acceptance path: >= 32 concurrent requests with mixed
+    per-request batch sizes all answer exactly what the direct
+    ExportedModel call answers, and the batcher actually coalesces
+    (mean occupancy > 1 request/dispatch)."""
+    model, b, _ = exported_mlp
+    full = model(b.data)
+    with ServingEngine(model, max_wait_ms=50, queue_limit=128) as eng:
+        def fire(i):
+            n = 1 + i % 4
+            idx = [(i + j) % 16 for j in range(n)]
+            out = eng.submit(b.data[idx]).result(60)
+            np.testing.assert_allclose(out, full[idx],
+                                       rtol=1e-5, atol=1e-6)
+            return n
+        with ThreadPoolExecutor(8) as ex:
+            rows = list(ex.map(fire, range(32)))
+        m = eng.metrics()
+    assert m["requests"] == 32 and m["rows"] == sum(rows)
+    assert m["batch_occupancy"] > 1
+    assert m["dispatches"] < 32          # strictly fewer calls than requests
+    assert 0 < m["batch_fill"] <= 1
+    assert m["latency_ms"]["p99"] >= m["latency_ms"]["p50"] > 0
+
+
+def test_oversize_request_chunks(exported_mlp):
+    model, b, _ = exported_mlp
+    big = np.concatenate([b.data, b.data[:7]])     # 23 rows > batch 16
+    with ServingEngine(model, max_wait_ms=1) as eng:
+        out = eng.submit(big).result(60)
+    np.testing.assert_allclose(out[:16], model(b.data),
+                               rtol=1e-5, atol=1e-6)
+    assert out.shape[0] == 23
+
+
+def test_single_instance_promotion():
+    with ServingEngine(FakeModel(), max_wait_ms=1) as eng:
+        out = eng.submit(np.ones(3, np.float32)).result(10)
+    assert out.shape == (1, 3)
+
+
+def test_queue_full_sheds_then_drains():
+    eng = ServingEngine(FakeModel(), queue_limit=4, start=False)
+    reqs = [eng.submit(np.ones((1, 3), np.float32)) for _ in range(4)]
+    with pytest.raises(QueueFullError):
+        eng.submit(np.ones((1, 3), np.float32))
+    assert eng.metrics()["rejected"] == 1
+    assert eng.queue_depth == 4
+    eng.start()                 # backlog drains once dispatch runs
+    for r in reqs:
+        assert r.result(10).shape == (1, 3)
+    eng.close()
+
+
+def test_result_wait_timeout_never_hangs():
+    eng = ServingEngine(FakeModel(), start=False)
+    req = eng.submit(np.ones((1, 3), np.float32))
+    with pytest.raises(TimeoutError):
+        req.result(0.05)
+    eng.close()
+    # close() fails whatever was still queued
+    with pytest.raises(RuntimeError, match="closed"):
+        req.result(1)
+
+
+def test_expired_request_not_served():
+    """A request whose deadline passed while queued is failed with
+    TimeoutError at dispatch time, not run."""
+    fake = FakeModel()
+    eng = ServingEngine(fake, timeout_ms=30, start=False)
+    req = eng.submit(np.ones((1, 3), np.float32))
+    time.sleep(0.08)
+    eng.start()
+    with pytest.raises(TimeoutError, match="expired"):
+        req.result(10)
+    assert fake.calls == 0
+    assert eng.metrics()["timeouts"] == 1
+    eng.close()
+
+
+def test_callee_error_propagates():
+    eng = ServingEngine(FakeModel(fail=True), max_wait_ms=1)
+    req = eng.submit(np.ones((2, 3), np.float32))
+    with pytest.raises(RuntimeError, match="exploded"):
+        req.result(10)
+    assert eng.metrics()["errors"] == 1
+    eng.close()
+
+
+def test_submit_validation():
+    eng = ServingEngine(FakeModel(), start=False)
+    with pytest.raises(ValueError, match=r"data must be \(n, 3\)"):
+        eng.submit(np.ones((2, 5), np.float32))
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit(np.zeros((0, 3), np.float32))
+    with pytest.raises(RuntimeError, match="forward model; use submit"):
+        eng.submit_tokens(np.zeros((1, 12), np.int32), [1])
+    eng.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(np.ones((1, 3), np.float32))
+
+
+def test_decode_slot_packing_fake():
+    """Multiple generate requests pack into the decoder's slots (one
+    callee call) and each gets its own rows back."""
+    dec = FakeDecoder()
+    with ServingEngine(dec, max_wait_ms=50) as eng:
+        def fire(i):
+            toks = np.zeros((1, 12), np.int32)
+            toks[0, :2] = [i + 1, i + 2]
+            out = eng.submit_tokens(toks, [2]).result(10)
+            assert out.shape == (1, 12)
+            assert list(out[0, :5]) == [i + 1, i + 2, 99, 99, 99]
+        with ThreadPoolExecutor(4) as ex:
+            list(ex.map(fire, range(8)))
+        m = eng.metrics()
+    assert m["batch_occupancy"] > 1
+
+
+def test_decode_validation():
+    eng = ServingEngine(FakeDecoder(), start=False)
+    with pytest.raises(RuntimeError, match="use submit"):
+        eng.submit(np.ones((1, 3), np.float32))
+    with pytest.raises(ValueError, match=r"tokens must be \(n, 12\)"):
+        eng.submit_tokens(np.zeros((1, 5), np.int32), [1])
+    with pytest.raises(ValueError, match="max_prompt_len"):
+        eng.submit_tokens(np.zeros((1, 12), np.int32), [9])
+    with pytest.raises(ValueError, match=">= 1 token"):
+        eng.submit_tokens(np.zeros((1, 12), np.int32), [0])
+    eng.close()
+
+
+def test_decoder_engine_matches_direct(exported_decoder):
+    """Real exported decoder: coalesced 1-row generate requests answer
+    exactly the direct decoder call (greedy, row-independent)."""
+    dec = exported_decoder
+    toks = np.zeros((4, 16), np.int32)
+    prompts = [[3, 4, 5], [10, 11], [0, 1, 2, 3], [7]]
+    lens = np.array([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    full = dec(toks, lens)
+    with ServingEngine(dec, max_wait_ms=50, queue_limit=64) as eng:
+        def fire(i):
+            out = eng.submit_tokens(toks[i % 4][None],
+                                    lens[i % 4][None]).result(120)
+            np.testing.assert_array_equal(out[0], full[i % 4])
+        with ThreadPoolExecutor(8) as ex:
+            list(ex.map(fire, range(12)))
+        m = eng.metrics()
+    assert m["batch_occupancy"] > 1
+
+
+def test_live_trainer_callee(exported_mlp):
+    """Serving a live Trainer answers the same probabilities its export
+    does — the no-export dev-box path."""
+    model, b, tr = exported_mlp
+    full = model(b.data)
+    with ServingEngine(tr, max_wait_ms=10) as eng:
+        assert eng.kind == "forward" and eng.batch == 16
+        out = eng.submit(b.data[:5]).result(60)
+    np.testing.assert_allclose(np.asarray(out).reshape(5, -1),
+                               full[:5].reshape(5, -1),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_wrap_rejects_unservable():
+    with pytest.raises(TypeError, match="cannot serve"):
+        ServingEngine(object())
+    class MetaNoShape:
+        meta = {"magic": "x"}
+    with pytest.raises(ValueError, match="meta sidecar"):
+        ServingEngine(MetaNoShape())
+
+
+def test_stats_shared_instance():
+    """A caller may hand in its own ServeStats (aggregating several
+    engines onto one /metrics surface)."""
+    st = ServeStats(window=16)
+    with ServingEngine(FakeModel(), max_wait_ms=1, stats=st) as eng:
+        eng.submit(np.ones((2, 3), np.float32)).result(10)
+    snap = st.snapshot()
+    assert snap["requests"] == 1 and snap["rows"] == 2

@@ -1,0 +1,297 @@
+"""HTTP front end (cxxnet_tpu/serve/server.py): endpoint contracts,
+concurrent mixed-size /predict traffic against a real exported MLP,
+backpressure (429, never a hang), the error-code mapping, and the
+``task = serve`` CLI wiring end to end."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import config, models, serving
+from cxxnet_tpu.io import DataBatch
+from cxxnet_tpu.serve import ServingEngine
+from cxxnet_tpu.serve.server import build_server
+from cxxnet_tpu.trainer import Trainer
+
+
+class FakeModel:
+    meta = {"input_shape": [8, 3], "input_dtype": "float32"}
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def __call__(self, data):
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(data) * 2.0
+
+
+class FakeDecoder:
+    meta = {"kind": "generate", "batch": 4, "seq_len": 12,
+            "max_prompt_len": 8, "max_new": 3}
+
+    def __call__(self, toks, lens, seed=0):
+        out = np.array(toks, np.int32)
+        for i, n in enumerate(np.asarray(lens)):
+            out[i, n:n + 3] = 99
+        return out
+
+
+def _url(srv):
+    return "http://127.0.0.1:%d" % srv.server_address[1]
+
+
+def _post(url, path, obj, timeout=30):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.load(r)
+
+
+def _get(url, path, timeout=10):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.status, json.load(r)
+
+
+@pytest.fixture(scope="module")
+def exported_mlp(tmp_path_factory):
+    tr = Trainer()
+    for k, v in config.parse_string(models.mnist_mlp(nhidden=16,
+                                                     nclass=4)):
+        tr.set_param(k, v)
+    for k, v in (("dev", "cpu:0"), ("batch_size", "16"), ("eta", "0.2"),
+                 ("input_shape", "1,1,32"), ("seed", "5")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    b = DataBatch(data=rs.randn(16, 1, 1, 32).astype(np.float32),
+                  label=rs.randint(0, 4, size=(16, 1)).astype(np.float32))
+    for _ in range(3):
+        tr.update(b)
+    path = str(tmp_path_factory.mktemp("http") / "m.export")
+    serving.export_model(tr, path, platforms=["cpu"])
+    return path, serving.load_exported(path), b
+
+
+# ----------------------------------------------------------------------
+
+def test_predict_http_concurrent(exported_mlp):
+    """The acceptance path over HTTP: >= 32 concurrent mixed-size
+    /predict requests, every response equals direct
+    ExportedModel.predict, /metrics shows real coalescing."""
+    _, model, b = exported_mlp
+    full = model(b.data)
+    pred_full = model.predict(b.data)
+    eng = ServingEngine(model, max_wait_ms=50, queue_limit=128)
+    srv = build_server(eng, port=0)
+    srv.start_background()
+    url = _url(srv)
+    try:
+        s, h = _get(url, "/healthz")
+        assert s == 200 and h["ok"] and h["kind"] == "forward" \
+            and h["batch"] == 16
+
+        def fire(i):
+            n = 1 + i % 4
+            idx = [(i + j) % 16 for j in range(n)]
+            s, body = _post(url, "/predict",
+                            {"data": b.data[idx].tolist()}, timeout=60)
+            assert s == 200
+            np.testing.assert_allclose(
+                np.asarray(body["output"]), full[idx],
+                rtol=1e-5, atol=1e-6)
+            assert body["pred"] == [int(pred_full[j]) for j in idx]
+        with ThreadPoolExecutor(8) as ex:
+            list(ex.map(fire, range(32)))
+
+        s, m = _get(url, "/metrics")
+        assert s == 200
+        assert m["requests"] == 32
+        assert m["batch_occupancy"] > 1
+        assert m["dispatches"] < 32
+        assert m["latency_ms"]["p99"] >= m["latency_ms"]["p50"] > 0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+
+def test_saturated_queue_returns_429_not_hang():
+    """With the dispatch thread held, the queue_limit-th+1 request gets
+    an immediate 429 (with Retry-After) instead of hanging; starting
+    the engine drains the backlog to 200s."""
+    eng = ServingEngine(FakeModel(), queue_limit=3, start=False)
+    srv = build_server(eng, port=0)
+    srv.start_background()
+    url = _url(srv)
+    try:
+        ex = ThreadPoolExecutor(4)
+        futs = [ex.submit(_post, url, "/predict",
+                          {"data": [[1.0, 2.0, 3.0]]}) for _ in range(3)]
+        deadline = time.monotonic() + 10
+        while eng.queue_depth < 3:
+            assert time.monotonic() < deadline, "backlog never built"
+            time.sleep(0.01)
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url, "/predict", {"data": [[1.0, 2.0, 3.0]]},
+                  timeout=10)
+        assert ei.value.code == 429
+        assert ei.value.headers.get("Retry-After")
+        assert time.monotonic() - t0 < 5   # shed, not hung
+        eng.start()
+        for f in futs:
+            s, body = f.result(timeout=10)
+            assert s == 200 and body["output"] == [[2.0, 4.0, 6.0]]
+        ex.shutdown()
+        s, m = _get(url, "/metrics")
+        assert m["rejected"] == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+
+def test_error_code_mapping():
+    eng = ServingEngine(FakeModel(), max_wait_ms=1)
+    srv = build_server(eng, port=0, max_body=1 << 16)
+    srv.start_background()
+    url = _url(srv)
+    try:
+        for payload, code, why in [
+                ({}, 400, "missing data"),
+                ({"data": [[1.0, 2.0]]}, 400, "bad shape"),
+                ({"prompts": [[1]]}, 400, "predict without data")]:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(url, "/predict", payload)
+            assert ei.value.code == code, why
+        # malformed JSON
+        req = urllib.request.Request(url + "/predict", data=b"{nope")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+        # wrong endpoint for the artifact kind
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url, "/generate", {"prompts": [[1]]})
+        assert ei.value.code == 409
+        # unknown path
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(url, "/nope")
+        assert ei.value.code == 404
+        # oversized body
+        big = {"data": [[0.0] * 3] * 4000}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url, "/predict", big)
+        assert ei.value.code == 413
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+
+def test_request_timeout_returns_504():
+    eng = ServingEngine(FakeModel(delay=1.0), max_wait_ms=1)
+    srv = build_server(eng, port=0, request_timeout=0.05)
+    srv.start_background()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(_url(srv), "/predict", {"data": [[1.0, 2.0, 3.0]]})
+        assert ei.value.code == 504
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+
+def test_generate_http():
+    """/generate packs prompts into decoder slots and trims each answer
+    to prompt + max_new tokens."""
+    eng = ServingEngine(FakeDecoder(), max_wait_ms=20)
+    srv = build_server(eng, port=0)
+    srv.start_background()
+    url = _url(srv)
+    try:
+        s, h = _get(url, "/healthz")
+        assert h["kind"] == "decode" and h["max_new"] == 3
+        s, body = _post(url, "/generate",
+                        {"prompts": [[1, 2, 3], [5]]})
+        assert s == 200
+        assert body["tokens"] == [[1, 2, 3, 99, 99, 99],
+                                  [5, 99, 99, 99]]
+        for payload in [{}, {"prompts": []}, {"prompts": [[]]},
+                        {"prompts": [[1] * 9]},
+                        {"prompts": [["a"]]}]:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(url, "/generate", payload)
+            assert ei.value.code == 400, payload
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url, "/predict", {"data": [[1.0]]})
+        assert ei.value.code == 409
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+
+def test_cli_task_serve_end_to_end(exported_mlp, tmp_path):
+    """task=serve over an exported artifact: the subprocess needs no
+    trainer, no iterators, and no data files — just export_in — and
+    answers /predict until SIGINT."""
+    path, model, b = exported_mlp
+    conf = tmp_path / "serve.conf"
+    conf.write_text("task = serve\n")
+    # reserve a free port (close + immediate rebind by the child)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cxxnet_tpu", str(conf),
+         "export_in=%s" % path, "serve_port=%d" % port,
+         "serve_max_wait_ms=5", "silent=1"],
+        cwd=repo, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE)
+    url = "http://127.0.0.1:%d" % port
+    try:
+        deadline = time.monotonic() + 120
+        while True:
+            try:
+                st, h = _get(url, "/healthz", timeout=2)
+                break
+            except (urllib.error.URLError, ConnectionError, OSError):
+                if proc.poll() is not None:
+                    out, err = proc.communicate()
+                    raise AssertionError(
+                        "serve exited early: %s\n%s"
+                        % (out.decode(), err.decode()))
+                assert time.monotonic() < deadline, "server never came up"
+                time.sleep(0.25)
+        assert h["ok"] and h["batch"] == 16
+        st, body = _post(url, "/predict",
+                         {"data": b.data[:3].tolist()}, timeout=60)
+        assert st == 200
+        np.testing.assert_allclose(np.asarray(body["output"]),
+                                   model(b.data[:3]),
+                                   rtol=1e-5, atol=1e-6)
+        st, m = _get(url, "/metrics")
+        assert m["requests"] == 1 and m["kind"] == "forward"
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()

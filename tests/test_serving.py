@@ -2,6 +2,7 @@
 the serialized artifact must reproduce the trainer's forward exactly
 and run standalone through jax.export.deserialize."""
 
+import json
 import os
 
 import numpy as np
@@ -42,6 +43,69 @@ def test_export_roundtrip_matches_trainer(tmp_path):
     np.testing.assert_allclose(probs.reshape(16, 4), ref.reshape(16, 4),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(m.predict(b.data), tr.predict(b))
+
+
+def test_partial_batch_pad_and_trim(tmp_path):
+    """Requests below the exported batch pad up to the exported shape
+    and trim the output (row-independent forward: real rows exact);
+    above it, the call chunks — arbitrary per-request sizes work."""
+    tr, b = _trained(tmp_path)
+    path = str(tmp_path / "m.export")
+    serving.export_model(tr, path, platforms=["cpu"])
+    m = serving.load_exported(path)
+    full = m(b.data)
+    for n in (1, 5, 15):
+        np.testing.assert_allclose(m(b.data[:n]), full[:n],
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(m.predict(b.data[:n]),
+                                   m.predict(b.data)[:n])
+    # oversize: 16 + 16 + 5 rows across three exported-batch chunks
+    big = np.concatenate([b.data, b.data, b.data[:5]])
+    out = m(big)
+    assert out.shape[0] == 37
+    np.testing.assert_allclose(out[:16], full, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(out[32:], full[:5], rtol=1e-6, atol=1e-7)
+    # still validated: trailing dims and emptiness
+    with pytest.raises(ValueError, match="data must be"):
+        m(np.zeros((4, 1, 1, 31), np.float32))
+    with pytest.raises(ValueError, match="at least one row"):
+        m(np.zeros((0, 1, 1, 32), np.float32))
+    assert m.batch == 16
+
+
+def test_load_exported_error_paths(tmp_path):
+    """load_exported dispatch: missing blob, wrong magic (both through
+    load_exported and ExportedModel directly), meta-less bare blob."""
+    # missing blob entirely
+    with pytest.raises(FileNotFoundError):
+        serving.load_exported(str(tmp_path / "nothere.bin"))
+    # wrong magic in the sidecar is rejected before the blob is read
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(b"not a real export")
+    (tmp_path / "bad.bin.meta").write_text(
+        json.dumps({"magic": "someone-elses-format",
+                    "input_shape": [1, 1, 1, 1]}))
+    with pytest.raises(ValueError, match="not a cxxnet_tpu export"):
+        serving.load_exported(str(bad))
+    with pytest.raises(ValueError, match="not a cxxnet_tpu export"):
+        serving.ExportedModel(str(bad))
+
+
+def test_load_exported_kind_dispatch_and_bare_blob(tmp_path):
+    """kind dispatch (absent kind -> ExportedModel) and the meta-less
+    load: a bare blob still serves at the exact exported shape."""
+    tr, b = _trained(tmp_path)
+    path = str(tmp_path / "m.export")
+    serving.export_model(tr, path, platforms=["cpu"])
+    m = serving.load_exported(path)
+    assert isinstance(m, serving.ExportedModel) \
+        and not isinstance(m, serving.ExportedDecoder)
+    full = m(b.data)
+    os.remove(path + ".meta")
+    bare = serving.load_exported(path)
+    assert isinstance(bare, serving.ExportedModel)
+    assert bare.meta is None and bare.batch is None
+    np.testing.assert_allclose(bare(b.data), full)
 
 
 def test_export_bakes_weights(tmp_path):
@@ -305,6 +369,14 @@ def test_export_generate_validations(tmp_path):
     toks[:, 0] = [1, 2]
     out = dec(toks, np.ones(2, np.int32))
     assert out.shape == (2, 24)
+    # oversize: 3 rows through the 2-slot artifact run as two chunks;
+    # greedy rows must match the exact-shape call (row independence)
+    toks3 = np.zeros((3, 24), np.int32)
+    toks3[:, 0] = [1, 2, 1]
+    out3 = dec(toks3, np.ones(3, np.int32))
+    assert out3.shape == (3, 24)
+    np.testing.assert_array_equal(out3[:2], out)
+    np.testing.assert_array_equal(out3[2], out[0])
     # the 0-length-row invariant the in-framework path enforces
     with pytest.raises(ValueError, match=">= 1 token"):
         dec(toks, np.array([1, 0], np.int32))

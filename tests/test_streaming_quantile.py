@@ -1,0 +1,79 @@
+"""metrics.StreamingQuantile: exact agreement with np.percentile over
+the retained window, sliding-window semantics past overflow, and the
+empty/degenerate cases serve/stats.py relies on."""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.metrics import StreamingQuantile
+
+
+def test_matches_percentile_under_window():
+    rs = np.random.RandomState(0)
+    vals = rs.randn(300)
+    sq = StreamingQuantile(window=1024)
+    for v in vals:
+        sq.add(v)
+    assert len(sq) == 300 and sq.count == 300
+    for q in (0.0, 0.5, 0.9, 0.99, 1.0):
+        assert sq.quantile(q) == pytest.approx(
+            np.percentile(vals, 100 * q))
+    p50, p90, p99 = sq.quantiles([0.5, 0.9, 0.99])
+    assert [p50, p90, p99] == pytest.approx(
+        list(np.percentile(vals, [50, 90, 99])))
+
+
+def test_exactly_full_window():
+    rs = np.random.RandomState(1)
+    vals = rs.rand(64)
+    sq = StreamingQuantile(window=64)
+    for v in vals:
+        sq.add(v)
+    assert len(sq) == 64
+    assert sq.quantile(0.5) == pytest.approx(np.percentile(vals, 50))
+
+
+def test_overflow_keeps_last_window():
+    """Past the window the estimator answers over the most recent
+    ``window`` observations only — recency is the telemetry contract."""
+    rs = np.random.RandomState(2)
+    vals = rs.randn(3000) * 10
+    sq = StreamingQuantile(window=256)
+    for v in vals:
+        sq.add(v)
+    assert len(sq) == 256 and sq.count == 3000
+    tail = vals[-256:]
+    for q in (0.5, 0.9, 0.99):
+        assert sq.quantile(q) == pytest.approx(
+            np.percentile(tail, 100 * q))
+
+
+def test_shifted_distribution_forgotten():
+    """A warmup latency spike falls out of the window: the p99 of a
+    window full of post-warmup values no longer sees it."""
+    sq = StreamingQuantile(window=100)
+    for _ in range(50):
+        sq.add(1000.0)        # warmup spike
+    for _ in range(100):
+        sq.add(1.0)           # steady state fills the window
+    assert sq.quantile(0.99) == pytest.approx(1.0)
+
+
+def test_empty_and_single():
+    sq = StreamingQuantile(window=8)
+    assert np.isnan(sq.quantile(0.5))
+    assert all(np.isnan(v) for v in sq.quantiles([0.5, 0.99]))
+    sq.add(7.0)
+    assert sq.quantile(0.0) == sq.quantile(1.0) == 7.0
+
+
+def test_clear_and_validation():
+    sq = StreamingQuantile(window=4)
+    for v in (1, 2, 3):
+        sq.add(v)
+    sq.clear()
+    assert len(sq) == 0 and np.isnan(sq.quantile(0.5))
+    sq.add(5.0)
+    assert sq.quantile(0.5) == 5.0
+    with pytest.raises(ValueError, match="window"):
+        StreamingQuantile(window=0)

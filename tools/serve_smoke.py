@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Serving-stack smoke: export a tiny MLP, serve it, hammer it.
+
+One command proves the whole `task = serve` chain (docs/serving.md):
+
+1. train a tiny synthetic MLP a few steps (CPU, seconds);
+2. `serving.export_model` it to a self-contained artifact;
+3. start `ServeHTTPServer` + `ServingEngine` on a free port;
+4. fire `--requests` concurrent `/predict` calls with mixed
+   per-request batch sizes from `--threads` client threads;
+5. verify EVERY response against the direct `ExportedModel` call and
+   print a one-line latency/occupancy report from `/metrics`.
+
+Exit status 0 only if all responses matched and the batcher actually
+coalesced (mean occupancy > 1). Used as the by-hand companion of
+tests/test_serve_http.py; runs under `JAX_PLATFORMS=cpu` anywhere.
+
+Usage: python tools/serve_smoke.py [--requests 64] [--threads 8]
+                                   [--max-wait-ms 10]
+"""
+import argparse
+import json
+import os
+import sys
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BATCH, NCLASS, DIM = 16, 4, 32
+
+
+def build_artifact(tmpdir):
+    from cxxnet_tpu import config, models, serving
+    from cxxnet_tpu.io import DataBatch
+    from cxxnet_tpu.trainer import Trainer
+
+    tr = Trainer()
+    for k, v in config.parse_string(
+            models.mnist_mlp(nhidden=16, nclass=NCLASS)):
+        tr.set_param(k, v)
+    for k, v in (("dev", "cpu:0"), ("batch_size", str(BATCH)),
+                 ("eta", "0.2"), ("input_shape", "1,1,%d" % DIM),
+                 ("seed", "7")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    b = DataBatch(
+        data=rs.randn(BATCH, 1, 1, DIM).astype(np.float32),
+        label=rs.randint(0, NCLASS, size=(BATCH, 1)).astype(np.float32))
+    for _ in range(3):
+        tr.update(b)
+    path = os.path.join(tmpdir, "smoke.export")
+    serving.export_model(tr, path, platforms=["cpu"])
+    return serving.load_exported(path)
+
+
+def post(url, path, obj, timeout=60):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+def get(url, path, timeout=10):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return json.load(r)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=64,
+                    help="concurrent /predict calls to fire")
+    ap.add_argument("--threads", type=int, default=8,
+                    help="client threads (concurrency)")
+    ap.add_argument("--max-wait-ms", type=float, default=10.0,
+                    help="engine batching window")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    from cxxnet_tpu.serve import ServingEngine
+    from cxxnet_tpu.serve.server import build_server
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        model = build_artifact(tmpdir)
+        rs = np.random.RandomState(1)
+        pool = rs.randn(BATCH, 1, 1, DIM).astype(np.float32)
+        full = model(pool)
+
+        eng = ServingEngine(model, max_wait_ms=args.max_wait_ms,
+                            queue_limit=max(128, 2 * args.requests))
+        srv = build_server(eng, port=0)
+        srv.start_background()
+        url = "http://127.0.0.1:%d" % srv.server_address[1]
+        assert get(url, "/healthz")["ok"]
+
+        bad = []
+
+        def fire(i):
+            n = 1 + i % 4           # mixed per-request batch sizes
+            idx = [(i + j) % BATCH for j in range(n)]
+            body = post(url, "/predict", {"data": pool[idx].tolist()})
+            try:
+                np.testing.assert_allclose(
+                    np.asarray(body["output"]), full[idx],
+                    rtol=1e-5, atol=1e-6)
+            except AssertionError as e:
+                bad.append((i, e))
+
+        with ThreadPoolExecutor(args.threads) as ex:
+            list(ex.map(fire, range(args.requests)))
+
+        m = get(url, "/metrics")
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+    lat = m["latency_ms"]
+    print("serve_smoke: %d reqs ok=%d  p50=%.1fms p90=%.1fms "
+          "p99=%.1fms  occupancy=%.2f fill=%.2f  dispatches=%d  "
+          "%.0f rows/s"
+          % (args.requests, args.requests - len(bad), lat["p50"],
+             lat["p90"], lat["p99"], m["batch_occupancy"],
+             m["batch_fill"], m["dispatches"], m["rows_per_sec"]))
+    if bad:
+        print("MISMATCHED responses: %s" % [i for i, _ in bad[:10]],
+              file=sys.stderr)
+        return 1
+    if m["batch_occupancy"] <= 1:
+        print("no coalescing happened (occupancy %.2f) — raise "
+              "--max-wait-ms or --threads" % m["batch_occupancy"],
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
