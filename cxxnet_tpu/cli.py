@@ -45,6 +45,15 @@ class LearnTask:
         self.model_in = "NULL"
         self.name_pred = "pred.txt"
         self.print_step = 100
+        # overlapped feed (io/prefetch.py): a background thread stages
+        # batches device-side device_prefetch_depth ahead of the
+        # dispatch loop; device_prefetch = 0 restores the legacy
+        # one-ahead helper loop. (prefetch_depth without the prefix is
+        # the DECODE-POOL window, an iterator-section key — distinct
+        # knob, distinct name, so a global setting of one cannot
+        # silently reconfigure the other.)
+        self.device_prefetch = 1
+        self.device_prefetch_depth = 2
         self.extract_node_name = ""
         self.output_format = 1
         self.trace = TraceSession()
@@ -86,6 +95,12 @@ class LearnTask:
             self.extract_node_name = val
         elif name == "output_format":
             self.output_format = 1 if val == "txt" else 0
+        elif name == "device_prefetch":
+            self.device_prefetch = int(val)
+        elif name == "device_prefetch_depth":
+            self.device_prefetch_depth = int(val)
+            if self.device_prefetch_depth < 1:
+                raise ValueError("device_prefetch_depth must be >= 1")
         self.trace.set_param(name, val)
         self.cfg.append((name, val))
 
@@ -132,6 +147,14 @@ class LearnTask:
         tr = Trainer()
         for k, v in self.cfg:
             tr.set_param(k, v)
+        if self.task in ("train", "finetune") and self.device_prefetch \
+                and not self.test_io \
+                and all(k != "donate_inputs" for k, _ in self.cfg):
+            # the device-prefetch feed stages every batch fresh and
+            # dispatches it exactly once, so the step programs may
+            # donate their input buffers; an explicit donate_inputs in
+            # the config always wins
+            tr.set_param("donate_inputs", "1")
         return tr
 
     def init(self) -> None:
@@ -180,6 +203,8 @@ class LearnTask:
         "start_counter", "model_in", "model_dir", "num_round",
         "max_round", "silent", "task", "test_io", "extract_node_name",
         "output_format", "data", "eval", "pred", "iter",
+        # overlapped-feed knobs (io/prefetch.py + task_train)
+        "device_prefetch", "device_prefetch_depth",
         # TraceSession (profiler.py)
         "profile", "profile_dir", "profile_start_batch",
         "profile_stop_batch",
@@ -354,6 +379,66 @@ class LearnTask:
         os.makedirs(self.model_dir, exist_ok=True)
         self.trainer.save_model(checkpoint.model_path(self.model_dir, counter))
 
+    def _serial_round(self, dispatch, gstagers, use_groups, fuse,
+                      sample_counter, start):
+        """Legacy (``device_prefetch = 0``) round body, plus the
+        ``test_io`` dry-run walk: one-ahead device staging on the
+        helper thread — batch k+1's host->device transfer is issued
+        while batch k computes; group_staging rotates two GroupStagers
+        so one fills while the other's transfer flies."""
+        self.itr_train.before_first()
+        pending = []
+        cur, infl = 0, None
+        while True:
+            has_next = self.itr_train.next()
+            if self.test_io != 0:
+                if not has_next:
+                    break
+                sample_counter += 1
+                self._print_progress(sample_counter, start)
+                continue
+            if use_groups:
+                if has_next:
+                    # add() copies the batch NOW, so the iterator
+                    # may reuse its buffers on the next next()
+                    gs = gstagers[cur]
+                    gs.add(self.itr_train.value)
+                    if gs.full:
+                        fut = self._stager.submit(gs.stage)
+                        # dispatch the PREVIOUS group while this
+                        # one's transfer flies on the helper thread
+                        if infl is not None:
+                            sample_counter = dispatch(
+                                infl.result(), sample_counter)
+                        infl = fut
+                        cur ^= 1
+                    continue
+                if infl is not None:
+                    sample_counter = dispatch(infl.result(),
+                                              sample_counter)
+                    infl = None
+                # round tail: partial group falls back per-step
+                for s in gstagers[cur].flush():
+                    sample_counter = dispatch([s], sample_counter)
+                break
+            nxt = None
+            if has_next:
+                nxt = self._stager.submit(self.trainer.stage,
+                                          self.itr_train.value)
+            if len(pending) >= fuse:
+                sample_counter = dispatch(pending, sample_counter)
+                pending = []
+            # resolve before touching the iterator again: next() may
+            # reuse the buffers the stager is still reading
+            if nxt is not None:
+                pending.append(nxt.result())
+            if not has_next:
+                break
+        if self.test_io == 0 and pending:
+            # round tail: a partial group falls back to per-step
+            sample_counter = dispatch(pending, sample_counter)
+        return sample_counter
+
     def task_train(self) -> None:
         """Reference: cxxnet_main.cpp:344-412."""
         start = time.time()
@@ -370,21 +455,35 @@ class LearnTask:
             return
         if self.test_io:
             print("start I/O test")
-        # one-ahead device staging: batch k+1's host->device transfer
-        # is issued on a helper thread while batch k computes. With
-        # fuse_steps = K the loop groups K batches per dispatch
-        # (Trainer.update_fused). Two staging modes:
-        #  * group_staging = 1 (default with fuse): each group is
-        #    copied incrementally into a preallocated stacked buffer
-        #    (GroupStager) and ships as ONE transfer — K-fold fewer
-        #    put round trips; two stagers rotate so one fills while
-        #    the other's transfer flies.
-        #  * group_staging = 0 (and always for fuse = 1): per-batch
-        #    stage() as before; fused dispatch stacks on device.
-        # Built ONCE for the run: the stacked host buffers (~K x batch
-        # bytes each) stay warm across rounds.
+        # overlapped feed, two generations:
+        #  * device_prefetch = 1 (default): DevicePrefetchIterator
+        #    (io/prefetch.py) stages batches/groups prefetch_depth
+        #    ahead on its own thread; this loop just pops ready-on-
+        #    device work and dispatches without blocking on step
+        #    results — JAX's async dispatch runs ahead and only
+        #    synchronizes at metric/eval/checkpoint boundaries. Time
+        #    blocked waiting for the feed is recorded as feed stall
+        #    (StepTimer.note_feed_wait) so starvation is measurable.
+        #  * device_prefetch = 0 (and test_io): the legacy one-ahead
+        #    helper-thread staging below. With fuse_steps = K both
+        #    modes group K batches per dispatch (Trainer.update_fused);
+        #    group_staging = 1 ships each group as ONE stacked
+        #    transfer (GroupStager), rotating two stagers here so one
+        #    fills while the other's transfer flies.
+        # Either feed preserves batch order, bytes, and RNG
+        # consumption (tests/test_prefetch.py pins the staged stream
+        # bitwise); fixed-seed trajectories agree across modes to
+        # float tolerance.
         fuse = max(1, self.trainer.fuse_steps)
-        use_groups = fuse > 1 and self.trainer.group_staging != 0
+        use_feed = self.device_prefetch != 0 and self.test_io == 0
+        use_groups = fuse > 1 and self.trainer.group_staging != 0 \
+            and not use_feed
+        feed = None
+        if use_feed:
+            from .io.prefetch import DevicePrefetchIterator
+            feed = DevicePrefetchIterator(
+                self.itr_train, self.trainer,
+                depth=self.device_prefetch_depth)
         gstagers = [GroupStager(self.trainer),
                     GroupStager(self.trainer)] if use_groups else None
 
@@ -419,57 +518,25 @@ class LearnTask:
             sample_counter = 0
             self.trainer.start_round(self.start_counter)
             self.timer.reset_clock()
-            self.itr_train.before_first()
-            pending = []
-            cur, infl = 0, None
-            while True:
-                has_next = self.itr_train.next()
-                if self.test_io != 0:
-                    if not has_next:
+            if feed is not None:
+                # dispatch-ahead loop: the producer thread owns the
+                # base iterator (before_first runs there); this loop
+                # only pops staged work and dispatches it
+                feed.before_first()
+                while True:
+                    t0 = time.perf_counter()
+                    has = feed.next()
+                    self.timer.note_feed_wait(time.perf_counter() - t0)
+                    if not has:
                         break
-                    sample_counter += 1
-                    self._print_progress(sample_counter, start)
-                    continue
-                if use_groups:
-                    if has_next:
-                        # add() copies the batch NOW, so the iterator
-                        # may reuse its buffers on the next next()
-                        gs = gstagers[cur]
-                        gs.add(self.itr_train.value)
-                        if gs.full:
-                            fut = self._stager.submit(gs.stage)
-                            # dispatch the PREVIOUS group while this
-                            # one's transfer flies on the helper thread
-                            if infl is not None:
-                                sample_counter = dispatch(
-                                    infl.result(), sample_counter)
-                            infl = fut
-                            cur ^= 1
-                        continue
-                    if infl is not None:
-                        sample_counter = dispatch(infl.result(),
-                                                  sample_counter)
-                        infl = None
-                    # round tail: partial group falls back per-step
-                    for s in gstagers[cur].flush():
-                        sample_counter = dispatch([s], sample_counter)
-                    break
-                nxt = None
-                if has_next:
-                    nxt = self._stager.submit(self.trainer.stage,
-                                              self.itr_train.value)
-                if len(pending) >= fuse:
-                    sample_counter = dispatch(pending, sample_counter)
-                    pending = []
-                # resolve before touching the iterator again: next() may
-                # reuse the buffers the stager is still reading
-                if nxt is not None:
-                    pending.append(nxt.result())
-                if not has_next:
-                    break
-            if self.test_io == 0 and pending:
-                # round tail: a partial group falls back to per-step
-                sample_counter = dispatch(pending, sample_counter)
+                    item = feed.value
+                    if isinstance(item, StagedBatch) and not item.fused:
+                        item = [item]   # tail / unfused: per-step path
+                    sample_counter = dispatch(item, sample_counter)
+            else:
+                sample_counter = self._serial_round(
+                    dispatch, gstagers, use_groups, fuse,
+                    sample_counter, start)
             if self.test_io == 0:
                 try:
                     sys.stderr.write("[%d]" % self.start_counter)
@@ -499,6 +566,16 @@ class LearnTask:
                     mem = device_memory_summary()
                     if mem:
                         print("device memory: %s" % mem)
+                    if feed is not None:
+                        st = feed.stats()
+                        print("feed: source %.2fs, stage %.2fs, "
+                              "backpressure %.2fs, stall %.2fs "
+                              "(stall frac %.3f, run total)"
+                              % (st["source_wait"]["wait_s"],
+                                 st["stage_busy"]["busy_s"],
+                                 st["put_wait"]["wait_s"],
+                                 st["get_wait"]["wait_s"],
+                                 st["feed_stall_frac"]))
             self.save_model_file()
         self.trace.close()
         self.trainer.wait_for_save()

@@ -274,11 +274,43 @@ class MNISTIterator(ArrayIterator):
                          round_batch=self.round_batch_cfg, seed=self.seed)
 
 
+class ProducerFailure:
+    """Sentinel a producer thread enqueues in place of an item when it
+    dies: carries the exception so the CONSUMER can re-raise it from
+    ``next()`` instead of hanging on a queue that will never fill
+    (shared by ThreadBufferIterator and io/prefetch.py)."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException) -> None:
+        self.exc = exc
+
+    def reraise(self) -> None:
+        raise RuntimeError(
+            "feed producer thread failed: %s" % self.exc) from self.exc
+
+
+def drain_producer(queue, thread) -> None:
+    """Restart path shared by the producer-backed iterators: pull the
+    old producer's queue until its end/failure sentinel so it can exit,
+    then join it. A failure sentinel is swallowed — the caller is
+    abandoning that epoch anyway."""
+    while not isinstance(queue.get(), (type(None), ProducerFailure)):
+        pass
+    thread.join()
+
+
 class ThreadBufferIterator(DataIterator):
     """Background-thread batch prefetch (reference:
     src/io/iter_batch_proc-inl.hpp:136-226, utils/thread_buffer.h:22):
     a bounded queue keeps ``buffer_size`` batches ready ahead of the
-    consumer so host IO overlaps device compute."""
+    consumer so host IO overlaps device compute. A producer-side error
+    (e.g. a corrupt JPEG mid-epoch) is forwarded through the queue and
+    re-raised by ``next()`` — it must surface, not starve the consumer.
+
+    For imgbin/imgbinx sources the decode itself additionally fans out
+    across ``prefetch_worker`` workers (io/prefetch.py), so this
+    wrapper is only needed for sources without a built-in pool."""
 
     def __init__(self, base: DataIterator, buffer_size: int = 2) -> None:
         self.base = base
@@ -290,6 +322,8 @@ class ThreadBufferIterator(DataIterator):
     def set_param(self, name: str, val: str) -> None:
         if name == "buffer_size":
             self.buffer_size = int(val)
+            if self.buffer_size < 1:
+                raise ValueError("threadbuffer: buffer_size must be >= 1")
         else:
             self.base.set_param(name, val)
 
@@ -297,19 +331,20 @@ class ThreadBufferIterator(DataIterator):
         self.base.init()
 
     def _producer(self, queue) -> None:
-        self.base.before_first()
-        while self.base.next():
-            queue.put(self.base.value)
+        try:
+            self.base.before_first()
+            while self.base.next():
+                queue.put(self.base.value)
+        except BaseException as e:
+            queue.put(ProducerFailure(e))
+            return
         queue.put(None)
 
     def before_first(self) -> None:
         import queue as queue_mod
         import threading
         if self._thread is not None:
-            # drain the previous producer so it can exit
-            while self._queue.get() is not None:
-                pass
-            self._thread.join()
+            drain_producer(self._queue, self._thread)
         self._queue = queue_mod.Queue(maxsize=self.buffer_size)
         self._thread = threading.Thread(
             target=self._producer, args=(self._queue,), daemon=True)
@@ -319,10 +354,12 @@ class ThreadBufferIterator(DataIterator):
         if self._queue is None:
             self.before_first()
         item = self._queue.get()
-        if item is None:
+        if item is None or isinstance(item, ProducerFailure):
             self._thread.join()
             self._thread = None
             self._queue = None
+            if item is not None:
+                item.reraise()
             return False
         self._batch = item
         return True

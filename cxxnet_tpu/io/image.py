@@ -65,13 +65,19 @@ def _to_u8(img: np.ndarray) -> np.ndarray:
 
 
 def _decode_image(buf: bytes) -> np.ndarray:
-    """JPEG/PNG bytes -> (3, h, w) float32 RGB in [0, 255]."""
+    """JPEG/PNG bytes -> (3, h, w) float32 RGB in [0, 255].
+
+    cvtColor + contiguous cast instead of a negative-stride fancy-index
+    copy: both run outside the GIL (cv2 releases it; numpy releases it
+    for contiguous casts), which is what lets the prefetch decode pool
+    (io/prefetch.py) scale across cores from Python threads."""
     import cv2
     arr = np.frombuffer(buf, np.uint8)
     bgr = cv2.imdecode(arr, cv2.IMREAD_COLOR)
     if bgr is None:
         raise ValueError("cannot decode image (%d bytes)" % len(buf))
-    return bgr[:, :, ::-1].astype(np.float32).transpose(2, 0, 1)
+    rgb = cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+    return rgb.astype(np.float32).transpose(2, 0, 1)
 
 
 def _load_image(path: str) -> np.ndarray:
@@ -268,22 +274,43 @@ class ImageBinIterator(InstIterator):
             for obj in iter_packfile(p):
                 yield obj
 
-    def next(self):
+    def next_raw(self):
+        """One object WITHOUT the Python-side decode: ``(index, label,
+        kind, payload)`` where kind is ``"img"`` (payload already a
+        decoded (3,h,w) array — the native loader's C++ threads did the
+        work) or ``"raw"`` (payload the encoded JPEG/PNG bytes), or
+        ``None`` at end of data. The parallel decode pool
+        (io/prefetch.py) consumes this so the expensive imdecode runs
+        on its workers, off this reader's thread."""
         if self._pos >= len(self._lst):
-            return False
+            return None
         idx, label, _ = self._lst[self._pos]
         self._pos += 1
         if self._loader is not None:
             kind, val = self._loader.next()
             if kind is None:
                 raise ValueError("packfile has fewer objects than .lst")
-            data = val if kind == "img" else _decode_image(val)
-        else:
-            try:
-                data = _decode_image(next(self._objs))
-            except StopIteration:
-                raise ValueError("packfile has fewer objects than .lst") \
-                    from None
+            return idx, label, kind, val
+        try:
+            buf = next(self._objs)
+        except StopIteration:
+            raise ValueError("packfile has fewer objects than .lst") \
+                from None
+        return idx, label, "raw", buf
+
+    @property
+    def native_active(self) -> bool:
+        """True when the C++ loader (its own decode thread pool) is
+        serving this iterator — the Python-side pool then has nothing
+        to parallelize and stays passthrough."""
+        return self._loader is not None
+
+    def next(self):
+        item = self.next_raw()
+        if item is None:
+            return False
+        idx, label, kind, val = item
+        data = val if kind == "img" else _decode_image(val)
         self._value = DataInst(idx, label, data)
         return True
 
@@ -715,11 +742,17 @@ class BatchAdaptIterator(DataIterator):
 def create_base_iterator(kind: str):
     """Base instance iterators, wrapped augment+batch by the factory
     (reference: src/io/data.cpp:35-64 wires img/imgbin through
-    AugmentIterator + BatchAdaptIterator)."""
+    AugmentIterator + BatchAdaptIterator). imgbin/imgbinx additionally
+    get the parallel decode pool (io/prefetch.py) between the packfile
+    reader and the augmenter — the default overlap wrapper, replacing
+    the old advice to chain ``iter = threadbuffer`` by hand; the
+    ``prefetch_worker`` / ``prefetch_depth`` / ``prefetch_mode`` keys
+    configure it, ``prefetch_worker = 0`` restores the serial path."""
     if kind == "img":
         inst = ImageListIterator()
     elif kind in ("imgbin", "imgbinx"):
-        inst = ImageBinIterator()
+        from .prefetch import ParallelDecodeIterator
+        inst = ParallelDecodeIterator(ImageBinIterator())
     else:
         return None
     return BatchAdaptIterator(AugmentIterator(inst))

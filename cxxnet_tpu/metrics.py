@@ -14,7 +14,10 @@ Two execution paths with identical math and the identical
 
 ``StreamingQuantile`` (bounded-window p50/p90/p99) lives here too: the
 serving telemetry (serve/stats.py) shares this module's statistics
-conventions rather than growing its own.
+conventions rather than growing its own. ``StallClock`` (per-stage
+wait/busy wall-time ledger) is the feed-pipeline counterpart: the
+overlapped input pipeline (io/prefetch.py), the train loop, and
+``bench.py feed`` all account stall time through it.
 """
 
 from __future__ import annotations
@@ -247,6 +250,56 @@ class StreamingQuantile:
 
     def clear(self) -> None:
         self._n = 0
+
+
+class StallClock:
+    """Wall-time ledger for one pipeline stage: how long it spent
+    *waiting* (blocked on a neighbour stage) versus *busy* (doing its
+    own work). The feed pipeline (io/prefetch.py) keeps one per
+    boundary — producer-waits-on-decoder, producer-waits-on-queue-slot
+    (backpressure: the device is the bottleneck), consumer-waits-on-
+    queue (feed stall: the device starves) — so `wait_frac` answers
+    directly which stage bounds the pipeline. Shares this module's
+    statistics conventions the way StreamingQuantile does for serving.
+
+    Each clock is written by exactly one thread (its stage); readers on
+    other threads see a consistent-enough snapshot for telemetry (a
+    torn read loses at most one sample, never corrupts a total)."""
+
+    __slots__ = ("wait_s", "busy_s", "waits", "events")
+
+    def __init__(self) -> None:
+        self.clear()
+
+    def clear(self) -> None:
+        self.wait_s = 0.0
+        self.busy_s = 0.0
+        self.waits = 0       # number of waits recorded
+        self.events = 0      # number of busy spans recorded
+
+    def add_wait(self, dt: float) -> None:
+        self.wait_s += float(dt)
+        self.waits += 1
+
+    def add_busy(self, dt: float) -> None:
+        self.busy_s += float(dt)
+        self.events += 1
+
+    @property
+    def total_s(self) -> float:
+        return self.wait_s + self.busy_s
+
+    @property
+    def wait_frac(self) -> float:
+        """Fraction of this stage's accounted wall time spent blocked;
+        0.0 when nothing has been recorded yet."""
+        t = self.total_s
+        return self.wait_s / t if t > 0 else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"wait_s": self.wait_s, "busy_s": self.busy_s,
+                "waits": self.waits, "events": self.events,
+                "wait_frac": self.wait_frac}
 
 
 def create_metric(name: str) -> Optional[Metric]:

@@ -7,7 +7,10 @@ files (SURVEY.md §5).  On TPU, profiler traces are table stakes: this
 module adds
 
 * ``StepTimer`` — rolling per-step wall time + images/sec, reported on
-  the progress line and per round;
+  the progress line and per round, plus feed-stall accounting (time the
+  train loop spent blocked waiting for the input pipeline to hand it
+  the next staged batch — the number the overlapped feed pipeline in
+  io/prefetch.py exists to drive to zero);
 * ``TraceSession`` — config-gated ``jax.profiler`` trace capture
   (``profile = 1``) writing a TensorBoard-loadable trace to
   ``profile_dir`` between ``profile_start_batch`` and
@@ -34,11 +37,17 @@ class StepTimer:
     dispatch + any host blocking, which is what the user experiences)."""
 
     def __init__(self, window: int = 50) -> None:
+        from .metrics import StallClock
         self.window = window
         self._times: List[float] = []
         self._last: Optional[float] = None
         self.total_steps = 0
         self.total_time = 0.0
+        # whole-run feed-stall ledger + per-round window (reset with the
+        # clock so the round summary reports THIS round's stall)
+        self.feed = StallClock()
+        self._round_wait = 0.0
+        self._round_time = 0.0
 
     def tick(self, n: int = 1) -> None:
         """Mark the end of ``n`` steps issued as one dispatch (the CLI's
@@ -52,6 +61,7 @@ class StepTimer:
         ADVICE r3)."""
         now = time.perf_counter()
         if self._last is not None:
+            self._round_time += now - self._last
             dt = (now - self._last) / n
             for _ in range(n):
                 self.total_time += dt
@@ -69,6 +79,30 @@ class StepTimer:
         totals (total_steps/total_time) are preserved."""
         self._last = None
         self._times = []
+        self._round_wait = 0.0
+        self._round_time = 0.0
+
+    def note_feed_wait(self, dt: float) -> None:
+        """Record ``dt`` seconds the train loop spent blocked waiting on
+        the input pipeline (the feed-stall half of the overlap ledger:
+        the device starving for data). The wait is part of the step wall
+        delta tick() measures, so the stall fraction is wait / measured
+        round time, not an addition to it. Waits before the clock is
+        armed (the pre-first-tick pipeline fill) are skipped: tick()
+        measures nothing there either, and counting them would inflate
+        the fraction past the window it is a fraction OF."""
+        if dt <= 0 or self._last is None:
+            return
+        self.feed.add_wait(dt)
+        self._round_wait += dt
+
+    @property
+    def round_feed_stall_frac(self) -> float:
+        """Fraction of this round's measured step wall time spent
+        waiting on the feed (0.0 until a full tick has landed)."""
+        if self._round_time <= 0:
+            return 0.0
+        return min(1.0, self._round_wait / self._round_time)
 
     @property
     def mean_step_ms(self) -> float:
@@ -81,8 +115,11 @@ class StepTimer:
         return 0.0 if ms == 0 else batch_size * 1000.0 / ms
 
     def summary(self, batch_size: int) -> str:
-        return "%.1f ms/step, %.1f images/sec" % (
+        s = "%.1f ms/step, %.1f images/sec" % (
             self.mean_step_ms, self.images_per_sec(batch_size))
+        if self._round_wait > 0:
+            s += ", feed stall %.1f%%" % (100.0 * self.round_feed_stall_frac)
+        return s
 
 
 def device_memory_summary() -> str:

@@ -1,0 +1,170 @@
+"""End-to-end smoke of the overlapped feed pipeline (docs/io.md).
+
+Drives BOTH feed shapes through the full stack — synthetic JPEG
+packfile -> imgbinx (parallel decode pool) and MNIST idx.gz -> mnist
+iterator -> threadbuffer — into a DevicePrefetchIterator feeding real
+train steps, including a mid-epoch restart (the historically
+deadlock-prone path: a producer blocked on a full queue must drain
+out, not hang). A watchdog hard-exits non-zero if anything wedges, so
+this is CI-safe: either it prints the stall breakdown and
+``feed_smoke ok``, or it dies loudly.
+
+Usage: JAX_PLATFORMS=cpu python tools/feed_smoke.py [--timeout 300]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _watchdog(seconds: int):
+    def fire():
+        import faulthandler
+        sys.stderr.write("feed_smoke: DEADLOCK — no completion within "
+                         "%ds; thread dump follows\n" % seconds)
+        faulthandler.dump_traceback()
+        os._exit(2)
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _tiny_trainer(input_shape, nclass, batch, **extra):
+    from cxxnet_tpu import config
+    from cxxnet_tpu.trainer import Trainer
+    text = """
+netconfig=start
+layer[+1:fl1] = flatten:fl1
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = %d,%d,%d
+batch_size = %d
+eta = 0.05
+metric = error
+""" % (input_shape + (batch,))
+    tr = Trainer()
+    for k, v in config.parse_string(text):
+        tr.set_param(k, v)
+    tr.set_param("dev", "cpu")
+    for k, v in extra.items():
+        tr.set_param(k, str(v))
+    tr.init_model()
+    return tr
+
+
+def _run_feed(name, itr, tr, rounds=2, restart=True):
+    """Full pipeline rounds through DevicePrefetchIterator; returns the
+    stall breakdown. ``restart`` exercises before_first mid-epoch."""
+    from cxxnet_tpu.io.prefetch import DevicePrefetchIterator
+    import numpy as np
+    feed = DevicePrefetchIterator(itr, tr, depth=2)
+    if restart:
+        feed.before_first()
+        for _ in range(2):
+            if not feed.next():
+                break
+            item = feed.value
+            # dispatch one, then abandon the epoch mid-flight
+            if isinstance(item, list):
+                for s in item:
+                    tr.update(s)
+            elif item.fused:
+                tr.update_fused(item)
+            else:
+                tr.update(item)
+    steps = 0
+    for _ in range(rounds):
+        feed.before_first()
+        while feed.next():
+            item = feed.value
+            if isinstance(item, list):
+                for s in item:
+                    tr.update(s)
+                steps += len(item)
+            elif item.fused:
+                tr.update_fused(item)
+                steps += item.fused
+            else:
+                tr.update(item)
+                steps += 1
+    np.asarray(tr._epoch_dev)   # fence: every dispatched step ran
+    assert steps > 0, "%s: feed produced no batches" % name
+    st = feed.stats()
+    print("%s: %d steps, stall breakdown %s"
+          % (name, steps, json.dumps(st)))
+    return st
+
+
+def _jpeg_feed(td):
+    import cv2
+    import numpy as np
+    from cxxnet_tpu.io import create_iterator
+    from cxxnet_tpu.io.binpage import BinaryPageWriter
+    rs = np.random.RandomState(0)
+    lst, binp = os.path.join(td, "s.lst"), os.path.join(td, "s.bin")
+    with open(lst, "w") as f, BinaryPageWriter(binp) as w:
+        for i in range(96):
+            img = cv2.resize(
+                rs.randint(0, 256, (12, 12, 3), np.uint8), (96, 96))
+            _, enc = cv2.imencode(".jpg", img)
+            w.push(enc.tobytes())
+            f.write("%d\t%d\timg%d.jpg\n" % (i, i % 4, i))
+    itr = create_iterator(
+        [("iter", "imgbinx"), ("image_list", lst), ("image_bin", binp),
+         ("rand_crop", "1"), ("rand_mirror", "1"),
+         ("native_decode", "0"), ("prefetch_worker", "2")],
+        [("batch_size", "16"), ("input_shape", "3,32,32"),
+         ("silent", "1")])
+    tr = _tiny_trainer((3, 32, 32), 4, 16)
+    return _run_feed("jpeg+pool", itr, tr)
+
+
+def _mnist_feed(td):
+    import numpy as np
+    from cxxnet_tpu.io import create_iterator
+    from tools.make_mnist_idx import write_idx
+    rs = np.random.RandomState(1)
+    img = os.path.join(td, "img.gz")
+    lab = os.path.join(td, "lab.gz")
+    write_idx(img, rs.randint(0, 255, (128, 28, 28)).astype(np.uint8))
+    write_idx(lab, rs.randint(0, 10, (128,)).astype(np.uint8))
+    itr = create_iterator(
+        [("iter", "mnist"), ("path_img", img), ("path_label", lab),
+         ("input_flat", "1"), ("shuffle", "1"),
+         ("iter", "threadbuffer"), ("buffer_size", "3")],
+        [("batch_size", "32"), ("input_shape", "1,1,784"),
+         ("silent", "1")])
+    # fuse_steps=2: the MNIST leg also exercises the fused GroupStager
+    # path through the device prefetcher
+    tr = _tiny_trainer((1, 1, 784), 10, 32, fuse_steps=2)
+    return _run_feed("mnist+threadbuffer+fuse2", itr, tr)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--timeout", type=int, default=300,
+                    help="watchdog: hard-exit 2 after this many seconds")
+    args = ap.parse_args()
+    _watchdog(args.timeout)
+    t0 = time.time()
+    with tempfile.TemporaryDirectory() as td:
+        _jpeg_feed(td)
+        _mnist_feed(td)
+    print("feed_smoke ok (%.1fs)" % (time.time() - t0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
