@@ -219,10 +219,12 @@ class LearnTask:
         "export_model": frozenset(["export_decode", "max_new",
                                    "temperature", "export_prompt_len",
                                    "export_out", "export_batch",
+                                   "export_batch_ladder",
                                    "export_platform"]),
         "serve": frozenset(["export_in", "serve_host", "serve_port",
                             "serve_max_wait_ms", "serve_max_batch",
-                            "serve_queue_limit", "serve_timeout_ms"]),
+                            "serve_queue_limit", "serve_timeout_ms",
+                            "serve_dispatch_depth", "serve_warmup"]),
     }
 
     def _iter_section_keys(self) -> set:
@@ -713,12 +715,16 @@ class LearnTask:
         baked in, versioned StableHLO) for serving without the framework
         — no reference analogue (its only deployment was task=pred in
         the training binary). Keys: export_out (path), export_batch
-        (serving batch size, default batch_size), export_platform
-        (comma list, default the training platform). With
-        export_decode=1 the KV-cache DECODER is exported instead
-        (serving.export_generate): max_new / temperature /
-        export_prompt_len shape the artifact; the decode_layout and
-        decode_kv knobs resolve exactly as task=generate would."""
+        (serving batch size, default batch_size),
+        export_batch_ladder (comma list of shape buckets, or "auto"
+        for powers of two up to the export batch — one artifact whose
+        smallest fitting bucket serves each request,
+        docs/serving.md), export_platform (comma list, default the
+        training platform). With export_decode=1 the KV-cache DECODER
+        is exported instead (serving.export_generate): max_new /
+        temperature / export_prompt_len shape the artifact; the
+        decode_layout and decode_kv knobs resolve exactly as
+        task=generate would."""
         from . import serving
         d = dict(self.cfg)
         out = d.get("export_out", "model.export")
@@ -726,18 +732,25 @@ class LearnTask:
         platforms = [p.strip() for p in plats.split(",") if p.strip()] \
             or None
         bs = int(d.get("export_batch", "0")) or None
+        ladder_s = d.get("export_batch_ladder", "").strip()
+        if ladder_s == "auto":
+            ladder = serving.auto_ladder(bs or self.trainer.batch_size)
+        elif ladder_s:
+            ladder = [int(x) for x in ladder_s.split(",") if x.strip()]
+        else:
+            ladder = None
         if int(d.get("export_decode", "0")):
             serving.export_generate(
                 self.trainer, out,
                 max_new=int(d.get("max_new", "32")),
                 temperature=float(d.get("temperature", "0")),
                 prompt_len=int(d.get("export_prompt_len", "0")) or None,
-                batch_size=bs,
+                batch_size=bs, batch_ladder=ladder,
                 platforms=platforms)
             print("exported decoder to %s (+.meta)" % out)
             return
         serving.export_model(self.trainer, out, batch_size=bs,
-                             platforms=platforms)
+                             batch_ladder=ladder, platforms=platforms)
         print("exported model to %s (+.meta)" % out)
 
     def task_serve(self) -> None:
@@ -748,9 +761,13 @@ class LearnTask:
         serve_host (default 127.0.0.1), serve_port (default 8080; 0
         binds a free port), serve_max_wait_ms (batching window,
         default 5), serve_max_batch (rows per dispatch, default the
-        exported batch), serve_queue_limit (pending requests before
-        429, default 64), serve_timeout_ms (per-request deadline,
-        default 30000). Blocks until interrupted."""
+        largest exported bucket), serve_queue_limit (pending requests
+        before 429, default 64), serve_timeout_ms (per-request
+        deadline, default 30000), serve_dispatch_depth (batches in
+        flight between the dispatch and completion threads, default
+        2; 0 = serial dispatch), serve_warmup (default 1: pre-run
+        every exported bucket at start so no user request eats a
+        first-call compile). Blocks until interrupted."""
         from . import serving
         from .serve import ServingEngine
         from .serve.server import build_server
@@ -768,7 +785,9 @@ class LearnTask:
             max_wait_ms=float(d.get("serve_max_wait_ms", "5")),
             max_batch=int(d.get("serve_max_batch", "0")) or None,
             queue_limit=int(d.get("serve_queue_limit", "64")),
-            timeout_ms=timeout_ms)
+            timeout_ms=timeout_ms,
+            dispatch_depth=int(d.get("serve_dispatch_depth", "2")),
+            warmup=bool(int(d.get("serve_warmup", "1"))))
         srv = build_server(
             engine, d.get("serve_host", "127.0.0.1"),
             int(d.get("serve_port", "8080")),
@@ -779,10 +798,12 @@ class LearnTask:
             verbose=not self.silent)
         host, port = srv.server_address[:2]
         if not self.silent:
-            print("serving %s on http://%s:%d (exported batch %d, "
-                  "max_wait %gms, queue %d)"
-                  % (engine.kind, host, port, engine.batch,
-                     1000.0 * engine.max_wait, engine.queue_limit))
+            print("serving %s on http://%s:%d (buckets %s, "
+                  "max_wait %gms, queue %d, dispatch_depth %d)"
+                  % (engine.kind, host, port,
+                     ",".join(map(str, engine.buckets)),
+                     1000.0 * engine.max_wait, engine.queue_limit,
+                     engine.dispatch_depth))
             sys.stdout.flush()
         try:
             srv.serve_forever()

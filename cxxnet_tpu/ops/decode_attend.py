@@ -80,11 +80,15 @@ def _plan(B, nh, Sl, d, itemsize, budget=5 * 1024 * 1024,
                                      scale_bytes_per_slot))
     except ValueError:
         pass
-    # any 128-multiple chunk tiles cleanly ((blk, d) blocks are
-    # 8-aligned on the sublane dim); descending, so the largest
-    # divisor of Sl that fits wins — e.g. Sl=1152 takes blk=384, not
-    # a 9-step 128-chunk grid
-    for blk in range(min(Sl, 1024), 127, -128):
+    # only 128-multiple chunks tile cleanly ((blk, d) blocks are
+    # 8-aligned on the sublane dim), so candidates step down the
+    # 128-grid from the largest aligned start — a non-aligned Sl has
+    # no aligned divisor and falls through to the loud error below
+    # (previously Sl itself leaked in as a candidate, so e.g. Sl=960
+    # could plan blk=320, violating the documented alignment rule).
+    # Descending, so the largest divisor of Sl that fits wins — e.g.
+    # Sl=1152 takes blk=384, not a 9-step 128-chunk grid
+    for blk in range(min(Sl, 1024) // 128 * 128, 127, -128):
         if Sl % blk:
             continue
         per_row = 2 * (2 * nh * blk * (d * itemsize

@@ -5,7 +5,7 @@ threadbuffer/prefetch iterator chain (reference: src/utils/
 thread_buffer.h — decouple the producer from the consumer, keep the
 device busy). This module is the serving-side dual: many small
 producers (request threads) in front of ONE consumer — an AOT-exported
-forward/decoder that only accepts its exported batch shape — with a
+forward/decoder that only accepts its exported batch shape(s) — with a
 bounded admission queue and a single dispatch thread between them.
 
 Mechanics:
@@ -18,10 +18,27 @@ Mechanics:
 * The dispatch thread takes the oldest request, then coalesces further
   whole requests FIFO until the exported batch is row-full or
   ``max_wait_ms`` passes — the classic dynamic-batching latency/
-  occupancy knob. Rows from all taken requests are packed into one
-  zero-padded exported-shape buffer, the callee runs once, and each
-  request gets its row slice back (pad-and-trim; row independence of
-  the forward/decode keeps real rows exact).
+  occupancy knob.
+* SHAPE-BUCKET LADDER: against a ``batch_ladder`` artifact
+  (serving.export_model / export_generate) the dispatch runs the
+  smallest exported bucket that holds the gathered rows instead of
+  padding to the max batch — a 1-row request on a 64-batch artifact
+  pays a 1-row forward, not a 64-row one. v1 single-shape artifacts
+  serve unchanged (a one-rung ladder).
+* ZERO-COPY ASSEMBLY: each bucket owns a small pool of preallocated
+  input buffers; request rows are copied in place (no per-dispatch
+  ``np.zeros`` + ``np.concatenate``), and a buffer returns to its pool
+  once its batch's outputs have materialized.
+* PIPELINED DISPATCH: with ``dispatch_depth >= 1`` the dispatch thread
+  only SUBMITS the batch (JAX dispatches asynchronously) and hands the
+  pending device result to a completion thread over a
+  ``dispatch_depth``-bounded queue; the completion thread blocks on
+  the result, trims, and finishes requests. Gather+pack of batch N+1
+  overlaps device execution of batch N — the serving mirror of the
+  train loop's dispatch-ahead. ``dispatch_depth = 0`` is the serial
+  mode (submit, block, finish, repeat) kept for paired benchmarking.
+* ``warmup()`` pre-runs every bucket once (compile + first-call costs
+  land before traffic); ``warmup=True`` runs it inside ``start()``.
 * Decoder callees batch at SLOT granularity, continuous-batching
   style: the exported decode loop owns B sequence slots, and every
   dispatch refills all free slots from the queue (unused slots run a
@@ -41,6 +58,7 @@ served in-process — the dev-box path, no export step).
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from collections import deque
@@ -92,8 +110,18 @@ class Request:
         return self._value
 
 
+def _callee_buckets(obj, batch: int) -> List[int]:
+    """The exported bucket ladder: the artifact's ``buckets`` (or the
+    meta's ``batch_ladder``) when present, else the single batch."""
+    b = getattr(obj, "buckets", None)
+    if not b:
+        meta = getattr(obj, "meta", None) or {}
+        b = meta.get("batch_ladder") if isinstance(meta, dict) else None
+    return sorted(int(x) for x in b) if b else [int(batch)]
+
+
 # ----------------------------------------------------------------------
-# callee adapters: one uniform (batch, run) surface over the three
+# callee adapters: one uniform (buckets, run) surface over the three
 # things the engine can serve
 
 class _ForwardCallee:
@@ -108,12 +136,23 @@ class _ForwardCallee:
                 "ServingEngine needs the .meta sidecar (input_shape) "
                 "to batch requests against an exported model")
         self.batch = int(meta["input_shape"][0])
+        self.buckets = _callee_buckets(model, self.batch)
+        self.batch = self.buckets[-1]
         self.item_shape = tuple(int(d) for d in meta["input_shape"][1:])
         self.dtype = np.dtype(meta.get("input_dtype", "float32"))
         self._model = model
+        self._exact = getattr(model, "call_exact", None)
 
     def run(self, data: np.ndarray) -> np.ndarray:
         return np.asarray(self._model(data))
+
+    def run_exact(self, buf: np.ndarray):
+        """Run the bucket matching ``buf.shape[0]``; returns the
+        un-materialized device array when the callee supports async
+        dispatch (ExportedModel.call_exact), else a host array."""
+        if self._exact is not None:
+            return self._exact(buf)
+        return self._model(buf)
 
 
 class _TrainerCallee:
@@ -123,6 +162,7 @@ class _TrainerCallee:
 
     def __init__(self, trainer):
         self.batch = int(trainer.batch_size)
+        self.buckets = [self.batch]
         net = trainer.net
         self.item_shape = tuple(int(d) for d in net.node_shapes[0][1:])
         self.dtype = (np.dtype(np.uint8) if net.input_norm is not None
@@ -147,6 +187,9 @@ class _TrainerCallee:
         out = outs[0] if len(outs) == 1 else np.concatenate(outs)
         return out[:n]
 
+    def run_exact(self, buf: np.ndarray):
+        return self.run(buf)
+
 
 class _DecodeCallee:
     """An ExportedDecoder: B sequence slots, (tokens, lens, seed) in,
@@ -156,14 +199,24 @@ class _DecodeCallee:
     def __init__(self, dec):
         m = dec.meta
         self.batch = int(m["batch"])
+        self.buckets = _callee_buckets(dec, self.batch)
+        self.batch = self.buckets[-1]
         self.seq_len = int(m["seq_len"])
         self.max_prompt_len = int(m["max_prompt_len"])
         self.max_new = int(m["max_new"])
         self._dec = dec
+        self._exact = getattr(dec, "call_exact", None)
 
     def run(self, toks: np.ndarray, lens: np.ndarray,
             seed: int) -> np.ndarray:
         return np.asarray(self._dec(toks, lens, seed=seed))
+
+    def run_exact(self, toks: np.ndarray, lens: np.ndarray, seed: int):
+        if self._exact is not None:
+            import jax
+            key = np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+            return self._exact(toks, lens, key)
+        return self._dec(toks, lens, seed=seed)
 
 
 def _wrap_callee(callee):
@@ -183,31 +236,55 @@ def _wrap_callee(callee):
         "(load_exported) or a live Trainer" % (callee,))
 
 
+class _Pending:
+    """One submitted batch in flight between the dispatch thread and
+    the completion thread: the un-materialized device output, the
+    requests it answers, and the input buffer to recycle."""
+
+    __slots__ = ("out", "live", "rows", "bucket", "buf")
+
+    def __init__(self, out, live, rows, bucket, buf):
+        self.out = out
+        self.live = live
+        self.rows = rows
+        self.bucket = bucket
+        self.buf = buf
+
+
 # ----------------------------------------------------------------------
 
 class ServingEngine:
-    """Admission queue + dispatch thread + pad-and-trim batcher in
+    """Admission queue + dispatch thread + bucket-ladder batcher in
     front of one compiled callee.
 
     Knobs:
-      max_wait_ms    how long the batcher holds a non-full batch open
-                     for more requests (latency floor vs occupancy)
-      max_batch      cap on coalesced rows per dispatch (default and
-                     ceiling: the exported batch size)
-      queue_limit    pending requests before admission sheds
-      timeout_ms     per-request deadline (0 disables); expired
-                     requests fail with TimeoutError, unserved
-      start=False    leaves the dispatch thread stopped (tests use it
-                     to saturate the queue deterministically)
+      max_wait_ms     how long the batcher holds a non-full batch open
+                      for more requests (latency floor vs occupancy)
+      max_batch       cap on coalesced rows per dispatch (default and
+                      ceiling: the largest exported bucket)
+      queue_limit     pending requests before admission sheds
+      timeout_ms      per-request deadline (0 disables); expired
+                      requests fail with TimeoutError, unserved
+      dispatch_depth  batches in flight between the dispatch and
+                      completion threads (default 2; 0 = serial
+                      dispatch, the pre-pipelining behavior)
+      warmup          run ``warmup()`` inside ``start()`` — every
+                      bucket pre-runs once so no user request eats a
+                      first-call compile (default False; the CLI's
+                      ``serve_warmup`` turns it on for task=serve)
+      start=False     leaves the dispatch thread stopped (tests use it
+                      to saturate the queue deterministically)
     """
 
     def __init__(self, callee, max_wait_ms: float = 5.0,
                  max_batch: Optional[int] = None, queue_limit: int = 64,
                  timeout_ms: float = 30000.0,
+                 dispatch_depth: int = 2, warmup: bool = False,
                  stats: Optional[ServeStats] = None, seed: int = 0,
                  start: bool = True):
         self.callee = _wrap_callee(callee)
         self.batch = self.callee.batch
+        self.buckets = list(self.callee.buckets)
         self.kind = self.callee.kind
         self.max_batch = min(int(max_batch), self.batch) if max_batch \
             else self.batch
@@ -216,23 +293,58 @@ class ServingEngine:
         self.max_wait = max(float(max_wait_ms), 0.0) / 1000.0
         self.queue_limit = int(queue_limit)
         self.timeout_s = float(timeout_ms) / 1000.0
+        self.dispatch_depth = max(int(dispatch_depth), 0)
         self.stats = stats or ServeStats()
         self._seed = int(seed)
         self._ndispatch = 0
+        self._warmup_on_start = bool(warmup)
+        self.warmup_runs = 0
         self._q: deque = deque()
         self._cond = threading.Condition()
         self._closed = False
         self._started = False
+        # per-bucket free-lists of preallocated input buffers: a buffer
+        # leaves the pool at pack time and returns once its batch's
+        # outputs materialized, so in-flight device reads can never see
+        # a buffer being refilled (bounded by dispatch_depth + 1)
+        self._pool = {b: deque() for b in self.buckets}
+        self._inflight: Optional[queue.Queue] = (
+            queue.Queue(maxsize=self.dispatch_depth)
+            if self.dispatch_depth > 0 else None)
         self._thread = threading.Thread(
             target=self._loop, name="serve-dispatch", daemon=True)
+        self._cthread = (threading.Thread(
+            target=self._complete_loop, name="serve-complete",
+            daemon=True) if self._inflight is not None else None)
         if start:
             self.start()
 
     # ------------------------------------------------------------------
     def start(self) -> None:
         if not self._started:
+            if self._warmup_on_start:
+                self.warmup()
             self._started = True
             self._thread.start()
+            if self._cthread is not None:
+                self._cthread.start()
+
+    def warmup(self) -> None:
+        """Pre-run every exported bucket once (and materialize the
+        result) so first-call compile/setup costs land here, not on a
+        user request. Not counted in the serving stats."""
+        c = self.callee
+        for b in self.buckets:
+            if self.kind == "forward":
+                buf = self._get_buf(b)
+                np.asarray(c.run_exact(buf))
+            else:
+                buf = self._get_buf(b)
+                toks, lens = buf
+                lens[:] = 1
+                np.asarray(c.run_exact(toks, lens, self._seed))
+            self._put_buf(b, buf)
+            self.warmup_runs += 1
 
     @property
     def queue_depth(self) -> int:
@@ -246,9 +358,12 @@ class ServingEngine:
         snap["queue_depth"] = self.queue_depth
         snap["kind"] = self.kind
         snap["exported_batch"] = self.batch
+        snap["buckets"] = list(self.buckets)
         snap["max_batch"] = self.max_batch
         snap["max_wait_ms"] = 1000.0 * self.max_wait
         snap["queue_limit"] = self.queue_limit
+        snap["dispatch_depth"] = self.dispatch_depth
+        snap["warmup_runs"] = self.warmup_runs
         return snap
 
     # ------------------------------------------------------------------
@@ -316,6 +431,28 @@ class ServingEngine:
             self._cond.notify()
 
     # ------------------------------------------------------------------
+    # zero-copy batch assembly: per-bucket buffer pools
+
+    def _get_buf(self, bucket: int):
+        pool = self._pool[bucket]
+        try:
+            return pool.popleft()
+        except IndexError:
+            pass
+        if self.kind == "forward":
+            return np.zeros((bucket,) + self.callee.item_shape,
+                            self.callee.dtype)
+        return (np.zeros((bucket, self.callee.seq_len), np.int32),
+                np.ones((bucket,), np.int32))
+
+    def _put_buf(self, bucket: int, buf) -> None:
+        self._pool[bucket].append(buf)
+
+    def _pick_bucket(self, rows: int) -> int:
+        from ..serving import _pick_bucket
+        return _pick_bucket(self.buckets, rows)
+
+    # ------------------------------------------------------------------
     def _gather(self) -> Optional[List[Request]]:
         """Take the oldest request, coalesce whole follow-ups FIFO until
         row-full or max_wait elapses. None = closed and drained."""
@@ -355,75 +492,130 @@ class ServingEngine:
         if not live:
             return
         rows = sum(r.rows for r in live)
+        if rows > self.batch:
+            # one oversize request (coalescing is capped at max_batch
+            # <= batch): the callee chunks it itself, synchronously
+            try:
+                if self.callee.kind == "forward":
+                    out = self.callee.run(live[0].payload)
+                else:
+                    toks, lens, seed = live[0].payload
+                    self._ndispatch += 1
+                    out = self.callee.run(
+                        toks, lens,
+                        int(seed if seed is not None
+                            else self._seed + self._ndispatch))
+            except Exception as e:
+                self.stats.on_error(len(live))
+                for r in live:
+                    r._finish(error=e)
+                return
+            pend = _Pending(out, live, rows, self.batch, None)
+        else:
+            bucket = self._pick_bucket(rows)
+            buf = self._get_buf(bucket)
+            try:
+                if self.callee.kind == "forward":
+                    out = self._run_forward(live, buf)
+                else:
+                    out = self._run_decode(live, buf)
+            except Exception as e:   # submit failure fails the batch
+                self._put_buf(bucket, buf)
+                self.stats.on_error(len(live))
+                for r in live:
+                    r._finish(error=e)
+                return
+            pend = _Pending(out, live, rows, bucket, buf)
+        if self._inflight is not None:
+            # hand the pending device result to the completion thread;
+            # blocks once dispatch_depth batches are in flight — the
+            # pipelining backpressure
+            self._inflight.put(pend)
+        else:
+            self._finish_batch(pend)
+
+    def _finish_batch(self, pend: _Pending) -> None:
+        """Materialize the device result, trim, answer every request.
+        Runs on the completion thread (pipelined) or inline (serial)."""
         try:
-            if self.callee.kind == "forward":
-                out = self._run_forward(live, rows)
-            else:
-                out = self._run_decode(live, rows)
-        except Exception as e:   # callee failure fails the whole batch
-            self.stats.on_error(len(live))
-            for r in live:
+            out = np.asarray(pend.out)
+        except Exception as e:
+            # async-dispatch failures surface here, not at submit: the
+            # batch errors and is NOT counted as a served dispatch
+            self.stats.on_error(len(pend.live))
+            for r in pend.live:
                 r._finish(error=e)
             return
-        self.stats.on_dispatch(len(live), min(rows, self.batch),
-                               self.batch)
+        finally:
+            pend.out = None
+            if pend.buf is not None:
+                self._put_buf(pend.bucket, pend.buf)
+        self.stats.on_dispatch(len(pend.live),
+                               min(pend.rows, pend.bucket), pend.bucket)
         done = time.monotonic()
         lo = 0
-        for r in live:
+        for r in pend.live:
             r._finish(value=out[lo:lo + r.rows])
             self.stats.on_complete(done - r.t_submit, r.rows)
             lo += r.rows
 
-    def _run_forward(self, live: List[Request], rows: int) -> np.ndarray:
-        c = self.callee
-        if len(live) == 1:
-            # single request: the callee pads/chunks itself (an
-            # oversize request can exceed the exported batch)
-            return c.run(live[0].payload)
-        buf = np.zeros((self.batch,) + c.item_shape, c.dtype)
+    def _run_forward(self, live: List[Request], buf: np.ndarray):
         lo = 0
         for r in live:
             buf[lo:lo + r.rows] = r.payload
             lo += r.rows
-        return c.run(buf)[:rows]
+        # rows past lo keep whatever the buffer last held — row
+        # independence of the forward makes pad content irrelevant,
+        # and not touching it is the zero-copy point
+        return self.callee.run_exact(buf)
 
-    def _run_decode(self, live: List[Request], rows: int) -> np.ndarray:
+    def _run_decode(self, live: List[Request], buf):
         c = self.callee
+        toks, lens = buf
         self._ndispatch += 1
         seed = next((r.payload[2] for r in live
                      if r.payload[2] is not None),
                     self._seed + self._ndispatch)
-        if len(live) == 1:
-            toks, lens, _ = live[0].payload
-            return c.run(toks, lens, int(seed))
-        # slot assembly: pack every request's prompt rows into the B
-        # decode slots; unused slots run a 1-token dummy prompt
-        toks = np.zeros((self.batch, c.seq_len), np.int32)
-        lens = np.ones((self.batch,), np.int32)
+        # slot assembly: pack every request's prompt rows into the
+        # bucket's decode slots; unused slots run a 1-token dummy
+        # prompt (their token content is whatever the buffer held)
         lo = 0
         for r in live:
             t, l, _ = r.payload
             toks[lo:lo + r.rows] = t
             lens[lo:lo + r.rows] = l
             lo += r.rows
-        return c.run(toks, lens, int(seed))[:rows]
+        lens[lo:] = 1
+        return c.run_exact(toks, lens, int(seed))
 
     def _loop(self) -> None:
         while True:
             reqs = self._gather()
             if reqs is None:
+                if self._inflight is not None:
+                    self._inflight.put(None)   # completion shutdown
                 return
             self._dispatch(reqs)
 
+    def _complete_loop(self) -> None:
+        while True:
+            pend = self._inflight.get()
+            if pend is None:
+                return
+            self._finish_batch(pend)
+
     # ------------------------------------------------------------------
     def close(self, timeout: float = 10.0) -> None:
-        """Stop admission, drain what's queued, join the dispatch
-        thread; anything still pending afterwards fails."""
+        """Stop admission, drain what's queued and in flight, join the
+        dispatch + completion threads; anything still pending
+        afterwards fails."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
         if self._started:
             self._thread.join(timeout)
+            if self._cthread is not None:
+                self._cthread.join(timeout)
         with self._cond:
             while self._q:
                 self._q.popleft()._finish(

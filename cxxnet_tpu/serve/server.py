@@ -100,6 +100,8 @@ class ServeHandler(BaseHTTPRequestHandler):
         eng: ServingEngine = self.server.engine
         if self.path == "/healthz":
             info = {"ok": True, "kind": eng.kind, "batch": eng.batch,
+                    "buckets": list(eng.buckets),
+                    "dispatch_depth": eng.dispatch_depth,
                     "queue_depth": eng.queue_depth}
             if eng.kind == "decode":
                 info["seq_len"] = eng.callee.seq_len

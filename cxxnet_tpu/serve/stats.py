@@ -13,9 +13,15 @@ questions:
 * ``batch_occupancy`` — mean REQUESTS coalesced per dispatch. > 1
   means the dynamic batcher is actually merging traffic (the number
   the acceptance check watches).
-* ``batch_fill`` — mean fraction of the exported batch's rows carrying
-  real data. Low fill with high occupancy says requests are tiny;
-  high fill says the exported batch size matches the traffic.
+* ``batch_fill`` — mean fraction of the DISPATCHED bucket's rows
+  carrying real data. Low fill with high occupancy says requests are
+  tiny; high fill says the chosen bucket matches the traffic. With a
+  shape-bucket ladder the denominator is the bucket each dispatch
+  actually ran, so fill measures ladder efficiency, not padding to
+  the max batch.
+
+``bucket_dispatches`` counts dispatches per bucket size — the ladder's
+load histogram (a v1 single-shape artifact shows one bucket).
 
 All counters are totals since construction; latency percentiles are
 over the last ``window`` completed requests. Thread-safe (one lock —
@@ -45,6 +51,7 @@ class ServeStats:
         self.timeouts = 0        # expired before / while dispatching
         self.errors = 0          # failed inside the callee
         self._fill_sum = 0.0
+        self.bucket_dispatches: Dict[int, int] = {}
 
     # ------------------------------------------------------------------
     def on_reject(self) -> None:
@@ -61,11 +68,15 @@ class ServeStats:
 
     def on_dispatch(self, nreq: int, rows: int, capacity: int) -> None:
         """One callee invocation coalescing ``nreq`` requests totalling
-        ``rows`` rows against a ``capacity``-row exported batch."""
+        ``rows`` rows against a ``capacity``-row batch shape — the
+        bucket the dispatch actually ran, which is also the
+        ``bucket_dispatches`` histogram key."""
         with self._lock:
             self.dispatches += 1
             self.dispatched_requests += nreq
             self._fill_sum += rows / float(capacity) if capacity else 0.0
+            self.bucket_dispatches[int(capacity)] = \
+                self.bucket_dispatches.get(int(capacity), 0) + 1
 
     def on_complete(self, latency_s: float, rows: int) -> None:
         """One request answered (dispatch + result handed back)."""
@@ -95,6 +106,9 @@ class ServeStats:
                     if self.dispatches else 0.0),
                 "batch_fill": (self._fill_sum / self.dispatches
                                if self.dispatches else 0.0),
+                "bucket_dispatches": {
+                    str(b): n for b, n
+                    in sorted(self.bucket_dispatches.items())},
                 "rows_per_sec": self.rows / elapsed,
                 "requests_per_sec": n / elapsed,
                 "latency_ms": {

@@ -24,8 +24,39 @@ import numpy as np
 MAGIC = "cxxnet_tpu.export.v1"
 
 
+def auto_ladder(batch: int) -> list:
+    """The default shape-bucket ladder for ``batch``: powers of two
+    1, 2, 4, ... capped by ``batch``, with ``batch`` itself as the top
+    rung (e.g. 24 -> [1, 2, 4, 8, 16, 24])."""
+    batch = int(batch)
+    if batch < 1:
+        raise ValueError("batch must be >= 1, got %d" % batch)
+    ladder, b = [], 1
+    while b < batch:
+        ladder.append(b)
+        b *= 2
+    ladder.append(batch)
+    return ladder
+
+
+def _norm_ladder(batch_ladder, batch_size) -> list:
+    """Sorted unique bucket list; ``batch_size`` (when given) joins as
+    a rung so the exported max batch honors it either way."""
+    rungs = {int(b) for b in batch_ladder}
+    if batch_size:
+        rungs.add(int(batch_size))
+    ladder = sorted(rungs)
+    if not ladder:
+        raise ValueError("batch_ladder must name at least one bucket")
+    if ladder[0] < 1:
+        raise ValueError("batch_ladder buckets must be >= 1, got %s"
+                         % (ladder,))
+    return ladder
+
+
 def export_model(trainer, path: str,
                  batch_size: Optional[int] = None,
+                 batch_ladder: Optional[Sequence[int]] = None,
                  platforms: Optional[Sequence[str]] = None) -> None:
     """Serialize ``trainer``'s forward pass (weights baked in) to
     ``path`` (+ ``path.meta`` json with the io contract).
@@ -37,6 +68,15 @@ def export_model(trainer, path: str,
     deferred normalization (``on_device_norm``, net.input_norm set),
     the export takes raw uint8 pixels and bakes the ``(x-mean)*scale``
     in — the meta file records ``input_dtype`` either way.
+
+    ``batch_ladder`` exports a SHAPE-BUCKET LADDER instead of one
+    shape: each bucket's forward is serialized into the same artifact
+    (blobs concatenated; meta records ``batch_ladder`` +
+    ``ladder_blob_bytes``), so a serving engine can run a partial
+    batch at the smallest bucket that fits instead of padding to the
+    max — load-proportional compute (docs/serving.md). The meta's
+    ``input_shape`` carries the max bucket, so single-shape readers
+    keep working against the top rung.
 
     Multi-host: collective (all processes must call together to gather
     cross-process-sharded weights); only process 0 writes the files."""
@@ -56,8 +96,12 @@ def export_model(trainer, path: str,
         trainer.params)
     if jax.process_index() != 0:
         return
-    bs = batch_size or trainer.batch_size
-    shape = (bs,) + tuple(net.node_shapes[0][1:])
+    if batch_ladder is not None:
+        ladder = _norm_ladder(batch_ladder, batch_size)
+    else:
+        ladder = [int(batch_size or trainer.batch_size)]
+    bs = ladder[-1]
+    item = tuple(net.node_shapes[0][1:])
     in_dtype = np.uint8 if net.input_norm is not None else np.float32
 
     def forward(data):
@@ -66,27 +110,38 @@ def export_model(trainer, path: str,
 
     if platforms is None:
         platforms = [trainer.mesh.devices.flat[0].platform]
-    exp = jexport.export(
-        jax.jit(forward), platforms=list(platforms))(
-            jax.ShapeDtypeStruct(shape, in_dtype))
-    out_shape = tuple(net.node_shapes[net.out_node])
-    blob = exp.serialize()
+    # one rung exported, serialized, and written at a time: holding
+    # every rung's weights-baked-in blob at once would multiply peak
+    # host memory by the ladder length
+    sizes = []
     with open(path, "wb") as f:
-        f.write(blob)
+        for b in ladder:
+            blob = jexport.export(
+                jax.jit(forward), platforms=list(platforms))(
+                    jax.ShapeDtypeStruct((b,) + item,
+                                         in_dtype)).serialize()
+            f.write(blob)
+            sizes.append(len(blob))
+    out_shape = tuple(net.node_shapes[net.out_node])
+    meta = {
+        "magic": MAGIC,
+        "input_shape": [bs] + list(item),
+        "input_dtype": np.dtype(in_dtype).name,
+        "output_shape": [bs] + list(out_shape[1:]),
+        "platforms": list(platforms),
+    }
+    if len(ladder) > 1:
+        meta["batch_ladder"] = ladder
+        meta["ladder_blob_bytes"] = sizes
     with open(path + ".meta", "w") as f:
-        json.dump({
-            "magic": MAGIC,
-            "input_shape": list(shape),
-            "input_dtype": np.dtype(in_dtype).name,
-            "output_shape": [bs] + list(out_shape[1:]),
-            "platforms": list(platforms),
-        }, f)
+        json.dump(meta, f)
 
 
 def export_generate(trainer, path: str, max_new: int = 32,
                     temperature: float = 0.0,
                     prompt_len: Optional[int] = None,
                     batch_size: Optional[int] = None,
+                    batch_ladder: Optional[Sequence[int]] = None,
                     platforms: Optional[Sequence[str]] = None) -> None:
     """Serialize the KV-cache DECODER (weights baked in) to ``path``.
 
@@ -99,7 +154,11 @@ def export_generate(trainer, path: str, max_new: int = 32,
     max_new``); the trainer's ``decode_layout``/``decode_kv`` knobs
     (including the int8 cache) resolve exactly as ``task=generate``
     would via ``Trainer._resolve_decode``. Requires the canonical LM
-    graph (``generate.plan``). Multi-host: collective, process 0
+    graph (``generate.plan``). ``batch_ladder`` exports a shape-bucket
+    ladder of decoders into one artifact (see ``export_model``) —
+    every rung shares S/prompt_slots/max_new/temperature, only the
+    slot count B varies, and layout/kv re-resolve per rung (kernel
+    feasibility can depend on B). Multi-host: collective, process 0
     writes, like ``export_model``."""
     import jax
     from jax import export as jexport
@@ -113,7 +172,14 @@ def export_generate(trainer, path: str, max_new: int = 32,
             "(embed -> causal stack(s) -> head): " + why)
     net = trainer.net
     S = int(net.node_shapes[0][2])
-    B = int(batch_size or trainer.batch_size)
+    if batch_ladder is not None:
+        # same contract as export_model: an explicit ladder caps the
+        # artifact; trainer.batch_size only applies when no ladder and
+        # no batch_size was given
+        ladder = _norm_ladder(batch_ladder, batch_size)
+    else:
+        ladder = [int(batch_size or trainer.batch_size)]
+    B = ladder[-1]
     max_new = int(max_new)
     if max_new < 1:
         raise ValueError("max_new must be >= 1, got %d" % max_new)
@@ -132,33 +198,84 @@ def export_generate(trainer, path: str, max_new: int = 32,
         trainer.params)
     if jax.process_index() != 0:
         return
-    layout, kv = trainer._resolve_decode(plan, B, P, max_new)
     trainer._warn_moe_capacity(plan, "export_generate")
     platform = trainer.mesh.devices.flat[0].platform
-    fn = G.build(net, plan, max_new, float(temperature), B, S, P=P,
-                 layout=layout, platform=platform, kv=kv)
     if platforms is None:
         platforms = [platform]
-
-    def decode(toks, lens, key):
-        return fn(params, toks, lens, key)
-
-    exp = jexport.export(jax.jit(decode), platforms=list(platforms))(
-        jax.ShapeDtypeStruct((B, S), np.int32),
-        jax.ShapeDtypeStruct((B,), np.int32),
-        jax.ShapeDtypeStruct((2,), np.uint32))
+    sizes, resolved = [], []
     with open(path, "wb") as f:
-        f.write(exp.serialize())
+        for b in ladder:
+            # layout/kv re-resolve per rung: kernel feasibility (slotk
+            # grouping etc.) can depend on the slot count
+            layout, kv = trainer._resolve_decode(plan, b, P, max_new)
+            resolved.append((layout, kv))
+            fn = G.build(net, plan, max_new, float(temperature), b, S,
+                         P=P, layout=layout, platform=platform, kv=kv)
+
+            def decode(toks, lens, key, _fn=fn):
+                return _fn(params, toks, lens, key)
+
+            # write rung by rung (see export_model): no whole-ladder
+            # blob list resident at once
+            blob = jexport.export(
+                jax.jit(decode), platforms=list(platforms))(
+                    jax.ShapeDtypeStruct((b, S), np.int32),
+                    jax.ShapeDtypeStruct((b,), np.int32),
+                    jax.ShapeDtypeStruct((2,), np.uint32)).serialize()
+            f.write(blob)
+            sizes.append(len(blob))
+    meta = {
+        "magic": MAGIC,
+        "kind": "generate",
+        "batch": B, "seq_len": S, "max_new": max_new,
+        "max_prompt_len": prompt_len, "prompt_slots": P,
+        "temperature": float(temperature),
+        # the max rung's resolution is the headline contract; sub-max
+        # rungs may legitimately resolve differently (feasibility
+        # depends on B) and are listed per rung below
+        "decode_layout": resolved[-1][0], "decode_kv": resolved[-1][1],
+        "platforms": list(platforms),
+    }
+    if len(ladder) > 1:
+        meta["batch_ladder"] = ladder
+        meta["ladder_blob_bytes"] = sizes
+        meta["ladder_decode_layout"] = [r[0] for r in resolved]
+        meta["ladder_decode_kv"] = [r[1] for r in resolved]
     with open(path + ".meta", "w") as f:
-        json.dump({
-            "magic": MAGIC,
-            "kind": "generate",
-            "batch": B, "seq_len": S, "max_new": max_new,
-            "max_prompt_len": prompt_len, "prompt_slots": P,
-            "temperature": float(temperature),
-            "decode_layout": layout, "decode_kv": kv,
-            "platforms": list(platforms),
-        }, f)
+        json.dump(meta, f)
+
+
+def _load_exps(path: str, meta: Optional[dict]):
+    """Deserialize an artifact's program(s): a ``batch_ladder`` meta
+    splits the blob into per-bucket programs (``{bucket: exported}``),
+    a v1 single-shape artifact returns None (caller reads one blob)."""
+    if not meta or not meta.get("batch_ladder"):
+        return None
+    from jax import export as jexport
+    ladder = [int(b) for b in meta["batch_ladder"]]
+    sizes = meta.get("ladder_blob_bytes")
+    with open(path, "rb") as f:
+        blob = f.read()
+    if (not sizes or len(sizes) != len(ladder)
+            or sum(int(s) for s in sizes) != len(blob)):
+        raise ValueError(
+            "%s: batch_ladder meta does not match the blob (%d buckets,"
+            " ladder_blob_bytes %s vs %d bytes on disk)"
+            % (path, len(ladder), sizes, len(blob)))
+    exps, lo = {}, 0
+    for b, n in zip(ladder, sizes):
+        exps[b] = jexport.deserialize(blob[lo:lo + int(n)])
+        lo += int(n)
+    return exps
+
+
+def _pick_bucket(buckets: Sequence[int], rows: int) -> int:
+    """Smallest bucket that holds ``rows`` whole; the max bucket when
+    none does (the caller then chunks)."""
+    for b in buckets:
+        if b >= rows:
+            return b
+    return buckets[-1]
 
 
 class ExportedDecoder:
@@ -166,15 +283,22 @@ class ExportedDecoder:
     ``(tokens (n, S), lens (n,))`` int arrays (+ optional ``seed``)
     and returns the completed (n, S) token matrix. ``n`` need not equal
     the exported batch: short batches are padded with 1-token dummy
-    rows up to the exported shape (the artifact's only legal shape) and
-    the padding rows trimmed from the output; long batches run in
-    exported-batch chunks. Row independence of the decode (per-sequence
-    causal attention) keeps real rows byte-identical either way."""
+    rows up to the smallest exported bucket that fits (a ladder
+    artifact carries several; a v1 artifact has exactly one) and the
+    padding rows trimmed from the output; long batches run in
+    max-bucket chunks. Row independence of the decode (per-sequence
+    causal attention) keeps real rows byte-identical at temperature 0;
+    at temperature > 0 the sampled stream depends on the bucket shape
+    the rows land in, as it already depends on the batch they share a
+    dispatch with."""
 
     def __init__(self, path: str, meta: dict):
-        from jax import export as jexport
-        with open(path, "rb") as f:
-            self._exp = jexport.deserialize(f.read())
+        self._exps = _load_exps(path, meta)
+        if self._exps is None:
+            from jax import export as jexport
+            with open(path, "rb") as f:
+                self._exps = {int(meta["batch"]):
+                              jexport.deserialize(f.read())}
         self.meta = meta
 
     @property
@@ -185,11 +309,28 @@ class ExportedDecoder:
     def seq_len(self) -> int:
         return int(self.meta["seq_len"])
 
+    @property
+    def buckets(self) -> list:
+        return sorted(self._exps)
+
+    def call_exact(self, tokens: np.ndarray, lens: np.ndarray, key):
+        """Run the bucket matching ``tokens.shape[0]`` exactly — no
+        pad, no trim, and no host sync: returns the device array of
+        JAX's async dispatch (``np.asarray`` it to block). The serving
+        engine's pipelined dispatch lives on this."""
+        b = tokens.shape[0]
+        if b not in self._exps:
+            raise ValueError(
+                "no exported bucket of %d rows (ladder: %s)"
+                % (b, self.buckets))
+        return self._exps[b].call(tokens, lens, key)
+
     def __call__(self, tokens: np.ndarray, lens: np.ndarray,
                  seed: int = 0) -> np.ndarray:
         import jax
         m = self.meta
         B, S = int(m["batch"]), int(m["seq_len"])
+        buckets = self.buckets
         toks = np.asarray(tokens, np.int32)
         lens = np.asarray(lens, np.int32)
         if toks.ndim != 2 or toks.shape[1] != S:
@@ -211,18 +352,22 @@ class ExportedDecoder:
         outs = []
         for lo in range(0, n, B):
             t, l = toks[lo:lo + B], lens[lo:lo + B]
-            if t.shape[0] < B:
-                pad = B - t.shape[0]
+            b = _pick_bucket(buckets, t.shape[0])
+            if t.shape[0] < b:
+                pad = b - t.shape[0]
                 t = np.concatenate([t, np.zeros((pad, S), np.int32)])
                 l = np.concatenate([l, np.ones((pad,), np.int32)])
             # distinct key per chunk past the first: reusing one key
             # would make rows i and B+i (same slot, same key) sample
             # identically at temperature>0; chunk 0 keeps the base key
-            # so n <= B calls match tr.generate(seed) byte-exact
+            # so n <= B calls through the B-bucket match
+            # tr.generate(seed) byte-exact (on a ladder artifact a
+            # short call runs a smaller rung, whose sampled stream
+            # differs at temperature>0 — see the class docstring)
             key = np.asarray(
                 base if lo == 0 else jax.random.fold_in(base, lo // B),
                 np.uint32)
-            outs.append(np.asarray(self._exp.call(t, l, key)))
+            outs.append(np.asarray(self._exps[b].call(t, l, key)))
         out = outs[0] if len(outs) == 1 else np.concatenate(outs)
         return out[:n]
 
@@ -231,16 +376,19 @@ class ExportedModel:
     """A deserialized export: ``__call__`` runs the forward, ``predict``
     adds the argmax-per-row convention of ``task=pred``.
 
-    The exported program accepts exactly the exported batch shape, but
+    Each exported program accepts exactly its exported batch shape, but
     callers rarely arrive with it: ``__call__`` pads a short batch with
-    zero rows up to the exported batch and trims the padding from the
-    output, and runs a long batch in exported-batch chunks — row
-    independence of the forward keeps real rows unchanged. The .meta
-    sidecar supplies the contract; without it (bare blob) only the
-    exact exported shape works."""
+    zero rows up to the smallest exported bucket that fits (a
+    ``batch_ladder`` artifact carries several; a v1 artifact has one)
+    and trims the padding from the output, and runs a long batch in
+    max-bucket chunks — row independence of the forward keeps real
+    rows unchanged. The .meta sidecar supplies the contract; without
+    it (bare blob) only the exact exported shape works — and a LADDER
+    artifact's blob is a concatenation, so stripped of its sidecar it
+    degrades to the first (smallest) rung: keep the sidecar next to
+    ladder artifacts."""
 
     def __init__(self, path: str, meta: Optional[dict] = None):
-        from jax import export as jexport
         self.meta = meta
         if meta is None:
             meta_path = path + ".meta"
@@ -252,13 +400,43 @@ class ExportedModel:
                 if self.meta.get("magic") != MAGIC:
                     raise ValueError("%s: not a cxxnet_tpu export"
                                      % path)
-        with open(path, "rb") as f:
-            self._exp = jexport.deserialize(f.read())
+        self._exps = _load_exps(path, self.meta)
+        if self._exps is None:
+            from jax import export as jexport
+            with open(path, "rb") as f:
+                exp = jexport.deserialize(f.read())
+            shape = (self.meta or {}).get("input_shape")
+            # a meta-less bare blob has no batch contract: leave the
+            # bucket map empty and keep the single program (its own
+            # shape check is the only contract)
+            self._exps = {int(shape[0]): exp} if shape else {}
+            self._exp = exp
+        else:
+            self._exp = self._exps[max(self._exps)]
 
     @property
     def batch(self) -> Optional[int]:
         shape = (self.meta or {}).get("input_shape")
         return int(shape[0]) if shape else None
+
+    @property
+    def buckets(self) -> Optional[list]:
+        """Sorted exported batch sizes; None for a meta-less blob."""
+        return sorted(self._exps) if self._exps else None
+
+    def call_exact(self, data: np.ndarray):
+        """Run the bucket matching ``data.shape[0]`` exactly — no pad,
+        no trim, no host sync: returns JAX's async-dispatch device
+        array (``np.asarray`` it to block). The serving engine's
+        pipelined dispatch lives on this."""
+        if not self._exps:    # bare blob: the one program shape-checks
+            return self._exp.call(data)
+        b = data.shape[0]
+        if b not in self._exps:
+            raise ValueError(
+                "no exported bucket of %d rows (ladder: %s)"
+                % (b, sorted(self._exps)))
+        return self._exps[b].call(data)
 
     def __call__(self, data: np.ndarray) -> np.ndarray:
         dt = np.dtype((self.meta or {}).get("input_dtype", "float32"))
@@ -267,6 +445,7 @@ class ExportedModel:
         if shape is None or arr.shape == tuple(shape):
             return np.asarray(self._exp.call(arr))
         B = int(shape[0])
+        buckets = sorted(self._exps)
         item = tuple(shape[1:])
         if arr.ndim != 1 + len(item) or tuple(arr.shape[1:]) != item:
             raise ValueError(
@@ -278,10 +457,11 @@ class ExportedModel:
         outs = []
         for lo in range(0, n, B):
             chunk = arr[lo:lo + B]
-            if chunk.shape[0] < B:
-                pad = np.zeros((B - chunk.shape[0],) + item, dt)
+            b = _pick_bucket(buckets, chunk.shape[0])
+            if chunk.shape[0] < b:
+                pad = np.zeros((b - chunk.shape[0],) + item, dt)
                 chunk = np.concatenate([chunk, pad])
-            outs.append(np.asarray(self._exp.call(chunk)))
+            outs.append(np.asarray(self._exps[b].call(chunk)))
         out = outs[0] if len(outs) == 1 else np.concatenate(outs)
         return out[:n]
 

@@ -219,12 +219,15 @@ def test_legacy_dir_without_nonce_still_loads(tmp_path):
     assert counter == 1
 
 
-def test_elastic_resume_across_device_counts(tmp_path):
+def test_elastic_resume_across_device_counts(
+        tmp_path, no_persistent_compile_cache):
     """VERDICT r1 #5: train on the 8-device mesh with zero=3 (params
     sharded across all replicas), save sharded, then resume on 4 devices
     and on 1 device — assembled weights bit-identical, and training
     continues under the new topology (reshard happens at load-time
-    device_put, the restart-anywhere continue=1 UX)."""
+    device_put, the restart-anywhere continue=1 UX). Runs cache-fresh:
+    an r6 failure of this test bisected to ONE poisoned cached
+    jit_train_step executable (see conftest)."""
     tr8 = _mlp(zero="3", save_sharded="1")
     rs = np.random.RandomState(11)
     b = _batch(rs)

@@ -239,3 +239,21 @@ def test_decode_kv_rejects_unsupported_layouts():
     lens = np.ones(2, np.int32)
     with pytest.raises(ValueError):
         tr.generate(toks, lens, 2, temperature=0.0)
+
+
+def test_blocked_plan_only_picks_128_aligned_blocks():
+    """_plan's blocked fallback must honor the documented "any
+    128-multiple chunk tiles cleanly" rule: a non-128-multiple Sl has
+    no aligned divisor and must raise the loud alignment error, never
+    hand the kernel a misaligned blk (Sl=960 used to leak blk=320
+    through the Sl-anchored candidate walk)."""
+    B, nh, d = 8, 8, 64
+    # Sl=960: blk=320 divides it and fits a 2 MB budget, but 320 is
+    # not a 128-multiple — the plan must refuse, not schedule it
+    with pytest.raises(ValueError, match=r"128 \| Sl"):
+        da._plan(B, nh, 960, d, 2, budget=2 * 1024 * 1024)
+    # a 128-multiple Sl still plans blocked with an aligned blk under
+    # the same budget (the docstring's Sl=1152 -> blk=384 example)
+    plan = da._plan(B, nh, 1152, d, 2, budget=2 * 1024 * 1024)
+    assert plan[0] == "blocked" and plan[2] % 128 == 0
+    assert plan[2] == 384

@@ -282,3 +282,190 @@ def test_stats_shared_instance():
         eng.submit(np.ones((2, 3), np.float32)).result(10)
     snap = st.snapshot()
     assert snap["requests"] == 1 and snap["rows"] == 2
+
+
+# ----------------------------------------------------------------------
+# r6 serving fast path: bucket ladder, pipelined dispatch, warmup
+
+class FakeLadderModel:
+    """Ladder-aware fake: meta carries batch_ladder, and every call
+    records the batch shape it ran — the bucket-routing probe."""
+    meta = {"input_shape": [8, 3], "input_dtype": "float32",
+            "batch_ladder": [1, 2, 4, 8]}
+
+    def __init__(self, delay=0.0, poison=None):
+        self.shapes = []
+        self.delay = delay
+        self.poison = poison     # input value that makes the call fail
+        self.calls = 0
+
+    def __call__(self, data):
+        self.calls += 1
+        self.shapes.append(int(np.asarray(data).shape[0]))
+        if self.poison is not None and (data == self.poison).any():
+            raise RuntimeError("poisoned batch")
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(data) * 2.0
+
+
+def _ones(n, v=1.0):
+    return np.full((n, 3), v, np.float32)
+
+
+def test_bucket_selection_exact_and_between():
+    """Gathered rows run the smallest exported bucket that holds them:
+    2 rows -> bucket 2 (exact fit), 3 rows -> bucket 4 (between)."""
+    fake = FakeLadderModel()
+    eng = ServingEngine(fake, max_wait_ms=1, start=False)
+    assert eng.buckets == [1, 2, 4, 8]
+    r1 = eng.submit(_ones(1, 1.0))
+    r2 = eng.submit(_ones(1, 2.0))
+    eng.start()
+    np.testing.assert_allclose(r1.result(10), _ones(1, 2.0))
+    np.testing.assert_allclose(r2.result(10), _ones(1, 4.0))
+    r3 = eng.submit(_ones(3, 3.0))
+    np.testing.assert_allclose(r3.result(10), _ones(3, 6.0))
+    m = eng.metrics()
+    eng.close()
+    assert fake.shapes == [2, 4]
+    assert m["bucket_dispatches"] == {"2": 1, "4": 1}
+    # fill is measured against the CHOSEN bucket, not the max batch
+    assert m["batch_fill"] == pytest.approx((2 / 2 + 3 / 4) / 2)
+
+
+def test_bucket_over_max_splits():
+    """A single oversize request (> max bucket) goes to the callee
+    whole — it chunks itself — and is accounted at the max bucket."""
+    fake = FakeLadderModel()
+    with ServingEngine(fake, max_wait_ms=1) as eng:
+        out = eng.submit(_ones(11, 5.0)).result(10)
+        m = eng.metrics()
+    np.testing.assert_allclose(out, _ones(11, 10.0))
+    assert fake.shapes == [11]
+    assert m["bucket_dispatches"] == {"8": 1}
+
+
+def test_v1_single_shape_artifact_single_bucket():
+    """A v1 artifact (no batch_ladder meta) serves as a one-rung
+    ladder: every dispatch pads to the exported batch, unchanged."""
+    fake = FakeModel()
+    with ServingEngine(fake, max_wait_ms=1) as eng:
+        assert eng.buckets == [8]
+        out = eng.submit(_ones(1)).result(10)
+        m = eng.metrics()
+    assert out.shape == (1, 3)
+    assert m["bucket_dispatches"] == {"8": 1}
+
+
+def test_pipelined_dispatch_many_requests_fifo():
+    """dispatch_depth=2: many concurrent mixed-size requests all get
+    their own rows back (slicing/ordering survive the completion
+    thread handoff)."""
+    fake = FakeLadderModel(delay=0.002)
+    with ServingEngine(fake, max_wait_ms=2, dispatch_depth=2,
+                       queue_limit=256) as eng:
+        def fire(i):
+            n = 1 + i % 3
+            out = eng.submit(_ones(n, float(i + 1))).result(30)
+            np.testing.assert_allclose(out, _ones(n, 2.0 * (i + 1)))
+        with ThreadPoolExecutor(8) as ex:
+            list(ex.map(fire, range(48)))
+        m = eng.metrics()
+    assert m["requests"] == 48 and m["errors"] == 0
+    assert m["dispatch_depth"] == 2
+
+
+def test_pipelined_error_propagation_isolated():
+    """A callee failure under pipelining fails exactly the requests of
+    its batch; the engine keeps serving afterwards."""
+    fake = FakeLadderModel(poison=-1.0)
+    with ServingEngine(fake, max_wait_ms=1, dispatch_depth=2) as eng:
+        ok1 = eng.submit(_ones(2, 3.0)).result(10)
+        np.testing.assert_allclose(ok1, _ones(2, 6.0))
+        bad = eng.submit(_ones(1, -1.0))
+        with pytest.raises(RuntimeError, match="poisoned"):
+            bad.result(10)
+        ok2 = eng.submit(_ones(2, 4.0)).result(10)
+        np.testing.assert_allclose(ok2, _ones(2, 8.0))
+        m = eng.metrics()
+    assert m["errors"] == 1 and m["requests"] == 2
+
+
+def test_serial_mode_still_works():
+    """dispatch_depth=0 keeps the pre-pipelining inline path."""
+    fake = FakeLadderModel()
+    with ServingEngine(fake, max_wait_ms=1, dispatch_depth=0) as eng:
+        out = eng.submit(_ones(2, 1.5)).result(10)
+        m = eng.metrics()
+    np.testing.assert_allclose(out, _ones(2, 3.0))
+    assert m["dispatch_depth"] == 0 and m["requests"] == 1
+
+
+def test_warmup_runs_every_bucket_without_stats():
+    """warmup=True pre-runs each bucket once inside start(); serving
+    stats stay clean (no phantom requests/dispatches)."""
+    fake = FakeLadderModel()
+    eng = ServingEngine(fake, max_wait_ms=1, warmup=True, start=False)
+    assert fake.calls == 0           # start=False defers the warmup
+    eng.start()
+    assert fake.calls == 4 and sorted(fake.shapes) == [1, 2, 4, 8]
+    assert eng.warmup_runs == 4
+    m = eng.metrics()
+    assert m["requests"] == 0 and m["dispatches"] == 0
+    assert m["warmup_runs"] == 4
+    out = eng.submit(_ones(1, 2.0)).result(10)
+    np.testing.assert_allclose(out, _ones(1, 4.0))
+    eng.close()
+
+
+def test_decode_bucket_selection_fake():
+    """Decoder ladders route 1-row generate requests to the 1-slot
+    bucket instead of the full slot count."""
+    class FakeLadderDecoder(FakeDecoder):
+        meta = dict(FakeDecoder.meta, batch_ladder=[1, 2, 4])
+
+        def __init__(self):
+            self.shapes = []
+
+        def __call__(self, toks, lens, seed=0):
+            self.shapes.append(int(np.asarray(toks).shape[0]))
+            return FakeDecoder.__call__(self, toks, lens, seed)
+
+    dec = FakeLadderDecoder()
+    with ServingEngine(dec, max_wait_ms=1) as eng:
+        assert eng.buckets == [1, 2, 4]
+        toks = np.zeros((1, 12), np.int32)
+        toks[0, :2] = [5, 6]
+        out = eng.submit_tokens(toks, [2]).result(10)
+        m = eng.metrics()
+    assert list(out[0, :5]) == [5, 6, 99, 99, 99]
+    assert dec.shapes == [1]
+    assert m["bucket_dispatches"] == {"1": 1}
+
+
+def test_exported_ladder_engine_matches_direct(tmp_path_factory):
+    """Real ladder artifact through the engine: a lone 1-row request
+    dispatches at bucket 1 and answers exactly the direct call."""
+    tr = Trainer()
+    for k, v in config.parse_string(models.mnist_mlp(nhidden=16,
+                                                     nclass=4)):
+        tr.set_param(k, v)
+    for k, v in (("dev", "cpu:0"), ("batch_size", "8"), ("eta", "0.2"),
+                 ("input_shape", "1,1,32"), ("seed", "5")):
+        tr.set_param(k, v)
+    tr.init_model()
+    path = str(tmp_path_factory.mktemp("serve") / "ladder.export")
+    serving.export_model(tr, path, batch_ladder=[1, 2, 8],
+                         platforms=["cpu"])
+    m = serving.load_exported(path)
+    rs = np.random.RandomState(3)
+    data = rs.randn(8, 1, 1, 32).astype(np.float32)
+    full = m(data)
+    with ServingEngine(m, max_wait_ms=1, dispatch_depth=2,
+                       warmup=True) as eng:
+        out = eng.submit(data[:1]).result(60)
+        met = eng.metrics()
+    np.testing.assert_allclose(out, full[:1], rtol=1e-5, atol=1e-6)
+    assert met["bucket_dispatches"] == {"1": 1}
+    assert met["warmup_runs"] == 3

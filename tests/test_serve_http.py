@@ -295,3 +295,45 @@ def test_cli_task_serve_end_to_end(exported_mlp, tmp_path):
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait()
+
+
+def test_http_ladder_artifact_buckets_surface(exported_mlp, tmp_path):
+    """A bucket-ladder artifact over HTTP: /healthz advertises the
+    ladder + dispatch depth, a lone 1-row /predict runs (and answers
+    from) the 1-bucket, /metrics carries the bucket histogram."""
+    _, _, b = exported_mlp
+    tr = Trainer()
+    for k, v in config.parse_string(models.mnist_mlp(nhidden=16,
+                                                     nclass=4)):
+        tr.set_param(k, v)
+    for k, v in (("dev", "cpu:0"), ("batch_size", "16"), ("eta", "0.2"),
+                 ("input_shape", "1,1,32"), ("seed", "5")):
+        tr.set_param(k, v)
+    tr.init_model()
+    path = str(tmp_path / "ladder.export")
+    serving.export_model(tr, path, batch_ladder=[1, 4, 16],
+                         platforms=["cpu"])
+    model = serving.load_exported(path)
+    full = model(b.data)
+    eng = ServingEngine(model, max_wait_ms=1, dispatch_depth=2,
+                        warmup=True)
+    srv = build_server(eng, port=0)
+    srv.start_background()
+    url = _url(srv)
+    try:
+        st, h = _get(url, "/healthz")
+        assert h["buckets"] == [1, 4, 16]
+        assert h["dispatch_depth"] == 2
+        st, body = _post(url, "/predict",
+                         {"data": b.data[:1].tolist()}, timeout=60)
+        assert st == 200
+        np.testing.assert_allclose(np.asarray(body["output"]),
+                                   full[:1], rtol=1e-5, atol=1e-6)
+        st, m = _get(url, "/metrics")
+        assert m["buckets"] == [1, 4, 16]
+        assert m["bucket_dispatches"] == {"1": 1}
+        assert m["warmup_runs"] == 3
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()

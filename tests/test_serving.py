@@ -105,7 +105,12 @@ def test_load_exported_kind_dispatch_and_bare_blob(tmp_path):
     bare = serving.load_exported(path)
     assert isinstance(bare, serving.ExportedModel)
     assert bare.meta is None and bare.batch is None
+    assert bare.buckets is None
     np.testing.assert_allclose(bare(b.data), full)
+    # call_exact on a bare blob runs the one program (its own shape
+    # check is the contract) instead of refusing every shape
+    np.testing.assert_allclose(
+        np.asarray(bare.call_exact(b.data.astype(np.float32))), full)
 
 
 def test_export_bakes_weights(tmp_path):
@@ -380,3 +385,129 @@ def test_export_generate_validations(tmp_path):
     # the 0-length-row invariant the in-framework path enforces
     with pytest.raises(ValueError, match=">= 1 token"):
         dec(toks, np.array([1, 0], np.int32))
+
+
+# ----------------------------------------------------------------------
+# r6: the shape-bucket ladder artifact
+
+def test_export_ladder_roundtrip_and_bucket_routing(tmp_path):
+    """A batch_ladder export carries one program per bucket in ONE
+    artifact; __call__ answers exactly the fixed-shape export for
+    exact-fit, between-buckets, and over-max row counts."""
+    tr, b = _trained(tmp_path)
+    path = str(tmp_path / "ladder.export")
+    serving.export_model(tr, path, batch_ladder=[1, 2, 4, 16],
+                         platforms=["cpu"])
+    m = serving.load_exported(path)
+    assert m.buckets == [1, 2, 4, 16]
+    assert m.batch == 16
+    assert m.meta["batch_ladder"] == [1, 2, 4, 16]
+    assert len(m.meta["ladder_blob_bytes"]) == 4
+    full = m(b.data)
+    ref = tr.extract_feature(b, "top[-1]").reshape(16, -1)
+    np.testing.assert_allclose(full.reshape(16, -1), ref,
+                               rtol=1e-5, atol=1e-6)
+    for n in (1, 2, 3, 4, 7, 15, 16):   # exact fits AND between-bucket
+        np.testing.assert_allclose(m(b.data[:n]), full[:n],
+                                   rtol=1e-6, atol=1e-7)
+    # over-max: 16 + 5 rows -> a max-bucket chunk + an 8-less tail
+    # that lands on the smallest fitting bucket
+    big = np.concatenate([b.data, b.data[:5]])
+    out = m(big)
+    assert out.shape[0] == 21
+    np.testing.assert_allclose(out[:16], full, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(out[16:], full[:5], rtol=1e-6, atol=1e-7)
+    # call_exact: bucket shapes run as-is, others refuse
+    np.testing.assert_allclose(
+        np.asarray(m.call_exact(b.data[:2].astype(np.float32))),
+        full[:2], rtol=1e-6, atol=1e-7)
+    with pytest.raises(ValueError, match="no exported bucket"):
+        m.call_exact(b.data[:3].astype(np.float32))
+
+
+def test_export_ladder_auto_and_batch_size_rung(tmp_path):
+    """auto_ladder shapes, and export_batch joining the rungs."""
+    assert serving.auto_ladder(16) == [1, 2, 4, 8, 16]
+    assert serving.auto_ladder(24) == [1, 2, 4, 8, 16, 24]
+    assert serving.auto_ladder(1) == [1]
+    tr, _ = _trained(tmp_path)
+    path = str(tmp_path / "l2.export")
+    serving.export_model(tr, path, batch_size=8, batch_ladder=[1, 4],
+                         platforms=["cpu"])
+    m = serving.load_exported(path)
+    assert m.buckets == [1, 4, 8] and m.batch == 8
+
+
+def test_v1_single_shape_artifact_unchanged(tmp_path):
+    """Backward compat: an export WITHOUT batch_ladder writes the v1
+    meta (no ladder keys) and loads as a one-bucket artifact serving
+    exactly as before."""
+    tr, b = _trained(tmp_path)
+    path = str(tmp_path / "v1.export")
+    serving.export_model(tr, path, platforms=["cpu"])
+    meta = json.load(open(path + ".meta"))
+    assert "batch_ladder" not in meta and "ladder_blob_bytes" not in meta
+    m = serving.load_exported(path)
+    assert m.buckets == [16]
+    full = m(b.data)
+    np.testing.assert_allclose(m(b.data[:3]), full[:3],
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_ladder_meta_blob_mismatch_rejected(tmp_path):
+    """A ladder meta whose blob sizes do not cover the file is a loud
+    error, not a flatbuffers mystery."""
+    tr, _ = _trained(tmp_path)
+    path = str(tmp_path / "m3.export")
+    serving.export_model(tr, path, batch_ladder=[1, 16],
+                         platforms=["cpu"])
+    meta = json.load(open(path + ".meta"))
+    meta["ladder_blob_bytes"][0] += 1
+    with open(path + ".meta", "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ValueError, match="does not match the blob"):
+        serving.load_exported(path)
+
+
+def test_export_generate_ladder_greedy_bucket_invariant(tmp_path):
+    """Decoder ladder: every rung shares S/prompt region/max_new, and
+    greedy output is bucket-invariant — a 1-row call through the
+    1-slot rung matches the same row from the max-bucket call."""
+    tr = _trained_lm()
+    path = str(tmp_path / "dl.export")
+    serving.export_generate(tr, path, max_new=6, temperature=0.0,
+                            prompt_len=8, batch_ladder=[1, 2, 4],
+                            platforms=["cpu"])
+    dec = serving.load_exported(path)
+    assert isinstance(dec, serving.ExportedDecoder)
+    assert dec.buckets == [1, 2, 4] and dec.batch == 4
+    toks = np.zeros((4, 24), np.int32)
+    prompts = [[3, 4, 5], [10, 11], [0, 1, 2, 3], [7]]
+    lens = np.array([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    full = dec(toks, lens)
+    ref = np.asarray(tr.generate(toks, lens, 6, temperature=0.0))
+    np.testing.assert_array_equal(full, ref)
+    for i in range(4):
+        one = dec(toks[i][None], lens[i][None])
+        np.testing.assert_array_equal(one[0], full[i])
+    three = dec(toks[:3], lens[:3])          # between buckets -> 4
+    np.testing.assert_array_equal(three, full[:3])
+
+
+def test_empty_ladder_rejected(tmp_path):
+    tr, _ = _trained(tmp_path)
+    with pytest.raises(ValueError, match="at least one bucket"):
+        serving.export_model(tr, str(tmp_path / "e.export"),
+                             batch_ladder=[], platforms=["cpu"])
+
+
+def test_negative_batch_size_rung_rejected(tmp_path):
+    """An invalid batch_size merged into a ladder dies with the loud
+    bucket validation, not a cryptic negative-shape JAX error."""
+    tr, _ = _trained(tmp_path)
+    with pytest.raises(ValueError, match="buckets must be >= 1"):
+        serving.export_model(tr, str(tmp_path / "n.export"),
+                             batch_size=-3, batch_ladder=[1, 4],
+                             platforms=["cpu"])

@@ -92,8 +92,7 @@ def resident_fn(tr, toks, lens, max_new):
     layout = tr.decode_layout if tr.decode_layout != "auto" else "slot"
     kv = getattr(tr, "decode_kv", "native")
     (key, fn), = [(k, v) for k, v in tr._gen_cache.items()
-                  if k[0] == max_new and k[3] == layout
-                  and (len(k) < 6 or k[5] == kv)]
+                  if k[0] == max_new and k[3] == layout and k[5] == kv]
     toks_d = jax.device_put(jnp.asarray(toks, jnp.int32))
     lens_d = jax.device_put(jnp.asarray(lens))
     rng_d = jax.device_put(jax.random.PRNGKey(0))

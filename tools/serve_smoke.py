@@ -4,24 +4,32 @@
 One command proves the whole `task = serve` chain (docs/serving.md):
 
 1. train a tiny synthetic MLP a few steps (CPU, seconds);
-2. `serving.export_model` it to a self-contained artifact;
-3. start `ServeHTTPServer` + `ServingEngine` on a free port;
+2. `serving.export_model` it twice — v1 single-shape AND a
+   shape-bucket ladder artifact;
+3. start `ServeHTTPServer` + `ServingEngine` on a free port —
+   leg 1 serves the v1 artifact with the default engine, leg 2 the
+   ladder artifact with pipelined dispatch (`dispatch_depth=2`) and
+   `warmup=True`;
 4. fire `--requests` concurrent `/predict` calls with mixed
-   per-request batch sizes from `--threads` client threads;
+   per-request batch sizes from `--threads` client threads per leg;
 5. verify EVERY response against the direct `ExportedModel` call and
    print a one-line latency/occupancy report from `/metrics`.
 
-Exit status 0 only if all responses matched and the batcher actually
-coalesced (mean occupancy > 1). Used as the by-hand companion of
-tests/test_serve_http.py; runs under `JAX_PLATFORMS=cpu` anywhere.
+Exit status 0 only if all responses matched, the batcher actually
+coalesced (mean occupancy > 1), and the ladder leg dispatched at
+least one sub-max bucket. A watchdog hard-exits non-zero if anything
+wedges (same idiom as tools/feed_smoke.py), so this is CI-safe. Used
+as the by-hand companion of tests/test_serve_http.py; runs under
+`JAX_PLATFORMS=cpu` anywhere.
 
 Usage: python tools/serve_smoke.py [--requests 64] [--threads 8]
-                                   [--max-wait-ms 10]
+                                   [--max-wait-ms 10] [--timeout 300]
 """
 import argparse
 import json
 import os
 import sys
+import threading
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 
@@ -33,7 +41,22 @@ sys.path.insert(0, REPO)
 BATCH, NCLASS, DIM = 16, 4, 32
 
 
-def build_artifact(tmpdir):
+def _watchdog(seconds: int):
+    def fire():
+        import faulthandler
+        sys.stderr.write("serve_smoke: DEADLOCK — no completion within "
+                         "%ds; thread dump follows\n" % seconds)
+        faulthandler.dump_traceback()
+        os._exit(2)
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def build_artifacts(tmpdir):
+    """Train the tiny MLP once, export it v1-fixed AND as a bucket
+    ladder; returns the two loaded models."""
     from cxxnet_tpu import config, models, serving
     from cxxnet_tpu.io import DataBatch
     from cxxnet_tpu.trainer import Trainer
@@ -53,9 +76,13 @@ def build_artifact(tmpdir):
         label=rs.randint(0, NCLASS, size=(BATCH, 1)).astype(np.float32))
     for _ in range(3):
         tr.update(b)
-    path = os.path.join(tmpdir, "smoke.export")
-    serving.export_model(tr, path, platforms=["cpu"])
-    return serving.load_exported(path)
+    fixed = os.path.join(tmpdir, "smoke.export")
+    serving.export_model(tr, fixed, platforms=["cpu"])
+    laddered = os.path.join(tmpdir, "smoke_ladder.export")
+    serving.export_model(tr, laddered,
+                         batch_ladder=serving.auto_ladder(BATCH),
+                         platforms=["cpu"])
+    return serving.load_exported(fixed), serving.load_exported(laddered)
 
 
 def post(url, path, obj, timeout=60):
@@ -71,73 +98,112 @@ def get(url, path, timeout=10):
         return json.load(r)
 
 
+def run_leg(name, model, args, **engine_kw):
+    """Serve ``model``, hammer it with mixed-size concurrent requests,
+    verify every answer against the direct call; returns /metrics."""
+    from cxxnet_tpu.serve import ServingEngine
+    from cxxnet_tpu.serve.server import build_server
+
+    rs = np.random.RandomState(1)
+    pool = rs.randn(BATCH, 1, 1, DIM).astype(np.float32)
+    full = model(pool)
+
+    eng = ServingEngine(model, max_wait_ms=args.max_wait_ms,
+                        queue_limit=max(128, 2 * args.requests),
+                        **engine_kw)
+    srv = build_server(eng, port=0)
+    srv.start_background()
+    url = "http://127.0.0.1:%d" % srv.server_address[1]
+    health = get(url, "/healthz")
+    assert health["ok"], health
+
+    bad = []
+
+    # a lone 1-row request first: on a ladder artifact it MUST take
+    # the 1-bucket (nothing to coalesce with), pinning bucket routing
+    body = post(url, "/predict", {"data": pool[:1].tolist()})
+    np.testing.assert_allclose(np.asarray(body["output"]), full[:1],
+                               rtol=1e-5, atol=1e-6)
+
+    def fire(i):
+        n = 1 + i % 4           # mixed per-request batch sizes
+        idx = [(i + j) % BATCH for j in range(n)]
+        body = post(url, "/predict", {"data": pool[idx].tolist()})
+        try:
+            np.testing.assert_allclose(
+                np.asarray(body["output"]), full[idx],
+                rtol=1e-5, atol=1e-6)
+        except AssertionError as e:
+            bad.append((i, e))
+
+    with ThreadPoolExecutor(args.threads) as ex:
+        list(ex.map(fire, range(args.requests)))
+
+    m = get(url, "/metrics")
+    srv.shutdown()
+    srv.server_close()
+    eng.close()
+
+    lat = m["latency_ms"]
+    print("serve_smoke[%s]: %d reqs ok=%d  p50=%.1fms p90=%.1fms "
+          "p99=%.1fms  occupancy=%.2f fill=%.2f  dispatches=%d  "
+          "buckets=%s  %.0f rows/s"
+          % (name, args.requests, args.requests - len(bad), lat["p50"],
+             lat["p90"], lat["p99"], m["batch_occupancy"],
+             m["batch_fill"], m["dispatches"],
+             m.get("bucket_dispatches"), m["rows_per_sec"]))
+    if bad:
+        print("MISMATCHED responses: %s" % [i for i, _ in bad[:10]],
+              file=sys.stderr)
+    return m, not bad
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--requests", type=int, default=64,
-                    help="concurrent /predict calls to fire")
+                    help="concurrent /predict calls to fire per leg")
     ap.add_argument("--threads", type=int, default=8,
                     help="client threads (concurrency)")
     ap.add_argument("--max-wait-ms", type=float, default=10.0,
                     help="engine batching window")
+    ap.add_argument("--timeout", type=int, default=300,
+                    help="watchdog: hard-exit 2 after this many seconds")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _watchdog(args.timeout)
     import tempfile
 
-    from cxxnet_tpu.serve import ServingEngine
-    from cxxnet_tpu.serve.server import build_server
-
     with tempfile.TemporaryDirectory() as tmpdir:
-        model = build_artifact(tmpdir)
-        rs = np.random.RandomState(1)
-        pool = rs.randn(BATCH, 1, 1, DIM).astype(np.float32)
-        full = model(pool)
+        fixed, laddered = build_artifacts(tmpdir)
 
-        eng = ServingEngine(model, max_wait_ms=args.max_wait_ms,
-                            queue_limit=max(128, 2 * args.requests))
-        srv = build_server(eng, port=0)
-        srv.start_background()
-        url = "http://127.0.0.1:%d" % srv.server_address[1]
-        assert get(url, "/healthz")["ok"]
+        m1, ok1 = run_leg("v1+serial", fixed, args, dispatch_depth=0)
+        m2, ok2 = run_leg("ladder+pipelined", laddered, args,
+                          dispatch_depth=2, warmup=True)
 
-        bad = []
-
-        def fire(i):
-            n = 1 + i % 4           # mixed per-request batch sizes
-            idx = [(i + j) % BATCH for j in range(n)]
-            body = post(url, "/predict", {"data": pool[idx].tolist()})
-            try:
-                np.testing.assert_allclose(
-                    np.asarray(body["output"]), full[idx],
-                    rtol=1e-5, atol=1e-6)
-            except AssertionError as e:
-                bad.append((i, e))
-
-        with ThreadPoolExecutor(args.threads) as ex:
-            list(ex.map(fire, range(args.requests)))
-
-        m = get(url, "/metrics")
-        srv.shutdown()
-        srv.server_close()
-        eng.close()
-
-    lat = m["latency_ms"]
-    print("serve_smoke: %d reqs ok=%d  p50=%.1fms p90=%.1fms "
-          "p99=%.1fms  occupancy=%.2f fill=%.2f  dispatches=%d  "
-          "%.0f rows/s"
-          % (args.requests, args.requests - len(bad), lat["p50"],
-             lat["p90"], lat["p99"], m["batch_occupancy"],
-             m["batch_fill"], m["dispatches"], m["rows_per_sec"]))
-    if bad:
-        print("MISMATCHED responses: %s" % [i for i, _ in bad[:10]],
-              file=sys.stderr)
-        return 1
-    if m["batch_occupancy"] <= 1:
+    rc = 0
+    if not (ok1 and ok2):
+        rc = 1
+    if m1["batch_occupancy"] <= 1:
         print("no coalescing happened (occupancy %.2f) — raise "
-              "--max-wait-ms or --threads" % m["batch_occupancy"],
+              "--max-wait-ms or --threads" % m1["batch_occupancy"],
               file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    buckets = {int(b) for b in (m2.get("bucket_dispatches") or {})}
+    if len(m2.get("buckets", [])) <= 1 or not any(
+            b < max(m2["buckets"]) for b in buckets):
+        print("ladder leg never dispatched a sub-max bucket "
+              "(dispatches by bucket: %s)" % m2.get("bucket_dispatches"),
+              file=sys.stderr)
+        rc = 1
+    if m2.get("warmup_runs", 0) < len(m2.get("buckets", [])):
+        print("ladder leg warmup did not cover every bucket (%s of %s)"
+              % (m2.get("warmup_runs"), m2.get("buckets")),
+              file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print("serve_smoke ok")
+    return rc
 
 
 if __name__ == "__main__":
