@@ -605,6 +605,7 @@ def feed_main(args) -> None:
     import numpy as np
 
     from cxxnet_tpu.io.prefetch import DevicePrefetchIterator
+    from cxxnet_tpu.obs.registry import Registry
 
     platform = jax.devices()[0].platform
     workers = args.feed_workers
@@ -675,6 +676,11 @@ def feed_main(args) -> None:
         tr2 = _feed_trainer(platform, donate=True)
         feed = DevicePrefetchIterator(it_pool, tr2,
                                       depth=args.feed_depth)
+        # obs registry over the same clocks the stats() dict reads:
+        # the ledger's observability fields come from the registry
+        # snapshot, exercising the adapter path end to end (net=obs)
+        obs_reg = Registry()
+        feed.bind_registry(obs_reg)
         feed.before_first()                 # warm epoch: compiles
         while feed.next():
             tr2.update(feed.value)
@@ -734,6 +740,17 @@ def feed_main(args) -> None:
     # manufacture (or erase) the gain; the best-of rates above may come
     # from different windows and their quotient can exceed it
     overlap_vs_serialized = pair_ratio or None
+    # observability-derived fields, read back through the metrics
+    # registry (obs/registry.py) rather than the stats() dict — the
+    # ledger carries what a scraper would see (the LAST window's
+    # clocks; the best-window breakdown stays in feed_stall_fractions)
+    obs_fields = {
+        "feed_stall_frac": obs_reg.get_value("cxxnet_feed_stall_frac"),
+        "source_wait_frac": obs_reg.get_value(
+            "cxxnet_feed_source_wait_frac"),
+        "backpressure_wait_s": obs_reg.get_value(
+            "cxxnet_feed_backpressure_wait_seconds"),
+    }
     entry = {
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "images_per_sec": round(overlapped_ips, 1),
@@ -741,8 +758,15 @@ def feed_main(args) -> None:
         "overlap_vs_serialized": round(overlap_vs_serialized, 3)
         if overlap_vs_serialized else None,
         "prefetch_worker": eff_workers,
+        "obs": obs_fields,
     }
     best = _update_history(entry, net="feed")
+    # metric="timestamp": obs rows are snapshots, not best-window
+    # races — ISO timestamps compare lexicographically, so "best"
+    # means NEWEST and the ledger's obs headline never goes stale
+    _update_history(dict(obs_fields, source="feed",
+                         timestamp=entry["timestamp"]), net="obs",
+                    metric="timestamp")
     print(json.dumps({
         "metric": "host_feed_images_per_sec",
         "value": round(overlapped_ips, 1),
@@ -784,6 +808,7 @@ def feed_main(args) -> None:
             "feed_stall_s": round(stats["get_wait"]["wait_s"], 4),
             "feed_stall_frac": round(stats["feed_stall_frac"], 4),
         } if stats else None,
+        "obs": obs_fields,
         "best_recorded": best,
         "note": "overlap_vs_serialized >= 1.5 on a multi-core host is "
                 "the pipeline working: parallel decode + H2D prefetch "
@@ -832,7 +857,7 @@ eta = 0.01
 
 
 def _serve_window(model, nreq, threads, rows_of, max_wait_ms,
-                  dispatch_depth, data):
+                  dispatch_depth, data, registry=None):
     """One closed-loop window: ``threads`` clients fire ``nreq``
     requests at a fresh engine; returns (rows_per_sec, metrics)."""
     from concurrent.futures import ThreadPoolExecutor
@@ -840,7 +865,8 @@ def _serve_window(model, nreq, threads, rows_of, max_wait_ms,
     from cxxnet_tpu.serve import ServingEngine
     eng = ServingEngine(model, max_wait_ms=max_wait_ms,
                         dispatch_depth=dispatch_depth,
-                        queue_limit=max(128, 2 * nreq))
+                        queue_limit=max(128, 2 * nreq),
+                        registry=registry)
 
     def fire(i):
         n = rows_of(i)
@@ -930,18 +956,33 @@ def serve_main(args) -> None:
                 break
 
         # ---- leg 2: throughput, pipelined vs serial (paired) ----
+        from cxxnet_tpu.obs.registry import Registry
         serial_rps, pipe_rps, pipe_ratio = 0.0, 0.0, 0.0
-        best_m = None
+        best_m, best_obs = None, None
         deadline = time.perf_counter() + SERVE_BUDGET_S / 2
         thr_trials = 0
         while True:
             s_rate, _ = _serve_window(ladder, nreq, threads, mixed,
                                       2.0, 0, data)
+            # fresh registry per window: the ledger's obs fields come
+            # from the registry snapshot of the winning window, same
+            # numbers /metrics?format=prom would have exported
+            reg = Registry()
             p_rate, pm = _serve_window(ladder, nreq, threads, mixed,
-                                       2.0, 2, data)
+                                       2.0, 2, data, registry=reg)
             serial_rps = max(serial_rps, s_rate)
             if p_rate > pipe_rps:
                 pipe_rps, best_m = p_rate, pm
+                best_obs = {
+                    "batch_fill": reg.get_value(
+                        "cxxnet_serve_batch_fill"),
+                    "batch_occupancy": reg.get_value(
+                        "cxxnet_serve_batch_occupancy"),
+                    "requests_total": reg.get_value(
+                        "cxxnet_serve_requests_total"),
+                    "timeouts_total": reg.get_value(
+                        "cxxnet_serve_timeouts_total"),
+                }
             pipe_ratio = max(pipe_ratio, p_rate / s_rate)
             thr_trials += 1
             if thr_trials >= max(3, args.trials) and pipe_ratio >= 1.1:
@@ -974,8 +1015,14 @@ def serve_main(args) -> None:
         "p50_1row_ms_bucketed": round(p50_ladder, 3),
         "p50_1row_ms_fixed": round(p50_fixed, 3),
         "bucket_p50_speedup": round(ladder_ratio, 3),
+        "obs": best_obs,
     }
     best = _update_history(entry, net="serve", metric="rows_per_sec")
+    if best_obs:
+        # metric="timestamp": newest snapshot wins (see feed_main)
+        _update_history(dict(best_obs, source="serve",
+                             timestamp=entry["timestamp"]), net="obs",
+                        metric="timestamp")
     print(json.dumps({
         "metric": "serve_rows_per_sec",
         "value": round(pipe_rps, 1),
@@ -1008,6 +1055,11 @@ def serve_main(args) -> None:
         "throughput_trials": thr_trials,
         "bucket_dispatches_best_window": (best_m or {}).get(
             "bucket_dispatches"),
+        "obs": best_obs,
+        "obs_note": "observability-derived fields read back from the "
+                    "best window's metrics registry snapshot "
+                    "(obs/registry.py) — the same series "
+                    "/metrics?format=prom exports",
         "offered_load_sweep": sweep,
         "best_recorded": best,
     }))

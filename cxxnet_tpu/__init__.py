@@ -34,7 +34,7 @@ def __getattr__(name):
         return Trainer
     if name in ("models", "wrapper", "trainer", "io", "parallel",
                 "metrics", "checkpoint", "profiler", "layers", "model",
-                "updater", "serving", "serve"):
+                "updater", "serving", "serve", "obs"):
         import importlib
         return importlib.import_module("." + name, __name__)
     raise AttributeError(name)

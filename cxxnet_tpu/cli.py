@@ -56,6 +56,18 @@ class LearnTask:
         self.device_prefetch_depth = 2
         self.extract_node_name = ""
         self.output_format = 1
+        # unified observability (docs/observability.md): trace_out=<f>
+        # writes a Chrome trace-event JSON of every host thread lane
+        # (decode workers, dev-prefetch producer, dispatch loop, serve
+        # pipeline); telemetry_port=N serves the global metrics
+        # registry over HTTP beside the run (0 binds a free port)
+        self.trace_out = ""
+        self.telemetry_port: Optional[int] = None
+        self._telemetry = None
+        self._obs_hooks: List = []   # global-registry hooks this run
+                                     # registered; removed at run end
+                                     # so repeated in-process runs do
+                                     # not pin dead trainers/feeds
         self.trace = TraceSession()
         self.timer = StepTimer()
         from concurrent.futures import ThreadPoolExecutor
@@ -101,6 +113,13 @@ class LearnTask:
             self.device_prefetch_depth = int(val)
             if self.device_prefetch_depth < 1:
                 raise ValueError("device_prefetch_depth must be >= 1")
+        elif name == "trace_out":
+            self.trace_out = val
+        elif name == "telemetry_port":
+            self.telemetry_port = int(val)
+            if self.telemetry_port < 0:
+                raise ValueError("telemetry_port must be >= 0 "
+                                 "(0 binds a free port)")
         self.trace.set_param(name, val)
         self.cfg.append((name, val))
 
@@ -123,23 +142,63 @@ class LearnTask:
                           os.environ.get("PS_NUM_WORKER", "1"))),
                 int(d.get("dist_worker_rank",
                           os.environ.get("PS_RANK", "0"))))
-        self.init()
-        if not self.silent:
-            print("initializing end, start working")
-        if self.task in ("train", "finetune"):
-            self.task_train()
-        elif self.task == "pred":
-            self.task_predict()
-        elif self.task == "extract":
-            self.task_extract()
-        elif self.task == "export_model":
-            self.task_export()
-        elif self.task == "generate":
-            self.task_generate()
-        elif self.task == "export_reference":
-            self.task_export_reference()
-        elif self.task == "serve":
-            self.task_serve()
+        from .obs import trace as obs_trace
+        from .obs.registry import get_registry
+        try:
+            # observability setup lives INSIDE the try: if e.g. the
+            # telemetry port is taken, the already-installed tracer
+            # still gets uninstalled below instead of accumulating
+            # events for the rest of the process
+            if self.trace_out:
+                obs_trace.start(self.trace_out)
+            if self.telemetry_port is not None:
+                from .obs.telemetry import start_telemetry
+                self._telemetry = start_telemetry(self.telemetry_port)
+                if not self.silent:
+                    print("telemetry on http://127.0.0.1:%d/metrics"
+                          % self._telemetry.port)
+                    sys.stdout.flush()
+            self.init()
+            if not self.silent:
+                print("initializing end, start working")
+            if self.task in ("train", "finetune"):
+                self.task_train()
+            elif self.task == "pred":
+                self.task_predict()
+            elif self.task == "extract":
+                self.task_extract()
+            elif self.task == "export_model":
+                self.task_export()
+            elif self.task == "generate":
+                self.task_generate()
+            elif self.task == "export_reference":
+                self.task_export_reference()
+            elif self.task == "serve":
+                self.task_serve()
+        finally:
+            # each cleanup is independent: a failing trace write must
+            # not skip the server shutdown (or vice versa) nor mask
+            # the task's own exception
+            for h in self._obs_hooks:
+                get_registry().remove_hook(h)
+            self._obs_hooks = []
+            if self._telemetry is not None:
+                try:
+                    self._telemetry.shutdown()
+                    self._telemetry.server_close()
+                except Exception as e:
+                    sys.stderr.write("telemetry shutdown failed: %s\n"
+                                     % e)
+                self._telemetry = None
+            if self.trace_out:
+                try:
+                    path = obs_trace.stop()
+                    if path and not self.silent:
+                        print("wrote host trace to %s (chrome://"
+                              "tracing / tools/trace_report.py)"
+                              % path)
+                except Exception as e:
+                    sys.stderr.write("trace write failed: %s\n" % e)
         return 0
 
     # ------------------------------------------------------------------
@@ -205,9 +264,11 @@ class LearnTask:
         "output_format", "data", "eval", "pred", "iter",
         # overlapped-feed knobs (io/prefetch.py + task_train)
         "device_prefetch", "device_prefetch_depth",
-        # TraceSession (profiler.py)
+        # TraceSession (obs/trace.py ProfilerSession)
         "profile", "profile_dir", "profile_start_batch",
         "profile_stop_batch",
+        # unified observability (obs/, docs/observability.md)
+        "trace_out", "telemetry_port",
     ])
     # keys consumed only by a specific task's run() — claimed for the
     # audit ONLY when that task is active, so a stray 'temperature='
@@ -224,7 +285,8 @@ class LearnTask:
         "serve": frozenset(["export_in", "serve_host", "serve_port",
                             "serve_max_wait_ms", "serve_max_batch",
                             "serve_queue_limit", "serve_timeout_ms",
-                            "serve_dispatch_depth", "serve_warmup"]),
+                            "serve_dispatch_depth", "serve_warmup",
+                            "serve_access_log"]),
     }
 
     def _iter_section_keys(self) -> set:
@@ -481,11 +543,19 @@ class LearnTask:
         use_groups = fuse > 1 and self.trainer.group_staging != 0 \
             and not use_feed
         feed = None
+        # publish the train-loop telemetry into the global registry
+        # (the telemetry_port endpoint and any in-process scraper read
+        # the same numbers the round summary prints)
+        from .obs import trace as obs_trace
+        from .obs.registry import get_registry, watch_steptimer
+        self._obs_hooks.append(
+            watch_steptimer(self.timer, registry=get_registry()))
         if use_feed:
             from .io.prefetch import DevicePrefetchIterator
             feed = DevicePrefetchIterator(
                 self.itr_train, self.trainer,
                 depth=self.device_prefetch_depth)
+            self._obs_hooks += feed.bind_registry(get_registry())
         gstagers = [GroupStager(self.trainer),
                     GroupStager(self.trainer)] if use_groups else None
 
@@ -496,11 +566,13 @@ class LearnTask:
             # transfers (helper thread) overlap this group's step(s)
             if isinstance(group, StagedBatch):
                 n = group.fused or 1
-                with self.trace.step(n):
+                with self.trace.step(n), \
+                        obs_trace.span("train.dispatch", "train"):
                     self.trainer.update_fused(group)
             else:
                 n = len(group)
-                with self.trace.step(n):
+                with self.trace.step(n), \
+                        obs_trace.span("train.dispatch", "train"):
                     if n == 1:
                         self.trainer.update(group[0])
                     else:
@@ -767,7 +839,10 @@ class LearnTask:
         flight between the dispatch and completion threads, default
         2; 0 = serial dispatch), serve_warmup (default 1: pre-run
         every exported bucket at start so no user request eats a
-        first-call compile). Blocks until interrupted."""
+        first-call compile), serve_access_log (default 0: one
+        structured JSON line per request on stderr — method, path,
+        status, request_id, wall ms; docs/observability.md). Blocks
+        until interrupted."""
         from . import serving
         from .serve import ServingEngine
         from .serve.server import build_server
@@ -779,6 +854,7 @@ class LearnTask:
         else:
             raise RuntimeError(
                 "task=serve needs export_in=<artifact> or model_in=<ckpt>")
+        from .obs.registry import get_registry
         timeout_ms = float(d.get("serve_timeout_ms", "30000"))
         engine = ServingEngine(
             callee,
@@ -787,7 +863,11 @@ class LearnTask:
             queue_limit=int(d.get("serve_queue_limit", "64")),
             timeout_ms=timeout_ms,
             dispatch_depth=int(d.get("serve_dispatch_depth", "2")),
-            warmup=bool(int(d.get("serve_warmup", "1"))))
+            warmup=bool(int(d.get("serve_warmup", "1"))),
+            # the process-global registry: /metrics?format=prom and a
+            # telemetry_port endpoint in the same process render one
+            # shared view
+            registry=get_registry())
         srv = build_server(
             engine, d.get("serve_host", "127.0.0.1"),
             int(d.get("serve_port", "8080")),
@@ -795,7 +875,8 @@ class LearnTask:
             # wait must then be unbounded too, not an instant 504
             request_timeout=(timeout_ms / 1000.0 if timeout_ms > 0
                              else None),
-            verbose=not self.silent)
+            verbose=not self.silent,
+            access_log=bool(int(d.get("serve_access_log", "0"))))
         host, port = srv.server_address[:2]
         if not self.silent:
             print("serving %s on http://%s:%d (buckets %s, "

@@ -39,15 +39,19 @@ from typing import Optional
 
 from . import DataIterator, ProducerFailure, drain_producer
 from ..metrics import StallClock
+from ..obs import trace as _trace
 
 
 def _decode_task(idx, label, buf):
     """Decode one encoded image object into a DataInst — the unit of
     work shipped to pool workers. Top-level (picklable) so the process
     mode can reference it; imports stay inside so spawned workers load
-    only numpy + cv2, not jax."""
+    only numpy + cv2, not jax. The span puts each decode on its worker
+    thread's trace lane (a spawned process has no tracer installed, so
+    there it is the disabled one-branch path)."""
     from .image import DataInst, _decode_image
-    return DataInst(idx, label, _decode_image(buf))
+    with _trace.span("decode", "feed"):
+        return DataInst(idx, label, _decode_image(buf))
 
 
 class ParallelDecodeIterator:
@@ -224,6 +228,14 @@ class ParallelDecodeIterator:
         prefetch_depth — the backpressure tests pin this)."""
         return len(self._pending)
 
+    def bind_registry(self, registry=None,
+                      prefix: str = "cxxnet_decode"):
+        """Publish the decode-wait clock (consumer blocked on a not-
+        yet-finished decode) into an obs registry. Returns the hooks
+        (for ``Registry.remove_hook`` at end of use)."""
+        return [self.decode_wait.bind_registry(prefix + "_wait",
+                                               registry)]
+
     def close(self) -> None:
         if self._pool is not None:
             self._pool.shutdown(wait=False)
@@ -296,7 +308,14 @@ class DevicePrefetchIterator:
     def _put(self, q, item) -> None:
         t0 = time.perf_counter()
         q.put(item)
-        self.put_wait.add_wait(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self.put_wait.add_wait(dt)
+        tr = _trace.active()
+        if tr is not None and dt > 1e-4:
+            # only materialized waits become spans: an uncontended put
+            # is sub-100us and would bury the lane in noise
+            tr.complete("feed.backpressure", "feed", t0,
+                        t0 + dt)
 
     def _produce(self, q, gen) -> None:
         from ..trainer import GroupStager
@@ -328,17 +347,19 @@ class DevicePrefetchIterator:
                     q.put(None)
                     return
                 t0 = time.perf_counter()
-                has = self.base.next()
+                with _trace.span("feed.source_next", "feed"):
+                    has = self.base.next()
                 self.source_wait.add_wait(time.perf_counter() - t0)
                 if not has:
                     break
                 batch = self.base.value
                 t0 = time.perf_counter()
-                if gs is not None:
-                    gs.add(batch)   # copies now; base may reuse buffers
-                    staged = gs.stage() if gs.full else None
-                else:
-                    staged = tr.stage(batch)
+                with _trace.span("feed.stage", "feed"):
+                    if gs is not None:
+                        gs.add(batch)   # copies now; base may reuse
+                        staged = gs.stage() if gs.full else None
+                    else:
+                        staged = tr.stage(batch)
                 self.stage_busy.add_busy(time.perf_counter() - t0)
                 if gs is not None:
                     if staged is not None:
@@ -385,7 +406,8 @@ class DevicePrefetchIterator:
         if self._queue is None:
             self.before_first()
         t0 = time.perf_counter()
-        item = self._queue.get()
+        with _trace.span("feed.get", "feed"):
+            item = self._queue.get()
         self.get_wait.add_wait(time.perf_counter() - t0)
         if item is None or isinstance(item, ProducerFailure):
             self._thread.join()
@@ -401,6 +423,31 @@ class DevicePrefetchIterator:
     def value(self):
         """A StagedBatch (plain or fused group) or list of StagedBatch."""
         return self._value
+
+    def bind_registry(self, registry=None,
+                      prefix: str = "cxxnet_feed"):
+        """Publish the four boundary clocks plus the headline
+        ``<prefix>_stall_frac`` gauge into an obs registry (pulled at
+        scrape time; the producer/consumer hot paths are untouched).
+        The training CLI binds the global registry here so the
+        ``telemetry_port`` endpoint can answer 'is the device
+        starving?' mid-round. Returns the hooks — pass them to
+        ``Registry.remove_hook`` when this iterator is done (a
+        registered hook pins the iterator, its trainer, and their
+        device buffers)."""
+        from ..obs.registry import get_registry
+        reg = registry or get_registry()
+        hooks = [
+            self.source_wait.bind_registry(prefix + "_source", reg),
+            self.stage_busy.bind_registry(prefix + "_stage", reg),
+            self.put_wait.bind_registry(prefix + "_backpressure", reg),
+            self.get_wait.bind_registry(prefix + "_get", reg),
+        ]
+        g = reg.gauge(prefix + "_stall_frac",
+                      "consumer wait over total accounted feed time")
+        hooks.append(reg.add_hook(
+            lambda: g.set(self.stats()["feed_stall_frac"])))
+        return hooks
 
     def stats(self) -> dict:
         """Per-boundary stall snapshot; ``feed_stall_frac`` is consumer

@@ -251,6 +251,16 @@ class StreamingQuantile:
     def clear(self) -> None:
         self._n = 0
 
+    def bind_registry(self, name: str, registry=None,
+                      quantiles=(0.5, 0.9, 0.99), **labels):
+        """Publish this window's quantiles into an obs registry (a
+        gauge with a ``q`` label, pulled at scrape time — the add()
+        hot path is untouched). Returns the hook for
+        ``Registry.remove_hook``. See obs/registry.py."""
+        from .obs.registry import watch_quantile
+        return watch_quantile(self, name, registry=registry,
+                              quantiles=quantiles, labels=labels)
+
 
 class StallClock:
     """Wall-time ledger for one pipeline stage: how long it spent
@@ -300,6 +310,16 @@ class StallClock:
         return {"wait_s": self.wait_s, "busy_s": self.busy_s,
                 "waits": self.waits, "events": self.events,
                 "wait_frac": self.wait_frac}
+
+    def bind_registry(self, name: str, registry=None, **labels):
+        """Publish this clock into an obs registry as
+        ``<name>_{wait_seconds,busy_seconds,waits,events,wait_frac}``
+        gauges, pulled at scrape time — the add_wait/add_busy hot path
+        is untouched. Returns the hook for ``Registry.remove_hook``.
+        See obs/registry.py."""
+        from .obs.registry import watch_stallclock
+        return watch_stallclock(self, name, registry=registry,
+                                labels=labels)
 
 
 def create_metric(name: str) -> Optional[Metric]:

@@ -1,0 +1,48 @@
+"""cxxnet_tpu.obs — unified observability: metrics registry + tracing.
+
+Before this package the repo's observability was four ad-hoc surfaces
+(``profiler.StepTimer``, ``metrics.StallClock``, ``serve/stats.py``,
+and a JSON ``/metrics`` handler) that could not be correlated with
+each other or scraped by standard tooling. ``obs`` gives them one
+shared backbone:
+
+* :mod:`.registry` — process-global, thread-safe Counter / Gauge /
+  Histogram primitives with labels, a JSON snapshot, and a Prometheus
+  text-exposition renderer. Existing telemetry objects *publish into
+  it* through pull-adapters (``watch_stallclock`` / ``watch_steptimer``
+  / ``watch_quantile`` and ``ServeStats.bind_registry``) instead of
+  keeping private dicts, so ``/metrics?format=prom`` and the training
+  telemetry endpoint render every number from the same place.
+* :mod:`.trace` — a low-overhead structured span tracer emitting
+  Chrome trace-event JSON (``chrome://tracing`` / Perfetto loadable)
+  with explicit thread lanes and flow events, instrumented across
+  every thread boundary in the tree: decode-pool workers, the device
+  prefetch producer, the dispatch-ahead train loop, and the serving
+  engine's admission → dispatch → completion pipeline. Disabled mode
+  is one module-global read and a shared no-op singleton — zero
+  allocation per call. ``ProfilerSession`` (the jax.profiler capture
+  formerly ``profiler.TraceSession``) lives here too, so there is
+  exactly one tracing module in the tree.
+* :mod:`.telemetry` — the lightweight HTTP endpoint (``telemetry_port``
+  in cli.py) exposing the global registry (JSON + Prometheus) plus
+  per-device memory during training.
+
+See docs/observability.md for the full contract (metric naming, trace
+format, request-id semantics).
+"""
+
+from .registry import (Counter, Gauge, Histogram, Registry,
+                       get_registry, watch_quantile, watch_stallclock,
+                       watch_steptimer)
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "get_registry",
+           "watch_quantile", "watch_stallclock", "watch_steptimer",
+           "trace", "telemetry"]
+
+
+def __getattr__(name):
+    # trace/telemetry load lazily (telemetry pulls in http.server)
+    if name in ("trace", "telemetry"):
+        import importlib
+        return importlib.import_module("." + name, __name__)
+    raise AttributeError(name)

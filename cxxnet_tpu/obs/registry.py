@@ -1,0 +1,428 @@
+"""Metrics registry: Counter / Gauge / Histogram with labels, JSON
+snapshot, Prometheus text exposition.
+
+One process-global :class:`Registry` (``get_registry()``) is the
+shared sink every subsystem publishes into; private registries are
+plain constructions (the serving engine keeps one per engine so
+side-by-side engines in one process — the test suite, paired
+benchmarks — never fight over series).
+
+Two publishing styles:
+
+* **push** — hot paths call ``counter.inc()`` / ``hist.observe()``
+  directly (one lock acquire on a plain dict; no string formatting
+  until scrape time).
+* **pull** — existing telemetry objects (``metrics.StallClock``,
+  ``profiler.StepTimer``, ``metrics.StreamingQuantile``,
+  ``serve.stats.ServeStats``) keep their own state and register a
+  *collection hook* that copies it into registry series at scrape
+  time (``watch_stallclock`` & friends). The scrape pays the cost,
+  the hot path pays nothing new, and every legacy number becomes
+  scrapeable without rewriting its accounting.
+
+Exposition: ``render_prom()`` emits the Prometheus text format
+(``# HELP`` / ``# TYPE`` / ``name{label="v"} value``; histograms as
+cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``);
+``snapshot()`` returns the same data as a JSON-ready dict.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# default histogram buckets: latency-ish seconds ladder (prom default)
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                   2.5, 5.0, 10.0)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample-value formatting."""
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return "%d" % int(v)
+    return repr(v)
+
+
+def _esc(s: str) -> str:
+    """Escape a label value for the text exposition."""
+    return (str(s).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels_text(names: Tuple[str, ...], values: Tuple[str, ...],
+                 extra: str = "") -> str:
+    parts = ['%s="%s"' % (n, _esc(v)) for n, v in zip(names, values)]
+    if extra:
+        parts.append(extra)
+    return "{%s}" % ",".join(parts) if parts else ""
+
+
+class _Metric:
+    """Base: one named family of label-keyed series."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()) -> None:
+        if not _NAME_RE.match(name):
+            raise ValueError("invalid metric name %r" % name)
+        for l in labelnames:
+            if not _LABEL_RE.match(l) or l == "le":
+                raise ValueError("invalid label name %r" % l)
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, ...], object] = {}
+
+    def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                "%s takes labels %s, got %s"
+                % (self.name, sorted(self.labelnames), sorted(labels)))
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def _items(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return sorted(self._series.items())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+    # rendering -------------------------------------------------------
+    def _render_series(self, key, val, out: List[str]) -> None:
+        out.append("%s%s %s" % (
+            self.name, _labels_text(self.labelnames, key), _fmt(val)))
+
+    def render(self, out: List[str]) -> None:
+        if self.help:
+            out.append("# HELP %s %s"
+                       % (self.name,
+                          self.help.replace("\\", "\\\\")
+                          .replace("\n", "\\n")))
+        out.append("# TYPE %s %s" % (self.name, self.kind))
+        for key, val in self._items():
+            self._render_series(key, val, out)
+
+    def _snapshot_value(self, val):
+        return val
+
+    def snapshot(self) -> dict:
+        return {
+            "type": self.kind,
+            "help": self.help,
+            "series": [
+                {"labels": dict(zip(self.labelnames, key)),
+                 "value": self._snapshot_value(val)}
+                for key, val in self._items()],
+        }
+
+
+class Counter(_Metric):
+    """Monotonically increasing count. ``inc()`` is the push path;
+    ``set_total()`` exists for pull-adapters that mirror an external
+    running total (the adapter, not the counter, owns monotonicity)."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError("counter increment must be >= 0")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def set_total(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    """Point-in-time value; may go up or down."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics): observe()
+    increments every bucket whose upper bound covers the value, plus
+    ``_sum`` and ``_count``."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help, labelnames)
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError("histogram needs at least one bucket")
+        if bs[-1] != float("inf"):
+            bs.append(float("inf"))
+        self.buckets = tuple(bs)
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            st = self._series.get(key)
+            if st is None:
+                st = [[0] * len(self.buckets), 0.0, 0]
+                self._series[key] = st
+            counts, _, _ = st
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    counts[i] += 1
+            st[1] += v
+            st[2] += 1
+
+    def _render_series(self, key, st, out: List[str]) -> None:
+        counts, total, n = st
+        for b, c in zip(self.buckets, counts):
+            le = "+Inf" if math.isinf(b) else _fmt(b)
+            out.append("%s_bucket%s %d" % (
+                self.name,
+                _labels_text(self.labelnames, key, 'le="%s"' % le), c))
+        out.append("%s_sum%s %s" % (
+            self.name, _labels_text(self.labelnames, key), _fmt(total)))
+        out.append("%s_count%s %d" % (
+            self.name, _labels_text(self.labelnames, key), n))
+
+    def _snapshot_value(self, st):
+        counts, total, n = st
+        return {
+            "sum": total, "count": n,
+            "buckets": {
+                ("+Inf" if math.isinf(b) else _fmt(b)): c
+                for b, c in zip(self.buckets, counts)},
+        }
+
+
+class Registry:
+    """Thread-safe name → metric map with get-or-create semantics and
+    scrape-time collection hooks (the pull-adapter mechanism)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._hooks: List[Callable[[], None]] = []
+
+    # creation --------------------------------------------------------
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) \
+                        or m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        "metric %r already registered as %s%s"
+                        % (name, m.kind, list(m.labelnames)))
+                return m
+            m = cls(name, help, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS
+                  ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def add_hook(self, fn: Callable[[], None]) -> Callable[[], None]:
+        """Register a scrape-time hook (idempotent by identity): called
+        before every snapshot/render to copy external state into
+        registry series. Returns ``fn`` — keep it to ``remove_hook``
+        later; a hook closure pins whatever it captures (a trainer, a
+        feed iterator) for as long as it stays registered."""
+        with self._lock:
+            if fn not in self._hooks:
+                self._hooks.append(fn)
+        return fn
+
+    def remove_hook(self, fn: Callable[[], None]) -> None:
+        """Unregister a hook (no-op when absent): callers that bind
+        per-run objects into a long-lived registry (the CLI binds each
+        run's StepTimer/feed into the process-global one) remove them
+        at run end so N runs do not pin N object graphs."""
+        with self._lock:
+            try:
+                self._hooks.remove(fn)
+            except ValueError:
+                pass
+
+    # collection ------------------------------------------------------
+    def collect(self) -> None:
+        """Run the pull hooks. A failing hook is counted, not fatal —
+        one broken adapter must not take down the whole scrape."""
+        with self._lock:
+            hooks = list(self._hooks)
+        errs = 0
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:
+                errs += 1
+        if errs:
+            self.counter("cxxnet_obs_hook_errors_total",
+                         "collection hooks that raised").inc(errs)
+
+    def get_value(self, name: str, **labels) -> Optional[float]:
+        """Convenience: collect, then read one counter/gauge series
+        (None when the metric or series does not exist)."""
+        self.collect()
+        with self._lock:
+            m = self._metrics.get(name)
+        if m is None:
+            return None
+        try:
+            with m._lock:
+                v = m._series.get(m._key(labels))
+            return None if v is None else float(v)  # type: ignore
+        except (ValueError, TypeError):
+            return None
+
+    def snapshot(self) -> dict:
+        """JSON-ready dump of every metric family."""
+        self.collect()
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        return {name: m.snapshot() for name, m in metrics}
+
+    def render_prom(self) -> str:
+        """Prometheus text exposition (format version 0.0.4)."""
+        self.collect()
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out: List[str] = []
+        for _, m in metrics:
+            m.render(out)
+        return "\n".join(out) + "\n"
+
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_global_registry = Registry()
+
+
+def get_registry() -> Registry:
+    """The process-global registry: the default publishing target for
+    training-side telemetry and the ``telemetry_port`` endpoint."""
+    return _global_registry
+
+
+# ----------------------------------------------------------------------
+# pull-adapters: bridge the pre-existing telemetry objects into a
+# registry without changing their hot-path accounting
+
+def watch_stallclock(clock, name: str, registry: Optional[Registry] = None,
+                     labels: Optional[Dict[str, str]] = None
+                     ) -> Callable[[], None]:
+    """Publish a ``metrics.StallClock`` as gauges
+    ``<name>_{wait_seconds,busy_seconds,waits,events,wait_frac}``."""
+    reg = registry or get_registry()
+    labels = dict(labels or {})
+    names = tuple(labels)
+    gs = {f: reg.gauge("%s_%s" % (name, f),
+                       "StallClock %s" % f, names)
+          for f in ("wait_seconds", "busy_seconds", "waits", "events",
+                    "wait_frac")}
+
+    def pull():
+        gs["wait_seconds"].set(clock.wait_s, **labels)
+        gs["busy_seconds"].set(clock.busy_s, **labels)
+        gs["waits"].set(clock.waits, **labels)
+        gs["events"].set(clock.events, **labels)
+        gs["wait_frac"].set(clock.wait_frac, **labels)
+
+    return reg.add_hook(pull)
+
+
+def watch_steptimer(timer, registry: Optional[Registry] = None,
+                    prefix: str = "cxxnet_train") -> Callable[[], None]:
+    """Publish a ``profiler.StepTimer``: rolling step time, whole-run
+    totals, and the feed-stall ledger."""
+    reg = registry or get_registry()
+    g_ms = reg.gauge(prefix + "_step_ms",
+                     "rolling mean wall ms per train step")
+    c_steps = reg.counter(prefix + "_steps_total",
+                          "train steps with measured wall time")
+    c_time = reg.counter(prefix + "_step_seconds_total",
+                         "total measured step wall seconds")
+    c_wait = reg.counter(prefix + "_feed_wait_seconds_total",
+                         "train loop seconds blocked on the feed")
+    g_frac = reg.gauge(prefix + "_round_feed_stall_frac",
+                       "this round's feed-stall fraction")
+
+    def pull():
+        g_ms.set(timer.mean_step_ms)
+        c_steps.set_total(timer.total_steps)
+        c_time.set_total(timer.total_time)
+        c_wait.set_total(timer.feed.wait_s)
+        g_frac.set(timer.round_feed_stall_frac)
+
+    return reg.add_hook(pull)
+
+
+def watch_quantile(q, name: str, registry: Optional[Registry] = None,
+                   quantiles: Sequence[float] = (0.5, 0.9, 0.99),
+                   labels: Optional[Dict[str, str]] = None
+                   ) -> Callable[[], None]:
+    """Publish a ``metrics.StreamingQuantile`` as a gauge with a ``q``
+    label per requested quantile plus a ``<name>_count`` counter."""
+    reg = registry or get_registry()
+    labels = dict(labels or {})
+    g = reg.gauge(name, "streaming quantile over the recency window",
+                  tuple(labels) + ("q",))
+    c = reg.counter(name + "_count", "observations ever added",
+                    tuple(labels))
+
+    def pull():
+        vals = q.quantiles(list(quantiles))
+        for qq, v in zip(quantiles, vals):
+            if v == v:          # skip NaN (empty window)
+                g.set(v, q="%g" % qq, **labels)
+        c.set_total(q.count, **labels)
+
+    return reg.add_hook(pull)

@@ -1,0 +1,143 @@
+"""Lightweight telemetry HTTP endpoint for training processes.
+
+``telemetry_port = N`` in the CLI starts this server beside the train
+loop (``0`` binds a free port, printed at startup): the same registry
+the serving ``/metrics`` renders — step timing, feed-stall clocks,
+decode-pool waits — becomes scrapeable mid-run without attaching a
+profiler or waiting for the round summary.
+
+Endpoints:
+  GET /metrics               JSON snapshot of the registry plus a
+                             ``device_memory`` summary string
+  GET /metrics?format=prom   Prometheus text exposition (0.0.4)
+  GET /healthz               {"ok": true}
+
+Stdlib-only (ThreadingHTTPServer) like serve/server.py; one daemon
+thread, silent request logging. Device memory also publishes as the
+``cxxnet_device_peak_bytes`` / ``cxxnet_device_bytes_limit`` gauges
+(per-device labels) through a registry hook, so the Prometheus view
+carries it too.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlsplit
+
+from .registry import PROM_CONTENT_TYPE, Registry, get_registry
+
+
+def watch_device_memory(registry: Optional[Registry] = None):
+    """Registry hook publishing per-device peak/limit HBM bytes (the
+    numbers behind ``profiler.device_memory_summary``); devices that
+    report no stats (CPU backends) simply publish nothing. Idempotent
+    per registry — repeated start_telemetry calls in one process must
+    not stack duplicate hooks."""
+    reg = registry or get_registry()
+    existing = getattr(reg, "_device_memory_hook", None)
+    if existing is not None:
+        return existing
+    g_peak = reg.gauge("cxxnet_device_peak_bytes",
+                       "per-device peak bytes in use", ("device",))
+    g_limit = reg.gauge("cxxnet_device_bytes_limit",
+                        "per-device memory limit", ("device",))
+
+    def pull():
+        import jax
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                g_peak.set(peak, device=str(d.id))
+            limit = stats.get("bytes_limit")
+            if limit is not None:
+                g_limit.set(limit, device=str(d.id))
+
+    reg._device_memory_hook = pull
+    return reg.add_hook(pull)
+
+
+class _TelemetryHandler(BaseHTTPRequestHandler):
+    server_version = "cxxnet-tpu-telemetry/0.1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # scrapers poll; stay silent
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        parts = urlsplit(self.path)
+        if parts.path == "/healthz":
+            self._send(200, b'{"ok": true}', "application/json")
+            return
+        if parts.path != "/metrics":
+            self._send(404, b'{"error": "no such path"}',
+                       "application/json")
+            return
+        reg: Registry = self.server.registry
+        fmt = parse_qs(parts.query).get("format", ["json"])[0]
+        if fmt == "prom":
+            self._send(200, reg.render_prom().encode("utf-8"),
+                       PROM_CONTENT_TYPE)
+            return
+        if fmt != "json":
+            # same contract as serve/server.py's /metrics: an unknown
+            # format is a 400, not a silent JSON fallback
+            self._send(400, b'{"error": "format must be json or prom"}',
+                       "application/json")
+            return
+        snap = {"metrics": reg.snapshot()}
+        try:
+            from ..profiler import device_memory_summary
+            snap["device_memory"] = device_memory_summary()
+        except Exception:
+            snap["device_memory"] = ""
+        self._send(200, json.dumps(snap).encode("utf-8"),
+                   "application/json")
+
+
+class TelemetryServer(ThreadingHTTPServer):
+    """``port=0`` binds a free port (read ``server_address[1]``)."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.registry = registry or get_registry()
+        super().__init__((host, port), _TelemetryHandler)
+
+    def start_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever,
+                             name="telemetry-http", daemon=True)
+        t.start()
+        return t
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+def start_telemetry(port: int, registry: Optional[Registry] = None,
+                    host: str = "127.0.0.1") -> TelemetryServer:
+    """Build + start the endpoint on a daemon thread; registers the
+    device-memory hook so /metrics?format=prom carries HBM gauges."""
+    reg = registry or get_registry()
+    watch_device_memory(reg)
+    srv = TelemetryServer(reg, host, port)
+    srv.start_background()
+    return srv

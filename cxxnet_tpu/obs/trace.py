@@ -1,0 +1,371 @@
+"""Structured span tracing: Chrome trace-event JSON with thread lanes.
+
+One tracer serves the whole process. Call sites use the module-level
+helpers (``span`` / ``instant`` / ``counter`` / ``flow_*``); with no
+tracer installed each helper is one module-global read, one branch,
+and a shared no-op singleton — **zero allocation per call** — so the
+instrumentation stays in the hot paths permanently (decode workers,
+the device-prefetch producer, the dispatch-ahead train loop, the
+serving engine's dispatch/completion threads) and costs nothing until
+``trace_out=`` turns it on.
+
+Output is the Chrome trace-event format (load the file in
+``chrome://tracing`` or https://ui.perfetto.dev, or summarize with
+``tools/trace_report.py``):
+
+* ``X`` complete events — one per span, with wall ``ts``/``dur`` in
+  microseconds relative to tracer start;
+* ``M`` metadata events — one ``thread_name`` per lane, so decode
+  workers, the dev-prefetch producer, serve-dispatch, serve-complete
+  and the main loop each get a labelled row;
+* ``s``/``t``/``f`` flow events — arrows linking one logical request
+  across threads (the serving request-id pipeline uses these:
+  admission on the handler thread → dispatch → completion).
+
+``ProfilerSession`` (the config-gated ``jax.profiler`` XLA capture,
+formerly ``profiler.TraceSession``) lives here as well so all tracing
+machinery sits in one module; ``profiler.TraceSession`` remains as a
+compatibility alias.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager: the disabled-mode return
+    value of ``span()``. A singleton on purpose — the disabled tracer
+    must not allocate per call (tier-1 test pins the identity)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    """One live span: records an ``X`` complete event on exit."""
+
+    __slots__ = ("_tr", "name", "cat", "args", "_t0")
+
+    def __init__(self, tr: "Tracer", name: str, cat: str,
+                 args: Optional[dict]) -> None:
+        self._tr = tr
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._tr.complete(self.name, self.cat, self._t0,
+                          time.perf_counter(), self.args)
+        return False
+
+
+class Tracer:
+    """Event sink: thread-safe append of trace events, JSON writer.
+
+    Appends go to a plain list (CPython ``list.append`` is atomic);
+    the lock only guards lane registration and the final write. A
+    ``max_events`` cap bounds memory on runaway runs — events past the
+    cap are counted in ``dropped`` and noted in the written file.
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 max_events: int = 1_000_000) -> None:
+        self.path = path
+        self.max_events = int(max_events)
+        self.dropped = 0
+        self._t0 = time.perf_counter()
+        self._wall0 = time.time()
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._lanes: Dict[tuple, tuple] = {}  # (ident, name) ->
+                                              # (lane id, name)
+
+    # ------------------------------------------------------------------
+    def _ts(self, t: Optional[float] = None) -> float:
+        return ((time.perf_counter() if t is None else t)
+                - self._t0) * 1e6
+
+    def _tid(self) -> int:
+        # keyed by (ident, name), not ident alone: the OS reuses
+        # thread ids, and a short-lived thread's successor (e.g. the
+        # serve-complete thread after a dev-prefetch epoch ended) must
+        # get its own lane, not inherit the dead one's label
+        name = threading.current_thread().name
+        key = (threading.get_ident(), name)
+        lane = self._lanes.get(key)
+        if lane is None:
+            with self._lock:
+                lane = self._lanes.setdefault(
+                    key, (len(self._lanes), name))
+        return lane[0]
+
+    def _emit(self, ev: dict) -> None:
+        if len(self._events) >= self.max_events:
+            self.dropped += 1
+            return
+        self._events.append(ev)
+
+    # event kinds ------------------------------------------------------
+    def span(self, name: str, cat: str = "app",
+             args: Optional[dict] = None) -> _Span:
+        return _Span(self, name, cat, args)
+
+    def complete(self, name: str, cat: str, t0: float, t1: float,
+                 args: Optional[dict] = None) -> None:
+        ev = {"ph": "X", "name": name, "cat": cat, "pid": 0,
+              "tid": self._tid(), "ts": self._ts(t0),
+              "dur": (t1 - t0) * 1e6}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def instant(self, name: str, cat: str = "app",
+                args: Optional[dict] = None) -> None:
+        ev = {"ph": "i", "name": name, "cat": cat, "pid": 0,
+              "tid": self._tid(), "ts": self._ts(), "s": "t"}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def counter(self, name: str, values: Dict[str, float],
+                cat: str = "app") -> None:
+        self._emit({"ph": "C", "name": name, "cat": cat, "pid": 0,
+                    "tid": self._tid(), "ts": self._ts(),
+                    "args": dict(values)})
+
+    def _flow(self, ph: str, name: str, fid: int, cat: str) -> None:
+        # flow ids are caller-owned (the serving engine uses its
+        # process-wide request sequence) — one id space, one arrow
+        # per logical request
+        ev = {"ph": ph, "name": name, "cat": cat, "pid": 0,
+              "tid": self._tid(), "ts": self._ts(), "id": int(fid)}
+        if ph == "f":
+            ev["bp"] = "e"   # bind to the enclosing span's end
+        self._emit(ev)
+
+    def flow_start(self, name: str, fid: int, cat: str = "flow") -> None:
+        self._flow("s", name, fid, cat)
+
+    def flow_step(self, name: str, fid: int, cat: str = "flow") -> None:
+        self._flow("t", name, fid, cat)
+
+    def flow_end(self, name: str, fid: int, cat: str = "flow") -> None:
+        self._flow("f", name, fid, cat)
+
+    # output -----------------------------------------------------------
+    def trace_events(self) -> List[dict]:
+        """Metadata (process/thread names, lane order) + the events."""
+        with self._lock:
+            lanes = sorted(self._lanes.values())
+            events = list(self._events)
+        meta: List[dict] = [{
+            "ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+            "args": {"name": "cxxnet_tpu"}}]
+        for tid, name in lanes:
+            meta.append({"ph": "M", "name": "thread_name", "pid": 0,
+                         "tid": tid, "args": {"name": name}})
+            meta.append({"ph": "M", "name": "thread_sort_index",
+                         "pid": 0, "tid": tid,
+                         "args": {"sort_index": tid}})
+        return meta + events
+
+    def write(self, path: Optional[str] = None) -> str:
+        path = path or self.path
+        if not path:
+            raise ValueError("no output path: Tracer(path=...) or "
+                             "write(path)")
+        doc = {
+            "traceEvents": self.trace_events(),
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "clock": "perf_counter, us since trace start",
+                "wall_start_unix": self._wall0,
+                "dropped_events": self.dropped,
+            },
+        }
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+
+# ----------------------------------------------------------------------
+# module-level API: the one branch every call site pays when disabled
+
+_active: Optional[Tracer] = None
+
+
+def active() -> Optional[Tracer]:
+    return _active
+
+
+def enabled() -> bool:
+    return _active is not None
+
+
+def start(path: Optional[str] = None, **kw) -> Tracer:
+    """Install the process tracer (replacing any previous one)."""
+    global _active
+    _active = Tracer(path, **kw)
+    return _active
+
+
+def stop(path: Optional[str] = None) -> Optional[str]:
+    """Uninstall the tracer and write its file (when it has a path);
+    returns the written path, or None if tracing was off."""
+    global _active
+    tr = _active
+    _active = None
+    if tr is None:
+        return None
+    if path or tr.path:
+        return tr.write(path)
+    return None
+
+
+def span(name: str, cat: str = "app", args: Optional[dict] = None):
+    """A context manager timing one span. Disabled: the shared no-op
+    singleton (same object every call — no allocation)."""
+    tr = _active
+    if tr is None:
+        return NOOP_SPAN
+    return _Span(tr, name, cat, args)
+
+
+def instant(name: str, cat: str = "app",
+            args: Optional[dict] = None) -> None:
+    tr = _active
+    if tr is not None:
+        tr.instant(name, cat, args)
+
+
+def counter(name: str, values: Dict[str, float],
+            cat: str = "app") -> None:
+    tr = _active
+    if tr is not None:
+        tr.counter(name, values, cat)
+
+
+def flow_start(name: str, fid: int, cat: str = "flow") -> None:
+    tr = _active
+    if tr is not None:
+        tr.flow_start(name, fid, cat)
+
+
+def flow_step(name: str, fid: int, cat: str = "flow") -> None:
+    tr = _active
+    if tr is not None:
+        tr.flow_step(name, fid, cat)
+
+
+def flow_end(name: str, fid: int, cat: str = "flow") -> None:
+    tr = _active
+    if tr is not None:
+        tr.flow_end(name, fid, cat)
+
+
+# ----------------------------------------------------------------------
+class ProfilerSession:
+    """Config-gated jax.profiler trace over a window of train steps
+    (formerly ``profiler.TraceSession``; moved here so every tracing
+    surface lives in ``obs`` — the Chrome-trace writer above is the
+    host-side span view, this is the XLA/device-op view, and they are
+    enabled by different knobs because they answer different questions).
+
+    Keys (global config, broadcast like every other param):
+      profile = 0|1            enable trace capture
+      profile_dir = <dir>      output directory (default "profile")
+      profile_start_batch = n  first batch (of round 0) inside the trace
+      profile_stop_batch = n   batch after which the trace is written
+    """
+
+    def __init__(self) -> None:
+        self.enabled = 0
+        self.dir = "profile"
+        self.start_batch = 2   # skip compile on step 0/1 by default
+        self.stop_batch = 12
+        self._active = False
+        self._done = False
+        self._step = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "profile":
+            self.enabled = int(val)
+        elif name == "profile_dir":
+            self.dir = val
+        elif name == "profile_start_batch":
+            self.start_batch = int(val)
+        elif name == "profile_stop_batch":
+            self.stop_batch = int(val)
+
+    # ------------------------------------------------------------------
+    def step(self, nbatch: int = 1):
+        """Context manager wrapping one train dispatch covering ``nbatch``
+        batches (1 for a plain step; K for a fused fuse_steps group):
+        starts/stops the trace at the configured BATCH indices, so the
+        profile window stays in batch units whatever the dispatch
+        grouping. The step_num annotation is the dispatch's first batch
+        index."""
+        n = self._step
+        self._step += nbatch
+        if not self.enabled or self._done:
+            return contextlib.nullcontext()
+        if self.stop_batch <= self.start_batch:
+            # validated here, not in set_param: the keys arrive in
+            # config order, so an eager per-key check would reject a
+            # valid config whose stop line comes after its start line
+            # (ADVICE r3 wanted the inverted window caught — an
+            # inverted window would otherwise trace until close())
+            raise ValueError(
+                "profile_stop_batch (%d) must be > profile_start_batch "
+                "(%d)" % (self.stop_batch, self.start_batch))
+        import jax
+
+        if not self._active and n >= self.start_batch:
+            # start only when the dispatch BEGINS inside the window: a
+            # fused group merely spanning start_batch would otherwise
+            # pull the group's compile dispatch into the profile —
+            # exactly what start_batch exists to skip (ADVICE r3). With
+            # fuse_steps=K the effective start rounds up to the next
+            # group boundary.
+            os.makedirs(self.dir, exist_ok=True)
+            jax.profiler.start_trace(self.dir)
+            self._active = True
+        elif self._active and n >= self.stop_batch:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            return contextlib.nullcontext()
+        if self._active:
+            return jax.profiler.StepTraceAnnotation("train", step_num=n)
+        return contextlib.nullcontext()
+
+    def close(self) -> None:
+        """Flush an open trace (end of training / interrupt)."""
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

@@ -22,14 +22,20 @@ Trace capture and memory reporting are inert unless ``profile = 1``. The
 per-round speed summary prints whenever ``silent = 0`` (an addition to
 the reference's stdout; the compatibility surface — the stderr
 ``name-metric:value`` eval lines and the model format — is unchanged).
+
+Since the ``obs`` subsystem landed, all tracing machinery lives in
+``obs/trace.py`` (the host-side Chrome-trace span writer AND this
+jax.profiler capture): ``TraceSession`` here is a compatibility alias
+of ``obs.trace.ProfilerSession``, and ``StepTimer`` publishes into the
+metrics registry through ``obs.registry.watch_steptimer``.
 """
 
 from __future__ import annotations
 
-import contextlib
-import os
 import time
 from typing import List, Optional
+
+from .obs.trace import ProfilerSession as TraceSession  # noqa: F401
 
 
 class StepTimer:
@@ -144,84 +150,3 @@ def device_memory_summary() -> str:
         else:
             parts.append("%s: %.1f MiB peak" % (str(d.id), peak / 2**20))
     return "; ".join(parts)
-
-
-class TraceSession:
-    """Config-gated jax.profiler trace over a window of train steps.
-
-    Keys (global config, broadcast like every other param):
-      profile = 0|1            enable trace capture
-      profile_dir = <dir>      output directory (default "profile")
-      profile_start_batch = n  first batch (of round 0) inside the trace
-      profile_stop_batch = n   batch after which the trace is written
-    """
-
-    def __init__(self) -> None:
-        self.enabled = 0
-        self.dir = "profile"
-        self.start_batch = 2   # skip compile on step 0/1 by default
-        self.stop_batch = 12
-        self._active = False
-        self._done = False
-        self._step = 0
-
-    def set_param(self, name: str, val: str) -> None:
-        if name == "profile":
-            self.enabled = int(val)
-        elif name == "profile_dir":
-            self.dir = val
-        elif name == "profile_start_batch":
-            self.start_batch = int(val)
-        elif name == "profile_stop_batch":
-            self.stop_batch = int(val)
-
-    # ------------------------------------------------------------------
-    def step(self, nbatch: int = 1):
-        """Context manager wrapping one train dispatch covering ``nbatch``
-        batches (1 for a plain step; K for a fused fuse_steps group):
-        starts/stops the trace at the configured BATCH indices, so the
-        profile window stays in batch units whatever the dispatch
-        grouping. The step_num annotation is the dispatch's first batch
-        index."""
-        n = self._step
-        self._step += nbatch
-        if not self.enabled or self._done:
-            return contextlib.nullcontext()
-        if self.stop_batch <= self.start_batch:
-            # validated here, not in set_param: the keys arrive in
-            # config order, so an eager per-key check would reject a
-            # valid config whose stop line comes after its start line
-            # (ADVICE r3 wanted the inverted window caught — an
-            # inverted window would otherwise trace until close())
-            raise ValueError(
-                "profile_stop_batch (%d) must be > profile_start_batch "
-                "(%d)" % (self.stop_batch, self.start_batch))
-        import jax
-
-        if not self._active and n >= self.start_batch:
-            # start only when the dispatch BEGINS inside the window: a
-            # fused group merely spanning start_batch would otherwise
-            # pull the group's compile dispatch into the profile —
-            # exactly what start_batch exists to skip (ADVICE r3). With
-            # fuse_steps=K the effective start rounds up to the next
-            # group boundary.
-            os.makedirs(self.dir, exist_ok=True)
-            jax.profiler.start_trace(self.dir)
-            self._active = True
-        elif self._active and n >= self.stop_batch:
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
-            return contextlib.nullcontext()
-        if self._active:
-            return jax.profiler.StepTraceAnnotation("train", step_num=n)
-        return contextlib.nullcontext()
-
-    def close(self) -> None:
-        """Flush an open trace (end of training / interrupt)."""
-        if self._active:
-            import jax
-
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True

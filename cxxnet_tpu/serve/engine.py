@@ -58,6 +58,8 @@ served in-process — the dev-box path, no export step).
 
 from __future__ import annotations
 
+import itertools
+import os
 import queue
 import threading
 import time
@@ -66,6 +68,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import trace as _trace
+from ..obs.registry import Registry
 from .stats import ServeStats
 
 
@@ -73,11 +77,25 @@ class QueueFullError(RuntimeError):
     """Admission queue at queue_limit — shed load (maps to HTTP 429)."""
 
 
+# process-wide request numbering: the sequence is the trace flow id and
+# the tail of the request id, so one request is one arrow in the trace
+# and one greppable token in the access log
+_REQ_SEQ = itertools.count(1)
+_REQ_SALT = "%04x" % (os.getpid() & 0xffff)
+
+
 class Request:
-    """One in-flight request, completed by the dispatch thread."""
+    """One in-flight request, completed by the dispatch thread.
+
+    Carries the per-request observability contract: ``id`` (unique in
+    this process, echoed by the HTTP layer as ``request_id`` /
+    ``X-Request-Id``) and the timing stamps behind ``timing()`` —
+    monotonic marks at submit, dispatch pick-up, device submit, and
+    completion."""
 
     __slots__ = ("rows", "payload", "t_submit", "deadline",
-                 "_event", "_value", "_error")
+                 "_event", "_value", "_error",
+                 "seq", "id", "t_dispatch", "t_infer", "t_done")
 
     def __init__(self, rows: int, payload, timeout_s: Optional[float]):
         self.rows = rows
@@ -85,6 +103,11 @@ class Request:
         self.t_submit = time.monotonic()
         self.deadline = (self.t_submit + timeout_s
                          if timeout_s and timeout_s > 0 else None)
+        self.seq = next(_REQ_SEQ)
+        self.id = "req-%s-%06x" % (_REQ_SALT, self.seq)
+        self.t_dispatch: Optional[float] = None   # picked by dispatcher
+        self.t_infer: Optional[float] = None      # device submit done
+        self.t_done: Optional[float] = None       # answer materialized
         self._event = threading.Event()
         self._value = None
         self._error: Optional[BaseException] = None
@@ -93,6 +116,23 @@ class Request:
         self._value = value
         self._error = error
         self._event.set()
+
+    def timing(self) -> dict:
+        """Per-request latency breakdown in ms (None where the request
+        never reached that stage — e.g. expired in the queue):
+        queue_wait (submit → dispatcher pick-up), dispatch (pack +
+        device submit), materialize (async wait + trim), total."""
+        def ms(a, b):
+            return None if a is None or b is None \
+                else round(1000.0 * (b - a), 3)
+        end = self.t_done if self.t_done is not None else (
+            time.monotonic() if self._event.is_set() else None)
+        return {
+            "queue_wait_ms": ms(self.t_submit, self.t_dispatch),
+            "dispatch_ms": ms(self.t_dispatch, self.t_infer),
+            "materialize_ms": ms(self.t_infer, self.t_done),
+            "total_ms": ms(self.t_submit, end),
+        }
 
     @property
     def done(self) -> bool:
@@ -272,6 +312,13 @@ class ServingEngine:
                       bucket pre-runs once so no user request eats a
                       first-call compile (default False; the CLI's
                       ``serve_warmup`` turns it on for task=serve)
+      registry        obs metrics registry to publish into (default: a
+                      fresh private one per engine). Share a registry
+                      across engines ONLY one engine at a time — the
+                      cxxnet_serve_* series family is per-prefix, so
+                      two engines on one registry overwrite each
+                      other's samples; aggregate engines by sharing a
+                      ServeStats instead (serve/stats.py)
       start=False     leaves the dispatch thread stopped (tests use it
                       to saturate the queue deterministically)
     """
@@ -281,6 +328,7 @@ class ServingEngine:
                  timeout_ms: float = 30000.0,
                  dispatch_depth: int = 2, warmup: bool = False,
                  stats: Optional[ServeStats] = None, seed: int = 0,
+                 registry: Optional[Registry] = None,
                  start: bool = True):
         self.callee = _wrap_callee(callee)
         self.batch = self.callee.batch
@@ -295,6 +343,20 @@ class ServingEngine:
         self.timeout_s = float(timeout_ms) / 1000.0
         self.dispatch_depth = max(int(dispatch_depth), 0)
         self.stats = stats or ServeStats()
+        # per-engine registry by default (side-by-side engines in one
+        # process must not fight over series); the CLI passes the
+        # process-global one so telemetry and serving share a view
+        self.registry = registry if registry is not None else Registry()
+        g_q = self.registry.gauge("cxxnet_serve_queue_depth",
+                                  "requests pending admission")
+        # keep the hook handles: close() detaches them, so a closed
+        # engine on a SHARED registry (the CLI passes the global one)
+        # neither stays pinned in memory nor keeps writing its series
+        self._registry_hooks = [
+            self.stats.bind_registry(self.registry),
+            self.registry.add_hook(
+                lambda: g_q.set(self.queue_depth)),
+        ]
         self._seed = int(seed)
         self._ndispatch = 0
         self._warmup_on_start = bool(warmup)
@@ -428,6 +490,19 @@ class ServingEngine:
                 raise QueueFullError(
                     "admission queue full (%d pending)" % len(self._q))
             self._q.append(req)
+            tr = _trace.active()
+            if tr is not None:
+                # the flow arrow starts on the SUBMITTING thread (an
+                # HTTP handler, a bench client): admission → dispatch
+                # → completion reads as one request crossing three
+                # lanes. Emitted while still HOLDING the lock: the
+                # dispatch thread cannot gather this request until the
+                # lock releases, so the flow start's timestamp always
+                # precedes the dispatch-side flow step (an out-of-order
+                # s/t pair would not render as an arrow)
+                with tr.span("serve.admit", "serve",
+                             {"request_id": req.id, "rows": req.rows}):
+                    tr.flow_start("request", req.seq, "serve")
             self._cond.notify()
 
     # ------------------------------------------------------------------
@@ -488,43 +563,62 @@ class ServingEngine:
                     "request expired after %.0f ms in queue"
                     % (1000.0 * (now - r.t_submit))))
             else:
+                r.t_dispatch = now
                 live.append(r)
         if not live:
             return
+        tr = _trace.active()
         rows = sum(r.rows for r in live)
         if rows > self.batch:
             # one oversize request (coalescing is capped at max_batch
             # <= batch): the callee chunks it itself, synchronously
             try:
-                if self.callee.kind == "forward":
-                    out = self.callee.run(live[0].payload)
-                else:
-                    toks, lens, seed = live[0].payload
-                    self._ndispatch += 1
-                    out = self.callee.run(
-                        toks, lens,
-                        int(seed if seed is not None
-                            else self._seed + self._ndispatch))
+                with _trace.span("serve.dispatch", "serve",
+                                 {"rows": rows, "oversize": True}):
+                    if tr is not None:
+                        for r in live:
+                            tr.flow_step("request", r.seq, "serve")
+                    if self.callee.kind == "forward":
+                        out = self.callee.run(live[0].payload)
+                    else:
+                        toks, lens, seed = live[0].payload
+                        self._ndispatch += 1
+                        out = self.callee.run(
+                            toks, lens,
+                            int(seed if seed is not None
+                                else self._seed + self._ndispatch))
             except Exception as e:
                 self.stats.on_error(len(live))
                 for r in live:
                     r._finish(error=e)
                 return
+            t_infer = time.monotonic()
+            for r in live:
+                r.t_infer = t_infer
             pend = _Pending(out, live, rows, self.batch, None)
         else:
             bucket = self._pick_bucket(rows)
             buf = self._get_buf(bucket)
             try:
-                if self.callee.kind == "forward":
-                    out = self._run_forward(live, buf)
-                else:
-                    out = self._run_decode(live, buf)
+                with _trace.span("serve.dispatch", "serve",
+                                 {"rows": rows, "bucket": bucket,
+                                  "requests": len(live)}):
+                    if tr is not None:
+                        for r in live:
+                            tr.flow_step("request", r.seq, "serve")
+                    if self.callee.kind == "forward":
+                        out = self._run_forward(live, buf)
+                    else:
+                        out = self._run_decode(live, buf)
             except Exception as e:   # submit failure fails the batch
                 self._put_buf(bucket, buf)
                 self.stats.on_error(len(live))
                 for r in live:
                     r._finish(error=e)
                 return
+            t_infer = time.monotonic()
+            for r in live:
+                r.t_infer = t_infer
             pend = _Pending(out, live, rows, bucket, buf)
         if self._inflight is not None:
             # hand the pending device result to the completion thread;
@@ -537,8 +631,12 @@ class ServingEngine:
     def _finish_batch(self, pend: _Pending) -> None:
         """Materialize the device result, trim, answer every request.
         Runs on the completion thread (pipelined) or inline (serial)."""
+        tr = _trace.active()
         try:
-            out = np.asarray(pend.out)
+            with _trace.span("serve.materialize", "serve",
+                             {"rows": pend.rows,
+                              "bucket": pend.bucket}):
+                out = np.asarray(pend.out)
         except Exception as e:
             # async-dispatch failures surface here, not at submit: the
             # batch errors and is NOT counted as a served dispatch
@@ -555,9 +653,18 @@ class ServingEngine:
         done = time.monotonic()
         lo = 0
         for r in pend.live:
+            r.t_done = done
             r._finish(value=out[lo:lo + r.rows])
             self.stats.on_complete(done - r.t_submit, r.rows)
             lo += r.rows
+        if tr is not None:
+            # the flow ends where the answer was handed back: one
+            # "complete" span per request so the arrow has a landing
+            # pad on the completion lane
+            for r in pend.live:
+                with tr.span("serve.complete", "serve",
+                             {"request_id": r.id}):
+                    tr.flow_end("request", r.seq, "serve")
 
     def _run_forward(self, live: List[Request], buf: np.ndarray):
         lo = 0
@@ -620,6 +727,13 @@ class ServingEngine:
             while self._q:
                 self._q.popleft()._finish(
                     error=RuntimeError("engine closed"))
+        # freeze the registry at the engine's final state, then detach:
+        # post-close scrapes read the last totals without executing (or
+        # pinning) the dead engine's hooks
+        self.registry.collect()
+        for h in self._registry_hooks:
+            self.registry.remove_hook(h)
+        self._registry_hooks = []
 
     def __enter__(self) -> "ServingEngine":
         return self

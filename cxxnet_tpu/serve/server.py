@@ -7,11 +7,23 @@ dispatch thread batches across all of them. JSON in, JSON out.
 
 Endpoints:
   POST /predict    {"data": nested list (n, *item_shape)} ->
-                   {"output": probs, "pred": task=pred convention}
+                   {"output": probs, "pred": task=pred convention,
+                    "request_id", "timing"}
   POST /generate   {"prompts": [[token ids] ...], "seed": optional} ->
-                   {"tokens": [[prompt + completion] ...]}
+                   {"tokens": [[prompt + completion] ...],
+                    "request_id", "timing"}
   GET  /healthz    liveness + the artifact contract
-  GET  /metrics    engine.metrics() (see serve/stats.py for schema)
+  GET  /metrics    engine.metrics() JSON (see serve/stats.py);
+                   ?format=prom renders the engine registry as
+                   Prometheus text exposition instead
+
+Per-request observability (docs/observability.md): every admitted
+request carries an engine-assigned ``request_id``, echoed in the JSON
+body and the ``X-Request-Id`` response header (on error bodies too,
+once admission succeeded), beside a ``timing`` breakdown
+(queue_wait/dispatch/materialize/total ms). ``access_log=True`` emits
+one structured JSON line per request to stderr — method, path,
+status, request id, wall ms — or hands the record to a callable.
 
 Error mapping: malformed body/shape -> 400, wrong endpoint for the
 artifact kind -> 409, queue full -> 429 (with Retry-After), request
@@ -24,11 +36,14 @@ from __future__ import annotations
 import json
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
+from ..obs.registry import PROM_CONTENT_TYPE
 from .engine import QueueFullError, ServingEngine
 
 
@@ -46,6 +61,11 @@ class ServeHandler(BaseHTTPRequestHandler):
     server_version = "cxxnet-tpu-serve/0.1"
     protocol_version = "HTTP/1.1"
 
+    # per-request state for the access log (set fresh per dispatch)
+    _req_id: Optional[str] = None
+    _status: int = 0
+    _t0: float = 0.0
+
     # ------------------------------------------------------------------
     def log_message(self, fmt, *args):   # default spams stderr per hit
         if self.server.verbose:
@@ -53,16 +73,52 @@ class ServeHandler(BaseHTTPRequestHandler):
                              % (self.address_string(), fmt % args))
 
     def _send(self, code: int, obj) -> None:
+        """Strict-JSON response (json.dumps, never repr); the current
+        request id, when one was assigned, rides both the body and the
+        X-Request-Id header so error payloads stay correlatable."""
+        if self._req_id is not None and isinstance(obj, dict) \
+                and "request_id" not in obj:
+            obj = dict(obj, request_id=self._req_id)
         body = json.dumps(obj).encode("utf-8")
+        self._status = code
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if self._req_id is not None:
+            self.send_header("X-Request-Id", self._req_id)
         if code == 429:
             self.send_header("Retry-After", "1")
         if self.close_connection:
             self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_text(self, code: int, text: str, ctype: str) -> None:
+        body = text.encode("utf-8")
+        self._status = code
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _access_log(self, method: str) -> None:
+        sink = self.server.access_log
+        if not sink:
+            return
+        rec = {
+            "ts": round(time.time(), 6),
+            "method": method,
+            "path": self.path,
+            "status": self._status,
+            "ms": round(1000.0 * (time.perf_counter() - self._t0), 3),
+            "request_id": self._req_id,
+            "client": self.address_string(),
+        }
+        if callable(sink):
+            sink(rec)
+        else:
+            sys.stderr.write("access %s\n" % json.dumps(rec))
 
     def _read_json(self) -> Optional[dict]:
         try:
@@ -97,8 +153,17 @@ class ServeHandler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------------
     def do_GET(self):
+        self._req_id, self._status = None, 0
+        self._t0 = time.perf_counter()
+        try:
+            self._route_get()
+        finally:
+            self._access_log("GET")
+
+    def _route_get(self):
         eng: ServingEngine = self.server.engine
-        if self.path == "/healthz":
+        parts = urlsplit(self.path)
+        if parts.path == "/healthz":
             info = {"ok": True, "kind": eng.kind, "batch": eng.batch,
                     "buckets": list(eng.buckets),
                     "dispatch_depth": eng.dispatch_depth,
@@ -108,21 +173,35 @@ class ServeHandler(BaseHTTPRequestHandler):
                 info["max_prompt_len"] = eng.callee.max_prompt_len
                 info["max_new"] = eng.callee.max_new
             self._send(200, info)
-        elif self.path == "/metrics":
-            self._send(200, eng.metrics())
+        elif parts.path == "/metrics":
+            fmt = parse_qs(parts.query).get("format", ["json"])[0]
+            if fmt == "prom":
+                self._send_text(200, eng.registry.render_prom(),
+                                PROM_CONTENT_TYPE)
+            elif fmt == "json":
+                self._send(200, eng.metrics())
+            else:
+                self._send(400, {"error":
+                                 "format must be json or prom"})
         else:
-            self._send(404, {"error": "no such path %s" % self.path})
+            self._send(404, {"error": "no such path %s" % parts.path})
 
     def do_POST(self):
-        if self.path == "/predict":
-            self._post_predict()
-        elif self.path == "/generate":
-            self._post_generate()
-        else:
-            self._send(404, {"error": "no such path %s" % self.path})
+        self._req_id, self._status = None, 0
+        self._t0 = time.perf_counter()
+        try:
+            if self.path == "/predict":
+                self._post_predict()
+            elif self.path == "/generate":
+                self._post_generate()
+            else:
+                self._send(404, {"error": "no such path %s" % self.path})
+        finally:
+            self._access_log("POST")
 
     # ------------------------------------------------------------------
     def _wait(self, req) -> Optional[np.ndarray]:
+        self._req_id = req.id
         try:
             return req.result(self.server.request_timeout)
         except TimeoutError as e:
@@ -155,7 +234,9 @@ class ServeHandler(BaseHTTPRequestHandler):
         if out is None:
             return
         self._send(200, {"output": out.tolist(),
-                         "pred": _pred_convention(out)})
+                         "pred": _pred_convention(out),
+                         "request_id": req.id,
+                         "timing": req.timing()})
 
     def _post_generate(self):
         eng: ServingEngine = self.server.engine
@@ -205,7 +286,9 @@ class ServeHandler(BaseHTTPRequestHandler):
             return
         self._send(200, {"tokens": [
             [int(t) for t in out[i, :int(lens[i]) + c.max_new]]
-            for i in range(len(prompts))]})
+            for i in range(len(prompts))],
+            "request_id": req.id,
+            "timing": req.timing()})
 
 
 class ServeHTTPServer(ThreadingHTTPServer):
@@ -217,11 +300,15 @@ class ServeHTTPServer(ThreadingHTTPServer):
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 8080,
                  request_timeout: Optional[float] = 30.0,
-                 max_body: int = 64 << 20, verbose: bool = False):
+                 max_body: int = 64 << 20, verbose: bool = False,
+                 access_log=False):
         self.engine = engine
         self.request_timeout = request_timeout
         self.max_body = max_body
         self.verbose = verbose
+        # False = off, True = JSON lines on stderr, callable = custom
+        # sink receiving the record dict (tests, log shippers)
+        self.access_log = access_log
         super().__init__((host, port), ServeHandler)
 
     def start_background(self) -> threading.Thread:

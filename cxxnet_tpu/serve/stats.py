@@ -26,6 +26,12 @@ load histogram (a v1 single-shape artifact shows one bucket).
 All counters are totals since construction; latency percentiles are
 over the last ``window`` completed requests. Thread-safe (one lock —
 the dispatch thread and every HTTP handler thread report here).
+
+``bind_registry`` publishes the same numbers into an obs metrics
+registry (obs/registry.py) at scrape time, which is what the
+``/metrics?format=prom`` Prometheus view renders — the JSON
+``snapshot()`` and the exposition are two projections of one state,
+never two sets of counters that can drift.
 """
 
 from __future__ import annotations
@@ -85,6 +91,54 @@ class ServeStats:
             self.rows += rows
             self._lat.add(latency_s)
             self._lat_sum += latency_s
+
+    # ------------------------------------------------------------------
+    def bind_registry(self, registry, prefix: str = "cxxnet_serve"):
+        """Register a pull hook copying this object's state into
+        ``registry`` series at scrape time (counters mirror the running
+        totals via set_total; the event-path locking is unchanged).
+        Returns the hook (``Registry.remove_hook`` detaches it).
+
+        One ``prefix`` maps one stats object onto one series family:
+        binding TWO ServeStats to the same registry under the same
+        prefix makes the later hook overwrite the earlier one's
+        samples. To aggregate several engines onto one scrape, give
+        the engines one shared ServeStats (the supported aggregation
+        path) or bind each under a distinct prefix."""
+        cs = {f: registry.counter("%s_%s_total" % (prefix, f),
+                                  "serving %s since engine start" % f)
+              for f in ("requests", "rows", "dispatches",
+                        "dispatched_requests", "rejected", "timeouts",
+                        "errors")}
+        c_bucket = registry.counter(
+            prefix + "_bucket_dispatches_total",
+            "dispatches per exported bucket", ("bucket",))
+        g_occ = registry.gauge(prefix + "_batch_occupancy",
+                               "mean requests coalesced per dispatch")
+        g_fill = registry.gauge(
+            prefix + "_batch_fill",
+            "mean fraction of dispatched-bucket rows carrying data")
+        g_up = registry.gauge(prefix + "_uptime_seconds",
+                              "seconds since stats construction")
+        g_lat = registry.gauge(prefix + "_latency_ms",
+                               "request latency over the recency window",
+                               ("q",))
+
+        def pull():
+            snap = self.snapshot()
+            for f, c in cs.items():
+                # dispatched_requests is an attribute only (the JSON
+                # snapshot exposes it as batch_occupancy's numerator)
+                c.set_total(snap[f] if f in snap else getattr(self, f))
+            for b, n in snap["bucket_dispatches"].items():
+                c_bucket.set_total(n, bucket=b)
+            g_occ.set(snap["batch_occupancy"])
+            g_fill.set(snap["batch_fill"])
+            g_up.set(snap["uptime_sec"])
+            for q, v in snap["latency_ms"].items():
+                g_lat.set(v, q=q)
+
+        return registry.add_hook(pull)
 
     # ------------------------------------------------------------------
     def snapshot(self) -> Dict:

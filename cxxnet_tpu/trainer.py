@@ -26,6 +26,7 @@ from .graph import NetConfig
 from .io import DataBatch, DataIterator
 from .metrics import MetricSet
 from .model import Network
+from .obs import trace as _trace
 from .updater import NetUpdater, UpdaterHyperParams
 
 ConfigEntry = Tuple[str, str]
@@ -94,14 +95,16 @@ class GroupStager:
             raise RuntimeError(
                 "GroupStager.stage needs %d batches, has %d (use "
                 "flush() for a partial tail)" % (self.k, self.n))
-        d, es, ls = self._bufs
-        out = self.tr._put_group(d, es, ls)
-        # device_put is async: wait for the transfer so the caller may
-        # refill these host buffers the moment this returns (stage runs
-        # on the CLI's helper thread, so blocking here IS the overlap)
-        jax.block_until_ready(out.device)
-        self.n = 0
-        return out
+        with _trace.span("trainer.stage_group", "h2d"):
+            d, es, ls = self._bufs
+            out = self.tr._put_group(d, es, ls)
+            # device_put is async: wait for the transfer so the caller
+            # may refill these host buffers the moment this returns
+            # (stage runs on the CLI's helper thread, so blocking here
+            # IS the overlap)
+            jax.block_until_ready(out.device)
+            self.n = 0
+            return out
 
     def flush(self) -> List["StagedBatch"]:
         """Stage a partial tail: one per-batch StagedBatch per slot."""
@@ -863,10 +866,11 @@ class Trainer:
         reading the host buffer on return, ADVICE r3). stage() runs on
         helper threads in every hot path, so blocking here IS the
         overlap, as in GroupStager.stage."""
-        self._maybe_set_norm(batch)
-        dev = self._put_batch(batch)
-        jax.block_until_ready(dev)
-        return StagedBatch(dev, batch)
+        with _trace.span("trainer.stage", "h2d"):
+            self._maybe_set_norm(batch)
+            dev = self._put_batch(batch)
+            jax.block_until_ready(dev)
+            return StagedBatch(dev, batch)
 
     def stage_fused(self, batches) -> "StagedBatch":
         """Stage a full fuse_steps group as ONE stacked host->device
@@ -1405,6 +1409,15 @@ class Trainer:
     # ------------------------------------------------------------------
     def evaluate(self, iter_eval: Optional[DataIterator],
                  data_name: str) -> str:
+        # traced as a span: evaluate is the round-boundary host<->device
+        # sync point, i.e. exactly the gap between dispatch bursts a
+        # trace viewer would otherwise show as unexplained idle
+        with _trace.span("trainer.evaluate", "train",
+                         {"name": data_name}):
+            return self._evaluate(iter_eval, data_name)
+
+    def _evaluate(self, iter_eval: Optional[DataIterator],
+                  data_name: str) -> str:
         """Round-end metric report (reference: nnet_impl-inl.hpp:224-245).
 
         Both halves run on accumulated device statistics: the train

@@ -297,6 +297,131 @@ def test_cli_task_serve_end_to_end(exported_mlp, tmp_path):
             proc.wait()
 
 
+def test_metrics_content_types_json_and_prom():
+    """Satellite: /metrics answers strict JSON (json.loads-parseable,
+    application/json) by default and Prometheus text exposition under
+    ?format=prom — with the right content type each way."""
+    eng = ServingEngine(FakeModel(), max_wait_ms=1)
+    srv = build_server(eng, port=0)
+    srv.start_background()
+    url = _url(srv)
+    try:
+        eng.submit(np.ones((2, 3), np.float32)).result(10)
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            m = json.loads(r.read())             # strict JSON
+        assert m["requests"] == 1
+        with urllib.request.urlopen(url + "/metrics?format=prom",
+                                    timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            text = r.read().decode()
+        assert "# TYPE cxxnet_serve_requests_total counter" in text
+        assert "cxxnet_serve_requests_total 1" in text
+        assert "cxxnet_serve_queue_depth 0" in text
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(url, "/metrics?format=xml")
+        assert ei.value.code == 400
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+
+def test_request_id_and_timing_in_responses():
+    """Per-request observability over HTTP: unique request_id in body
+    and X-Request-Id header, plus the queue-wait/dispatch/materialize
+    timing breakdown, on /predict and /generate alike."""
+    eng = ServingEngine(FakeModel(), max_wait_ms=1)
+    srv = build_server(eng, port=0)
+    srv.start_background()
+    url = _url(srv)
+    seen = set()
+    try:
+        for _ in range(3):
+            req = urllib.request.Request(
+                url + "/predict",
+                data=json.dumps({"data": [[1.0, 2.0, 3.0]]}).encode())
+            with urllib.request.urlopen(req, timeout=10) as r:
+                body = json.load(r)
+                assert r.headers["X-Request-Id"] == body["request_id"]
+            assert body["request_id"].startswith("req-")
+            seen.add(body["request_id"])
+            t = body["timing"]
+            for k in ("queue_wait_ms", "dispatch_ms",
+                      "materialize_ms", "total_ms"):
+                assert t[k] is not None and t[k] >= 0.0, (k, t)
+            assert t["total_ms"] >= t["queue_wait_ms"]
+        assert len(seen) == 3
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+    eng2 = ServingEngine(FakeDecoder(), max_wait_ms=1)
+    srv2 = build_server(eng2, port=0)
+    srv2.start_background()
+    try:
+        s, body = _post(_url(srv2), "/generate", {"prompts": [[1, 2]]})
+        assert s == 200
+        assert body["request_id"].startswith("req-")
+        assert body["timing"]["total_ms"] >= 0.0
+    finally:
+        srv2.shutdown()
+        srv2.server_close()
+        eng2.close()
+
+
+def test_structured_access_log():
+    """access_log sinks one record per request — status, path, wall
+    ms, and the request id once admission assigned one (errors that
+    never reached admission log request_id=None)."""
+    records = []
+    eng = ServingEngine(FakeModel(), max_wait_ms=1)
+    srv = build_server(eng, port=0, access_log=records.append)
+    srv.start_background()
+    url = _url(srv)
+    try:
+        s, body = _post(url, "/predict", {"data": [[1.0, 2.0, 3.0]]})
+        assert s == 200
+        _get(url, "/healthz")
+        with pytest.raises(urllib.error.HTTPError):
+            _post(url, "/predict", {})           # 400, no admission
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+    by_path = {}
+    for r in records:
+        by_path.setdefault((r["method"], r["path"], r["status"]),
+                           []).append(r)
+        assert r["ms"] >= 0.0 and "ts" in r
+    ok = by_path[("POST", "/predict", 200)][0]
+    assert ok["request_id"] == body["request_id"]
+    assert by_path[("GET", "/healthz", 200)][0]["request_id"] is None
+    assert by_path[("POST", "/predict", 400)][0]["request_id"] is None
+
+
+def test_error_response_carries_request_id_on_504():
+    """Once admitted, even an error body is correlatable: the 504
+    timeout payload carries the request id it was assigned."""
+    eng = ServingEngine(FakeModel(delay=1.0), max_wait_ms=1)
+    srv = build_server(eng, port=0, request_timeout=0.05)
+    srv.start_background()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(_url(srv), "/predict", {"data": [[1.0, 2.0, 3.0]]})
+        assert ei.value.code == 504
+        body = json.loads(ei.value.read())
+        assert body["request_id"].startswith("req-")
+        assert ei.value.headers["X-Request-Id"] == body["request_id"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+
 def test_http_ladder_artifact_buckets_surface(exported_mlp, tmp_path):
     """A bucket-ladder artifact over HTTP: /healthz advertises the
     ladder + dispatch depth, a lone 1-row /predict runs (and answers

@@ -1,0 +1,237 @@
+"""End-to-end smoke of the unified observability stack
+(docs/observability.md) — the one-command proof that ONE trace file
+carries every thread boundary in the tree.
+
+Leg 1 (train): synthetic JPEG packfile -> imgbinx with a 2-worker
+decode pool -> DevicePrefetchIterator -> real train steps, with the
+Chrome-trace tracer on and the telemetry HTTP endpoint up; both
+/metrics formats are scraped and sanity-checked (strict JSON; valid
+Prometheus text exposition carrying the feed stall clocks).
+
+Leg 2 (serve): a ServingEngine + HTTP server over the SAME process
+(live-trainer callee), fired with concurrent mixed-size /predict
+requests; every response must carry a request_id + timing breakdown,
+the access log must record every hit, and /metrics?format=prom must
+answer with the Prometheus content type.
+
+Then the trace is written and tools/trace_report.py must find >= 3
+non-empty thread lanes (decode worker, dev-prefetch producer, serve
+dispatch/completion, main loop) and >= 1 matched flow (a serving
+request linked admission -> completion across threads). A watchdog
+hard-exits non-zero if anything wedges — CI-safe like feed_smoke.
+
+Usage: JAX_PLATFORMS=cpu python tools/obs_smoke.py \
+           [--timeout 300] [--trace-out obs_trace.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _watchdog(seconds: int):
+    def fire():
+        import faulthandler
+        sys.stderr.write("obs_smoke: DEADLOCK — no completion within "
+                         "%ds; thread dump follows\n" % seconds)
+        faulthandler.dump_traceback()
+        os._exit(2)
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _tiny_trainer(batch=16):
+    from cxxnet_tpu import config
+    from cxxnet_tpu.trainer import Trainer
+    text = """
+netconfig=start
+layer[+1:fl1] = flatten:fl1
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,32,32
+batch_size = %d
+eta = 0.05
+metric = error
+""" % batch
+    tr = Trainer()
+    for k, v in config.parse_string(text):
+        tr.set_param(k, v)
+    tr.set_param("dev", "cpu")
+    tr.init_model()
+    return tr
+
+
+def _jpeg_iterator(td, n=64):
+    import cv2
+    import numpy as np
+    from cxxnet_tpu.io import create_iterator
+    from cxxnet_tpu.io.binpage import BinaryPageWriter
+    rs = np.random.RandomState(0)
+    lst, binp = os.path.join(td, "o.lst"), os.path.join(td, "o.bin")
+    with open(lst, "w") as f, BinaryPageWriter(binp) as w:
+        for i in range(n):
+            img = cv2.resize(
+                rs.randint(0, 256, (12, 12, 3), np.uint8), (96, 96))
+            _, enc = cv2.imencode(".jpg", img)
+            w.push(enc.tobytes())
+            f.write("%d\t%d\timg%d.jpg\n" % (i, i % 4, i))
+    return create_iterator(
+        [("iter", "imgbinx"), ("image_list", lst), ("image_bin", binp),
+         ("rand_crop", "1"), ("rand_mirror", "1"),
+         ("native_decode", "0"), ("prefetch_worker", "2")],
+        [("batch_size", "16"), ("input_shape", "3,32,32"),
+         ("silent", "1")])
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def _train_leg(td, tr):
+    """Overlapped feed + train steps under trace + telemetry; returns
+    after scraping and checking both /metrics formats."""
+    from cxxnet_tpu.io.prefetch import DevicePrefetchIterator
+    from cxxnet_tpu.obs import trace as obs_trace
+    from cxxnet_tpu.obs.registry import get_registry
+    from cxxnet_tpu.obs.telemetry import start_telemetry
+    import numpy as np
+
+    itr = _jpeg_iterator(td)
+    feed = DevicePrefetchIterator(itr, tr, depth=2)
+    feed.bind_registry(get_registry())
+    tele = start_telemetry(0)
+    steps = 0
+    for _ in range(2):
+        feed.before_first()
+        while feed.next():
+            with obs_trace.span("train.dispatch", "train"):
+                tr.update(feed.value)
+            steps += 1
+    np.asarray(tr._epoch_dev)   # fence: every dispatched step ran
+    assert steps > 0, "train leg produced no steps"
+
+    base = "http://127.0.0.1:%d" % tele.port
+    st, ct, body = _get(base + "/metrics")
+    assert st == 200 and ct.startswith("application/json"), (st, ct)
+    snap = json.loads(body)     # strict JSON or this throws
+    assert "cxxnet_feed_get_wait_seconds" in snap["metrics"], \
+        "feed stall clocks missing from the registry snapshot"
+    st, ct, body = _get(base + "/metrics?format=prom")
+    assert st == 200 and ct.startswith("text/plain; version=0.0.4"), \
+        (st, ct)
+    text = body.decode()
+    assert "# TYPE cxxnet_feed_stall_frac gauge" in text, \
+        "prom exposition missing the feed stall gauge"
+    tele.shutdown()
+    tele.server_close()
+    print("train leg: %d steps, telemetry scraped "
+          "(json + prom) on port %d" % (steps, tele.port))
+
+
+def _serve_leg(tr):
+    """Engine + HTTP server over the live trainer: request ids, timing
+    breakdowns, access log, prom metrics."""
+    from concurrent.futures import ThreadPoolExecutor
+    import numpy as np
+    from cxxnet_tpu.serve import ServingEngine
+    from cxxnet_tpu.serve.server import build_server
+
+    access = []
+    eng = ServingEngine(tr, max_wait_ms=5, queue_limit=64)
+    srv = build_server(eng, port=0, access_log=access.append)
+    srv.start_background()
+    url = "http://127.0.0.1:%d" % srv.server_address[1]
+    rs = np.random.RandomState(0)
+    data = rs.randn(4, 3, 32, 32).astype(np.float32)
+    try:
+        def fire(i):
+            n = 1 + i % 3
+            req = urllib.request.Request(
+                url + "/predict",
+                data=json.dumps({"data": data[:n].tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                body = json.load(r)
+                rid = r.headers.get("X-Request-Id")
+            assert body["request_id"].startswith("req-"), body
+            assert rid == body["request_id"], (rid, body["request_id"])
+            t = body["timing"]
+            for k in ("queue_wait_ms", "dispatch_ms",
+                      "materialize_ms", "total_ms"):
+                assert t.get(k) is not None and t[k] >= 0, (k, t)
+            return body["request_id"]
+
+        with ThreadPoolExecutor(4) as ex:
+            ids = list(ex.map(fire, range(12)))
+        assert len(set(ids)) == 12, "request ids not unique"
+        st, ct, body = _get(url + "/metrics?format=prom")
+        assert st == 200 and ct.startswith("text/plain; version=0.0.4")
+        assert "cxxnet_serve_requests_total 12" in body.decode()
+        st, ct, body = _get(url + "/metrics")
+        assert json.loads(body)["requests"] == 12
+        logged = [r for r in access if r["path"] == "/predict"]
+        assert len(logged) == 12 and all(
+            r["status"] == 200 and r["request_id"] for r in logged), \
+            "access log incomplete: %r" % logged[:3]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+    print("serve leg: 12 requests, unique ids, timing breakdowns, "
+          "%d access-log records" % len(access))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--timeout", type=int, default=300,
+                    help="watchdog: hard-exit 2 after this many seconds")
+    ap.add_argument("--trace-out", default="",
+                    help="keep the trace file here (default: temp dir)")
+    args = ap.parse_args()
+    _watchdog(args.timeout)
+    t0 = time.time()
+
+    from cxxnet_tpu.obs import trace as obs_trace
+    from tools.trace_report import load_events, report, _human
+
+    with tempfile.TemporaryDirectory() as td:
+        trace_path = args.trace_out or os.path.join(td, "obs_trace.json")
+        obs_trace.start(trace_path)
+        tr = _tiny_trainer()
+        _train_leg(td, tr)
+        _serve_leg(tr)
+        obs_trace.stop()
+
+        rep = report(load_events(trace_path))   # json.loads-able or dies
+        print(_human(rep))
+        lanes = {l["name"] for l in rep["lanes"]}
+        assert rep["nonempty_lanes"] >= 3, \
+            "need >= 3 thread lanes, got %s" % sorted(lanes)
+        assert any("decode" in n for n in lanes), lanes
+        assert any("dev-prefetch" in n for n in lanes), lanes
+        assert any("serve-" in n for n in lanes), lanes
+        assert rep["flows"]["matched"] >= 1, \
+            "no request flow linked admission -> completion"
+        if args.trace_out:
+            print("trace kept at %s" % trace_path)
+    print("obs_smoke ok (%.1fs)" % (time.time() - t0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

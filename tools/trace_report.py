@@ -1,0 +1,162 @@
+"""Summarize a Chrome trace-event JSON written by obs/trace.py.
+
+Answers the questions a trace viewer answers, but in CI: which thread
+lanes exist and how busy each one was (lane utilization over the trace
+wall span; nested spans double-count, so a lane wrapping its inner
+spans in an outer one can read > 100%),
+where the time went per span name (count/total/mean/max),
+the top stall spans (the ``*wait*``/``*stall*``/``*backpressure*``/
+``*get*`` family — time something sat blocked), and whether flow
+events (request arrows) start AND finish.
+
+Usage:
+  python tools/trace_report.py trace.json              # human summary
+  python tools/trace_report.py trace.json --json       # one JSON line
+  python tools/trace_report.py trace.json --min-lanes 3 --require-flow
+                                                       # CI assertions
+
+``--min-lanes N`` exits 2 unless >= N lanes carry at least one span;
+``--require-flow`` exits 2 unless at least one flow start has a
+matching finish. tools/obs_smoke.py runs both assertions over its
+end-to-end artifact.
+"""
+
+import argparse
+import json
+import sys
+
+STALL_MARKERS = ("wait", "stall", "backpressure", ".get")
+
+
+def load_events(path):
+    with open(path) as f:
+        doc = json.load(f)
+    return doc["traceEvents"] if isinstance(doc, dict) else doc
+
+
+def report(events):
+    """Aggregate a trace-event list into the summary dict."""
+    lane_names = {}
+    lanes = {}
+    spans = {}
+    flows = {"starts": set(), "steps": set(), "ends": set()}
+    t_min, t_max = None, None
+    for ev in events:
+        ph = ev.get("ph")
+        tid = ev.get("tid", 0)
+        if ph == "M":
+            if ev.get("name") == "thread_name":
+                lane_names[tid] = ev.get("args", {}).get("name", "")
+            continue
+        ts = ev.get("ts")
+        if ph == "X":
+            dur = float(ev.get("dur", 0.0))
+            lane = lanes.setdefault(tid, {"events": 0, "busy_us": 0.0})
+            lane["events"] += 1
+            lane["busy_us"] += dur
+            st = spans.setdefault(
+                ev.get("name", "?"),
+                {"count": 0, "total_us": 0.0, "max_us": 0.0})
+            st["count"] += 1
+            st["total_us"] += dur
+            st["max_us"] = max(st["max_us"], dur)
+            if ts is not None:
+                t_min = ts if t_min is None else min(t_min, ts)
+                t_max = (ts + dur) if t_max is None \
+                    else max(t_max, ts + dur)
+        elif ph == "s":
+            flows["starts"].add(ev.get("id"))
+        elif ph == "t":
+            flows["steps"].add(ev.get("id"))
+        elif ph == "f":
+            flows["ends"].add(ev.get("id"))
+    wall_us = (t_max - t_min) if t_min is not None else 0.0
+    lane_rows = []
+    for tid, lane in sorted(lanes.items()):
+        lane_rows.append({
+            "tid": tid,
+            "name": lane_names.get(tid, "tid%d" % tid),
+            "events": lane["events"],
+            "busy_ms": round(lane["busy_us"] / 1000.0, 3),
+            "utilization": round(lane["busy_us"] / wall_us, 4)
+            if wall_us > 0 else 0.0,
+        })
+    span_rows = []
+    for name, st in sorted(spans.items(),
+                           key=lambda kv: -kv[1]["total_us"]):
+        span_rows.append({
+            "name": name,
+            "count": st["count"],
+            "total_ms": round(st["total_us"] / 1000.0, 3),
+            "mean_ms": round(st["total_us"] / st["count"] / 1000.0, 4),
+            "max_ms": round(st["max_us"] / 1000.0, 3),
+        })
+    stalls = [r for r in span_rows
+              if any(m in r["name"] for m in STALL_MARKERS)]
+    matched = flows["starts"] & flows["ends"]
+    return {
+        "wall_ms": round(wall_us / 1000.0, 3),
+        "lanes": lane_rows,
+        "nonempty_lanes": len(lane_rows),
+        "spans": span_rows,
+        "top_stalls": stalls[:10],
+        "flows": {
+            "started": len(flows["starts"]),
+            "finished": len(flows["ends"]),
+            "matched": len(matched),
+        },
+    }
+
+
+def _human(rep):
+    out = ["trace: %.1f ms wall, %d lanes"
+           % (rep["wall_ms"], rep["nonempty_lanes"])]
+    out.append("lanes (busy ms / utilization):")
+    for l in rep["lanes"]:
+        out.append("  %-24s %9.2f ms  %5.1f%%  (%d events)"
+                   % (l["name"], l["busy_ms"],
+                      100.0 * l["utilization"], l["events"]))
+    out.append("top spans by total time:")
+    for s in rep["spans"][:12]:
+        out.append("  %-24s n=%-6d total %9.2f ms  mean %8.3f ms  "
+                   "max %8.2f ms"
+                   % (s["name"], s["count"], s["total_ms"],
+                      s["mean_ms"], s["max_ms"]))
+    if rep["top_stalls"]:
+        out.append("top stalls:")
+        for s in rep["top_stalls"][:6]:
+            out.append("  %-24s n=%-6d total %9.2f ms"
+                       % (s["name"], s["count"], s["total_ms"]))
+    f = rep["flows"]
+    out.append("flows: %d started, %d finished, %d matched"
+               % (f["started"], f["finished"], f["matched"]))
+    return "\n".join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", help="Chrome trace-event JSON file")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON line")
+    ap.add_argument("--min-lanes", type=int, default=0,
+                    help="exit 2 unless >= N lanes carry spans")
+    ap.add_argument("--require-flow", action="store_true",
+                    help="exit 2 unless >= 1 flow start has a matching "
+                         "finish")
+    args = ap.parse_args()
+    rep = report(load_events(args.trace))
+    print(json.dumps(rep) if args.json else _human(rep))
+    if args.min_lanes and rep["nonempty_lanes"] < args.min_lanes:
+        sys.stderr.write("trace_report: only %d non-empty lanes "
+                         "(need %d)\n"
+                         % (rep["nonempty_lanes"], args.min_lanes))
+        return 2
+    if args.require_flow and rep["flows"]["matched"] < 1:
+        sys.stderr.write("trace_report: no matched flow "
+                         "(start + finish) found\n")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
