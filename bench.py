@@ -181,6 +181,8 @@ def main() -> None:
         return feed_main(args)
     if args.mode == "serve":
         return serve_main(args)
+    if args.mode == "chaos":
+        return chaos_main(args)
     if args.devices:
         return scaling_main(args)
     iters, n_trials = args.iters, args.trials
@@ -484,7 +486,7 @@ def _parse_args():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "mode", nargs="?", default="train",
-        choices=("train", "feed", "serve"),
+        choices=("train", "feed", "serve", "chaos"),
         help="train (default): the AlexNet step/staging protocol. "
              "feed: the host-feed pipeline benchmark — decode-only, "
              "stage-only, serialized decode->stage->step, and the "
@@ -495,7 +497,12 @@ def _parse_args():
              "sweep (p50/p99 latency + throughput) plus paired "
              "same-window trials of the shape-bucket ladder vs "
              "padding to full batch (1-row p50) and pipelined "
-             "dispatch_depth=2 vs serial (sustained rows/sec).")
+             "dispatch_depth=2 vs serial (sustained rows/sec). "
+             "chaos: the resilience scenario benchmark — steady load "
+             "through the 3-replica router scored per wall window "
+             "for SLO attainment, run twice: undisturbed, and with a "
+             "replica killed + a hot artifact swap mid-window "
+             "(net=chaos in the ledger).")
     ap.add_argument("--serve-requests", type=int, default=96,
                     help="requests per serve-bench window")
     ap.add_argument("--serve-threads", type=int, default=8,
@@ -828,7 +835,11 @@ SERVE_NCLASS = 64
 SERVE_BUDGET_S = 120
 
 
-def _serve_trainer(platform):
+def _mlp_forward_trainer(platform, hidden, nclass, dim, batch):
+    """The serving benches' shared model shape: a 2-layer MLP over a
+    (1, 1, dim) input — sized by the caller (the serve bench wants a
+    forward whose cost is visibly batch-proportional; the chaos bench
+    wants cheap per-replica compiles)."""
     from cxxnet_tpu import config as cfg_mod
     from cxxnet_tpu.trainer import Trainer
     text = """
@@ -846,7 +857,7 @@ netconfig=end
 input_shape = 1,1,%d
 batch_size = %d
 eta = 0.01
-""" % (SERVE_HIDDEN, SERVE_NCLASS, SERVE_DIM, SERVE_BATCH)
+""" % (hidden, nclass, dim, batch)
     tr = Trainer()
     for k, v in cfg_mod.parse_string(text):
         tr.set_param(k, v)
@@ -854,6 +865,11 @@ eta = 0.01
     tr.set_param("eval_train", "0")
     tr.init_model()
     return tr
+
+
+def _serve_trainer(platform):
+    return _mlp_forward_trainer(platform, SERVE_HIDDEN, SERVE_NCLASS,
+                                SERVE_DIM, SERVE_BATCH)
 
 
 def _serve_window(model, nreq, threads, rows_of, max_wait_ms,
@@ -1061,6 +1077,191 @@ def serve_main(args) -> None:
                     "(obs/registry.py) — the same series "
                     "/metrics?format=prom exports",
         "offered_load_sweep": sweep,
+        "best_recorded": best,
+    }))
+
+
+# chaos scenario bench: a smaller MLP than the serve bench (each of
+# the 3 replicas — plus the swap spares — pays its own artifact load +
+# per-bucket warmup, so the model must stay cheap to compile)
+CHAOS_DIM = 128
+CHAOS_HIDDEN = 256
+CHAOS_NCLASS = 16
+CHAOS_BATCH = 16
+CHAOS_LADDER = [1, 4, 16]
+CHAOS_REPLICAS = 3
+CHAOS_WINDOW_S = 1.0
+CHAOS_WINDOWS = 6
+CHAOS_SLO_MS = 500.0
+CHAOS_KILL_AT_S = 2.0      # replica killed this far into the run
+CHAOS_SWAP_AT_S = 3.0      # hot swap starts this far into the run
+
+
+def _chaos_trainer(platform):
+    return _mlp_forward_trainer(platform, CHAOS_HIDDEN, CHAOS_NCLASS,
+                                CHAOS_DIM, CHAOS_BATCH)
+
+
+def _chaos_scenario(factory, data, threads, chaos):
+    """One closed-loop run of CHAOS_WINDOWS x CHAOS_WINDOW_S seconds
+    against a fresh 3-replica router; with ``chaos`` a replica is
+    killed at CHAOS_KILL_AT_S and the artifact hot-swapped at
+    CHAOS_SWAP_AT_S. Returns per-window counts + SLO attainment
+    (fraction of ANSWERED requests inside their deadline)."""
+    import threading
+
+    from cxxnet_tpu.serve.engine import DrainError
+    from cxxnet_tpu.serve.faults import FaultInjector
+    from cxxnet_tpu.serve.replica import ReplicaSet
+    from cxxnet_tpu.serve.router import (NoReplicaError, Router,
+                                         ShedError)
+
+    inj = FaultInjector(seed=3)
+    rs = ReplicaSet(factory, n=CHAOS_REPLICAS, fault=inj,
+                    version="v1", fail_threshold=2, backoff_s=0.3,
+                    dead_after=4, heartbeat_s=0.2,
+                    engine_kw=dict(max_wait_ms=2.0, queue_limit=128))
+    rs.start()
+    router = Router(rs, max_retries=2, timeout_ms=CHAOS_SLO_MS)
+    results = []                      # (t_rel, kind, within_slo)
+    t0 = time.perf_counter()
+    t_end = t0 + CHAOS_WINDOWS * CHAOS_WINDOW_S
+
+    def worker(wi):
+        k = wi
+        while time.perf_counter() < t_end:
+            k += 1
+            i = k % CHAOS_BATCH
+            ts = time.perf_counter()
+            try:
+                req = router.submit(data[i:i + 1],
+                                    timeout_ms=CHAOS_SLO_MS)
+                req.result()
+                dt = time.perf_counter() - ts
+                results.append((ts - t0, "ok",
+                                dt * 1000.0 <= CHAOS_SLO_MS))
+            except (ShedError, NoReplicaError, DrainError):
+                results.append((ts - t0, "shed", False))
+            except Exception:
+                results.append((ts - t0, "fail", False))
+
+    workers = [threading.Thread(target=worker, args=(wi,))
+               for wi in range(threads)]
+    for w in workers:
+        w.start()
+    swap_s = None
+    if chaos:
+        time.sleep(max(t0 + CHAOS_KILL_AT_S - time.perf_counter(), 0))
+        inj.die("r2")
+        time.sleep(max(t0 + CHAOS_SWAP_AT_S - time.perf_counter(), 0))
+        t_swap = time.perf_counter()
+        router.swap(factory, "v2", drain_timeout=30)
+        swap_s = time.perf_counter() - t_swap
+    for w in workers:
+        w.join()
+    m = router.metrics()
+    router.close()
+    rs.close()
+
+    windows = [{"ok": 0, "shed": 0, "fail": 0}
+               for _ in range(CHAOS_WINDOWS)]
+    answered, within = 0, 0
+    for t_rel, kind, ok_slo in results:
+        wi = min(int(t_rel / CHAOS_WINDOW_S), CHAOS_WINDOWS - 1)
+        windows[wi][kind] += 1
+        if kind == "ok":
+            answered += 1
+            within += 1 if ok_slo else 0
+    return {
+        "slo_attainment": round(within / answered, 4) if answered
+        else 0.0,
+        "answered": answered,
+        "failed": sum(w["fail"] for w in windows),
+        "shed": sum(w["shed"] for w in windows),
+        "windows_ok_per_sec": [
+            round(w["ok"] / CHAOS_WINDOW_S, 1) for w in windows],
+        "all_windows_nonzero": all(w["ok"] > 0 for w in windows),
+        "retries": m["retries"],
+        "swaps": m["swaps"],
+        "swap_wall_s": round(swap_s, 3) if swap_s is not None else None,
+        "replica_states": {k: v["state"]
+                           for k, v in m["replicas"].items()},
+    }
+
+
+def chaos_main(args) -> None:
+    """The resilience scenario benchmark (``python bench.py chaos``).
+
+    Steady closed-loop load from ``--serve-threads`` clients through
+    the 3-replica router, each request carrying a CHAOS_SLO_MS
+    deadline, scored per 1-second wall window. Run twice: undisturbed
+    (the SLO baseline), then with a replica KILLED mid-window
+    (injected die — probes included) and a hot artifact swap while
+    traffic flows. The honest yardstick: SLO attainment = fraction of
+    ANSWERED requests inside their deadline, per-window throughput
+    must never hit zero, and non-shed failures must be zero. One JSON
+    line; ledger net=chaos."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from cxxnet_tpu import serving
+
+    platform = jax.devices()[0].platform
+    rs_data = np.random.RandomState(0)
+    data = rs_data.randn(CHAOS_BATCH, 1, 1, CHAOS_DIM).astype(
+        np.float32)
+    with tempfile.TemporaryDirectory() as td:
+        tr = _chaos_trainer(platform)
+        path = os.path.join(td, "chaos.export")
+        serving.export_model(tr, path, batch_ladder=CHAOS_LADDER,
+                             platforms=[platform])
+        del tr
+        factory = lambda: serving.load_exported(path)  # noqa: E731
+
+        steady = _chaos_scenario(factory, data, args.serve_threads,
+                                 chaos=False)
+        chaos = _chaos_scenario(factory, data, args.serve_threads,
+                                chaos=True)
+
+    entry = {
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "slo_ms": CHAOS_SLO_MS,
+        "slo_attainment": steady["slo_attainment"],
+        "slo_attainment_chaos": chaos["slo_attainment"],
+        "kept_serving_through_kill": chaos["all_windows_nonzero"],
+        "nonshed_failures_chaos": chaos["failed"],
+        "retries_chaos": chaos["retries"],
+        "min_window_ok_per_sec_chaos": min(
+            chaos["windows_ok_per_sec"]),
+    }
+    best = _update_history(entry, net="chaos",
+                           metric="slo_attainment_chaos")
+    print(json.dumps({
+        "metric": "chaos_slo_attainment",
+        "value": chaos["slo_attainment"],
+        "unit": "fraction of answered requests meeting their deadline",
+        "platform": platform,
+        "host_cores": os.cpu_count() or 1,
+        "measured_as": "MLP %dx%dx%d ladder %s, %d replicas, %d "
+                       "closed-loop clients with %gms deadlines, "
+                       "%d x %gs wall windows; chaos run: replica "
+                       "killed (injected die) at %gs, hot swap to a "
+                       "new artifact at %gs, both under load"
+                       % (CHAOS_DIM, CHAOS_HIDDEN, CHAOS_NCLASS,
+                          CHAOS_LADDER, CHAOS_REPLICAS,
+                          args.serve_threads, CHAOS_SLO_MS,
+                          CHAOS_WINDOWS, CHAOS_WINDOW_S,
+                          CHAOS_KILL_AT_S, CHAOS_SWAP_AT_S),
+        "steady": steady,
+        "chaos": chaos,
+        "slo_note": "attainment counts ANSWERED requests inside "
+                    "their deadline; sheds are intentional rejections "
+                    "(priority/deadline policy) and scored separately "
+                    "— non-shed failures in the chaos run are the "
+                    "red flag, and per-window ok/sec > 0 everywhere "
+                    "means the kill + swap never stopped service",
         "best_recorded": best,
     }))
 

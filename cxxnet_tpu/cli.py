@@ -286,7 +286,10 @@ class LearnTask:
                             "serve_max_wait_ms", "serve_max_batch",
                             "serve_queue_limit", "serve_timeout_ms",
                             "serve_dispatch_depth", "serve_warmup",
-                            "serve_access_log"]),
+                            "serve_access_log",
+                            # multi-replica front end (serve/router.py)
+                            "serve_replicas", "serve_max_retries",
+                            "serve_priority_default", "serve_swap"]),
     }
 
     def _iter_section_keys(self) -> set:
@@ -841,50 +844,86 @@ class LearnTask:
         every exported bucket at start so no user request eats a
         first-call compile), serve_access_log (default 0: one
         structured JSON line per request on stderr — method, path,
-        status, request_id, wall ms; docs/observability.md). Blocks
-        until interrupted."""
+        status, request_id, wall ms; docs/observability.md).
+
+        serve_replicas = N (default 1) runs the resilient multi-
+        replica topology instead: N supervised ServingEngine replicas
+        (each its own artifact load + warmup) behind the SLO-aware
+        router — failover with serve_max_retries (default 1) bounded
+        retries, priority classes (serve_priority_default, default
+        "normal"), deadline-aware shedding, graceful drain, and the
+        POST /swap hot-artifact-swap endpoint (serve_swap = 0
+        disables). Needs export_in (a live trainer cannot be
+        replicated). Blocks until interrupted."""
         from . import serving
         from .serve import ServingEngine
         from .serve.server import build_server
         d = dict(self.cfg)
-        if "export_in" in d:
-            callee = serving.load_exported(d["export_in"])
-        elif self.trainer is not None:
-            callee = self.trainer
-        else:
-            raise RuntimeError(
-                "task=serve needs export_in=<artifact> or model_in=<ckpt>")
         from .obs.registry import get_registry
         timeout_ms = float(d.get("serve_timeout_ms", "30000"))
-        engine = ServingEngine(
-            callee,
+        n_rep = int(d.get("serve_replicas", "1"))
+        engine_kw = dict(
             max_wait_ms=float(d.get("serve_max_wait_ms", "5")),
             max_batch=int(d.get("serve_max_batch", "0")) or None,
             queue_limit=int(d.get("serve_queue_limit", "64")),
             timeout_ms=timeout_ms,
-            dispatch_depth=int(d.get("serve_dispatch_depth", "2")),
-            warmup=bool(int(d.get("serve_warmup", "1"))),
-            # the process-global registry: /metrics?format=prom and a
-            # telemetry_port endpoint in the same process render one
-            # shared view
-            registry=get_registry())
+            dispatch_depth=int(d.get("serve_dispatch_depth", "2")))
+        if n_rep > 1:
+            if "export_in" not in d:
+                raise RuntimeError(
+                    "serve_replicas > 1 needs export_in=<artifact> "
+                    "(each replica loads its own copy; a live trainer "
+                    "cannot be replicated)")
+            from .serve.replica import ReplicaSet
+            from .serve.router import Router
+            path = d["export_in"]
+            rs = ReplicaSet(
+                lambda: serving.load_exported(path), n=n_rep,
+                engine_kw=engine_kw, registry=get_registry(),
+                version=os.path.basename(path))
+            rs.start()
+            backend = Router(
+                rs,
+                max_retries=int(d.get("serve_max_retries", "1")),
+                timeout_ms=timeout_ms,
+                default_priority=d.get("serve_priority_default",
+                                       "normal"))
+        else:
+            if "export_in" in d:
+                callee = serving.load_exported(d["export_in"])
+            elif self.trainer is not None:
+                callee = self.trainer
+            else:
+                raise RuntimeError(
+                    "task=serve needs export_in=<artifact> or "
+                    "model_in=<ckpt>")
+            backend = ServingEngine(
+                callee,
+                warmup=bool(int(d.get("serve_warmup", "1"))),
+                # the process-global registry: /metrics?format=prom
+                # and a telemetry_port endpoint in the same process
+                # render one shared view
+                registry=get_registry(), **engine_kw)
         srv = build_server(
-            engine, d.get("serve_host", "127.0.0.1"),
+            backend, d.get("serve_host", "127.0.0.1"),
             int(d.get("serve_port", "8080")),
             # 0 disables the deadline engine-side; the handler's result
             # wait must then be unbounded too, not an instant 504
             request_timeout=(timeout_ms / 1000.0 if timeout_ms > 0
                              else None),
             verbose=not self.silent,
-            access_log=bool(int(d.get("serve_access_log", "0"))))
+            access_log=bool(int(d.get("serve_access_log", "0"))),
+            allow_swap=bool(int(d.get("serve_swap", "1"))))
         host, port = srv.server_address[:2]
         if not self.silent:
             print("serving %s on http://%s:%d (buckets %s, "
-                  "max_wait %gms, queue %d, dispatch_depth %d)"
-                  % (engine.kind, host, port,
-                     ",".join(map(str, engine.buckets)),
-                     1000.0 * engine.max_wait, engine.queue_limit,
-                     engine.dispatch_depth))
+                  "max_wait %gms, queue %d, dispatch_depth %s%s)"
+                  % (backend.kind, host, port,
+                     ",".join(map(str, backend.buckets)),
+                     engine_kw["max_wait_ms"],
+                     engine_kw["queue_limit"],
+                     backend.dispatch_depth,
+                     ", replicas %d" % n_rep if n_rep > 1 else ""))
             sys.stdout.flush()
         try:
             srv.serve_forever()
@@ -892,7 +931,7 @@ class LearnTask:
             pass
         finally:
             srv.server_close()
-            engine.close()
+            backend.close()
 
     def task_extract(self) -> None:
         """Reference: cxxnet_main.cpp:284-343."""

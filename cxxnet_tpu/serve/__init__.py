@@ -1,5 +1,5 @@
 """cxxnet_tpu.serve — dynamic-batching inference serving over exported
-artifacts (or a live trainer).
+artifacts (or a live trainer), single-engine or multi-replica.
 
 The deployment story past ``task=export_model``: ``serving.py`` turns a
 trained net into a self-contained AOT artifact, and this package turns
@@ -7,30 +7,59 @@ that artifact into a trafficable service —
 
 * :mod:`.engine` — :class:`ServingEngine`: bounded admission queue +
   one dispatch thread coalescing arbitrary per-request batch sizes
-  into padded exported-shape batches (max_wait_ms / max_batch /
-  queue_limit / timeout_ms knobs), with slot-granular continuous
-  admission for exported decoders;
+  into shape-bucket batches (max_wait_ms / max_batch / queue_limit /
+  timeout_ms knobs), slot-granular continuous admission for exported
+  decoders, per-request deadlines, expired-request sweeping, and a
+  formal ``drain(timeout)`` (:class:`DrainError`);
 * :mod:`.server` — stdlib ThreadingHTTPServer exposing /predict,
-  /generate, /healthz, /metrics with JSON bodies, per-request
-  timeouts, and 429 backpressure;
+  /generate, /healthz, /metrics (+ /swap under a router) with JSON
+  bodies, per-request timeouts, computed Retry-After backpressure;
 * :mod:`.stats` — streaming latency/occupancy telemetry
   (p50/p90/p99, throughput, queue depth, batch occupancy) built on
-  ``metrics.StreamingQuantile``.
+  ``metrics.StreamingQuantile``;
+* :mod:`.replica` — :class:`ReplicaSet`: N supervised engine replicas
+  (warming/healthy/degraded/draining/dead, heartbeat probes,
+  exponential-backoff re-admission);
+* :mod:`.router` — :class:`Router`: least-outstanding load balancing,
+  bounded deadline-respecting failover, priority + deadline shedding
+  with computed Retry-After, graceful drain, zero-downtime hot swap;
+* :mod:`.faults` — :class:`FaultInjector`: the deterministic fault
+  seam every robustness claim above is tested against.
 
-CLI: ``task = serve`` (docs/serving.md, docs/tasks.md).
+CLI: ``task = serve`` (+ ``serve_replicas = N`` for the router
+topology) — docs/serving.md, docs/tasks.md.
 """
 
-from .engine import QueueFullError, Request, ServingEngine
+from .engine import (DrainError, QueueFullError, Request,
+                     RequestExpired, ServingEngine)
 from .stats import ServeStats
 
-__all__ = ["QueueFullError", "Request", "ServingEngine", "ServeStats",
-           "ServeHTTPServer", "build_server"]
+__all__ = ["QueueFullError", "Request", "RequestExpired", "DrainError",
+           "ServingEngine", "ServeStats",
+           "ServeHTTPServer", "build_server",
+           "Router", "RouterRequest", "ShedError", "NoReplicaError",
+           "FailoverExhausted",
+           "ReplicaSet", "Replica",
+           "FaultInjector", "FaultError", "ReplicaDead"]
+
+# lazily-resolved names -> defining submodule: server.py pulls in
+# http.server, router/replica/faults are only needed by multi-replica
+# deployments — engine-only users (and the package import) stay light
+_LAZY = {
+    "ServeHTTPServer": "server", "build_server": "server",
+    "Router": "router", "RouterRequest": "router",
+    "ShedError": "router", "NoReplicaError": "router",
+    "FailoverExhausted": "router",
+    "ReplicaSet": "replica", "Replica": "replica",
+    "FaultInjector": "faults", "FaultError": "faults",
+    "ReplicaDead": "faults",
+}
 
 
 def __getattr__(name):
-    # server.py pulls in http.server; lazy so engine-only users (and
-    # the package docstring import) stay light
-    if name in ("ServeHTTPServer", "build_server"):
-        from . import server
-        return getattr(server, name)
+    mod = _LAZY.get(name)
+    if mod is not None:
+        import importlib
+        return getattr(importlib.import_module("." + mod, __name__),
+                       name)
     raise AttributeError(name)

@@ -77,6 +77,19 @@ class QueueFullError(RuntimeError):
     """Admission queue at queue_limit — shed load (maps to HTTP 429)."""
 
 
+class RequestExpired(TimeoutError):
+    """The request's own deadline passed while it sat in the queue —
+    congestion, not a replica fault (the router does not retry these:
+    any retry would answer past the deadline anyway)."""
+
+
+class DrainError(RuntimeError):
+    """The engine (or router) drained before this request could be
+    answered, or refused it because a drain is in progress — maps to
+    HTTP 503 + Retry-After, never 429 (the service is going away or
+    coming up, not overloaded)."""
+
+
 # process-wide request numbering: the sequence is the trace flow id and
 # the tail of the request id, so one request is one arrow in the trace
 # and one greppable token in the access log
@@ -94,7 +107,7 @@ class Request:
     completion."""
 
     __slots__ = ("rows", "payload", "t_submit", "deadline",
-                 "_event", "_value", "_error",
+                 "_event", "_value", "_error", "_flock",
                  "seq", "id", "t_dispatch", "t_infer", "t_done")
 
     def __init__(self, rows: int, payload, timeout_s: Optional[float]):
@@ -109,13 +122,23 @@ class Request:
         self.t_infer: Optional[float] = None      # device submit done
         self.t_done: Optional[float] = None       # answer materialized
         self._event = threading.Event()
+        self._flock = threading.Lock()
         self._value = None
         self._error: Optional[BaseException] = None
 
-    def _finish(self, value=None, error: Optional[BaseException] = None):
-        self._value = value
-        self._error = error
-        self._event.set()
+    def _finish(self, value=None,
+                error: Optional[BaseException] = None) -> bool:
+        """First finisher wins (returns True); later calls are no-ops.
+        A drain can fail a request that an in-flight batch answers a
+        moment later — exactly one outcome must count, or the engine's
+        live-request accounting would go negative."""
+        with self._flock:
+            if self._event.is_set():
+                return False
+            self._value = value
+            self._error = error
+            self._event.set()
+            return True
 
     def timing(self) -> dict:
         """Per-request latency breakdown in ms (None where the request
@@ -148,6 +171,56 @@ class Request:
         if self._error is not None:
             raise self._error
         return self._value
+
+
+def next_request_seq() -> int:
+    """Allocate a sequence number from the process-wide request space
+    (the router uses it so its ids and flow ids share the engine's id
+    space — one request, one arrow, at every tier)."""
+    return next(_REQ_SEQ)
+
+
+def request_id_for(seq: int) -> str:
+    return "req-%s-%06x" % (_REQ_SALT, seq)
+
+
+def coerce_forward(callee, data) -> np.ndarray:
+    """Validate + normalize a forward payload against a callee contract
+    (shared by ServingEngine.submit and the router's eager admission
+    check, so malformed bodies 400 at the door in both topologies)."""
+    arr = np.asarray(data, callee.dtype)
+    item = callee.item_shape
+    if arr.shape == item:
+        arr = arr[None]
+    if arr.ndim != 1 + len(item) or tuple(arr.shape[1:]) != item:
+        raise ValueError(
+            "data must be (n, %s), got %s"
+            % (", ".join(map(str, item)), arr.shape))
+    if arr.shape[0] < 1:
+        raise ValueError("empty request")
+    return arr
+
+
+def coerce_tokens(callee, tokens, lens):
+    """Validate + normalize a generate payload against a decoder
+    contract (see coerce_forward)."""
+    toks = np.asarray(tokens, np.int32)
+    lens = np.asarray(lens, np.int32)
+    S = callee.seq_len
+    if toks.ndim != 2 or toks.shape[1] != S:
+        raise ValueError("tokens must be (n, %d), got %s"
+                         % (S, toks.shape))
+    n = toks.shape[0]
+    if n < 1:
+        raise ValueError("empty request")
+    if lens.shape != (n,) or int(lens.min(initial=1)) < 1:
+        raise ValueError(
+            "lens must be (%d,) with every prompt >= 1 token" % n)
+    if int(lens.max(initial=0)) > callee.max_prompt_len:
+        raise ValueError(
+            "a prompt exceeds the exported max_prompt_len %d"
+            % callee.max_prompt_len)
+    return toks, lens
 
 
 def _callee_buckets(obj, batch: int) -> List[int]:
@@ -313,12 +386,19 @@ class ServingEngine:
                       first-call compile (default False; the CLI's
                       ``serve_warmup`` turns it on for task=serve)
       registry        obs metrics registry to publish into (default: a
-                      fresh private one per engine). Share a registry
-                      across engines ONLY one engine at a time — the
-                      cxxnet_serve_* series family is per-prefix, so
-                      two engines on one registry overwrite each
-                      other's samples; aggregate engines by sharing a
-                      ServeStats instead (serve/stats.py)
+                      fresh private one per engine). Two engines may
+                      share one registry ONLY when each carries
+                      distinct ``obs_labels`` (the replica set labels
+                      every engine ``replica=<name>``); unlabeled
+                      engines on one registry overwrite each other's
+                      cxxnet_serve_* samples — aggregate those by
+                      sharing a ServeStats instead (serve/stats.py)
+      obs_labels      constant labels stamped on every registry series
+                      this engine publishes (e.g. {"replica": "r1"})
+      fault_hook      callable invoked at the top of every dispatch —
+                      the fault-injection seam (serve/faults.py). A
+                      raising hook fails the batch through the real
+                      error path; a sleeping hook is a real stall.
       start=False     leaves the dispatch thread stopped (tests use it
                       to saturate the queue deterministically)
     """
@@ -329,6 +409,8 @@ class ServingEngine:
                  dispatch_depth: int = 2, warmup: bool = False,
                  stats: Optional[ServeStats] = None, seed: int = 0,
                  registry: Optional[Registry] = None,
+                 obs_labels: Optional[dict] = None,
+                 fault_hook=None,
                  start: bool = True):
         self.callee = _wrap_callee(callee)
         self.batch = self.callee.batch
@@ -343,28 +425,41 @@ class ServingEngine:
         self.timeout_s = float(timeout_ms) / 1000.0
         self.dispatch_depth = max(int(dispatch_depth), 0)
         self.stats = stats or ServeStats()
+        self.fault_hook = fault_hook
+        self.obs_labels = dict(obs_labels or {})
         # per-engine registry by default (side-by-side engines in one
         # process must not fight over series); the CLI passes the
-        # process-global one so telemetry and serving share a view
+        # process-global one so telemetry and serving share a view,
+        # and the replica set shares one with per-replica obs_labels
         self.registry = registry if registry is not None else Registry()
         g_q = self.registry.gauge("cxxnet_serve_queue_depth",
-                                  "requests pending admission")
+                                  "requests pending admission",
+                                  tuple(self.obs_labels))
         # keep the hook handles: close() detaches them, so a closed
         # engine on a SHARED registry (the CLI passes the global one)
         # neither stays pinned in memory nor keeps writing its series
         self._registry_hooks = [
-            self.stats.bind_registry(self.registry),
+            self.stats.bind_registry(self.registry,
+                                     labels=self.obs_labels),
             self.registry.add_hook(
-                lambda: g_q.set(self.queue_depth)),
+                lambda: g_q.set(self.queue_depth, **self.obs_labels)),
         ]
         self._seed = int(seed)
         self._ndispatch = 0
         self._warmup_on_start = bool(warmup)
+        self._warmed = False
         self.warmup_runs = 0
         self._q: deque = deque()
         self._cond = threading.Condition()
         self._closed = False
+        self._draining = False
         self._started = False
+        # live-request ledger: every admitted-but-unanswered request.
+        # drain() waits on it and can fail exactly the stragglers; the
+        # first-finisher-wins Request._finish keeps it consistent when
+        # a drain races an in-flight completion
+        self._live_lock = threading.Lock()
+        self._live: set = set()
         # per-bucket free-lists of preallocated input buffers: a buffer
         # leaves the pool at pack time and returns once its batch's
         # outputs materialized, so in-flight device reads can never see
@@ -407,17 +502,64 @@ class ServingEngine:
                 np.asarray(c.run_exact(toks, lens, self._seed))
             self._put_buf(b, buf)
             self.warmup_runs += 1
+        self._warmed = True
+
+    @property
+    def state(self) -> str:
+        """Lifecycle for readiness checks: ``warming`` (a requested
+        warmup has not finished — an engine that never asked for one is
+        ready as built), ``serving``, ``draining``, ``closed``. The
+        HTTP layer 503s anything but ``serving``."""
+        if self._closed:
+            return "closed"
+        if self._draining:
+            return "draining"
+        if self._warmup_on_start and not self._warmed:
+            return "warming"
+        return "serving"
 
     @property
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._q)
 
+    @property
+    def live_requests(self) -> int:
+        """Admitted requests not yet answered (queued + in flight)."""
+        with self._live_lock:
+            return len(self._live)
+
+    def retry_after_s(self) -> float:
+        """Suggested client back-off, the Retry-After header value:
+        while draining/warming a short fixed hint (the state change,
+        not the backlog, decides when to come back); when saturated the
+        estimated time for the current backlog to clear, clamped to
+        [1, 30] seconds."""
+        if self._closed or self._draining \
+                or (self._warmup_on_start and not self._warmed):
+            return 2.0
+        est = self.stats.estimate_clear_s(self.queue_depth)
+        return min(max(est, 1.0), 30.0)
+
+    def healthz(self) -> dict:
+        """The /healthz payload: readiness + the artifact contract."""
+        info = {"ok": self.state == "serving", "state": self.state,
+                "kind": self.kind, "batch": self.batch,
+                "buckets": list(self.buckets),
+                "dispatch_depth": self.dispatch_depth,
+                "queue_depth": self.queue_depth}
+        if self.kind == "decode":
+            info["seq_len"] = self.callee.seq_len
+            info["max_prompt_len"] = self.callee.max_prompt_len
+            info["max_new"] = self.callee.max_new
+        return info
+
     def metrics(self) -> dict:
         """stats snapshot + live gauges + the engine's configuration —
         the /metrics payload."""
         snap = self.stats.snapshot()
         snap["queue_depth"] = self.queue_depth
+        snap["state"] = self.state
         snap["kind"] = self.kind
         snap["exported_batch"] = self.batch
         snap["buckets"] = list(self.buckets)
@@ -429,66 +571,97 @@ class ServingEngine:
         return snap
 
     # ------------------------------------------------------------------
-    def submit(self, data: np.ndarray) -> Request:
+    def _timeout_s(self, timeout_ms) -> Optional[float]:
+        """Per-request deadline override: None = the engine default,
+        0 = no deadline, > 0 = that many ms."""
+        return self.timeout_s if timeout_ms is None \
+            else float(timeout_ms) / 1000.0
+
+    def submit(self, data: np.ndarray,
+               timeout_ms: Optional[float] = None,
+               priority=None) -> Request:
         """Enqueue a forward request of any row count ``n >= 1``:
         ``data`` is ``(n, *item_shape)`` (a bare ``item_shape`` array
-        is promoted to one row). Returns a :class:`Request`."""
+        is promoted to one row). ``timeout_ms`` overrides the engine
+        deadline for this request (0 = none). ``priority`` is accepted
+        for surface parity with the router front end (serve/router.py)
+        — a single engine has one class and ignores it. Returns a
+        :class:`Request`."""
         if self.callee.kind != "forward":
             raise RuntimeError(
                 "this engine serves a decoder; use submit_tokens")
-        arr = np.asarray(data, self.callee.dtype)
-        item = self.callee.item_shape
-        if arr.shape == item:
-            arr = arr[None]
-        if arr.ndim != 1 + len(item) or tuple(arr.shape[1:]) != item:
-            raise ValueError(
-                "data must be (n, %s), got %s"
-                % (", ".join(map(str, item)), arr.shape))
-        if arr.shape[0] < 1:
-            raise ValueError("empty request")
-        req = Request(arr.shape[0], arr, self.timeout_s)
+        arr = coerce_forward(self.callee, data)
+        req = Request(arr.shape[0], arr, self._timeout_s(timeout_ms))
         self._admit(req)
         return req
 
     def submit_tokens(self, tokens: np.ndarray, lens: Sequence[int],
-                      seed: Optional[int] = None) -> Request:
+                      seed: Optional[int] = None,
+                      timeout_ms: Optional[float] = None,
+                      priority=None) -> Request:
         """Enqueue a generate request: ``tokens (n, seq_len)`` int32
         (prompt left-aligned per row, rest zeros), ``lens (n,)`` with
         ``1 <= len <= max_prompt_len``. ``seed`` seeds the sampling
         key of the dispatch this request lands in (one key per
         compiled decode call — requests sharing a dispatch share it;
-        irrelevant for greedy temperature-0 artifacts)."""
+        irrelevant for greedy temperature-0 artifacts). ``timeout_ms``
+        / ``priority`` as in :meth:`submit`."""
         if self.callee.kind != "decode":
             raise RuntimeError(
                 "this engine serves a forward model; use submit")
-        toks = np.asarray(tokens, np.int32)
-        lens = np.asarray(lens, np.int32)
-        S = self.callee.seq_len
-        if toks.ndim != 2 or toks.shape[1] != S:
-            raise ValueError("tokens must be (n, %d), got %s"
-                             % (S, toks.shape))
-        n = toks.shape[0]
-        if n < 1:
-            raise ValueError("empty request")
-        if lens.shape != (n,) or int(lens.min(initial=1)) < 1:
-            raise ValueError(
-                "lens must be (%d,) with every prompt >= 1 token" % n)
-        if int(lens.max(initial=0)) > self.callee.max_prompt_len:
-            raise ValueError(
-                "a prompt exceeds the exported max_prompt_len %d"
-                % self.callee.max_prompt_len)
-        req = Request(n, (toks, lens, seed), self.timeout_s)
+        toks, lens = coerce_tokens(self.callee, tokens, lens)
+        req = Request(toks.shape[0], (toks, lens, seed),
+                      self._timeout_s(timeout_ms))
         self._admit(req)
         return req
+
+    def _finish_req(self, req: Request, value=None,
+                    error: Optional[BaseException] = None) -> bool:
+        """Finish a request exactly once and keep the live ledger in
+        step; returns whether THIS call was the finisher."""
+        if req._finish(value, error):
+            with self._live_lock:
+                self._live.discard(req)
+            return True
+        return False
+
+    def _sweep_expired_locked(self) -> int:
+        """Drop already-dead requests from the admission queue (called
+        with the lock held, when the queue is full): a queue packed
+        with expired requests must not shed live traffic. Swept
+        requests count as ``timeouts`` — they died of their deadline,
+        not of admission policy."""
+        now = time.monotonic()
+        dead: List[Request] = []
+        alive: List[Request] = []
+        for r in self._q:
+            (dead if r.deadline is not None and now > r.deadline
+             else alive).append(r)
+        if not dead:
+            return 0
+        self._q.clear()
+        self._q.extend(alive)
+        for r in dead:
+            self.stats.on_timeout()
+            self._finish_req(r, error=RequestExpired(
+                "request expired after %.0f ms in queue (swept at "
+                "admission)" % (1000.0 * (now - r.t_submit))))
+        return len(dead)
 
     def _admit(self, req: Request) -> None:
         with self._cond:
             if self._closed:
                 raise RuntimeError("engine is closed")
+            if self._draining:
+                raise DrainError("engine is draining — not admitting")
+            if len(self._q) >= self.queue_limit:
+                self._sweep_expired_locked()
             if len(self._q) >= self.queue_limit:
                 self.stats.on_reject()
                 raise QueueFullError(
                     "admission queue full (%d pending)" % len(self._q))
+            with self._live_lock:
+                self._live.add(req)
             self._q.append(req)
             tr = _trace.active()
             if tr is not None:
@@ -559,7 +732,7 @@ class ServingEngine:
         for r in reqs:
             if r.deadline is not None and now > r.deadline:
                 self.stats.on_timeout()
-                r._finish(error=TimeoutError(
+                self._finish_req(r, error=RequestExpired(
                     "request expired after %.0f ms in queue"
                     % (1000.0 * (now - r.t_submit))))
             else:
@@ -573,6 +746,8 @@ class ServingEngine:
             # one oversize request (coalescing is capped at max_batch
             # <= batch): the callee chunks it itself, synchronously
             try:
+                if self.fault_hook is not None:
+                    self.fault_hook()
                 with _trace.span("serve.dispatch", "serve",
                                  {"rows": rows, "oversize": True}):
                     if tr is not None:
@@ -590,7 +765,7 @@ class ServingEngine:
             except Exception as e:
                 self.stats.on_error(len(live))
                 for r in live:
-                    r._finish(error=e)
+                    self._finish_req(r, error=e)
                 return
             t_infer = time.monotonic()
             for r in live:
@@ -600,6 +775,8 @@ class ServingEngine:
             bucket = self._pick_bucket(rows)
             buf = self._get_buf(bucket)
             try:
+                if self.fault_hook is not None:
+                    self.fault_hook()
                 with _trace.span("serve.dispatch", "serve",
                                  {"rows": rows, "bucket": bucket,
                                   "requests": len(live)}):
@@ -614,7 +791,7 @@ class ServingEngine:
                 self._put_buf(bucket, buf)
                 self.stats.on_error(len(live))
                 for r in live:
-                    r._finish(error=e)
+                    self._finish_req(r, error=e)
                 return
             t_infer = time.monotonic()
             for r in live:
@@ -642,7 +819,7 @@ class ServingEngine:
             # batch errors and is NOT counted as a served dispatch
             self.stats.on_error(len(pend.live))
             for r in pend.live:
-                r._finish(error=e)
+                self._finish_req(r, error=e)
             return
         finally:
             pend.out = None
@@ -654,8 +831,10 @@ class ServingEngine:
         lo = 0
         for r in pend.live:
             r.t_done = done
-            r._finish(value=out[lo:lo + r.rows])
-            self.stats.on_complete(done - r.t_submit, r.rows)
+            if self._finish_req(r, value=out[lo:lo + r.rows]):
+                # a drain may have failed this request already — only
+                # the winning outcome reaches the completion stats
+                self.stats.on_complete(done - r.t_submit, r.rows)
             lo += r.rows
         if tr is not None:
             # the flow ends where the answer was handed back: one
@@ -712,6 +891,40 @@ class ServingEngine:
             self._finish_batch(pend)
 
     # ------------------------------------------------------------------
+    def drain(self, timeout: float = 10.0) -> int:
+        """Graceful shutdown of traffic, the formal successor of the
+        old stop-by-close: stop admitting (new submissions raise
+        :class:`DrainError` → HTTP 503 + Retry-After), keep answering
+        everything already admitted, and after ``timeout`` seconds fail
+        the stragglers with :class:`DrainError` (HTTP 503, request id
+        preserved). Idempotent; returns the straggler count. The
+        dispatch threads stay up — ``close()`` afterwards joins them."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = time.monotonic() + max(float(timeout), 0.0)
+        while time.monotonic() < deadline:
+            if self.live_requests == 0:
+                return 0
+            time.sleep(0.005)
+        with self._live_lock:
+            stragglers = list(self._live)
+        n = 0
+        for r in stragglers:
+            if self._finish_req(r, error=DrainError(
+                    "request %s unanswered after %.1fs drain window"
+                    % (r.id, timeout))):
+                self.stats.on_drained()
+                n += 1
+        with self._cond:
+            # everything queued is finished now; clear it so the
+            # dispatch thread doesn't burn callee time on the dead
+            self._q.clear()
+        if n:
+            _trace.instant("serve.drain_stragglers", "serve",
+                           {"failed": n})
+        return n
+
     def close(self, timeout: float = 10.0) -> None:
         """Stop admission, drain what's queued and in flight, join the
         dispatch + completion threads; anything still pending
@@ -725,8 +938,8 @@ class ServingEngine:
                 self._cthread.join(timeout)
         with self._cond:
             while self._q:
-                self._q.popleft()._finish(
-                    error=RuntimeError("engine closed"))
+                self._finish_req(self._q.popleft(),
+                                 error=RuntimeError("engine closed"))
         # freeze the registry at the engine's final state, then detach:
         # post-close scrapes read the last totals without executing (or
         # pinning) the dead engine's hooks

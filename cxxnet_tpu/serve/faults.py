@@ -1,0 +1,172 @@
+"""Deterministic fault injection for the serving tier.
+
+The robustness claims of the multi-replica front end (serve/replica.py
++ serve/router.py) are only worth anything if they are PROVEN against
+real failure paths, not mocks. This module is the one seam: a
+:class:`FaultInjector` hands each replica's engine a ``fault_hook``
+(see ``ServingEngine(fault_hook=...)``) that the dispatch thread calls
+at the top of every dispatch — a raising hook fails the batch through
+the engine's real error path (stats, request errors, router failover),
+a sleeping hook is a real stall the deadline machinery must survive.
+Heartbeat probes dispatch through the same engine, so a died replica
+keeps failing its probes exactly like it keeps failing traffic.
+
+Fault kinds (all per replica name, rule order preserved):
+
+* ``fail(replica, times, after)``   — raise :class:`FaultError` on
+  dispatches ``(after, after+times]``; the classic crash-mid-dispatch.
+* ``hang(replica, delay_s, times, after)`` — sleep ``delay_s`` before
+  running; long enough and the request blows its deadline while the
+  dispatch thread is wedged (the hang-past-deadline scenario), short
+  enough and it is just a slow replica.
+* ``die(replica, at)``              — every dispatch with ordinal
+  ``>= at`` raises :class:`ReplicaDead`; dead stays dead, probes
+  included, until the rule is cleared.
+* ``flaky(replica, p, times)``      — raise with probability ``p``
+  per dispatch, drawn from the injector's seeded RNG: deterministic
+  given (seed, dispatch order).
+
+Dispatch ordinals are per replica and count engine dispatches (batch
+submissions, warmups excluded), which is the granularity the engine
+fails at anyway. Rules can be added/cleared mid-run (thread-safe) —
+the chaos smoke kills a replica in the middle of a load window.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class FaultError(RuntimeError):
+    """An injected dispatch failure (the retryable kind)."""
+
+
+class ReplicaDead(FaultError):
+    """An injected permanent death — every dispatch from the fatal
+    ordinal on fails, heartbeat probes included."""
+
+
+class _Rule:
+    __slots__ = ("kind", "after", "until", "delay_s", "p", "at")
+
+    def __init__(self, kind: str, after: int = 0,
+                 until: Optional[int] = None, delay_s: float = 0.0,
+                 p: float = 0.0, at: int = 0):
+        self.kind = kind
+        self.after = after        # fire on ordinals > after ...
+        self.until = until        # ... and <= until (None = forever)
+        self.delay_s = delay_s
+        self.p = p
+        self.at = at
+
+    def active(self, n: int) -> bool:
+        return n > self.after and (self.until is None or n <= self.until)
+
+
+class FaultInjector:
+    """Seedable per-replica fault plan; one instance serves a whole
+    replica set (``ReplicaSet(fault=injector)``)."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._rng = random.Random(int(seed))
+        self._rules: Dict[str, List[_Rule]] = {}
+        self._count: Dict[str, int] = {}
+        self.injected = 0      # faults actually fired
+
+    # rule construction ------------------------------------------------
+    def _add(self, replica: str, rule: _Rule) -> "FaultInjector":
+        with self._lock:
+            self._rules.setdefault(str(replica), []).append(rule)
+        return self
+
+    def fail(self, replica: str, times: int = 1,
+             after: int = 0) -> "FaultInjector":
+        return self._add(replica, _Rule("fail", after=after,
+                                        until=after + int(times)))
+
+    def hang(self, replica: str, delay_s: float, times: int = 1,
+             after: int = 0) -> "FaultInjector":
+        return self._add(replica, _Rule(
+            "hang", after=after, until=after + int(times),
+            delay_s=float(delay_s)))
+
+    def die(self, replica: str, at: Optional[int] = None
+            ) -> "FaultInjector":
+        """Kill ``replica`` from dispatch ordinal ``at`` on (default:
+        the very next dispatch — kill it NOW)."""
+        if at is None:
+            at = self.dispatches(replica) + 1
+        return self._add(replica, _Rule("die", at=int(at)))
+
+    def flaky(self, replica: str, p: float,
+              times: Optional[int] = None,
+              after: int = 0) -> "FaultInjector":
+        return self._add(replica, _Rule(
+            "flaky", after=after,
+            until=None if times is None else after + int(times),
+            p=float(p)))
+
+    def clear(self, replica: Optional[str] = None) -> "FaultInjector":
+        """Remove every rule (for one replica, or all): a revived
+        replica's probes start passing again."""
+        with self._lock:
+            if replica is None:
+                self._rules.clear()
+            else:
+                self._rules.pop(str(replica), None)
+        return self
+
+    # the engine-side seam ---------------------------------------------
+    def dispatches(self, replica: str) -> int:
+        with self._lock:
+            return self._count.get(str(replica), 0)
+
+    def hook(self, replica: str):
+        """The ``fault_hook`` for one replica's engine."""
+        name = str(replica)
+
+        def _hook():
+            self.on_dispatch(name)
+
+        return _hook
+
+    def on_dispatch(self, replica: str) -> None:
+        sleep_s = 0.0
+        err: Optional[BaseException] = None
+        with self._lock:
+            n = self._count.get(replica, 0) + 1
+            self._count[replica] = n
+            for rule in self._rules.get(replica, ()):
+                if rule.kind == "die":
+                    if n >= rule.at:
+                        err = ReplicaDead(
+                            "replica %s died (injected, at dispatch "
+                            "%d >= %d)" % (replica, n, rule.at))
+                        break
+                elif not rule.active(n):
+                    continue
+                elif rule.kind == "fail":
+                    err = FaultError(
+                        "replica %s dispatch %d failed (injected)"
+                        % (replica, n))
+                    break
+                elif rule.kind == "flaky":
+                    if self._rng.random() < rule.p:
+                        err = FaultError(
+                            "replica %s dispatch %d failed (injected, "
+                            "flaky p=%g)" % (replica, n, rule.p))
+                        break
+                elif rule.kind == "hang":
+                    sleep_s = max(sleep_s, rule.delay_s)
+            if err is not None or sleep_s > 0.0:
+                self.injected += 1
+        if sleep_s > 0.0:
+            # sleep OUTSIDE the lock: a hung replica must not wedge the
+            # injector for its healthy siblings
+            time.sleep(sleep_s)
+        if err is not None:
+            raise err

@@ -1,0 +1,516 @@
+"""Supervised serving replicas: N engines behind one health model.
+
+The paper's deployment story is many devices behind one parameter
+server; the serving mirror is many :class:`ServingEngine` replicas
+behind one router (serve/router.py). This module owns the replicas'
+LIFECYCLE — the router only ever asks "who is admitting?":
+
+* each :class:`Replica` loads its OWN artifact copy (``factory()``),
+  warms every bucket, and publishes its metrics into the shared
+  registry under ``replica=<name>`` labels;
+* health is a small state machine::
+
+      warming ──> healthy <──────────┐
+                     │ consecutive   │ probe ok
+                     ▼ failures      │
+                  degraded ──────────┘   (backoff-gated probes;
+                     │ dead_after probes  backoff doubles per miss,
+                     ▼ failed             capped at backoff_max_s)
+                   dead
+      healthy/degraded ──drain_replica()──> draining ──> dead
+
+  Failures are reported by the router (dispatch errors, suspected
+  hangs); re-admission is EARNED by a heartbeat probe — a real 1-row
+  request through the engine, so injected faults (serve/faults.py)
+  and real breakage gate probes exactly like traffic.
+* ``drain_replica`` stops admission on one replica, lets in-flight
+  work finish (``ServingEngine.drain``), then detaches it — the
+  building block of both graceful shutdown and hot swap.
+* ``spawn`` adds a warmed replica at runtime — the hot-swap spare.
+
+The supervisor thread (``supervise=True``) ticks every
+``heartbeat_s``: probing degraded replicas whose backoff expired and
+declaring replicas whose dispatch thread died dead. Tests drive
+``tick()`` by hand for determinism.
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..obs import trace as _trace
+from ..obs.registry import Registry
+from .engine import ServingEngine
+
+WARMING = "warming"
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+DRAINING = "draining"
+DEAD = "dead"
+
+_STATE_CODE = {WARMING: 0, HEALTHY: 1, DEGRADED: 2, DRAINING: 3,
+               DEAD: 4}
+
+
+class Replica:
+    """One supervised engine. State transitions happen under the
+    owning :class:`ReplicaSet`'s lock; ``outstanding`` (router attempts
+    in flight) has its own small lock because the router bumps it on
+    every attempt."""
+
+    def __init__(self, name: str, factory: Callable, version: str):
+        self.name = name
+        self.factory = factory
+        self.version = version
+        self.engine: Optional[ServingEngine] = None
+        self.state = WARMING
+        self.error: Optional[BaseException] = None   # last failure
+        self.failures = 0          # consecutive, reported by router
+        self.probe_failures = 0    # consecutive, while degraded
+        self.backoff_s = 0.0
+        self.next_probe = 0.0
+        self.t_healthy: Optional[float] = None
+        self._olock = threading.Lock()
+        self.outstanding = 0
+
+    def note_outstanding(self, d: int) -> None:
+        with self._olock:
+            self.outstanding += d
+
+    def queue_depth(self) -> int:
+        eng = self.engine
+        return eng.queue_depth if eng is not None else 0
+
+    def describe(self) -> Dict:
+        eng = self.engine
+        return {
+            "state": self.state,
+            "version": self.version,
+            "outstanding": self.outstanding,
+            "queue_depth": self.queue_depth(),
+            "failures": self.failures,
+            "backoff_s": round(self.backoff_s, 3),
+            "engine_state": eng.state if eng is not None else None,
+            "last_error": (None if self.error is None
+                           else "%s: %s" % (type(self.error).__name__,
+                                            self.error)),
+        }
+
+
+class ReplicaSet:
+    """Build, watch, drain, and replace N serving replicas.
+
+    Parameters:
+      factory         zero-arg callable returning a fresh callee (an
+                      artifact load — each replica gets its own copy)
+      n               replica count
+      engine_kw       ServingEngine knobs shared by every replica
+                      (warmup is forced on: a replica is only healthy
+                      once every bucket has pre-run)
+      registry        shared obs registry; every replica publishes
+                      cxxnet_serve_* under replica=<name> labels, the
+                      set publishes cxxnet_replica_{state,outstanding}
+      version         artifact version label (surfaced in /healthz and
+                      response metadata; hot swap changes it)
+      fault           serve/faults.py FaultInjector — each replica's
+                      engine gets ``fault.hook(name)``
+      fail_threshold  consecutive router-reported failures before a
+                      healthy replica degrades
+      backoff_s / backoff_max_s
+                      re-admission probe backoff: first probe after
+                      backoff_s, doubling per failed probe, capped
+      dead_after      consecutive failed probes before a degraded
+                      replica is declared dead (None = keep probing)
+      probe_timeout_s heartbeat probe deadline
+      heartbeat_s     supervisor tick period
+      supervise       start the supervisor thread in start() (tests
+                      call tick() by hand instead)
+    """
+
+    def __init__(self, factory: Callable, n: int = 2,
+                 engine_kw: Optional[dict] = None,
+                 registry: Optional[Registry] = None,
+                 version: str = "v1", fault=None,
+                 fail_threshold: int = 3, backoff_s: float = 0.25,
+                 backoff_max_s: float = 30.0,
+                 dead_after: Optional[int] = 8,
+                 probe_timeout_s: float = 10.0,
+                 heartbeat_s: float = 0.5, supervise: bool = True,
+                 name_prefix: str = "r"):
+        if n < 1:
+            raise ValueError("need at least one replica")
+        self.factory = factory
+        self.engine_kw = dict(engine_kw or {})
+        self.engine_kw.pop("warmup", None)
+        self.engine_kw.pop("registry", None)
+        self.registry = registry if registry is not None else Registry()
+        self.version = str(version)
+        self.fault = fault
+        self.fail_threshold = int(fail_threshold)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.dead_after = dead_after
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.heartbeat_s = float(heartbeat_s)
+        self._supervise = bool(supervise)
+        self._prefix = name_prefix
+        self._seq = itertools.count(1)
+        self._lock = threading.RLock()
+        self.replicas: List[Replica] = [
+            Replica("%s%d" % (self._prefix, next(self._seq)),
+                    factory, self.version) for _ in range(n)]
+        self._stop = threading.Event()
+        self._sup_thread: Optional[threading.Thread] = None
+        self._closed = False
+        g_state = self.registry.gauge(
+            "cxxnet_replica_state",
+            "replica health (0 warming 1 healthy 2 degraded "
+            "3 draining 4 dead)", ("replica",))
+        g_out = self.registry.gauge(
+            "cxxnet_replica_outstanding",
+            "router attempts in flight on the replica", ("replica",))
+
+        def pull():
+            with self._lock:
+                reps = list(self.replicas)
+            for r in reps:
+                g_state.set(_STATE_CODE.get(r.state, -1), replica=r.name)
+                g_out.set(r.outstanding, replica=r.name)
+
+        self._registry_hook = self.registry.add_hook(pull)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def _build(self, rep: Replica) -> None:
+        """Load + warm one replica's engine (runs on its own thread);
+        flips warming → healthy, or → dead on a build failure."""
+        try:
+            with _trace.span("replica.load", "replica",
+                             {"replica": rep.name,
+                              "version": rep.version}):
+                hook = (self.fault.hook(rep.name)
+                        if self.fault is not None else None)
+                eng = ServingEngine(
+                    rep.factory(), registry=self.registry,
+                    obs_labels={"replica": rep.name},
+                    fault_hook=hook, warmup=True, start=True,
+                    **self.engine_kw)
+        except Exception as e:
+            with self._lock:
+                rep.error = e
+                rep.state = DEAD
+            _trace.instant("replica.build_failed", "replica",
+                           {"replica": rep.name, "error": str(e)})
+            return
+        with self._lock:
+            if self._closed:
+                rep.state = DEAD
+            else:
+                rep.engine = eng
+                if rep.state == WARMING:
+                    rep.state = HEALTHY
+                    rep.t_healthy = time.monotonic()
+        if rep.state == DEAD:     # set closed under us mid-build
+            eng.close(timeout=1.0)
+
+    def start(self, timeout: float = 300.0) -> "ReplicaSet":
+        """Build every replica in parallel (artifact loads + warmup
+        overlap), wait until each settles (healthy or dead), start the
+        supervisor. Raises if NO replica came up — a set that cannot
+        serve at all should fail loudly at deploy time."""
+        threads = []
+        for rep in self.replicas:
+            if rep.state == WARMING and rep.engine is None:
+                t = threading.Thread(
+                    target=self._build, args=(rep,),
+                    name="replica-%s-load" % rep.name, daemon=True)
+                t.start()
+                threads.append(t)
+        deadline = time.monotonic() + timeout
+        for t in threads:
+            t.join(max(deadline - time.monotonic(), 0.0))
+        if not any(r.state == HEALTHY for r in self.replicas):
+            errs = "; ".join(
+                "%s: %s" % (r.name, r.error) for r in self.replicas)
+            raise RuntimeError("no replica became healthy: %s" % errs)
+        if self._supervise and self._sup_thread is None:
+            self._sup_thread = threading.Thread(
+                target=self._run, name="replica-supervisor",
+                daemon=True)
+            self._sup_thread.start()
+        return self
+
+    def spawn(self, factory: Optional[Callable] = None,
+              version: Optional[str] = None, block: bool = True,
+              timeout: float = 300.0) -> Replica:
+        """Add one replica at runtime (the hot-swap spare): load +
+        warm it; it starts admitting the moment it turns healthy."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("replica set is closed")
+            rep = Replica("%s%d" % (self._prefix, next(self._seq)),
+                          factory or self.factory,
+                          str(version or self.version))
+            self.replicas.append(rep)
+        t = threading.Thread(target=self._build, args=(rep,),
+                             name="replica-%s-load" % rep.name,
+                             daemon=True)
+        t.start()
+        if block:
+            t.join(timeout)
+        return rep
+
+    # ------------------------------------------------------------------
+    # router-facing queries
+
+    def admitting(self) -> List[Replica]:
+        """Replicas the router may send NEW work to."""
+        with self._lock:
+            return [r for r in self.replicas
+                    if r.state == HEALTHY and r.engine is not None
+                    and r.engine.state == "serving"]
+
+    def pick(self, excluded=()) -> Optional[Replica]:
+        """Least-outstanding-work admitting replica not in
+        ``excluded`` (ties break by queue depth, then name — so an
+        idle set routes deterministically)."""
+        cands = [r for r in self.admitting() if r.name not in excluded]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (r.outstanding,
+                                         r.queue_depth(), r.name))
+
+    def contract(self):
+        """The callee adapter describing the served artifact's io
+        contract (shapes, dtype, decode limits) — from any live
+        replica, preferring healthy ones. None while everything is
+        still warming."""
+        with self._lock:
+            live = [r for r in self.replicas if r.engine is not None
+                    and r.state not in (DEAD,)]
+            if not live:
+                return None
+            for r in live:
+                if r.state == HEALTHY:
+                    return r.engine.callee
+            return live[0].engine.callee
+
+    def any_engine(self) -> Optional[ServingEngine]:
+        with self._lock:
+            for r in self.replicas:
+                if r.engine is not None and r.state != DEAD:
+                    return r.engine
+        return None
+
+    def state_counts(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for r in self.replicas:
+                out[r.state] = out.get(r.state, 0) + 1
+            return out
+
+    def by_name(self, name: str) -> Replica:
+        with self._lock:
+            for r in self.replicas:
+                if r.name == name:
+                    return r
+        raise KeyError("no replica named %r" % name)
+
+    # ------------------------------------------------------------------
+    # health reporting (router-driven) + probes (supervisor-driven)
+
+    def report_success(self, rep: Replica) -> None:
+        with self._lock:
+            rep.failures = 0
+
+    def report_failure(self, rep: Replica,
+                       err: BaseException) -> None:
+        """A dispatch on ``rep`` failed (error or suspected hang).
+        ``fail_threshold`` consecutive failures take it out of rotation
+        until a probe earns re-admission."""
+        with self._lock:
+            rep.failures += 1
+            rep.error = err
+            if rep.state == HEALTHY \
+                    and rep.failures >= self.fail_threshold:
+                rep.state = DEGRADED
+                rep.probe_failures = 0
+                rep.backoff_s = self.backoff_s
+                rep.next_probe = time.monotonic() + rep.backoff_s
+                _trace.instant("replica.degraded", "replica",
+                               {"replica": rep.name,
+                                "error": str(err)})
+
+    def _probe(self, rep: Replica) -> bool:
+        """One heartbeat: a real 1-row request through the engine (so
+        fault hooks and genuine breakage gate it alike)."""
+        eng = rep.engine
+        if eng is None:
+            return False
+        try:
+            with _trace.span("replica.probe", "replica",
+                             {"replica": rep.name}):
+                c = eng.callee
+                if eng.kind == "forward":
+                    data = np.zeros((1,) + c.item_shape, c.dtype)
+                    r = eng.submit(
+                        data, timeout_ms=1000.0 * self.probe_timeout_s)
+                else:
+                    toks = np.zeros((1, c.seq_len), np.int32)
+                    r = eng.submit_tokens(
+                        toks, [1],
+                        timeout_ms=1000.0 * self.probe_timeout_s)
+                r.result(self.probe_timeout_s)
+            return True
+        except Exception as e:
+            rep.error = e
+            return False
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """One supervisor step: probe degraded replicas whose backoff
+        expired; declare replicas with a dead dispatch thread dead."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            reps = list(self.replicas)
+        for rep in reps:
+            if rep.state == DEGRADED and now >= rep.next_probe:
+                ok = self._probe(rep)
+                with self._lock:
+                    if rep.state != DEGRADED:
+                        continue   # drained/killed while probing
+                    if ok:
+                        rep.state = HEALTHY
+                        rep.t_healthy = time.monotonic()
+                        rep.failures = 0
+                        rep.probe_failures = 0
+                        rep.backoff_s = 0.0
+                        _trace.instant("replica.readmitted", "replica",
+                                       {"replica": rep.name})
+                    else:
+                        rep.probe_failures += 1
+                        rep.backoff_s = min(
+                            max(rep.backoff_s, self.backoff_s) * 2.0,
+                            self.backoff_max_s)
+                        rep.next_probe = time.monotonic() \
+                            + rep.backoff_s
+                        if self.dead_after is not None \
+                                and rep.probe_failures \
+                                >= self.dead_after:
+                            self._mark_dead(rep)
+            elif rep.state == HEALTHY and rep.engine is not None \
+                    and rep.engine._started \
+                    and not rep.engine._thread.is_alive():
+                # the dispatch thread itself died — nothing will ever
+                # answer; the strongest possible failure signal
+                with self._lock:
+                    self._mark_dead(rep)
+
+    def _mark_dead(self, rep: Replica) -> None:
+        # caller holds the lock (or is the lock-free init path)
+        if rep.state == DEAD:
+            return
+        rep.state = DEAD
+        _trace.instant("replica.dead", "replica",
+                       {"replica": rep.name,
+                        "error": str(rep.error) if rep.error else None})
+        eng = rep.engine
+        if eng is not None:
+            # close on a side thread: a wedged dispatch thread must not
+            # stall the supervisor for the join timeout
+            threading.Thread(
+                target=lambda: eng.close(timeout=2.0),
+                name="replica-%s-close" % rep.name,
+                daemon=True).start()
+
+    def kill(self, name: str) -> Replica:
+        """Administrative kill (chaos tooling): immediate dead, no
+        drain — in-flight requests fail and the router retries them."""
+        rep = self.by_name(name)
+        with self._lock:
+            self._mark_dead(rep)
+        return rep
+
+    # ------------------------------------------------------------------
+    # drain / detach
+
+    def drain_replica(self, name: str, timeout: float = 30.0) -> int:
+        """Gracefully take one replica out: stop admitting (state
+        ``draining`` — the router skips it), finish in-flight work
+        (``ServingEngine.drain``), then mark it dead. Returns the
+        straggler count the drain had to fail."""
+        rep = self.by_name(name)
+        with self._lock:
+            if rep.state == DEAD:
+                return 0
+            rep.state = DRAINING
+        with _trace.span("replica.drain", "replica",
+                         {"replica": rep.name, "timeout": timeout}):
+            n = rep.engine.drain(timeout) if rep.engine is not None \
+                else 0
+            # router attempts already submitted resolve when the engine
+            # answers; give their bookkeeping a moment to settle
+            deadline = time.monotonic() + 1.0
+            while rep.outstanding > 0 and time.monotonic() < deadline:
+                time.sleep(0.005)
+        with self._lock:
+            rep.state = DEAD
+        eng = rep.engine
+        if eng is not None:
+            eng.close(timeout=2.0)
+        return n
+
+    def detach(self, name: str) -> None:
+        """Forget a dead replica (post-drain hot-swap cleanup)."""
+        with self._lock:
+            for i, r in enumerate(self.replicas):
+                if r.name == name:
+                    if r.state != DEAD:
+                        raise RuntimeError(
+                            "detach of live replica %s (%s) — drain "
+                            "or kill it first" % (name, r.state))
+                    del self.replicas[i]
+                    return
+        raise KeyError("no replica named %r" % name)
+
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self.tick()
+            except Exception:
+                # the supervisor must outlive any one bad tick
+                traceback.print_exc(file=sys.stderr)
+
+    def close(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop.set()
+        if self._sup_thread is not None:
+            self._sup_thread.join(timeout)
+        with self._lock:
+            reps = list(self.replicas)
+        for rep in reps:
+            if rep.engine is not None:
+                try:
+                    rep.engine.close(timeout=timeout)
+                except Exception:
+                    pass
+            with self._lock:
+                rep.state = DEAD
+        self.registry.remove_hook(self._registry_hook)
+
+    def __enter__(self) -> "ReplicaSet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

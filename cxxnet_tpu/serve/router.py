@@ -1,0 +1,656 @@
+"""SLO-aware router over a :class:`~cxxnet_tpu.serve.replica.ReplicaSet`.
+
+The front door of the resilient serving tier: requests enter here, and
+every robustness behavior the tier claims is this module's admission
+and retry policy —
+
+* **load balancing**: each attempt goes to the admitting replica with
+  the least outstanding work (ties by queue depth, then name);
+* **failover**: an idempotent request whose replica fails mid-flight
+  (error, injected fault, suspected hang) retries on a DIFFERENT
+  replica — at most ``max_retries`` retries, and the per-request
+  deadline budget is respected ACROSS attempts: each attempt waits
+  ``remaining / (retries_left + 1)``, so a hang leaves room for the
+  retry and the client never waits past its deadline;
+* **deadline-aware shedding**: a request that cannot meet its deadline
+  (estimated backlog-clear time of the least-loaded replica exceeds
+  the budget) is rejected AT THE DOOR with a computed ``Retry-After``
+  (:class:`ShedError`) instead of queuing to die;
+* **priority shedding**: under load, lower classes shed first —
+  class ``batch`` (2) at 50% of aggregate queue capacity, ``normal``
+  (1) at 75%, ``high`` (0) only when every queue is truly full;
+* **graceful drain**: ``drain()`` stops admission (503 + Retry-After),
+  finishes in-flight work, fails stragglers with ``DrainError``;
+* **hot swap**: ``swap(factory, version)`` rolls the set one replica
+  at a time — warm the new version on a spare, let the router flip to
+  it, drain the old — zero downtime, version surfaced in ``/healthz``
+  and response metadata.
+
+The retry loop runs on the CALLER's thread inside
+``RouterRequest.result()`` (the HTTP handler thread that would block
+anyway), so failover needs no extra machinery. Spans + flow events
+(``router.admit`` → ``router.dispatch`` / ``router.retry`` →
+``router.complete``) make every failover one arrow in the trace.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..metrics import StreamingQuantile
+from ..obs import trace as _trace
+from ..obs.registry import Registry
+from .engine import (DrainError, QueueFullError, RequestExpired,
+                     coerce_forward, coerce_tokens, next_request_seq,
+                     request_id_for)
+from .replica import DEAD, HEALTHY, ReplicaSet
+
+PRIORITY_NAMES = {"high": 0, "interactive": 0, "normal": 1,
+                  "batch": 2, "background": 2}
+# class -> fraction of aggregate queue capacity at which it sheds;
+# class 0 is never pre-shed (only a truly full queue turns it away)
+DEFAULT_SHED_AT = {1: 0.75, 2: 0.5}
+
+
+def parse_priority(p, default: int = 1) -> int:
+    if p is None:
+        return int(default)
+    if isinstance(p, str):
+        try:
+            return PRIORITY_NAMES[p.lower()]
+        except KeyError:
+            raise ValueError(
+                "unknown priority %r (use %s or an int >= 0)"
+                % (p, "/".join(sorted(PRIORITY_NAMES))))
+    pr = int(p)
+    if pr < 0:
+        raise ValueError("priority must be >= 0 (0 = highest)")
+    return pr
+
+
+class ShedError(RuntimeError):
+    """Rejected at the door (HTTP 429): cannot or should not be
+    queued. ``retry_after_s`` is the computed back-off;``reason`` is
+    ``deadline`` / ``priority`` / ``capacity``."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0,
+                 reason: str = "capacity"):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+        self.reason = reason
+
+
+class NoReplicaError(RuntimeError):
+    """No replica can take traffic right now (HTTP 503)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 2.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class FailoverExhausted(RuntimeError):
+    """Every allowed attempt failed; carries the last per-replica
+    error as ``__cause__`` (HTTP 500)."""
+
+
+class RouterRequest:
+    """One client request as the router sees it: the attempt plan, the
+    deadline, and (after ``result()``) the outcome + which replica and
+    artifact version answered."""
+
+    __slots__ = ("router", "method", "args", "priority", "deadline",
+                 "timeout_s", "seq", "id", "t_submit", "attempts",
+                 "replica", "version", "_inner", "_state", "_outcome",
+                 "_lock")
+
+    def __init__(self, router: "Router", method: str, args: tuple,
+                 priority: int, timeout_s: Optional[float]):
+        self.router = router
+        self.method = method
+        self.args = args
+        self.priority = priority
+        self.timeout_s = timeout_s
+        self.t_submit = time.monotonic()
+        self.deadline = (self.t_submit + timeout_s
+                         if timeout_s and timeout_s > 0 else None)
+        self.seq = next_request_seq()
+        self.id = request_id_for(self.seq)
+        self.attempts = 0
+        self.replica: Optional[str] = None
+        self.version: Optional[str] = None
+        self._inner = None          # the winning engine Request
+        self._state = "pending"     # pending | ok | error
+        self._outcome = None
+        self._lock = threading.Lock()
+
+    @property
+    def done(self) -> bool:
+        return self._state != "pending"
+
+    def result(self, timeout: Optional[float] = None):
+        """Drive the attempt loop (on this thread) to an answer;
+        repeatable — later calls return the cached outcome."""
+        with self._lock:
+            if self._state == "ok":
+                return self._outcome
+            if self._state == "error":
+                raise self._outcome
+            try:
+                out = self.router._run(self, timeout)
+            except BaseException as e:
+                self._state, self._outcome = "error", e
+                raise
+            self._state, self._outcome = "ok", out
+            return out
+
+    def timing(self) -> dict:
+        """The winning attempt's engine timing plus router-level
+        totals (wall including every retry, attempt count)."""
+        base = dict(self._inner.timing()) if self._inner is not None \
+            else {"queue_wait_ms": None, "dispatch_ms": None,
+                  "materialize_ms": None, "total_ms": None}
+        base["router_total_ms"] = round(
+            1000.0 * (time.monotonic() - self.t_submit), 3)
+        base["attempts"] = self.attempts
+        return base
+
+    def response_meta(self) -> dict:
+        return {"replica": self.replica, "version": self.version,
+                "attempts": self.attempts}
+
+
+class Router:
+    """See the module docstring. Exposes the same duck-typed surface
+    the HTTP layer drives on a single engine (``submit`` /
+    ``submit_tokens`` / ``metrics`` / ``healthz`` / ``state`` /
+    ``retry_after_s`` / ``registry``), so ``build_server(router)``
+    just works."""
+
+    def __init__(self, replicas: ReplicaSet, max_retries: int = 1,
+                 timeout_ms: float = 30000.0,
+                 default_priority="normal",
+                 shed_at: Optional[Dict[int, float]] = None,
+                 registry: Optional[Registry] = None):
+        self.rs = replicas
+        self.max_retries = max(int(max_retries), 0)
+        self.timeout_s = float(timeout_ms) / 1000.0
+        self.default_priority = parse_priority(default_priority)
+        self.shed_at = dict(DEFAULT_SHED_AT if shed_at is None
+                            else shed_at)
+        self.registry = registry if registry is not None \
+            else replicas.registry
+        self._lock = threading.Lock()
+        self._outstanding = 0
+        self._draining = False
+        self._closed = False
+        self._swap_lock = threading.Lock()
+        self._lat = StreamingQuantile(1024)
+        self._t0 = time.monotonic()
+        self.counts: Dict[str, int] = {
+            k: 0 for k in ("requests", "completed", "retries",
+                           "failovers", "shed_deadline",
+                           "shed_priority", "shed_capacity",
+                           "no_replica", "drain_rejected", "swaps",
+                           "deadline_exhausted")}
+        cs = {k: self.registry.counter(
+            "cxxnet_router_%s_total" % k, "router %s" % k)
+            for k in self.counts}
+        g_out = self.registry.gauge("cxxnet_router_outstanding",
+                                    "requests inside the router")
+        g_lat = self.registry.gauge(
+            "cxxnet_router_latency_ms",
+            "client-observed latency incl. retries", ("q",))
+
+        def pull():
+            with self._lock:
+                snap = dict(self.counts)
+                out = self._outstanding
+                qs = self._lat.quantiles([0.5, 0.99])
+            for k, c in cs.items():
+                c.set_total(snap[k])
+            g_out.set(out)
+            for q, v in zip(("0.5", "0.99"), qs):
+                if v == v:
+                    g_lat.set(1000.0 * v, q=q)
+
+        self._registry_hook = self.registry.add_hook(pull)
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counts[key] = self.counts.get(key, 0) + n
+
+    # ------------------------------------------------------------------
+    # duck-typed contract surface (what the HTTP layer reads)
+
+    @property
+    def version(self) -> str:
+        return self.rs.version
+
+    @property
+    def callee(self):
+        c = self.rs.contract()
+        if c is None:
+            raise NoReplicaError("no replica is live yet")
+        return c
+
+    @property
+    def kind(self) -> Optional[str]:
+        c = self.rs.contract()
+        return c.kind if c is not None else None
+
+    @property
+    def buckets(self):
+        eng = self.rs.any_engine()
+        return list(eng.buckets) if eng is not None else []
+
+    @property
+    def batch(self) -> Optional[int]:
+        eng = self.rs.any_engine()
+        return eng.batch if eng is not None else None
+
+    @property
+    def dispatch_depth(self) -> Optional[int]:
+        eng = self.rs.any_engine()
+        return eng.dispatch_depth if eng is not None else None
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(r.queue_depth() for r in self.rs.admitting())
+
+    @property
+    def state(self) -> str:
+        if self._closed:
+            return "closed"
+        if self._draining:
+            return "draining"
+        if self.rs.admitting():
+            return "serving"
+        counts = self.rs.state_counts()
+        if counts.get("warming"):
+            return "warming"
+        return "unavailable"
+
+    def retry_after_s(self) -> float:
+        if self._closed or self._draining:
+            return 2.0
+        admitting = self.rs.admitting()
+        if not admitting:
+            return 2.0
+        est = min(r.engine.stats.estimate_clear_s(r.queue_depth())
+                  for r in admitting)
+        return min(max(est, 1.0), 30.0)
+
+    def healthz(self) -> dict:
+        info = {"ok": self.state == "serving", "state": self.state,
+                "version": self.version, "kind": self.kind,
+                "replicas": {r.name: r.describe()
+                             for r in self.rs.replicas},
+                "queue_depth": self.queue_depth}
+        eng = self.rs.any_engine()
+        if eng is not None:
+            info["batch"] = eng.batch
+            info["buckets"] = list(eng.buckets)
+            info["dispatch_depth"] = eng.dispatch_depth
+            c = eng.callee
+            if eng.kind == "decode":
+                info["seq_len"] = c.seq_len
+                info["max_prompt_len"] = c.max_prompt_len
+                info["max_new"] = c.max_new
+        return info
+
+    def metrics(self) -> dict:
+        with self._lock:
+            snap = dict(self.counts)
+            out = self._outstanding
+            p50, p90, p99 = self._lat.quantiles([0.5, 0.9, 0.99])
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        n = snap["completed"]
+        return {
+            "state": self.state, "version": self.version,
+            "kind": self.kind, "outstanding": out,
+            "uptime_sec": elapsed,
+            "requests": snap["requests"], "completed": n,
+            "requests_per_sec": n / elapsed,
+            "retries": snap["retries"],
+            "failovers": snap["failovers"],
+            "shed": {"deadline": snap["shed_deadline"],
+                     "priority": snap["shed_priority"],
+                     "capacity": snap["shed_capacity"],
+                     "no_replica": snap["no_replica"],
+                     "draining": snap["drain_rejected"]},
+            "deadline_exhausted": snap["deadline_exhausted"],
+            "swaps": snap["swaps"],
+            "latency_ms": {      # client-observed, retries included
+                "p50": 1000.0 * p50 if n else 0.0,
+                "p90": 1000.0 * p90 if n else 0.0,
+                "p99": 1000.0 * p99 if n else 0.0,
+            },
+            "replicas": {r.name: r.describe()
+                         for r in self.rs.replicas},
+        }
+
+    # ------------------------------------------------------------------
+    # admission
+
+    def submit(self, data, timeout_ms: Optional[float] = None,
+               priority=None) -> RouterRequest:
+        c = self.rs.contract()
+        if c is not None:
+            if c.kind != "forward":
+                raise RuntimeError(
+                    "this router serves a decoder; use submit_tokens")
+            data = coerce_forward(c, data)   # 400s at the door
+        return self._admit("submit", (data,), priority, timeout_ms)
+
+    def submit_tokens(self, tokens, lens, seed=None,
+                      timeout_ms: Optional[float] = None,
+                      priority=None) -> RouterRequest:
+        c = self.rs.contract()
+        if c is not None:
+            if c.kind != "decode":
+                raise RuntimeError(
+                    "this router serves a forward model; use submit")
+            tokens, lens = coerce_tokens(c, tokens, lens)
+        return self._admit("submit_tokens", (tokens, lens, seed),
+                           priority, timeout_ms)
+
+    def _admit(self, method: str, args: tuple, priority,
+               timeout_ms) -> RouterRequest:
+        if self._closed:
+            raise RuntimeError("router is closed")
+        if self._draining:
+            self._count("drain_rejected")
+            raise DrainError("router is draining — not admitting")
+        pr = parse_priority(priority, self.default_priority)
+        t_s = self.timeout_s if timeout_ms is None \
+            else float(timeout_ms) / 1000.0
+        admitting = self.rs.admitting()
+        if not admitting:
+            self._count("no_replica")
+            raise NoReplicaError(
+                "no healthy replica (%s)" % self.rs.state_counts())
+        cap = sum(r.engine.queue_limit for r in admitting)
+        with self._lock:
+            load = self._outstanding / float(max(cap, 1))
+        thresh = self.shed_at.get(pr)
+        if thresh is None and self.shed_at and pr > max(self.shed_at):
+            thresh = self.shed_at[max(self.shed_at)]   # lower classes
+        if thresh is not None and load >= thresh:
+            self._count("shed_priority")
+            _trace.instant("router.shed", "router",
+                           {"reason": "priority", "priority": pr,
+                            "load": round(load, 3)})
+            # retry_after_s() scans per-replica latency windows —
+            # computed only on the shed paths, never per admission
+            raise ShedError(
+                "priority %d shed at load %.2f (threshold %.2f)"
+                % (pr, load, thresh),
+                retry_after_s=self.retry_after_s(),
+                reason="priority")
+        if t_s and t_s > 0:
+            # can the least-loaded replica plausibly answer in budget?
+            best = min(r.engine.stats.estimate_clear_s(
+                r.queue_depth() + 1) for r in admitting)
+            if best > t_s:
+                self._count("shed_deadline")
+                _trace.instant("router.shed", "router",
+                               {"reason": "deadline",
+                                "est_wait_s": round(best, 3),
+                                "budget_s": round(t_s, 3)})
+                raise ShedError(
+                    "cannot meet deadline: estimated wait %.2fs "
+                    "exceeds budget %.2fs" % (best, t_s),
+                    retry_after_s=min(max(best - t_s, 1.0), 30.0),
+                    reason="deadline")
+        req = RouterRequest(self, method, args, pr,
+                            t_s if t_s and t_s > 0 else None)
+        with self._lock:
+            self._outstanding += 1
+            self.counts["requests"] += 1
+        tr = _trace.active()
+        if tr is not None:
+            with tr.span("router.admit", "router",
+                         {"request_id": req.id, "priority": pr}):
+                tr.flow_start("request", req.seq, "router")
+        return req
+
+    # ------------------------------------------------------------------
+    # the attempt loop (runs on the caller's thread via result())
+
+    def _run(self, req: RouterRequest, caller_timeout):
+        try:
+            return self._attempts(req, caller_timeout)
+        finally:
+            with self._lock:
+                self._outstanding -= 1
+
+    def _attempts(self, req: RouterRequest, caller_timeout):
+        excluded = set()
+        failures = 0
+        last: Optional[BaseException] = None
+        tr = _trace.active()
+        while True:
+            now = time.monotonic()
+            # the binding budget is the TIGHTER of the request deadline
+            # and the caller's wait (the HTTP layer's request_timeout):
+            # a client-supplied hour-long timeout_ms must not pin a
+            # handler thread past the server's own bound
+            bounds = []
+            if req.deadline is not None:
+                bounds.append(req.deadline - now)
+            if caller_timeout is not None:
+                bounds.append((req.t_submit + caller_timeout) - now)
+            remaining = min(bounds) if bounds else None
+            if remaining is not None and remaining <= 0:
+                self._count("deadline_exhausted")
+                raise RequestExpired(
+                    "deadline exhausted after %d attempt(s)%s"
+                    % (req.attempts,
+                       " (last: %s)" % last if last else "")) from last
+            rep = self.rs.pick(excluded)
+            if rep is None:
+                # every candidate is excluded or unhealthy; map the
+                # LAST admission obstacle to its documented status —
+                # all-full is a shed (429), all-draining is a drain
+                # (503), only exhausted REAL faults are a 500
+                if isinstance(last, QueueFullError):
+                    self._count("shed_capacity")
+                    raise ShedError(
+                        "every replica's queue is full",
+                        retry_after_s=self.retry_after_s(),
+                        reason="capacity") from last
+                if isinstance(last, DrainError):
+                    self._count("drain_rejected")
+                    raise last
+                if failures:
+                    self._count("failovers")
+                    raise FailoverExhausted(
+                        "no replica left to retry on after %d "
+                        "attempt(s)" % req.attempts) from last
+                self._count("no_replica")
+                raise NoReplicaError(
+                    "no healthy replica (%s)"
+                    % self.rs.state_counts()) from last
+            retries_left = self.max_retries - failures
+            attempt_wait = None
+            if remaining is not None:
+                # split the remaining budget so a hang on THIS attempt
+                # still leaves room for the allowed retries
+                attempt_wait = remaining / (retries_left + 1) \
+                    if retries_left > 0 else remaining
+            req.attempts += 1
+            rep.note_outstanding(+1)
+            try:
+                try:
+                    with _trace.span("router.dispatch", "router",
+                                     {"replica": rep.name,
+                                      "attempt": req.attempts,
+                                      "request_id": req.id}):
+                        if tr is not None:
+                            tr.flow_step("request", req.seq, "router")
+                        inner = getattr(rep.engine, req.method)(
+                            *req.args,
+                            timeout_ms=(1000.0 * remaining
+                                        if remaining is not None
+                                        else 0))
+                except (QueueFullError, DrainError) as e:
+                    # saturated or mid-drain: not a fault — route
+                    # around it without burning a retry
+                    excluded.add(rep.name)
+                    last = e
+                    continue
+                except RuntimeError as e:
+                    # engine closed under us (replica died between
+                    # pick and submit)
+                    excluded.add(rep.name)
+                    last = e
+                    continue
+                try:
+                    out = inner.result(attempt_wait)
+                except RequestExpired:
+                    # died of its own deadline inside the queue —
+                    # congestion; a retry would answer too late anyway
+                    self._count("deadline_exhausted")
+                    raise
+                except TimeoutError as e:
+                    # the attempt window elapsed with no answer: a
+                    # hung or wedged replica — fail over
+                    self.rs.report_failure(rep, e)
+                    excluded.add(rep.name)
+                    failures += 1
+                    last = e
+                    if failures > self.max_retries:
+                        self._count("failovers")
+                        raise TimeoutError(
+                            "unanswered after %d attempt(s) within "
+                            "the deadline budget" % req.attempts) \
+                            from e
+                    self._retry_mark(tr, req, rep, e, failures)
+                    continue
+                except Exception as e:
+                    # real dispatch/callee failure — fail over
+                    self.rs.report_failure(rep, e)
+                    excluded.add(rep.name)
+                    failures += 1
+                    last = e
+                    if failures > self.max_retries:
+                        self._count("failovers")
+                        raise
+                    self._retry_mark(tr, req, rep, e, failures)
+                    continue
+            finally:
+                rep.note_outstanding(-1)
+            # success
+            self.rs.report_success(rep)
+            req._inner = inner
+            req.replica, req.version = rep.name, rep.version
+            with self._lock:
+                # StreamingQuantile is not thread-safe; every handler
+                # thread completes requests here
+                self.counts["completed"] += 1
+                self._lat.add(time.monotonic() - req.t_submit)
+            if tr is not None:
+                with tr.span("router.complete", "router",
+                             {"request_id": req.id,
+                              "replica": rep.name,
+                              "attempts": req.attempts}):
+                    tr.flow_end("request", req.seq, "router")
+            return out
+
+    def _retry_mark(self, tr, req: RouterRequest, rep, err,
+                    failures: int) -> None:
+        self._count("retries")
+        if tr is not None:
+            with tr.span("router.retry", "router",
+                         {"request_id": req.id, "from": rep.name,
+                          "error": type(err).__name__,
+                          "retry": failures}):
+                tr.flow_step("request", req.seq, "router")
+
+    # ------------------------------------------------------------------
+    # drain / swap / close
+
+    def drain(self, timeout: float = 30.0) -> int:
+        """Graceful service shutdown: stop admitting (DrainError →
+        503), let in-flight requests complete, fail stragglers. Returns
+        the straggler count across replicas."""
+        self._draining = True
+        with _trace.span("router.drain", "router",
+                         {"timeout": timeout}):
+            deadline = time.monotonic() + max(float(timeout), 0.0)
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if self._outstanding == 0:
+                        break
+                time.sleep(0.005)
+            n = 0
+            for rep in list(self.rs.replicas):
+                if rep.engine is not None and rep.state != DEAD:
+                    n += rep.engine.drain(
+                        max(deadline - time.monotonic(), 0.0))
+            return n
+
+    def swap(self, factory, version: str,
+             drain_timeout: float = 30.0,
+             warm_timeout: float = 300.0) -> dict:
+        """Hot artifact swap, rolling, zero downtime: for each replica
+        still on the old version — spawn a spare on the NEW version,
+        wait until it is warm and admitting (the router flips to it by
+        construction: it is now a pick() candidate), then drain and
+        detach the old one. Capacity never drops below the starting
+        replica count. Raises (and stops rolling) if a spare fails to
+        warm — the old replicas keep serving."""
+        with self._swap_lock:
+            olds = [r for r in self.rs.replicas
+                    if r.state != DEAD and r.version != str(version)]
+            with _trace.span("router.swap", "router",
+                             {"version": str(version),
+                              "replacing": len(olds)}):
+                for old in olds:
+                    spare = self.rs.spawn(factory, version, block=True,
+                                          timeout=warm_timeout)
+                    if spare.state != HEALTHY:
+                        raise RuntimeError(
+                            "hot swap aborted: new replica %s failed "
+                            "to warm (%s); old replicas keep serving"
+                            % (spare.name, spare.error))
+                    _trace.instant("router.swap_flip", "router",
+                                   {"in": spare.name, "out": old.name})
+                    self.rs.drain_replica(old.name, drain_timeout)
+                    self.rs.detach(old.name)
+                self.rs.version = str(version)
+                self._count("swaps")
+        return {"ok": True, "version": self.version,
+                "replicas": {r.name: r.describe()
+                             for r in self.rs.replicas}}
+
+    def swap_artifact(self, path: str, version: Optional[str] = None,
+                      drain_timeout: float = 30.0) -> dict:
+        """Swap to an exported artifact on disk (the POST /swap
+        endpoint): validates the artifact loads BEFORE touching any
+        replica."""
+        import os
+
+        from .. import serving
+        serving.load_exported(path)       # fail fast on a bad artifact
+        return self.swap(lambda: serving.load_exported(path),
+                         version or os.path.basename(path),
+                         drain_timeout=drain_timeout)
+
+    def close(self, timeout: float = 5.0) -> None:
+        if self._closed:
+            return
+        try:
+            self.drain(timeout)
+        finally:
+            self._closed = True
+            self.rs.close(timeout)
+            self.registry.remove_hook(self._registry_hook)
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -25,15 +25,28 @@ once admission succeeded), beside a ``timing`` breakdown
 one structured JSON line per request to stderr — method, path,
 status, request id, wall ms — or hands the record to a callable.
 
-Error mapping: malformed body/shape -> 400, wrong endpoint for the
-artifact kind -> 409, queue full -> 429 (with Retry-After), request
-deadline exceeded -> 504, callee failure -> 500. A saturated server
-answers 429 immediately — it never hangs the client.
+The ``engine`` may also be a :class:`~cxxnet_tpu.serve.router.Router`
+over N supervised replicas (serve/replica.py) — same endpoints, plus
+``POST /swap`` (hot artifact swap) and per-replica detail in
+``/healthz``; responses then carry ``replica`` / ``version`` /
+``attempts`` metadata. Requests may set ``"priority"``
+(high/normal/batch or an int, router topology) and ``"timeout_ms"``
+(per-request deadline) in the JSON body.
+
+Error mapping (the failure-mode table in docs/serving.md): malformed
+body/shape -> 400, wrong endpoint for the artifact kind -> 409, queue
+full or shed (priority/deadline) -> 429 with a COMPUTED Retry-After
+(backlog-clear estimate, not a constant), draining / warming / no
+healthy replica -> 503 with Retry-After, request deadline exceeded ->
+504, drain failed an in-flight request -> 503 (X-Request-Id
+preserved), callee failure after any retries -> 500. A saturated
+server answers immediately — it never hangs the client.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import sys
 import threading
 import time
@@ -44,7 +57,8 @@ from urllib.parse import parse_qs, urlsplit
 import numpy as np
 
 from ..obs.registry import PROM_CONTENT_TYPE
-from .engine import QueueFullError, ServingEngine
+from .engine import DrainError, QueueFullError, ServingEngine
+from .router import NoReplicaError, ShedError
 
 
 def _pred_convention(out: np.ndarray):
@@ -72,13 +86,32 @@ class ServeHandler(BaseHTTPRequestHandler):
             sys.stderr.write("%s - %s\n"
                              % (self.address_string(), fmt % args))
 
-    def _send(self, code: int, obj) -> None:
+    def _retry_after(self, explicit: Optional[float] = None) -> int:
+        """The Retry-After value: an explicit per-error hint (a shed
+        carries its own computed estimate) or the engine/router's
+        backlog-clear estimate — never the old hardcoded 1."""
+        ra = explicit
+        if ra is None:
+            try:
+                ra = self.server.engine.retry_after_s()
+            except Exception:
+                ra = 1.0
+        return max(1, int(math.ceil(ra)))
+
+    def _send(self, code: int, obj,
+              retry_after: Optional[float] = None) -> None:
         """Strict-JSON response (json.dumps, never repr); the current
         request id, when one was assigned, rides both the body and the
-        X-Request-Id header so error payloads stay correlatable."""
+        X-Request-Id header so error payloads stay correlatable.
+        429/503 responses carry a computed Retry-After."""
         if self._req_id is not None and isinstance(obj, dict) \
                 and "request_id" not in obj:
             obj = dict(obj, request_id=self._req_id)
+        ra = None
+        if code in (429, 503):
+            ra = self._retry_after(retry_after)
+            if isinstance(obj, dict) and "retry_after_s" not in obj:
+                obj = dict(obj, retry_after_s=ra)
         body = json.dumps(obj).encode("utf-8")
         self._status = code
         self.send_response(code)
@@ -86,8 +119,8 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         if self._req_id is not None:
             self.send_header("X-Request-Id", self._req_id)
-        if code == 429:
-            self.send_header("Retry-After", "1")
+        if ra is not None:
+            self.send_header("Retry-After", str(ra))
         if self.close_connection:
             self.send_header("Connection", "close")
         self.end_headers()
@@ -164,15 +197,11 @@ class ServeHandler(BaseHTTPRequestHandler):
         eng: ServingEngine = self.server.engine
         parts = urlsplit(self.path)
         if parts.path == "/healthz":
-            info = {"ok": True, "kind": eng.kind, "batch": eng.batch,
-                    "buckets": list(eng.buckets),
-                    "dispatch_depth": eng.dispatch_depth,
-                    "queue_depth": eng.queue_depth}
-            if eng.kind == "decode":
-                info["seq_len"] = eng.callee.seq_len
-                info["max_prompt_len"] = eng.callee.max_prompt_len
-                info["max_new"] = eng.callee.max_new
-            self._send(200, info)
+            # readiness semantics: 200 only while serving; a draining
+            # or still-warming backend answers 503 so load balancers
+            # stop sending traffic BEFORE requests start bouncing
+            info = eng.healthz()
+            self._send(200 if info.get("ok") else 503, info)
         elif parts.path == "/metrics":
             fmt = parse_qs(parts.query).get("format", ["json"])[0]
             if fmt == "prom":
@@ -194,59 +223,120 @@ class ServeHandler(BaseHTTPRequestHandler):
                 self._post_predict()
             elif self.path == "/generate":
                 self._post_generate()
+            elif self.path == "/swap":
+                self._post_swap()
             else:
                 self._send(404, {"error": "no such path %s" % self.path})
         finally:
             self._access_log("POST")
 
     # ------------------------------------------------------------------
+    def _gate_state(self) -> bool:
+        """503 (with Retry-After) while the backend is not serving —
+        draining, still warming, or without a healthy replica. Runs
+        AFTER the body is read so the keep-alive stream stays framed."""
+        state = self.server.engine.state
+        if state != "serving":
+            self._send(503, {"error": "not accepting requests: %s"
+                             % state, "state": state})
+            return False
+        return True
+
+    def _submit_kwargs(self, payload) -> Optional[dict]:
+        """Per-request "timeout_ms" / "priority" body fields (None =
+        a 400 was already sent)."""
+        kw = {}
+        if "timeout_ms" in payload:
+            try:
+                kw["timeout_ms"] = float(payload["timeout_ms"])
+            except (TypeError, ValueError):
+                self._send(400, {"error": "timeout_ms must be a number"})
+                return None
+        if "priority" in payload:
+            kw["priority"] = payload["priority"]
+        return kw
+
     def _wait(self, req) -> Optional[np.ndarray]:
         self._req_id = req.id
         try:
             return req.result(self.server.request_timeout)
         except TimeoutError as e:
             self._send(504, {"error": str(e)})
+        except DrainError as e:
+            # an admitted request the drain had to fail: 503, and the
+            # already-set X-Request-Id keeps it correlatable
+            self._send(503, {"error": str(e)})
+        except ShedError as e:
+            self._send(429, {"error": str(e), "reason": e.reason},
+                       retry_after=e.retry_after_s)
+        except NoReplicaError as e:
+            self._send(503, {"error": str(e)},
+                       retry_after=e.retry_after_s)
         except Exception as e:
             self._send(500, {"error": "%s: %s" % (type(e).__name__, e)})
         return None
 
+    def _submit(self, fn, *args, **kw):
+        """Shared submit-time error mapping; returns None after
+        answering an error."""
+        try:
+            return fn(*args, **kw)
+        except QueueFullError as e:
+            self._send(429, {"error": str(e)})
+        except ShedError as e:
+            self._send(429, {"error": str(e), "reason": e.reason},
+                       retry_after=e.retry_after_s)
+        except DrainError as e:
+            self._send(503, {"error": str(e), "state": "draining"})
+        except NoReplicaError as e:
+            self._send(503, {"error": str(e)},
+                       retry_after=e.retry_after_s)
+        except (ValueError, TypeError) as e:
+            self._send(400, {"error": str(e)})
+        return None
+
     def _post_predict(self):
         eng: ServingEngine = self.server.engine
+        payload = self._read_json()
+        if payload is None:
+            return
+        if not self._gate_state():
+            return
         if eng.kind != "forward":
             self._send(409, {"error":
                              "this server hosts a decoder; POST /generate"})
             return
-        payload = self._read_json()
-        if payload is None:
-            return
         if "data" not in payload:
             self._send(400, {"error": 'body needs a "data" field'})
             return
-        try:
-            req = eng.submit(np.asarray(payload["data"]))
-        except QueueFullError as e:
-            self._send(429, {"error": str(e)})
+        kw = self._submit_kwargs(payload)
+        if kw is None:
             return
-        except (ValueError, TypeError) as e:
-            self._send(400, {"error": str(e)})
+        req = self._submit(eng.submit, np.asarray(payload["data"]),
+                           **kw)
+        if req is None:
             return
         out = self._wait(req)
         if out is None:
             return
-        self._send(200, {"output": out.tolist(),
-                         "pred": _pred_convention(out),
-                         "request_id": req.id,
-                         "timing": req.timing()})
+        extra = req.response_meta() if hasattr(req, "response_meta") \
+            else {}
+        self._send(200, dict({"output": out.tolist(),
+                              "pred": _pred_convention(out),
+                              "request_id": req.id,
+                              "timing": req.timing()}, **extra))
 
     def _post_generate(self):
         eng: ServingEngine = self.server.engine
+        payload = self._read_json()
+        if payload is None:
+            return
+        if not self._gate_state():
+            return
         if eng.kind != "decode":
             self._send(409, {"error":
                              "this server hosts a forward model; "
                              "POST /predict"})
-            return
-        payload = self._read_json()
-        if payload is None:
             return
         prompts = payload.get("prompts")
         if (not isinstance(prompts, list) or not prompts
@@ -272,23 +362,58 @@ class ServeHandler(BaseHTTPRequestHandler):
                 return
             lens[i] = len(p)
         seed = payload.get("seed")
-        try:
-            req = eng.submit_tokens(
-                toks, lens, None if seed is None else int(seed))
-        except QueueFullError as e:
-            self._send(429, {"error": str(e)})
+        kw = self._submit_kwargs(payload)
+        if kw is None:
             return
-        except (ValueError, TypeError) as e:
-            self._send(400, {"error": str(e)})
+        req = self._submit(eng.submit_tokens, toks, lens,
+                           None if seed is None else int(seed), **kw)
+        if req is None:
             return
         out = self._wait(req)
         if out is None:
             return
-        self._send(200, {"tokens": [
+        extra = req.response_meta() if hasattr(req, "response_meta") \
+            else {}
+        self._send(200, dict({"tokens": [
             [int(t) for t in out[i, :int(lens[i]) + c.max_new]]
             for i in range(len(prompts))],
             "request_id": req.id,
-            "timing": req.timing()})
+            "timing": req.timing()}, **extra))
+
+    def _post_swap(self):
+        """Hot artifact swap (router topology only): {"artifact":
+        path, "version": optional, "drain_timeout_s": optional}.
+        Rolls every replica to the new artifact with zero downtime."""
+        eng = self.server.engine
+        payload = self._read_json()
+        if payload is None:
+            return
+        if not hasattr(eng, "swap_artifact"):
+            self._send(409, {"error": "hot swap needs the "
+                             "multi-replica router "
+                             "(serve_replicas >= 2)"})
+            return
+        if not self.server.allow_swap:
+            self._send(403, {"error": "swap endpoint disabled "
+                             "(serve_swap = 0)"})
+            return
+        path = payload.get("artifact")
+        if not path or not isinstance(path, str):
+            self._send(400, {"error": 'body needs an "artifact" path'})
+            return
+        try:
+            info = eng.swap_artifact(
+                path, payload.get("version"),
+                drain_timeout=float(
+                    payload.get("drain_timeout_s", 30.0)))
+        except (OSError, ValueError, TypeError) as e:
+            self._send(400, {"error": "artifact rejected: %s" % e})
+            return
+        except Exception as e:
+            self._send(500, {"error": "swap failed: %s: %s"
+                             % (type(e).__name__, e)})
+            return
+        self._send(200, info)
 
 
 class ServeHTTPServer(ThreadingHTTPServer):
@@ -301,7 +426,7 @@ class ServeHTTPServer(ThreadingHTTPServer):
                  port: int = 8080,
                  request_timeout: Optional[float] = 30.0,
                  max_body: int = 64 << 20, verbose: bool = False,
-                 access_log=False):
+                 access_log=False, allow_swap: bool = True):
         self.engine = engine
         self.request_timeout = request_timeout
         self.max_body = max_body
@@ -309,6 +434,8 @@ class ServeHTTPServer(ThreadingHTTPServer):
         # False = off, True = JSON lines on stderr, callable = custom
         # sink receiving the record dict (tests, log shippers)
         self.access_log = access_log
+        # POST /swap (router topology): serve_swap = 0 turns it off
+        self.allow_swap = allow_swap
         super().__init__((host, port), ServeHandler)
 
     def start_background(self) -> threading.Thread:

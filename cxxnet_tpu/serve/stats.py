@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 from ..metrics import StreamingQuantile
 
@@ -56,6 +56,7 @@ class ServeStats:
         self.rejected = 0        # shed at admission (queue full)
         self.timeouts = 0        # expired before / while dispatching
         self.errors = 0          # failed inside the callee
+        self.drained = 0         # failed by a drain window expiring
         self._fill_sum = 0.0
         self.bucket_dispatches: Dict[int, int] = {}
 
@@ -71,6 +72,10 @@ class ServeStats:
     def on_error(self, n: int = 1) -> None:
         with self._lock:
             self.errors += n
+
+    def on_drained(self, n: int = 1) -> None:
+        with self._lock:
+            self.drained += n
 
     def on_dispatch(self, nreq: int, rows: int, capacity: int) -> None:
         """One callee invocation coalescing ``nreq`` requests totalling
@@ -93,50 +98,78 @@ class ServeStats:
             self._lat_sum += latency_s
 
     # ------------------------------------------------------------------
-    def bind_registry(self, registry, prefix: str = "cxxnet_serve"):
+    def estimate_clear_s(self, depth: int) -> float:
+        """Rough seconds for a backlog of ``depth`` queued requests to
+        clear at the recent service rate: depth / occupancy dispatches
+        at ~p50 latency each. Feeds the computed ``Retry-After`` and
+        the router's deadline-aware admission — an estimate good to a
+        small factor beats a hardcoded 1 in both places. With no
+        completed traffic yet (empty latency window) a conservative
+        50 ms per dispatch stands in."""
+        if depth <= 0:
+            return 0.0
+        with self._lock:
+            p50, = self._lat.quantiles([0.5])
+            occ = (self.dispatched_requests / self.dispatches
+                   if self.dispatches else 1.0)
+        per = p50 if p50 == p50 and p50 > 0 else 0.05   # NaN = empty
+        return depth / max(occ, 1.0) * per
+
+    # ------------------------------------------------------------------
+    def bind_registry(self, registry, prefix: str = "cxxnet_serve",
+                      labels: Optional[Dict[str, str]] = None):
         """Register a pull hook copying this object's state into
         ``registry`` series at scrape time (counters mirror the running
         totals via set_total; the event-path locking is unchanged).
         Returns the hook (``Registry.remove_hook`` detaches it).
 
-        One ``prefix`` maps one stats object onto one series family:
-        binding TWO ServeStats to the same registry under the same
-        prefix makes the later hook overwrite the earlier one's
-        samples. To aggregate several engines onto one scrape, give
-        the engines one shared ServeStats (the supported aggregation
-        path) or bind each under a distinct prefix."""
+        One (``prefix``, ``labels``) pair maps one stats object onto
+        one series set: binding TWO ServeStats to the same registry
+        under the same prefix AND labels makes the later hook overwrite
+        the earlier one's samples. The replica set distinguishes its
+        engines with ``labels={"replica": name}`` — N replicas share
+        one prefix and one scrape, each with its own label value. To
+        aggregate several engines onto one series instead, give the
+        engines one shared ServeStats (the supported aggregation
+        path)."""
+        labels = dict(labels or {})
+        names = tuple(labels)
         cs = {f: registry.counter("%s_%s_total" % (prefix, f),
-                                  "serving %s since engine start" % f)
+                                  "serving %s since engine start" % f,
+                                  names)
               for f in ("requests", "rows", "dispatches",
                         "dispatched_requests", "rejected", "timeouts",
-                        "errors")}
+                        "errors", "drained")}
         c_bucket = registry.counter(
             prefix + "_bucket_dispatches_total",
-            "dispatches per exported bucket", ("bucket",))
+            "dispatches per exported bucket", names + ("bucket",))
         g_occ = registry.gauge(prefix + "_batch_occupancy",
-                               "mean requests coalesced per dispatch")
+                               "mean requests coalesced per dispatch",
+                               names)
         g_fill = registry.gauge(
             prefix + "_batch_fill",
-            "mean fraction of dispatched-bucket rows carrying data")
+            "mean fraction of dispatched-bucket rows carrying data",
+            names)
         g_up = registry.gauge(prefix + "_uptime_seconds",
-                              "seconds since stats construction")
+                              "seconds since stats construction", names)
         g_lat = registry.gauge(prefix + "_latency_ms",
                                "request latency over the recency window",
-                               ("q",))
+                               names + ("q",))
 
         def pull():
             snap = self.snapshot()
             for f, c in cs.items():
                 # dispatched_requests is an attribute only (the JSON
                 # snapshot exposes it as batch_occupancy's numerator)
-                c.set_total(snap[f] if f in snap else getattr(self, f))
+                c.set_total(snap[f] if f in snap else getattr(self, f),
+                            **labels)
             for b, n in snap["bucket_dispatches"].items():
-                c_bucket.set_total(n, bucket=b)
-            g_occ.set(snap["batch_occupancy"])
-            g_fill.set(snap["batch_fill"])
-            g_up.set(snap["uptime_sec"])
+                c_bucket.set_total(n, bucket=b, **labels)
+            g_occ.set(snap["batch_occupancy"], **labels)
+            g_fill.set(snap["batch_fill"], **labels)
+            g_up.set(snap["uptime_sec"], **labels)
             for q, v in snap["latency_ms"].items():
-                g_lat.set(v, q=q)
+                g_lat.set(v, q=q, **labels)
 
         return registry.add_hook(pull)
 
@@ -155,6 +188,7 @@ class ServeStats:
                 "rejected": self.rejected,
                 "timeouts": self.timeouts,
                 "errors": self.errors,
+                "drained": self.drained,
                 "batch_occupancy": (
                     self.dispatched_requests / self.dispatches
                     if self.dispatches else 0.0),

@@ -15,7 +15,9 @@ import pytest
 
 from cxxnet_tpu import config, models, serving
 from cxxnet_tpu.io import DataBatch
-from cxxnet_tpu.serve import QueueFullError, ServeStats, ServingEngine
+from cxxnet_tpu.serve import (DrainError, QueueFullError,
+                              RequestExpired, ServeStats,
+                              ServingEngine)
 from cxxnet_tpu.trainer import Trainer
 
 
@@ -442,6 +444,146 @@ def test_decode_bucket_selection_fake():
     assert list(out[0, :5]) == [5, 6, 99, 99, 99]
     assert dec.shapes == [1]
     assert m["bucket_dispatches"] == {"1": 1}
+
+
+
+# ----------------------------------------------------------------------
+# r7 robustness satellites: expired-request sweep, per-request
+# deadlines, formal drain, fault hook, state machine
+
+def test_full_queue_sweeps_expired_before_shedding_live():
+    """A queue packed with already-dead requests must not shed live
+    traffic: admission sweeps the expired out (counted as timeouts,
+    not rejections) and admits the new arrival."""
+    eng = ServingEngine(FakeModel(), queue_limit=4, timeout_ms=30,
+                        start=False)
+    dead = [eng.submit(_ones(1)) for _ in range(4)]
+    time.sleep(0.08)                      # every queued deadline passes
+    live = eng.submit(_ones(1, 5.0))      # would have been shed before
+    for r in dead:
+        with pytest.raises(RequestExpired, match="swept at admission"):
+            r.result(1)
+    m = eng.metrics()
+    assert m["timeouts"] == 4 and m["rejected"] == 0
+    assert eng.queue_depth == 1
+    eng.start()
+    np.testing.assert_allclose(live.result(10), _ones(1, 10.0))
+    eng.close()
+
+
+def test_full_queue_of_live_requests_still_sheds():
+    eng = ServingEngine(FakeModel(), queue_limit=2, timeout_ms=30000,
+                        start=False)
+    held = [eng.submit(_ones(1)) for _ in range(2)]
+    with pytest.raises(QueueFullError):
+        eng.submit(_ones(1))
+    assert eng.metrics()["rejected"] == 1
+    assert len(held) == 2
+    eng.close()
+
+
+def test_per_request_timeout_override():
+    """submit(timeout_ms=...) overrides the engine deadline per
+    request; 0 disables it entirely."""
+    fake = FakeModel()
+    eng = ServingEngine(fake, timeout_ms=30000, start=False)
+    short = eng.submit(_ones(1), timeout_ms=20)
+    none = eng.submit(_ones(1), timeout_ms=0)
+    assert short.deadline is not None and none.deadline is None
+    time.sleep(0.05)
+    eng.start()
+    with pytest.raises(TimeoutError, match="expired"):
+        short.result(10)
+    assert none.result(10).shape == (1, 3)
+    assert eng.metrics()["timeouts"] == 1
+    eng.close()
+
+
+def test_drain_answers_inflight_then_blocks_admission():
+    """drain(): everything already admitted completes, new admissions
+    raise DrainError, and the state machine reflects it."""
+    eng = ServingEngine(FakeModel(delay=0.02), max_wait_ms=1)
+    assert eng.state == "serving"
+    reqs = [eng.submit(_ones(1, float(i + 1))) for i in range(3)]
+    assert eng.drain(timeout=10) == 0
+    for i, r in enumerate(reqs):
+        np.testing.assert_allclose(r.result(10),
+                                   _ones(1, 2.0 * (i + 1)))
+    assert eng.state == "draining"
+    with pytest.raises(DrainError, match="draining"):
+        eng.submit(_ones(1))
+    assert eng.retry_after_s() >= 1.0
+    assert not eng.healthz()["ok"]
+    assert eng.healthz()["state"] == "draining"
+    eng.close()
+    assert eng.state == "closed"
+
+
+def test_drain_timeout_fails_stragglers_with_drainerror():
+    """A drain that cannot finish in its window fails exactly the
+    stragglers with DrainError (counted as drained, not errors)."""
+    eng = ServingEngine(FakeModel(), start=False)   # nothing dispatches
+    reqs = [eng.submit(_ones(1)) for _ in range(3)]
+    assert eng.drain(timeout=0.05) == 3
+    for r in reqs:
+        with pytest.raises(DrainError, match="drain window"):
+            r.result(1)
+    m = eng.metrics()
+    assert m["drained"] == 3 and m["errors"] == 0
+    assert eng.live_requests == 0
+    eng.close()
+
+
+def test_fault_hook_drives_real_error_path():
+    """serve/faults.py seam: a raising hook fails the batch through
+    the engine's real error accounting, and a cleared injector lets
+    traffic flow again."""
+    from cxxnet_tpu.serve.faults import FaultError, FaultInjector
+    inj = FaultInjector(seed=0)
+    fake = FakeModel()
+    eng = ServingEngine(fake, max_wait_ms=1,
+                        fault_hook=inj.hook("r1"))
+    inj.fail("r1", times=1)
+    with pytest.raises(FaultError, match="injected"):
+        eng.submit(_ones(1)).result(10)
+    assert eng.metrics()["errors"] == 1
+    out = eng.submit(_ones(1, 2.0)).result(10)
+    np.testing.assert_allclose(out, _ones(1, 4.0))
+    assert inj.dispatches("r1") == 2
+    eng.close()
+
+
+def test_warming_state_until_warmup_completes():
+    fake = FakeLadderModel()
+    eng = ServingEngine(fake, warmup=True, start=False)
+    assert eng.state == "warming"
+    assert not eng.healthz()["ok"]
+    eng.start()
+    assert eng.state == "serving" and eng.healthz()["ok"]
+    eng.close()
+
+
+def test_obs_labels_namespace_registry_series():
+    """Two engines sharing one registry under distinct replica labels
+    publish side by side instead of overwriting each other."""
+    from cxxnet_tpu.obs.registry import Registry
+    reg = Registry()
+    e1 = ServingEngine(FakeModel(), max_wait_ms=1, registry=reg,
+                       obs_labels={"replica": "a"})
+    e2 = ServingEngine(FakeModel(), max_wait_ms=1, registry=reg,
+                       obs_labels={"replica": "b"})
+    e1.submit(_ones(1)).result(10)
+    e1.submit(_ones(1)).result(10)
+    e2.submit(_ones(1)).result(10)
+    assert reg.get_value("cxxnet_serve_requests_total",
+                         replica="a") == 2
+    assert reg.get_value("cxxnet_serve_requests_total",
+                         replica="b") == 1
+    text = reg.render_prom()
+    assert 'cxxnet_serve_requests_total{replica="a"} 2' in text
+    assert 'cxxnet_serve_requests_total{replica="b"} 1' in text
+    e1.close()
+    e2.close()
 
 
 def test_exported_ladder_engine_matches_direct(tmp_path_factory):

@@ -462,3 +462,209 @@ def test_http_ladder_artifact_buckets_surface(exported_mlp, tmp_path):
         srv.shutdown()
         srv.server_close()
         eng.close()
+
+
+# ----------------------------------------------------------------------
+# r7 robustness: readiness semantics, computed Retry-After, drain 503,
+# and the multi-replica router behind the same HTTP surface
+
+def test_healthz_and_predict_503_while_draining():
+    """A draining server is not-ready: /healthz turns 503 with the
+    state visible, and /predict answers 503 + Retry-After (not 429) —
+    load balancers stop routing BEFORE requests bounce."""
+    eng = ServingEngine(FakeModel(), max_wait_ms=1)
+    srv = build_server(eng, port=0)
+    srv.start_background()
+    url = _url(srv)
+    try:
+        s, h = _get(url, "/healthz")
+        assert s == 200 and h["ok"] and h["state"] == "serving"
+        eng.drain(timeout=1)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(url, "/healthz")
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert body["state"] == "draining" and body["ok"] is False
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url, "/predict", {"data": [[1.0, 2.0, 3.0]]})
+        assert ei.value.code == 503
+        ra = ei.value.headers.get("Retry-After")
+        assert ra is not None and int(ra) >= 1
+        body = json.loads(ei.value.read())
+        assert body["state"] == "draining"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+
+def test_drain_stragglers_get_503_with_request_id():
+    """An ADMITTED request the drain window has to fail maps to 503
+    with its X-Request-Id preserved — the satellite contract for
+    DrainError over HTTP."""
+    eng = ServingEngine(FakeModel(delay=5.0), max_wait_ms=1)
+    srv = build_server(eng, port=0, request_timeout=30)
+    srv.start_background()
+    url = _url(srv)
+    from concurrent.futures import ThreadPoolExecutor
+    ex = ThreadPoolExecutor(1)
+
+    def fire():
+        try:
+            _post(url, "/predict", {"data": [[1.0, 2.0, 3.0]]},
+                  timeout=30)
+            return None
+        except urllib.error.HTTPError as e:
+            return e
+    try:
+        fut = ex.submit(fire)
+        deadline = time.monotonic() + 10
+        while eng.live_requests < 1:
+            assert time.monotonic() < deadline, "request never admitted"
+            time.sleep(0.01)
+        time.sleep(0.05)        # let it reach the (slow) dispatch
+        assert eng.drain(timeout=0.1) >= 1
+        err = fut.result(timeout=30)
+        assert err is not None and err.code == 503
+        body = json.loads(err.read())
+        assert body["request_id"].startswith("req-")
+        assert err.headers["X-Request-Id"] == body["request_id"]
+        assert err.headers.get("Retry-After")
+    finally:
+        ex.shutdown(wait=False)
+        srv.shutdown()
+        srv.server_close()
+        eng.close(timeout=0.5)
+
+
+def test_retry_after_is_computed_not_hardcoded():
+    """429 Retry-After derives from the backlog estimate (>= 1s,
+    integral); the old constant '1' is gone as a special case only in
+    the sense that an idle queue legitimately rounds to 1."""
+    eng = ServingEngine(FakeModel(), queue_limit=2, start=False)
+    srv = build_server(eng, port=0)
+    srv.start_background()
+    url = _url(srv)
+    try:
+        from concurrent.futures import ThreadPoolExecutor
+        ex = ThreadPoolExecutor(2)
+        futs = [ex.submit(_post, url, "/predict",
+                          {"data": [[1.0, 2.0, 3.0]]}) for _ in range(2)]
+        deadline = time.monotonic() + 10
+        while eng.queue_depth < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url, "/predict", {"data": [[1.0, 2.0, 3.0]]})
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        body = json.loads(ei.value.read())
+        assert body["retry_after_s"] >= 1
+        eng.start()
+        for f in futs:
+            f.result(timeout=10)
+        ex.shutdown()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+
+def test_router_http_replicas_priority_and_hot_swap(exported_mlp):
+    """The multi-replica topology behind the unchanged HTTP surface:
+    per-replica /healthz detail with versions, priority + timeout_ms
+    body fields, response replica/version/attempts metadata,
+    per-replica labeled Prometheus series, and a zero-downtime POST
+    /swap while traffic flows."""
+    from cxxnet_tpu import serving as serving_mod
+    from cxxnet_tpu.serve.replica import ReplicaSet
+    from cxxnet_tpu.serve.router import Router
+    path, model, b = exported_mlp
+    full = model(b.data)
+    rs = ReplicaSet(lambda: serving_mod.load_exported(path), n=2,
+                    engine_kw=dict(max_wait_ms=2.0), supervise=False)
+    rs.start()
+    router = Router(rs, max_retries=1, timeout_ms=30000)
+    srv = build_server(router, port=0)
+    srv.start_background()
+    url = _url(srv)
+    try:
+        s, h = _get(url, "/healthz")
+        assert s == 200 and h["ok"] and h["version"] == "v1"
+        assert set(h["replicas"]) == {"r1", "r2"}
+        assert all(v["state"] == "healthy"
+                   for v in h["replicas"].values())
+        s, body = _post(url, "/predict",
+                        {"data": b.data[:2].tolist(),
+                         "priority": "high", "timeout_ms": 20000},
+                        timeout=60)
+        assert s == 200
+        np.testing.assert_allclose(np.asarray(body["output"]),
+                                   full[:2], rtol=1e-5, atol=1e-6)
+        assert body["replica"] in ("r1", "r2")
+        assert body["version"] == "v1" and body["attempts"] == 1
+        assert body["timing"]["router_total_ms"] >= 0.0
+        # bad priority -> 400 at the door
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url, "/predict", {"data": b.data[:1].tolist(),
+                                    "priority": "urgent"})
+        assert ei.value.code == 400
+        # per-replica series on one scrape
+        with urllib.request.urlopen(url + "/metrics?format=prom",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        assert 'cxxnet_serve_requests_total{replica="r1"}' in text
+        assert 'cxxnet_serve_requests_total{replica="r2"}' in text
+        assert "cxxnet_replica_state" in text
+        # hot swap via the endpoint, traffic continues, version flips
+        s, info = _post(url, "/swap",
+                        {"artifact": path, "version": "v2"},
+                        timeout=300)
+        assert s == 200 and info["ok"] and info["version"] == "v2"
+        s, body = _post(url, "/predict",
+                        {"data": b.data[:1].tolist()}, timeout=60)
+        assert s == 200 and body["version"] == "v2"
+        np.testing.assert_allclose(np.asarray(body["output"]),
+                                   full[:1], rtol=1e-5, atol=1e-6)
+        s, h = _get(url, "/healthz")
+        assert h["version"] == "v2"
+        assert all(v["version"] == "v2"
+                   for v in h["replicas"].values())
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        router.close()
+
+
+def test_swap_endpoint_guards():
+    """/swap 409s on a single engine, 403s when disabled, 400s on a
+    missing artifact."""
+    eng = ServingEngine(FakeModel(), max_wait_ms=1)
+    srv = build_server(eng, port=0)
+    srv.start_background()
+    url = _url(srv)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url, "/swap", {"artifact": "x.bin"})
+        assert ei.value.code == 409
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+    from cxxnet_tpu.serve.replica import ReplicaSet
+    from cxxnet_tpu.serve.router import Router
+    rs = ReplicaSet(FakeModel, n=2, supervise=False,
+                    engine_kw=dict(max_wait_ms=1.0))
+    rs.start()
+    router = Router(rs)
+    srv2 = build_server(router, port=0, allow_swap=False)
+    srv2.start_background()
+    url2 = _url(srv2)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url2, "/swap", {"artifact": "x.bin"})
+        assert ei.value.code == 403
+    finally:
+        srv2.shutdown()
+        srv2.server_close()
+        router.close()

@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Chaos smoke for the resilient multi-replica serving tier.
+
+One watchdogged command proves the r7 robustness story end to end
+(docs/serving.md — replicas / router / failover / drain / hot swap),
+with tracing on so the proof is INSPECTABLE, not just asserted:
+
+1. train a tiny MLP, export it twice (same weights): ``v1`` and
+   ``v2`` artifacts;
+2. start a 3-replica :class:`ReplicaSet` (each replica its own
+   artifact load + warmup) behind the SLO-aware :class:`Router` and
+   the stdlib HTTP server, with a seeded
+   :class:`~cxxnet_tpu.serve.faults.FaultInjector` wired through every
+   engine's dispatch path;
+3. run steady closed-loop HTTP load (mixed normal/batch priorities,
+   per-request deadlines) and, mid-run, KILL one replica (injected
+   ``die`` — every dispatch on it throws, heartbeat probes included)
+   and HOT-SWAP the artifact to ``v2`` via ``POST /swap``;
+4. assert: ZERO non-shed request failures (every response is 200 with
+   the numerically-correct answer, or an explicit 429 shed), at least
+   one recorded failover retry, the swap completed (every live
+   replica on ``v2``), and the killed replica is out of rotation;
+5. write the Chrome trace and hold it to the same bar CI holds the
+   committed artifact (``docs/chaos_trace_r07.json``,
+   ``tests/test_serve_router.py``): >= 1 matched request flow plus
+   ``router.retry`` / ``router.swap`` / ``replica.drain`` spans —
+   ``tools/trace_report.py --require-flow`` semantics.
+
+Usage: python tools/serve_chaos.py [--clients 3] [--interval-ms 250]
+           [--slo-ms 2000] [--trace-out chaos_trace.json]
+           [--timeout 600]
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BATCH, NCLASS, DIM = 16, 4, 32
+LADDER = [1, 4, 16]
+
+
+def _watchdog(seconds: int):
+    def fire():
+        import faulthandler
+        sys.stderr.write("serve_chaos: DEADLOCK — no completion within "
+                         "%ds; thread dump follows\n" % seconds)
+        faulthandler.dump_traceback()
+        os._exit(2)
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def build_artifacts(tmpdir):
+    """One tiny trained MLP, exported twice (identical weights) so the
+    hot swap is observable by version while every answer stays
+    verifiable against one reference."""
+    from cxxnet_tpu import config, models, serving
+    from cxxnet_tpu.io import DataBatch
+    from cxxnet_tpu.trainer import Trainer
+
+    tr = Trainer()
+    for k, v in config.parse_string(
+            models.mnist_mlp(nhidden=16, nclass=NCLASS)):
+        tr.set_param(k, v)
+    for k, v in (("dev", "cpu:0"), ("batch_size", str(BATCH)),
+                 ("eta", "0.2"), ("input_shape", "1,1,%d" % DIM),
+                 ("seed", "11")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    b = DataBatch(
+        data=rs.randn(BATCH, 1, 1, DIM).astype(np.float32),
+        label=rs.randint(0, NCLASS, size=(BATCH, 1)).astype(np.float32))
+    for _ in range(3):
+        tr.update(b)
+    v1 = os.path.join(tmpdir, "chaos_v1.export")
+    v2 = os.path.join(tmpdir, "chaos_v2.export")
+    serving.export_model(tr, v1, batch_ladder=LADDER, platforms=["cpu"])
+    serving.export_model(tr, v2, batch_ladder=LADDER, platforms=["cpu"])
+    return v1, v2, serving.load_exported(v1)
+
+
+def post(url, path, obj, timeout=120):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.load(r)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=3)
+    ap.add_argument("--interval-ms", type=float, default=250.0,
+                    help="per-client pacing (keeps the trace small)")
+    ap.add_argument("--slo-ms", type=float, default=2000.0,
+                    help="per-request deadline = the SLO")
+    ap.add_argument("--trace-out", default="chaos_trace.json")
+    ap.add_argument("--timeout", type=int, default=600,
+                    help="watchdog: hard-exit 2 after this many seconds")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _watchdog(args.timeout)
+    import tempfile
+
+    from cxxnet_tpu.obs import trace as obs_trace
+    from cxxnet_tpu.obs.registry import Registry
+    from cxxnet_tpu.serve.faults import FaultInjector
+    from cxxnet_tpu.serve.replica import DEAD, ReplicaSet
+    from cxxnet_tpu.serve.router import Router
+    from cxxnet_tpu.serve.server import build_server
+
+    rc = 0
+    with tempfile.TemporaryDirectory() as tmpdir:
+        v1_path, v2_path, model = build_artifacts(tmpdir)
+        rs_data = np.random.RandomState(1)
+        pool = rs_data.randn(BATCH, 1, 1, DIM).astype(np.float32)
+        full = np.asarray(model(pool))
+
+        obs_trace.start(args.trace_out)
+        from cxxnet_tpu import serving
+        inj = FaultInjector(seed=7)
+        replicas = ReplicaSet(
+            lambda: serving.load_exported(v1_path), n=3, fault=inj,
+            registry=Registry(), version="v1", fail_threshold=2,
+            backoff_s=0.3, dead_after=4, heartbeat_s=0.2,
+            probe_timeout_s=5.0,
+            engine_kw=dict(max_wait_ms=2.0, queue_limit=64))
+        replicas.start()
+        router = Router(replicas, max_retries=2,
+                        timeout_ms=args.slo_ms)
+        srv = build_server(router, port=0)
+        srv.start_background()
+        url = "http://127.0.0.1:%d" % srv.server_address[1]
+
+        stop = threading.Event()
+        outcomes = {"ok": 0, "shed": 0, "unavailable": 0, "fail": 0}
+        bad = []
+        lock = threading.Lock()
+
+        host, port = srv.server_address[:2]
+
+        def client(ci):
+            # ONE keep-alive connection per client: realistic, and it
+            # keeps the handler-thread (= trace lane) count at
+            # --clients instead of one lane per request
+            import http.client
+            conn = http.client.HTTPConnection(
+                host, port, timeout=args.slo_ms / 1000.0 + 30)
+            i = ci
+            while not stop.is_set():
+                i += 1
+                idx = i % BATCH
+                prio = "batch" if i % 3 == 0 else "normal"
+                try:
+                    conn.request("POST", "/predict", json.dumps({
+                        "data": pool[idx:idx + 1].tolist(),
+                        "priority": prio,
+                        "timeout_ms": args.slo_ms,
+                    }), {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    st = resp.status
+                    body = json.loads(resp.read())
+                    with lock:
+                        if st == 200 and np.allclose(
+                                np.asarray(body["output"]),
+                                full[idx:idx + 1],
+                                rtol=1e-5, atol=1e-6):
+                            outcomes["ok"] += 1
+                        elif st == 429:
+                            outcomes["shed"] += 1
+                        elif st == 503:
+                            outcomes["unavailable"] += 1
+                            bad.append((i, 503, "unavailable"))
+                        else:
+                            outcomes["fail"] += 1
+                            bad.append((i, st, body))
+                except Exception as e:
+                    with lock:
+                        outcomes["fail"] += 1
+                        bad.append((i, None, repr(e)))
+                    conn.close()
+                    conn = http.client.HTTPConnection(
+                        host, port,
+                        timeout=args.slo_ms / 1000.0 + 30)
+                stop.wait(args.interval_ms / 1000.0)
+            conn.close()
+
+        ex = ThreadPoolExecutor(args.clients)
+        clients = [ex.submit(client, ci) for ci in range(args.clients)]
+
+        # ---- the chaos timeline -------------------------------------
+        time.sleep(1.5)                     # steady state
+        inj.die("r2")                       # KILL one replica, live
+        print("serve_chaos: killed r2 (injected die)")
+        time.sleep(1.5)                     # failovers + degrade
+        st, info = post(url, "/swap",
+                        {"artifact": v2_path, "version": "v2"},
+                        timeout=300)        # HOT SWAP, live
+        print("serve_chaos: swapped to v2: %s"
+              % sorted(info["replicas"]))
+        time.sleep(1.5)                     # post-swap traffic
+        stop.set()
+        for c in clients:
+            c.result(timeout=60)
+        ex.shutdown()
+
+        m = router.metrics()
+        st, health = 0, None
+        try:
+            with urllib.request.urlopen(url + "/healthz",
+                                        timeout=10) as r:
+                health = json.load(r)
+                st = r.status
+        except urllib.error.HTTPError as e:
+            health, st = json.loads(e.read()), e.code
+        srv.shutdown()
+        srv.server_close()
+        router.close()
+        trace_path = obs_trace.stop()
+
+        # ---- assertions ---------------------------------------------
+        checks = []
+
+        def check(name, ok, detail=""):
+            checks.append((name, bool(ok), detail))
+            return bool(ok)
+
+        check("served_traffic", outcomes["ok"] > 20, outcomes)
+        check("zero_nonshed_failures",
+              outcomes["fail"] == 0 and outcomes["unavailable"] == 0,
+              bad[:5])
+        check("failover_retries_recorded", m["retries"] >= 1,
+              "retries=%d" % m["retries"])
+        check("swap_completed",
+              st == 200 and health["version"] == "v2"
+              and all(v["version"] == "v2"
+                      for v in health["replicas"].values()
+                      if v["state"] != DEAD),
+              health)
+        check("killed_replica_out_of_rotation",
+              all(v["state"] in (DEAD, "degraded")
+                  for k, v in (m["replicas"] or {}).items()
+                  if k == "r2"),
+              m["replicas"].get("r2"))
+        check("still_serving_after_chaos",
+              st == 200 and health["ok"], (st, health and health["ok"]))
+
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from tools.trace_report import load_events, report
+        rep = report(load_events(trace_path))
+        names = {s["name"] for s in rep["spans"]}
+        check("trace_matched_flows", rep["flows"]["matched"] >= 1,
+              rep["flows"])
+        check("trace_retry_flow", "router.retry" in names)
+        check("trace_swap_span", "router.swap" in names)
+        check("trace_drain_span", "replica.drain" in names)
+
+        for name, ok, detail in checks:
+            print("serve_chaos[%s]: %s %s"
+                  % ("ok" if ok else "FAIL", name,
+                     detail if not ok else ""))
+            if not ok:
+                rc = 1
+        print(json.dumps({
+            "metric": "serve_chaos",
+            "outcomes": outcomes,
+            "router": {k: m[k] for k in
+                       ("retries", "failovers", "completed", "swaps")},
+            "shed": m["shed"],
+            "trace": {"path": trace_path,
+                      "events_lanes": rep["nonempty_lanes"],
+                      "matched_flows": rep["flows"]["matched"]},
+            "version_after": health.get("version") if health else None,
+        }))
+        if rc == 0:
+            print("serve_chaos ok")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
