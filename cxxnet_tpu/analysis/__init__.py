@@ -1,0 +1,47 @@
+"""Concurrency & hot-path correctness tooling (docs/analysis.md).
+
+Three pieces, one goal — prove lock discipline and keep host syncs out
+of hot paths as the serving/feed tier grows threads:
+
+* :mod:`.lint` — an AST-based checker framework run over the whole
+  tree by ``tools/analysis_gate.py`` (a standing tier-1 gate via
+  ``tests/test_analysis.py``). Checker families: CONC (lock-acquisition
+  graph cycles, blocking calls under a held lock), SYNC (host-sync
+  constructs inside functions marked hot), OBS (span/metric
+  conventions from obs/).
+* :mod:`.lockcheck` — a lockdep-style runtime validator: instrumented
+  ``Lock``/``RLock``/``Condition``/``Queue`` factories that record
+  per-thread held-sets into a global acquisition-order graph with
+  cycle detection and held-too-long reporting. serve/* and
+  io/prefetch.py create their locks through the ``make_*`` seam, so
+  enabling the monitor instruments the real code paths; disabled (the
+  default) the seam returns plain ``threading`` primitives — one
+  branch at lock *creation*, nothing on acquire/release.
+* :func:`hot_path` — the marker the SYNC checker keys on. Zero
+  runtime cost: it stamps an attribute and returns the function.
+
+This package must stay import-light (stdlib only, no jax/numpy): the
+serving engine and the feed import the seam at module import time.
+"""
+
+from __future__ import annotations
+
+from . import lockcheck  # noqa: F401  (the seam modules import)
+
+_HOT_ATTR = "__cxxnet_hot_path__"
+
+
+def hot_path(fn):
+    """Mark ``fn`` as a hot path: the SYNC lint family flags host-sync
+    constructs (``block_until_ready``, ``np.asarray``, ``.item()``,
+    ``float()``/``int()`` of computed values) inside it. Pure marker —
+    returns ``fn`` unchanged apart from one attribute."""
+    try:
+        setattr(fn, _HOT_ATTR, True)
+    except (AttributeError, TypeError):  # builtins / slots: still legal
+        pass
+    return fn
+
+
+def is_hot_path(fn) -> bool:
+    return bool(getattr(fn, _HOT_ATTR, False))

@@ -1,0 +1,680 @@
+"""AST lint framework: concurrency & hot-path correctness checkers.
+
+Run by ``tools/analysis_gate.py`` over the whole tree (and by
+``tests/test_analysis.py`` as a standing tier-1 gate). Three checker
+families, each a :class:`Checker` the gate composes — adding a rule is
+adding a class to :data:`ALL_CHECKERS`:
+
+**CONC — lock discipline** (the static half of analysis/lockcheck.py)
+  The checker models each class's locks from ``self.X = Lock()/
+  RLock()/Condition()`` (or the ``lockcheck.make_*`` seam) and walks
+  every method with a held-lock stack from ``with self.X:`` nesting,
+  propagating one class's ``self.method()`` calls to a fixpoint:
+
+  * CONC001 — a cycle in a module's lock-acquisition graph (A taken
+    under B somewhere, B under A elsewhere): the classic AB/BA.
+  * CONC002 — a blocking call while a lock is held: ``time.sleep``,
+    thread ``.join()``, future/request ``.result()``, ``.wait()`` on
+    anything but the held condition itself, blocking ``get/put`` on a
+    queue attribute, engine ``submit``/``submit_tokens``, known
+    blocking ops (``serve_forever``, ``urlopen``, ``drain``,
+    ``drain_replica``, ``spawn``) — directly or via a same-class
+    method call.
+  * CONC003 — re-acquiring a held non-reentrant lock (self-deadlock).
+
+**SYNC — host syncs out of hot paths**
+  Functions marked ``@analysis.hot_path`` (or listed in the gate's
+  ``extra_hot`` config) must not force a device→host sync:
+
+  * SYNC001 — ``.block_until_ready()``
+  * SYNC002 — ``np.asarray(...)`` / ``np.array(...)``
+  * SYNC003 — ``.item()``
+  * SYNC004 — ``float(...)``/``int(...)`` of a computed value (a call
+    or subscript — ``float(x[0])`` syncs; ``float(timeout_ms)`` of a
+    plain name does not and is not flagged).
+
+**OBS — observability conventions** (obs/registry.py, obs/trace.py)
+  * OBS001 — a ``span(...)`` call that is not the context expression
+    of a ``with`` (an unmanaged span never records its exit: the
+    trace shows a lane that silently loses time).
+  * OBS002 — a literal metric name not matching ``cxxnet_[a-z0-9_]+``.
+  * OBS003 — a literal counter name not ending in ``_total``.
+  * OBS004 — more than %(max)d labels on one metric (label cardinality
+    is a product, not a sum; keep series enumerable).
+
+Checkers only see what is statically there: dynamically-built metric
+names are skipped, locks on foreign objects are invisible, and the
+runtime validator (lockcheck) covers what the AST cannot.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+METRIC_NAME_RE = re.compile(r"^cxxnet_[a-z0-9_]+$")
+MAX_LABELS = 4
+
+# method names on FOREIGN objects treated as blocking when called
+# under a held lock (same-class calls are resolved precisely instead)
+BLOCKING_METHOD_NAMES = {
+    "serve_forever", "urlopen", "drain", "drain_replica", "spawn",
+    "submit", "submit_tokens", "result",
+}
+# receiver-name heuristic separating thread.join() from str.join():
+# flag .join() only when the receiver's last name segment looks like a
+# thread/process handle
+_JOINABLE_RE = re.compile(r"(^t$|^th$|thread|proc|worker)", re.I)
+
+LOCK_FACTORY_KINDS = {
+    "Lock": "lock", "RLock": "rlock", "Condition": "cond",
+    "make_lock": "lock", "make_rlock": "rlock",
+    "make_condition": "cond",
+}
+QUEUE_FACTORY_NAMES = {"Queue", "LifoQueue", "PriorityQueue",
+                       "SimpleQueue", "make_queue"}
+
+
+class Finding:
+    """One lint finding. ``key`` (rule + file + qualified function) is
+    the waiver granularity — stable across unrelated edits, unlike a
+    line number."""
+
+    __slots__ = ("rule", "path", "line", "func", "msg")
+
+    def __init__(self, rule: str, path: str, line: int, func: str,
+                 msg: str) -> None:
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.func = func
+        self.msg = msg
+
+    @property
+    def key(self) -> str:
+        return "%s %s::%s" % (self.rule, self.path, self.func)
+
+    def __repr__(self) -> str:
+        return "%s %s:%d %s — %s" % (self.rule, self.path, self.line,
+                                     self.func, self.msg)
+
+
+class Module:
+    """One parsed source file handed to every checker."""
+
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path          # repo-relative, forward slashes
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+
+
+# ----------------------------------------------------------------------
+# shared AST helpers
+
+def dotted(node) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def _self_attr(node) -> Optional[str]:
+    """``X`` for an expression ``self.X``, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _contains_call(node: ast.AST, names: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            d = _call_name(sub)
+            if d is not None and d.rsplit(".", 1)[-1] in names:
+                return True
+    return False
+
+
+class Checker:
+    name = "base"
+
+    def check(self, mod: Module) -> List[Finding]:
+        raise NotImplementedError
+
+
+# ----------------------------------------------------------------------
+# CONC
+
+class _MethodSummary:
+    __slots__ = ("acquires", "blocking", "self_calls", "findings",
+                 "edges")
+
+    def __init__(self) -> None:
+        self.acquires: Set[str] = set()       # lock attrs taken inside
+        self.blocking: List[Tuple[int, str]] = []  # any depth
+        # (held locks at call, callee method name, line)
+        self.self_calls: List[Tuple[Tuple[str, ...], str, int]] = []
+        self.findings: List[Finding] = []     # direct blocking-under-lock
+        self.edges: List[Tuple[str, str, int]] = []  # (held, taken, ln)
+
+
+class _ClassModel:
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.locks: Dict[str, str] = {}    # attr -> lock|rlock|cond
+        self.queues: Set[str] = set()
+        self.methods: Dict[str, _MethodSummary] = {}
+
+
+def _lock_kind_of(value: ast.AST) -> Optional[str]:
+    """Lock kind when ``value`` (an assignment RHS) constructs one,
+    looking through ternaries/boolops for the factory call."""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            d = _call_name(sub)
+            if d is not None:
+                kind = LOCK_FACTORY_KINDS.get(d.rsplit(".", 1)[-1])
+                if kind is not None:
+                    return kind
+    return None
+
+
+def _is_queue_factory(value: ast.AST) -> bool:
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            d = _call_name(sub)
+            if d is not None \
+                    and d.rsplit(".", 1)[-1] in QUEUE_FACTORY_NAMES:
+                return True
+    return False
+
+
+class ConcChecker(Checker):
+    name = "CONC"
+
+    # -- per-method walk ----------------------------------------------
+    def _walk_fn(self, cls: _ClassModel, mod: Module, qual: str,
+                 fn, summary: _MethodSummary) -> None:
+        self._walk_body(cls, mod, qual, fn.body, [], summary)
+
+    def _walk_body(self, cls, mod, qual, body, held, summary) -> None:
+        for stmt in body:
+            self._walk_stmt(cls, mod, qual, stmt, held, summary)
+
+    def _walk_stmt(self, cls, mod, qual, stmt, held, summary) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a closure runs later, on its own stack: fresh held set,
+            # findings attributed to the nested qualname
+            inner = _MethodSummary()
+            nested_q = "%s.%s" % (qual, stmt.name)
+            self._walk_body(cls, mod, nested_q, stmt.body, [], inner)
+            summary.findings.extend(inner.findings)
+            summary.edges.extend(inner.edges)
+            # nested acquisitions/blocking do NOT propagate to the
+            # enclosing method (it only defines, not runs, them)
+            for held_at, callee, ln in inner.self_calls:
+                if held_at:   # closures holding locks calling methods
+                    summary.self_calls.append((held_at, callee, ln))
+            return
+        if isinstance(stmt, ast.With):
+            taken = []
+            for item in stmt.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and attr in cls.locks:
+                    for h in held + taken:
+                        summary.edges.append(
+                            (h, attr, item.context_expr.lineno))
+                    if attr in held + taken:
+                        if cls.locks[attr] != "rlock":
+                            summary.findings.append(Finding(
+                                "CONC003", mod.path,
+                                item.context_expr.lineno, qual,
+                                "re-acquiring held non-reentrant "
+                                "lock self.%s (self-deadlock)" % attr))
+                    taken.append(attr)
+                    summary.acquires.add(attr)
+                else:
+                    # non-lock context manager: still scan its
+                    # expression for blocking calls under held locks
+                    self._scan_expr(cls, mod, qual, item.context_expr,
+                                    held, summary)
+            self._walk_body(cls, mod, qual, stmt.body, held + taken,
+                            summary)
+            return
+        # every other statement: scan expressions, recurse into
+        # compound bodies with the same held set
+        for field in ("test", "value", "iter", "exc", "cause", "msg"):
+            sub = getattr(stmt, field, None)
+            if isinstance(sub, ast.AST):
+                self._scan_expr(cls, mod, qual, sub, held, summary)
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if isinstance(sub, list):
+                self._walk_body(cls, mod, qual, sub, held, summary)
+        for handler in getattr(stmt, "handlers", ()):
+            self._walk_body(cls, mod, qual, handler.body, held, summary)
+
+    def _scan_expr(self, cls, mod, qual, expr, held, summary) -> None:
+        # manual walk so a Lambda SUBTREE is skipped whole (it runs
+        # later, on its own stack — ast.walk would descend into it)
+        stack = [expr]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, ast.Lambda):
+                continue
+            if isinstance(sub, ast.Call):
+                self._scan_call(cls, mod, qual, sub, held, summary)
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _scan_call(self, cls, mod, qual, call, held, summary) -> None:
+        d = _call_name(call)
+        if d is None:
+            return
+        leaf = d.rsplit(".", 1)[-1]
+        # same-class method call: resolved precisely at fixpoint time
+        if isinstance(call.func, ast.Attribute) \
+                and _self_attr(call.func) is not None \
+                and leaf in cls.methods:
+            summary.self_calls.append(
+                (tuple(held), leaf, call.lineno))
+        desc = self._blocking_desc(cls, call, d, leaf, held)
+        if desc is None:
+            return
+        summary.blocking.append((call.lineno, desc))
+        if held:
+            summary.findings.append(Finding(
+                "CONC002", mod.path, call.lineno, qual,
+                "%s while holding self.%s" % (desc, held[-1])))
+
+    def _blocking_desc(self, cls, call, d, leaf, held) -> Optional[str]:
+        """A human description when ``call`` is a blocking operation,
+        else None."""
+        if d in ("time.sleep", "sleep"):
+            return "time.sleep(...)"
+        if leaf == "join" and isinstance(call.func, ast.Attribute):
+            recv = call.func.value
+            if isinstance(recv, ast.Constant):
+                return None       # ", ".join(...) — string join
+            rd = dotted(recv)
+            seg = rd.rsplit(".", 1)[-1] if rd else ""
+            if _JOINABLE_RE.search(seg):
+                return "thread %s.join(...)" % (rd or "?")
+            return None
+        if leaf == "wait" and isinstance(call.func, ast.Attribute):
+            attr = _self_attr(call.func.value)
+            if attr is not None and attr in held \
+                    and cls.locks.get(attr) == "cond":
+                return None   # cond.wait on the held condition releases
+            return "blocking .wait(...)"
+        if leaf in ("get", "put") and isinstance(call.func,
+                                                 ast.Attribute):
+            attr = _self_attr(call.func.value)
+            if attr is None or attr not in cls.queues:
+                return None
+            for kw in call.keywords:
+                if kw.arg == "block" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is False:
+                    return None
+            return "blocking queue .%s(...) on self.%s" % (leaf, attr)
+        if leaf in BLOCKING_METHOD_NAMES \
+                and isinstance(call.func, ast.Attribute):
+            # same-class calls are resolved precisely; only foreign
+            # receivers use the name heuristic
+            if _self_attr(call.func) is not None:
+                return None
+            return "blocking call .%s(...)" % leaf
+        if leaf in ("urlopen",):
+            return "network call %s(...)" % d
+        return None
+
+    # -- module-level assembly ----------------------------------------
+    def _model_class(self, node: ast.ClassDef) -> _ClassModel:
+        cls = _ClassModel(node.name)
+        for fn in node.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign) and sub.targets:
+                    attr = _self_attr(sub.targets[0])
+                    if attr is None:
+                        continue
+                    kind = _lock_kind_of(sub.value)
+                    if kind is not None:
+                        cls.locks[attr] = kind
+                    elif _is_queue_factory(sub.value):
+                        cls.queues.add(attr)
+        return cls
+
+    def check(self, mod: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        graph: Dict[str, Set[str]] = {}
+        edge_lines: Dict[Tuple[str, str], int] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = self._model_class(node)
+            if not cls.locks and not cls.queues:
+                continue
+            for fn in node.body:
+                if isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                    cls.methods[fn.name] = _MethodSummary()
+            for fn in node.body:
+                if isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                    qual = "%s.%s" % (cls.name, fn.name)
+                    self._walk_fn(cls, mod, qual, fn,
+                                  cls.methods[fn.name])
+            self._fixpoint(cls, mod, findings, graph, edge_lines)
+        findings.extend(self._cycles(mod, graph, edge_lines))
+        return findings
+
+    def _fixpoint(self, cls, mod, findings, graph, edge_lines) -> None:
+        # transitive acquires/blocking through same-class calls
+        acq_all = {m: set(s.acquires) for m, s in cls.methods.items()}
+        blk_all = {m: list(s.blocking) for m, s in cls.methods.items()}
+        changed = True
+        while changed:
+            changed = False
+            for m, s in cls.methods.items():
+                for _held, callee, _ln in s.self_calls:
+                    if callee not in acq_all:
+                        continue
+                    if not acq_all[callee] <= acq_all[m]:
+                        acq_all[m] |= acq_all[callee]
+                        changed = True
+                    for b in blk_all[callee]:
+                        if b not in blk_all[m]:
+                            blk_all[m].append(b)
+                            changed = True
+        for m, s in cls.methods.items():
+            findings.extend(s.findings)
+            qual = "%s.%s" % (cls.name, m)
+            for held, callee, ln in s.self_calls:
+                if not held or callee not in acq_all:
+                    continue
+                for taken in acq_all[callee]:
+                    for h in held:
+                        s.edges.append((h, taken, ln))
+                    if taken in held \
+                            and cls.locks.get(taken) != "rlock":
+                        findings.append(Finding(
+                            "CONC003", mod.path, ln, qual,
+                            "call to self.%s() re-acquires held "
+                            "non-reentrant lock self.%s" %
+                            (callee, taken)))
+                if blk_all[callee]:
+                    ln2, desc = blk_all[callee][0]
+                    findings.append(Finding(
+                        "CONC002", mod.path, ln, qual,
+                        "call to self.%s() (%s at line %d) while "
+                        "holding self.%s" %
+                        (callee, desc, ln2, held[-1])))
+            for h, t, ln in s.edges:
+                if h == t:
+                    continue
+                a = "%s.%s" % (cls.name, h)
+                b = "%s.%s" % (cls.name, t)
+                graph.setdefault(a, set()).add(b)
+                edge_lines.setdefault((a, b), ln)
+
+    def _cycles(self, mod, graph, edge_lines) -> List[Finding]:
+        findings: List[Finding] = []
+        seen_cycles: Set[frozenset] = set()
+        state: Dict[str, int] = {}   # 0 unseen 1 on-stack 2 done
+
+        def dfs(node, path):
+            state[node] = 1
+            for nxt in sorted(graph.get(node, ())):
+                if state.get(nxt, 0) == 1:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        ln = edge_lines.get((node, nxt), 0)
+                        findings.append(Finding(
+                            "CONC001", mod.path, ln, "<module>",
+                            "lock-acquisition cycle: %s"
+                            % " -> ".join(cyc)))
+                elif state.get(nxt, 0) == 0:
+                    dfs(nxt, path + [nxt])
+            state[node] = 2
+
+        for n in sorted(graph):
+            if state.get(n, 0) == 0:
+                dfs(n, [n])
+        return findings
+
+
+# ----------------------------------------------------------------------
+# SYNC
+
+class SyncChecker(Checker):
+    name = "SYNC"
+
+    def __init__(self, extra_hot: Sequence[str] = ()) -> None:
+        # extra_hot: "path::qualname" entries for hot paths that cannot
+        # carry the decorator (the config-list alternative)
+        self.extra_hot = set(extra_hot)
+
+    @staticmethod
+    def _is_hot(fn) -> bool:
+        for dec in fn.decorator_list:
+            d = dotted(dec) or (dotted(dec.func)
+                                if isinstance(dec, ast.Call) else None)
+            if d is not None and d.rsplit(".", 1)[-1] == "hot_path":
+                return True
+        return False
+
+    def check(self, mod: Module) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def visit(node, qual):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, qual + [child.name])
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    q = ".".join(qual + [child.name])
+                    if self._is_hot(child) \
+                            or "%s::%s" % (mod.path, q) \
+                            in self.extra_hot:
+                        self._check_hot(mod, q, child, findings)
+                    else:
+                        visit(child, qual + [child.name])
+
+        visit(mod.tree, [])
+        return findings
+
+    # host builtins whose result is a plain Python number — float()
+    # of these is arithmetic, not a device sync
+    _HOST_BUILTINS = {"max", "min", "len", "abs", "round", "sum",
+                      "ord", "str"}
+
+    @classmethod
+    def _computes_on_device(cls, node) -> bool:
+        """True when ``node`` could force a device value to host: a
+        subscript (``loss[0]``) or a call that is not a bare host
+        builtin — ``max(a, b)`` is arithmetic, ``out.mean()`` is a
+        device reduce (the builtin exemption is Name-calls only)."""
+        if isinstance(node, ast.Subscript):
+            return True
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name):
+                return node.func.id not in cls._HOST_BUILTINS
+            return True
+        return False
+
+    def _check_hot(self, mod, qual, fn, findings) -> None:
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = _call_name(sub)
+            leaf = d.rsplit(".", 1)[-1] if d else None
+            if leaf == "block_until_ready":
+                findings.append(Finding(
+                    "SYNC001", mod.path, sub.lineno, qual,
+                    "block_until_ready() in hot path"))
+            elif d in ("np.asarray", "numpy.asarray", "np.array",
+                       "numpy.array"):
+                findings.append(Finding(
+                    "SYNC002", mod.path, sub.lineno, qual,
+                    "%s(...) materializes to host in hot path" % d))
+            elif leaf == "item" and not sub.args \
+                    and isinstance(sub.func, ast.Attribute):
+                findings.append(Finding(
+                    "SYNC003", mod.path, sub.lineno, qual,
+                    ".item() host sync in hot path"))
+            elif isinstance(sub.func, ast.Name) \
+                    and sub.func.id in ("float", "int") and sub.args:
+                arg = sub.args[0]
+                if any(self._computes_on_device(x)
+                       for x in ast.walk(arg)):
+                    findings.append(Finding(
+                        "SYNC004", mod.path, sub.lineno, qual,
+                        "%s(...) of a computed value syncs in hot "
+                        "path" % sub.func.id))
+
+
+# ----------------------------------------------------------------------
+# OBS
+
+class ObsChecker(Checker):
+    name = "OBS"
+
+    METRIC_METHODS = {"counter", "gauge", "histogram"}
+
+    def check(self, mod: Module) -> List[Finding]:
+        if mod.path.endswith("obs/trace.py"):
+            return []   # the tracer's own definitions
+        findings: List[Finding] = []
+        managed: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    managed.add(id(item.context_expr))
+
+        def visit(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.ClassDef, ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    visit(child, stack + [child.name])
+                    continue
+                self._check_node(mod, child, stack, managed, findings)
+                visit(child, stack)
+
+        visit(mod.tree, [])
+        return findings
+
+    def _check_node(self, mod, node, stack, managed, findings) -> None:
+        qual = ".".join(stack) if stack else "<module>"
+        if not isinstance(node, ast.Call):
+            return
+        d = _call_name(node)
+        leaf = d.rsplit(".", 1)[-1] if d else None
+        if leaf == "span" and isinstance(node.func, ast.Attribute):
+            if id(node) not in managed:
+                findings.append(Finding(
+                    "OBS001", mod.path, node.lineno, qual,
+                    "span(...) not with-managed — an unmanaged span "
+                    "never records its exit"))
+            return
+        if leaf in self.METRIC_METHODS \
+                and isinstance(node.func, ast.Attribute) and node.args:
+            name_arg = node.args[0]
+            if isinstance(name_arg, ast.Constant) \
+                    and isinstance(name_arg.value, str):
+                name = name_arg.value
+                if not METRIC_NAME_RE.match(name):
+                    findings.append(Finding(
+                        "OBS002", mod.path, node.lineno, qual,
+                        "metric name %r breaks the cxxnet_[a-z0-9_]+ "
+                        "convention" % name))
+                elif leaf == "counter" and not name.endswith("_total"):
+                    findings.append(Finding(
+                        "OBS003", mod.path, node.lineno, qual,
+                        "counter %r must end in _total" % name))
+            labels = None
+            if len(node.args) >= 3:
+                labels = node.args[2]
+            for kw in node.keywords:
+                if kw.arg == "labelnames":
+                    labels = kw.value
+            if isinstance(labels, (ast.Tuple, ast.List)) \
+                    and len(labels.elts) > MAX_LABELS:
+                findings.append(Finding(
+                    "OBS004", mod.path, node.lineno, qual,
+                    "%d labels on one metric (max %d — cardinality "
+                    "is a product)" % (len(labels.elts), MAX_LABELS)))
+
+
+# ----------------------------------------------------------------------
+
+def all_checkers(extra_hot: Sequence[str] = ()) -> List[Checker]:
+    return [ConcChecker(), SyncChecker(extra_hot), ObsChecker()]
+
+
+def check_source(source: str, path: str = "<snippet>.py",
+                 extra_hot: Sequence[str] = ()) -> List[Finding]:
+    """Lint one source string (the fixture-test entry point)."""
+    mod = Module(path, source)
+    out: List[Finding] = []
+    for c in all_checkers(extra_hot):
+        out.extend(c.check(mod))
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def iter_py_files(root: str,
+                  subdirs: Sequence[str] = ("cxxnet_tpu", "tools"),
+                  extra_files: Sequence[str] = ("bench.py",)
+                  ) -> List[str]:
+    """Repo-relative paths of the tree the gate lints."""
+    out: List[str] = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fn), root))
+    for f in extra_files:
+        if os.path.exists(os.path.join(root, f)):
+            out.append(f)
+    return sorted(p.replace(os.sep, "/") for p in out)
+
+
+def check_tree(root: str, paths: Optional[Sequence[str]] = None,
+               extra_hot: Sequence[str] = ()) -> List[Finding]:
+    """Lint every file (repo-relative ``paths``, default the standard
+    tree) under ``root``; unparseable files become a PARSE finding
+    rather than an exception."""
+    findings: List[Finding] = []
+    checkers = all_checkers(extra_hot)
+    for rel in (paths if paths is not None else iter_py_files(root)):
+        full = os.path.join(root, rel)
+        try:
+            with open(full, "r", encoding="utf-8") as f:
+                mod = Module(rel, f.read())
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding("PARSE", rel, 0, "<module>",
+                                    "cannot lint: %s" % e))
+            continue
+        for c in checkers:
+            findings.extend(c.check(mod))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

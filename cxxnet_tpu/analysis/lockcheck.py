@@ -1,0 +1,381 @@
+"""Lockdep-style runtime lock validation (docs/analysis.md).
+
+The static CONC lint (analysis/lint.py) proves what it can see in the
+AST; this module proves what actually happens at runtime, the way the
+kernel's lockdep and ThreadSanitizer do it: every instrumented lock
+acquisition records an ordering edge from each lock the thread already
+holds to the one it is taking, keyed by the lock's NAME (its "lock
+class" — all ``Request._flock`` instances are one node), into one
+process-global graph. A new edge that closes a cycle is an AB/BA
+deadlock someone will eventually hit, reported the first time the
+*order* occurs — no need to lose the actual race.
+
+Checks:
+
+* **order-cycle** — edge A→B recorded when B ⇝ A already exists.
+* **same-name-nested** — two *instances* of one lock class nested
+  (the N-replicas version of A→A; a real AB/BA hazard between peers).
+* **self-deadlock** — a thread re-acquiring a non-reentrant lock it
+  already owns (raises: proceeding would hang the suite).
+* **held-too-long** — a lock held beyond ``held_warn_s`` wall seconds
+  (a blocking call under a lock shows up here even when the static
+  checker could not see it). ``Condition.wait`` releases the lock and
+  so correctly resets the clock.
+
+The seam: serve/* and io/prefetch.py create their locks through
+``make_lock / make_rlock / make_condition / make_queue``. With no
+monitor enabled (production default) these return plain ``threading``
+/ ``queue`` primitives — the only cost is one branch at lock
+*creation*; acquire/release run untouched stdlib code. Tests and
+tools/serve_chaos.py call :func:`enable` first, so every lock built
+afterwards is instrumented. Objects created *before* ``enable()``
+stay uninstrumented — enable the monitor before building engines.
+"""
+
+from __future__ import annotations
+
+import queue as _queue_mod
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+MAX_VIOLATIONS = 200
+
+
+class LockCheckError(RuntimeError):
+    """Raised for violations that cannot safely proceed (a thread
+    re-acquiring a non-reentrant lock it owns would simply hang)."""
+
+
+class Violation:
+    """One recorded discipline violation."""
+
+    __slots__ = ("kind", "msg", "thread", "t")
+
+    def __init__(self, kind: str, msg: str) -> None:
+        self.kind = kind
+        self.msg = msg
+        self.thread = threading.current_thread().name
+        self.t = time.time()
+
+    def __repr__(self) -> str:
+        return "<%s [%s] %s>" % (self.kind, self.thread, self.msg)
+
+
+class LockMonitor:
+    """The global acquisition-order graph + per-thread held sets.
+
+    One monitor watches every lock created through it; the graph is
+    keyed by lock NAME so N same-named instances (N replicas' engine
+    locks) share one node, exactly like lockdep lock classes."""
+
+    def __init__(self, held_warn_s: float = 1.0) -> None:
+        self.held_warn_s = float(held_warn_s)
+        self._mlock = threading.Lock()   # guards graph + violations
+        self._edges: Dict[str, Set[str]] = {}
+        self._edge_sites: Dict[Tuple[str, str], str] = {}
+        self._violations: List[Violation] = []
+        self._tls = threading.local()
+        self.created = 0                 # locks built through the seam
+
+    # -- factories -----------------------------------------------------
+    def lock(self, name: str) -> "_ILock":
+        self.created += 1
+        return _ILock(self, str(name))
+
+    def rlock(self, name: str) -> "_IRLock":
+        self.created += 1
+        return _IRLock(self, str(name))
+
+    def condition(self, name: str, lock=None) -> threading.Condition:
+        """A Condition over an instrumented lock: ``wait()`` releases
+        (and so resets the held clock on) the underlying lock, exactly
+        like the plain primitive."""
+        return threading.Condition(lock if lock is not None
+                                   else self.lock(name))
+
+    def queue(self, name: str, maxsize: int = 0) -> _queue_mod.Queue:
+        """A ``queue.Queue`` whose internal mutex (shared by its three
+        conditions) is instrumented — a blocking ``get``/``put`` made
+        while holding another instrumented lock becomes an ordering
+        edge, and a queue operation never shows up as held-too-long
+        because the condition waits release the mutex."""
+        q = _queue_mod.Queue(maxsize)
+        m = self.lock(name)
+        q.mutex = m
+        q.not_empty = threading.Condition(m)
+        q.not_full = threading.Condition(m)
+        q.all_tasks_done = threading.Condition(m)
+        return q
+
+    # -- inspection ----------------------------------------------------
+    def violations(self) -> List[Violation]:
+        with self._mlock:
+            return list(self._violations)
+
+    def edges(self) -> Dict[str, Set[str]]:
+        with self._mlock:
+            return {a: set(bs) for a, bs in self._edges.items()}
+
+    def held_now(self) -> List[str]:
+        return [n for n, _ in getattr(self._tls, "held", [])]
+
+    def reset(self) -> None:
+        with self._mlock:
+            self._edges.clear()
+            self._edge_sites.clear()
+            self._violations.clear()
+
+    def assert_clean(self) -> None:
+        v = self.violations()
+        if v:
+            raise AssertionError(
+                "lockcheck recorded %d violation(s):\n  %s"
+                % (len(v), "\n  ".join(map(repr, v))))
+
+    # -- recording (called from instrumented locks) --------------------
+    def _violate(self, kind: str, msg: str) -> None:
+        with self._mlock:
+            if len(self._violations) < MAX_VIOLATIONS:
+                self._violations.append(Violation(kind, msg))
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _reaches(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS: a path src ⇝ dst in the current edge set, or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._edges.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _acquired(self, name: str) -> None:
+        held = self._held()
+        if held:
+            tname = threading.current_thread().name
+            # collected under _mlock, appended inside the same hold:
+            # _violate() itself takes _mlock, so calling it from here
+            # would self-deadlock — the exact bug class this module
+            # exists to catch (and CONC003 flags statically)
+            found = []
+            with self._mlock:
+                for h, _t in held:
+                    if h == name:
+                        found.append(Violation(
+                            "same-name-nested",
+                            "two instances of lock class %r nested "
+                            "(AB/BA hazard between peers)" % name))
+                        continue
+                    if name not in self._edges.get(h, ()):
+                        path = self._reaches(name, h)
+                        if path is not None:
+                            found.append(Violation(
+                                "order-cycle",
+                                "acquiring %r while holding %r, but "
+                                "the reverse order %s is already "
+                                "established (first seen: %s)"
+                                % (name, h, " -> ".join(path + [name]),
+                                   self._edge_sites.get(
+                                       (path[0], path[1]), "?")
+                                   if len(path) > 1 else "?")))
+                        self._edges.setdefault(h, set()).add(name)
+                        self._edge_sites.setdefault(
+                            (h, name), "thread %s" % tname)
+                room = MAX_VIOLATIONS - len(self._violations)
+                if room > 0:
+                    self._violations.extend(found[:room])
+        held.append((name, time.perf_counter()))
+
+    def _released(self, name: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == name:
+                _, t0 = held.pop(i)
+                dur = time.perf_counter() - t0
+                if dur > self.held_warn_s:
+                    self._violate(
+                        "held-too-long",
+                        "%r held for %.3fs (warn threshold %.3fs) — "
+                        "blocking work under a lock" %
+                        (name, dur, self.held_warn_s))
+                return
+        # release of a lock this thread never recorded: a foreign
+        # release (another thread's lock) — a discipline break itself
+        self._violate("foreign-release",
+                      "release of %r by a thread that never "
+                      "acquired it" % name)
+
+
+class _ILock:
+    """Instrumented non-reentrant lock: the full ``threading.Lock``
+    surface plus ``_is_owned`` (so ``threading.Condition`` accepts it
+    without probing)."""
+
+    def __init__(self, mon: LockMonitor, name: str) -> None:
+        self._mon = mon
+        self.name = name
+        self._inner = threading.Lock()
+        self._owner: Optional[int] = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1
+                ) -> bool:
+        me = threading.get_ident()
+        if blocking and self._owner == me:
+            self._mon._violate(
+                "self-deadlock",
+                "thread re-acquiring non-reentrant lock %r it "
+                "already holds" % self.name)
+            raise LockCheckError(
+                "self-deadlock on lock %r" % self.name)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._owner = me
+            self._mon._acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        if self._owner != threading.get_ident():
+            self._mon._violate(
+                "foreign-release",
+                "lock %r released by a non-owner thread" % self.name)
+        self._owner = None
+        self._inner.release()
+        self._mon._released(self.name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _is_owned(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return "<ILock %r %s>" % (
+            self.name, "locked" if self.locked() else "unlocked")
+
+
+class _IRLock:
+    """Instrumented reentrant lock. Re-entry by the owner records
+    nothing (one held entry per outermost acquire); provides the
+    ``_release_save``/``_acquire_restore``/``_is_owned`` protocol so
+    ``threading.Condition`` fully releases it across ``wait()``."""
+
+    def __init__(self, mon: LockMonitor, name: str) -> None:
+        self._mon = mon
+        self.name = name
+        self._inner = threading.RLock()
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1
+                ) -> bool:
+        me = threading.get_ident()
+        if self._owner == me:
+            self._inner.acquire(blocking, timeout)
+            self._count += 1
+            return True
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._owner, self._count = me, 1
+            self._mon._acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        if self._owner != threading.get_ident():
+            raise RuntimeError("cannot release un-acquired lock")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+            self._inner.release()
+            self._mon._released(self.name)
+        else:
+            self._inner.release()
+
+    def _is_owned(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def _release_save(self):
+        count = self._count
+        self._owner, self._count = None, 0
+        for _ in range(count):
+            self._inner.release()
+        self._mon._released(self.name)
+        return count
+
+    def _acquire_restore(self, count) -> None:
+        for _ in range(count):
+            self._inner.acquire()
+        self._owner, self._count = threading.get_ident(), count
+        self._mon._acquired(self.name)
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return "<IRLock %r count=%d>" % (self.name, self._count)
+
+
+# ----------------------------------------------------------------------
+# module seam: what serve/* and io/prefetch.py actually call
+
+_active: Optional[LockMonitor] = None
+
+
+def enable(held_warn_s: float = 1.0) -> LockMonitor:
+    """Install a fresh process-global monitor; locks created through
+    the ``make_*`` seam AFTER this call are instrumented."""
+    global _active
+    _active = LockMonitor(held_warn_s=held_warn_s)
+    return _active
+
+
+def disable() -> Optional[LockMonitor]:
+    """Uninstall and return the monitor (its graph/violations stay
+    readable); subsequent ``make_*`` calls return plain primitives."""
+    global _active
+    m = _active
+    _active = None
+    return m
+
+
+def active() -> Optional[LockMonitor]:
+    return _active
+
+
+def make_lock(name: str):
+    m = _active
+    return threading.Lock() if m is None else m.lock(name)
+
+
+def make_rlock(name: str):
+    m = _active
+    return threading.RLock() if m is None else m.rlock(name)
+
+
+def make_condition(name: str):
+    m = _active
+    return threading.Condition() if m is None else m.condition(name)
+
+
+def make_queue(name: str, maxsize: int = 0):
+    m = _active
+    return (_queue_mod.Queue(maxsize) if m is None
+            else m.queue(name, maxsize))

@@ -17,6 +17,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from . import checkpoint, config
+from .analysis import hot_path
 from .io import DataIterator, create_iterator
 from .profiler import StepTimer, TraceSession, device_memory_summary
 from .trainer import GroupStager, StagedBatch, Trainer
@@ -562,11 +563,14 @@ class LearnTask:
         gstagers = [GroupStager(self.trainer),
                     GroupStager(self.trainer)] if use_groups else None
 
+        @hot_path
         def dispatch(group, sample_counter):
             # group: a list of per-batch StagedBatch, or one fused
             # StagedBatch group. dispatch is async: the call returns
             # while the device computes, so the next batches'
             # transfers (helper thread) overlap this group's step(s)
+            # (@hot_path: the SYNC lint gate keeps host syncs out —
+            # a float()/np.asarray() here would serialize the loop)
             if isinstance(group, StagedBatch):
                 n = group.fused or 1
                 with self.trace.step(n), \

@@ -38,6 +38,8 @@ from collections import deque
 from typing import Optional
 
 from . import DataIterator, ProducerFailure, drain_producer
+from ..analysis import hot_path
+from ..analysis import lockcheck as _lockcheck
 from ..metrics import StallClock
 from ..obs import trace as _trace
 
@@ -180,6 +182,7 @@ class ParallelDecodeIterator:
                     ("f", self._pool.submit(_decode_task, idx, label,
                                             val)))
 
+    @hot_path
     def next(self) -> bool:
         if self._workers <= 0:
             # serial passthrough: same read + decode path, no pool —
@@ -317,6 +320,7 @@ class DevicePrefetchIterator:
             tr.complete("feed.backpressure", "feed", t0,
                         t0 + dt)
 
+    @hot_path
     def _produce(self, q, gen) -> None:
         from ..trainer import GroupStager
         tr = self.trainer
@@ -387,7 +391,6 @@ class DevicePrefetchIterator:
 
     # ------------------------------------------------------------------
     def before_first(self) -> None:
-        import queue as queue_mod
         import threading
         # bump the generation FIRST so a mid-epoch producer cancels at
         # its next loop check rather than staging out the whole epoch
@@ -396,12 +399,14 @@ class DevicePrefetchIterator:
             # restart mid-epoch: drain the old producer out (its staged
             # device buffers are simply dropped)
             drain_producer(self._queue, self._thread)
-        self._queue = queue_mod.Queue(maxsize=self.depth)
+        self._queue = _lockcheck.make_queue("io.prefetch.stage",
+                                            maxsize=self.depth)
         self._thread = threading.Thread(
             target=self._produce, args=(self._queue, self._gen),
             name="dev-prefetch", daemon=True)
         self._thread.start()
 
+    @hot_path
     def next(self) -> bool:
         if self._queue is None:
             self.before_first()

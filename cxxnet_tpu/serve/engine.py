@@ -68,6 +68,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..analysis import hot_path
+from ..analysis import lockcheck as _lockcheck
 from ..obs import trace as _trace
 from ..obs.registry import Registry
 from .stats import ServeStats
@@ -122,7 +124,7 @@ class Request:
         self.t_infer: Optional[float] = None      # device submit done
         self.t_done: Optional[float] = None       # answer materialized
         self._event = threading.Event()
-        self._flock = threading.Lock()
+        self._flock = _lockcheck.make_lock("serve.request.flock")
         self._value = None
         self._error: Optional[BaseException] = None
 
@@ -450,7 +452,7 @@ class ServingEngine:
         self._warmed = False
         self.warmup_runs = 0
         self._q: deque = deque()
-        self._cond = threading.Condition()
+        self._cond = _lockcheck.make_condition("serve.engine.cond")
         self._closed = False
         self._draining = False
         self._started = False
@@ -458,7 +460,7 @@ class ServingEngine:
         # drain() waits on it and can fail exactly the stragglers; the
         # first-finisher-wins Request._finish keeps it consistent when
         # a drain races an in-flight completion
-        self._live_lock = threading.Lock()
+        self._live_lock = _lockcheck.make_lock("serve.engine.live")
         self._live: set = set()
         # per-bucket free-lists of preallocated input buffers: a buffer
         # leaves the pool at pack time and returns once its batch's
@@ -466,7 +468,8 @@ class ServingEngine:
         # a buffer being refilled (bounded by dispatch_depth + 1)
         self._pool = {b: deque() for b in self.buckets}
         self._inflight: Optional[queue.Queue] = (
-            queue.Queue(maxsize=self.dispatch_depth)
+            _lockcheck.make_queue("serve.engine.inflight",
+                                  maxsize=self.dispatch_depth)
             if self.dispatch_depth > 0 else None)
         self._thread = threading.Thread(
             target=self._loop, name="serve-dispatch", daemon=True)
@@ -648,6 +651,7 @@ class ServingEngine:
                 "admission)" % (1000.0 * (now - r.t_submit))))
         return len(dead)
 
+    @hot_path
     def _admit(self, req: Request) -> None:
         with self._cond:
             if self._closed:
@@ -701,6 +705,7 @@ class ServingEngine:
         return _pick_bucket(self.buckets, rows)
 
     # ------------------------------------------------------------------
+    @hot_path
     def _gather(self) -> Optional[List[Request]]:
         """Take the oldest request, coalesce whole follow-ups FIFO until
         row-full or max_wait elapses. None = closed and drained."""
@@ -726,6 +731,7 @@ class ServingEngine:
                 self._cond.wait(left)
             return taken
 
+    @hot_path
     def _dispatch(self, reqs: List[Request]) -> None:
         now = time.monotonic()
         live = []
@@ -805,6 +811,7 @@ class ServingEngine:
         else:
             self._finish_batch(pend)
 
+    @hot_path
     def _finish_batch(self, pend: _Pending) -> None:
         """Materialize the device result, trim, answer every request.
         Runs on the completion thread (pipelined) or inline (serial)."""
@@ -845,6 +852,7 @@ class ServingEngine:
                              {"request_id": r.id}):
                     tr.flow_end("request", r.seq, "serve")
 
+    @hot_path
     def _run_forward(self, live: List[Request], buf: np.ndarray):
         lo = 0
         for r in live:
@@ -855,6 +863,7 @@ class ServingEngine:
         # and not touching it is the zero-copy point
         return self.callee.run_exact(buf)
 
+    @hot_path
     def _run_decode(self, live: List[Request], buf):
         c = self.callee
         toks, lens = buf

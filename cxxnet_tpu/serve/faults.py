@@ -35,9 +35,10 @@ the chaos smoke kills a replica in the middle of a load window.
 from __future__ import annotations
 
 import random
-import threading
 import time
 from typing import Dict, List, Optional
+
+from ..analysis import lockcheck as _lockcheck
 
 
 class FaultError(RuntimeError):
@@ -71,7 +72,7 @@ class FaultInjector:
     replica set (``ReplicaSet(fault=injector)``)."""
 
     def __init__(self, seed: int = 0):
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("serve.faults.lock")
         self._rng = random.Random(int(seed))
         self._rules: Dict[str, List[_Rule]] = {}
         self._count: Dict[str, int] = {}

@@ -45,6 +45,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..analysis import lockcheck as _lockcheck
 from ..obs import trace as _trace
 from ..obs.registry import Registry
 from .engine import ServingEngine
@@ -77,7 +78,8 @@ class Replica:
         self.backoff_s = 0.0
         self.next_probe = 0.0
         self.t_healthy: Optional[float] = None
-        self._olock = threading.Lock()
+        self.probe_inflight = False   # guarded by the set's lock
+        self._olock = _lockcheck.make_lock("serve.replica.outstanding")
         self.outstanding = 0
 
     def note_outstanding(self, d: int) -> None:
@@ -162,7 +164,7 @@ class ReplicaSet:
         self._supervise = bool(supervise)
         self._prefix = name_prefix
         self._seq = itertools.count(1)
-        self._lock = threading.RLock()
+        self._lock = _lockcheck.make_rlock("serve.replicaset.lock")
         self.replicas: List[Replica] = [
             Replica("%s%d" % (self._prefix, next(self._seq)),
                     factory, self.version) for _ in range(n)]
@@ -310,6 +312,14 @@ class ReplicaSet:
                     return r.engine
         return None
 
+    def snapshot(self) -> List[Replica]:
+        """A locked copy of the replica list. Everything that iterates
+        replicas off the set's own lock (router healthz/metrics/drain/
+        swap) reads this — ``spawn``/``detach`` mutate the live list
+        concurrently (audit finding, r8)."""
+        with self._lock:
+            return list(self.replicas)
+
     def state_counts(self) -> Dict[str, int]:
         with self._lock:
             out: Dict[str, int] = {}
@@ -374,37 +384,36 @@ class ReplicaSet:
             rep.error = e
             return False
 
-    def tick(self, now: Optional[float] = None) -> None:
+    def tick(self, now: Optional[float] = None,
+             block: bool = True) -> None:
         """One supervisor step: probe degraded replicas whose backoff
-        expired; declare replicas with a dead dispatch thread dead."""
+        expired; declare replicas with a dead dispatch thread dead.
+
+        ``block=False`` (the supervisor's mode) runs each due probe on
+        its own short-lived thread: a probe is a REAL request and can
+        block for up to ``probe_timeout_s``, so probing serially on
+        the supervisor thread let one hung replica stall its siblings'
+        probes and dead-thread detection for the whole window — the
+        head-of-line blocking the analysis audit (r8) surfaced. A
+        per-replica in-flight flag keeps slow probes from stacking.
+        ``block=True`` (default) probes inline — deterministic for
+        tests and administrative calls."""
         now = time.monotonic() if now is None else now
         with self._lock:
             reps = list(self.replicas)
         for rep in reps:
             if rep.state == DEGRADED and now >= rep.next_probe:
-                ok = self._probe(rep)
                 with self._lock:
-                    if rep.state != DEGRADED:
-                        continue   # drained/killed while probing
-                    if ok:
-                        rep.state = HEALTHY
-                        rep.t_healthy = time.monotonic()
-                        rep.failures = 0
-                        rep.probe_failures = 0
-                        rep.backoff_s = 0.0
-                        _trace.instant("replica.readmitted", "replica",
-                                       {"replica": rep.name})
-                    else:
-                        rep.probe_failures += 1
-                        rep.backoff_s = min(
-                            max(rep.backoff_s, self.backoff_s) * 2.0,
-                            self.backoff_max_s)
-                        rep.next_probe = time.monotonic() \
-                            + rep.backoff_s
-                        if self.dead_after is not None \
-                                and rep.probe_failures \
-                                >= self.dead_after:
-                            self._mark_dead(rep)
+                    if rep.probe_inflight:
+                        continue
+                    rep.probe_inflight = True
+                if block:
+                    self._probe_and_score(rep)
+                else:
+                    threading.Thread(
+                        target=self._probe_and_score, args=(rep,),
+                        name="replica-%s-probe" % rep.name,
+                        daemon=True).start()
             elif rep.state == HEALTHY and rep.engine is not None \
                     and rep.engine._started \
                     and not rep.engine._thread.is_alive():
@@ -412,6 +421,37 @@ class ReplicaSet:
                 # answer; the strongest possible failure signal
                 with self._lock:
                     self._mark_dead(rep)
+
+    def _probe_and_score(self, rep: Replica) -> None:
+        """Run one heartbeat probe (blocking, possibly for the full
+        probe timeout) and apply its verdict under the set lock."""
+        try:
+            ok = self._probe(rep)
+            with self._lock:
+                if rep.state != DEGRADED:
+                    return   # drained/killed while probing
+                if ok:
+                    rep.state = HEALTHY
+                    rep.t_healthy = time.monotonic()
+                    rep.failures = 0
+                    rep.probe_failures = 0
+                    rep.backoff_s = 0.0
+                    _trace.instant("replica.readmitted", "replica",
+                                   {"replica": rep.name})
+                else:
+                    rep.probe_failures += 1
+                    rep.backoff_s = min(
+                        max(rep.backoff_s, self.backoff_s) * 2.0,
+                        self.backoff_max_s)
+                    rep.next_probe = time.monotonic() \
+                        + rep.backoff_s
+                    if self.dead_after is not None \
+                            and rep.probe_failures \
+                            >= self.dead_after:
+                        self._mark_dead(rep)
+        finally:
+            with self._lock:
+                rep.probe_inflight = False
 
     def _mark_dead(self, rep: Replica) -> None:
         # caller holds the lock (or is the lock-free init path)
@@ -484,7 +524,9 @@ class ReplicaSet:
     def _run(self) -> None:
         while not self._stop.wait(self.heartbeat_s):
             try:
-                self.tick()
+                # block=False: one wedged probe must not freeze the
+                # heartbeat for every other replica
+                self.tick(block=False)
             except Exception:
                 # the supervisor must outlive any one bad tick
                 traceback.print_exc(file=sys.stderr)

@@ -39,6 +39,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..analysis import hot_path
+from ..analysis import lockcheck as _lockcheck
 from ..metrics import StreamingQuantile
 from ..obs import trace as _trace
 from ..obs.registry import Registry
@@ -181,11 +183,11 @@ class Router:
                             else shed_at)
         self.registry = registry if registry is not None \
             else replicas.registry
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("serve.router.lock")
         self._outstanding = 0
         self._draining = False
         self._closed = False
-        self._swap_lock = threading.Lock()
+        self._swap_lock = _lockcheck.make_lock("serve.router.swap")
         self._lat = StreamingQuantile(1024)
         self._t0 = time.monotonic()
         self.counts: Dict[str, int] = {
@@ -286,7 +288,7 @@ class Router:
         info = {"ok": self.state == "serving", "state": self.state,
                 "version": self.version, "kind": self.kind,
                 "replicas": {r.name: r.describe()
-                             for r in self.rs.replicas},
+                             for r in self.rs.snapshot()},
                 "queue_depth": self.queue_depth}
         eng = self.rs.any_engine()
         if eng is not None:
@@ -328,7 +330,7 @@ class Router:
                 "p99": 1000.0 * p99 if n else 0.0,
             },
             "replicas": {r.name: r.describe()
-                         for r in self.rs.replicas},
+                         for r in self.rs.snapshot()},
         }
 
     # ------------------------------------------------------------------
@@ -356,6 +358,7 @@ class Router:
         return self._admit("submit_tokens", (tokens, lens, seed),
                            priority, timeout_ms)
 
+    @hot_path
     def _admit(self, method: str, args: tuple, priority,
                timeout_ms) -> RouterRequest:
         if self._closed:
@@ -426,6 +429,7 @@ class Router:
             with self._lock:
                 self._outstanding -= 1
 
+    @hot_path
     def _attempts(self, req: RouterRequest, caller_timeout):
         excluded = set()
         failures = 0
@@ -586,7 +590,7 @@ class Router:
                         break
                 time.sleep(0.005)
             n = 0
-            for rep in list(self.rs.replicas):
+            for rep in self.rs.snapshot():
                 if rep.engine is not None and rep.state != DEAD:
                     n += rep.engine.drain(
                         max(deadline - time.monotonic(), 0.0))
@@ -603,7 +607,7 @@ class Router:
         replica count. Raises (and stops rolling) if a spare fails to
         warm — the old replicas keep serving."""
         with self._swap_lock:
-            olds = [r for r in self.rs.replicas
+            olds = [r for r in self.rs.snapshot()
                     if r.state != DEAD and r.version != str(version)]
             with _trace.span("router.swap", "router",
                              {"version": str(version),
@@ -624,7 +628,7 @@ class Router:
                 self._count("swaps")
         return {"ok": True, "version": self.version,
                 "replicas": {r.name: r.describe()
-                             for r in self.rs.replicas}}
+                             for r in self.rs.snapshot()}}
 
     def swap_artifact(self, path: str, version: Optional[str] = None,
                       drain_timeout: float = 30.0) -> dict:

@@ -36,16 +36,16 @@ never two sets of counters that can drift.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional
 
+from ..analysis import lockcheck as _lockcheck
 from ..metrics import StreamingQuantile
 
 
 class ServeStats:
     def __init__(self, window: int = 1024) -> None:
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.make_lock("serve.stats.lock")
         self._t0 = time.monotonic()
         self._lat = StreamingQuantile(window)
         self._lat_sum = 0.0

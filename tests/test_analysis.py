@@ -1,0 +1,413 @@
+"""The analysis gate (cxxnet_tpu/analysis/lint.py +
+tools/analysis_gate.py): every checker rule proven against a fixture
+snippet that must trigger it AND a near-miss negative that must stay
+clean, the waiver mechanics, and the standing tier-1 gate itself —
+the whole tree lints clean against the committed baseline. Pure AST
+work: no jax, budget well under 10s."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+from cxxnet_tpu.analysis import lint
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+from analysis_gate import load_waivers, run_gate  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def findings(src, **kw):
+    return lint.check_source(textwrap.dedent(src), **kw)
+
+
+def rules(src, **kw):
+    return [f.rule for f in findings(src, **kw)]
+
+
+# ----------------------------------------------------------------------
+# CONC: lock graph + blocking under lock
+
+
+def test_conc_cycle_detected_and_acyclic_clean():
+    cycle = """
+    import threading
+    class C:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+        def one(self):
+            with self.a:
+                with self.b:
+                    pass
+        def two(self):
+            with self.b:
+                with self.a:
+                    pass
+    """
+    assert "CONC001" in rules(cycle)
+    acyclic = cycle.replace(
+        "with self.b:\n                with self.a:",
+        "with self.a:\n                with self.b:")
+    assert "CONC001" not in rules(acyclic)
+
+
+def test_conc_cycle_via_method_call():
+    """The AB/BA hidden behind a same-class call: one() nests a->b
+    directly, two() holds b and CALLS a method that takes a."""
+    src = """
+    import threading
+    class C:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+        def takes_a(self):
+            with self.a:
+                pass
+        def one(self):
+            with self.a:
+                with self.b:
+                    pass
+        def two(self):
+            with self.b:
+                self.takes_a()
+    """
+    assert "CONC001" in rules(src)
+
+
+def test_conc_blocking_under_lock():
+    src = """
+    import threading, time
+    class C:
+        def __init__(self):
+            self.lock = threading.Lock()
+        def bad(self):
+            with self.lock:
+                time.sleep(0.1)
+    """
+    out = findings(src)
+    assert [f.rule for f in out] == ["CONC002"]
+    assert out[0].func == "C.bad"
+    # near miss: the sleep outside the with is legal
+    ok = """
+    import threading, time
+    class C:
+        def __init__(self):
+            self.lock = threading.Lock()
+        def good(self):
+            with self.lock:
+                x = 1
+            time.sleep(0.1)
+    """
+    assert rules(ok) == []
+
+
+def test_conc_blocking_via_self_call():
+    src = """
+    import threading, time
+    class C:
+        def __init__(self):
+            self.lock = threading.Lock()
+        def slow(self):
+            time.sleep(0.5)
+        def bad(self):
+            with self.lock:
+                self.slow()
+    """
+    assert "CONC002" in rules(src)
+
+
+def test_conc_queue_and_join_and_result_under_lock():
+    src = """
+    import threading, queue
+    class C:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.q = queue.Queue(4)
+            self._thread = threading.Thread(target=print)
+        def bad_put(self):
+            with self.lock:
+                self.q.put(1)
+        def bad_join(self):
+            with self.lock:
+                self._thread.join()
+        def bad_result(self, fut):
+            with self.lock:
+                fut.result()
+    """
+    assert rules(src).count("CONC002") == 3
+    # near misses: non-blocking put, string join, dict get
+    ok = """
+    import threading, queue
+    class C:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.q = queue.Queue(4)
+        def ok_put(self):
+            with self.lock:
+                self.q.put(1, block=False)
+        def ok_join(self, parts):
+            with self.lock:
+                return ", ".join(parts)
+        def ok_get(self, d):
+            with self.lock:
+                return d.get("k", 0)
+    """
+    assert rules(ok) == []
+
+
+def test_conc_cond_wait_on_held_condition_is_exempt():
+    """Condition.wait RELEASES the held lock — the one blocking call
+    that is correct under its own lock (the engine's _gather)."""
+    ok = """
+    import threading
+    class C:
+        def __init__(self):
+            self.cond = threading.Condition()
+        def gather(self):
+            with self.cond:
+                self.cond.wait(0.05)
+    """
+    assert rules(ok) == []
+    # .wait on anything ELSE while holding a lock still flags
+    bad = """
+    import threading
+    class C:
+        def __init__(self):
+            self.cond = threading.Condition()
+            self.ev = threading.Event()
+        def bad(self):
+            with self.cond:
+                self.ev.wait(1.0)
+    """
+    assert "CONC002" in rules(bad)
+
+
+def test_conc_self_deadlock_and_rlock_exemption():
+    bad = """
+    import threading
+    class C:
+        def __init__(self):
+            self.lock = threading.Lock()
+        def outer(self):
+            with self.lock:
+                with self.lock:
+                    pass
+    """
+    assert "CONC003" in rules(bad)
+    ok = bad.replace("threading.Lock()", "threading.RLock()")
+    assert rules(ok) == []
+
+
+def test_conc_recognizes_lockcheck_seam_factories():
+    src = """
+    from cxxnet_tpu.analysis import lockcheck as _lockcheck
+    import time
+    class C:
+        def __init__(self):
+            self.lock = _lockcheck.make_lock("c.lock")
+        def bad(self):
+            with self.lock:
+                time.sleep(0.1)
+    """
+    assert "CONC002" in rules(src)
+
+
+# ----------------------------------------------------------------------
+# SYNC: host syncs in hot paths
+
+
+HOT_TMPL = """
+from cxxnet_tpu.analysis import hot_path
+import numpy as np
+@hot_path
+def hot(x):
+    %s
+def cold(x):
+    %s
+"""
+
+
+@pytest.mark.parametrize("stmt,rule", [
+    ("x.block_until_ready()", "SYNC001"),
+    ("y = np.asarray(x)", "SYNC002"),
+    ("y = np.array(x)", "SYNC002"),
+    ("y = x.item()", "SYNC003"),
+    ("y = float(x[0])", "SYNC004"),
+    ("y = int(x.sum())", "SYNC004"),
+])
+def test_sync_constructs_flagged_in_hot_only(stmt, rule):
+    out = findings(HOT_TMPL % (stmt, stmt))
+    assert [f.rule for f in out] == [rule]
+    assert out[0].func == "hot"   # the cold copy stays clean
+
+
+def test_sync_host_arithmetic_not_flagged():
+    """float(max(...)) is host arithmetic, not a device sync — the
+    Router._admit shape that must NOT trip the gate."""
+    ok = HOT_TMPL % ("y = x / float(max(len(x), 1))",
+                     "pass")
+    assert rules(ok) == []
+
+
+def test_sync_config_list_marks_hot_without_decorator():
+    src = """
+    import numpy as np
+    def loop(x):
+        return np.asarray(x)
+    """
+    assert rules(src) == []
+    assert rules(src, path="m.py",
+                 extra_hot=["m.py::loop"]) == ["SYNC002"]
+
+
+# ----------------------------------------------------------------------
+# OBS: span + metric conventions
+
+
+def test_obs_unmanaged_span_flagged_with_managed_clean():
+    bad = """
+    from cxxnet_tpu.obs import trace as _trace
+    def f():
+        _trace.span("work", "app")
+    """
+    assert rules(bad) == ["OBS001"]
+    ok = """
+    from cxxnet_tpu.obs import trace as _trace
+    def f():
+        with _trace.span("work", "app"):
+            pass
+    """
+    assert rules(ok) == []
+
+
+def test_obs_metric_name_conventions():
+    bad = """
+    def f(reg):
+        reg.gauge("serve_queue_depth", "no prefix")
+        reg.counter("cxxnet_requests", "counter w/o _total")
+        reg.gauge("cxxnet_ok_metric", "fine")
+    """
+    assert sorted(rules(bad)) == ["OBS002", "OBS003"]
+
+
+def test_obs_label_cardinality():
+    bad = """
+    def f(reg):
+        reg.gauge("cxxnet_g", "too many",
+                  ("a", "b", "c", "d", "e"))
+    """
+    assert rules(bad) == ["OBS004"]
+    ok = bad.replace('("a", "b", "c", "d", "e")', '("a", "b")')
+    assert rules(ok) == []
+
+
+# ----------------------------------------------------------------------
+# gate + waivers
+
+
+def test_waiver_roundtrip(tmp_path):
+    w = tmp_path / "waivers.txt"
+    w.write_text("# comment\n"
+                 "CONC002 pkg/m.py::C.bad deliberate, reason here\n"
+                 "SYNC002 pkg/gone.py::old.fn stale entry\n")
+    waivers = load_waivers(str(w))
+    assert waivers == {
+        "CONC002 pkg/m.py::C.bad": "deliberate, reason here",
+        "SYNC002 pkg/gone.py::old.fn": "stale entry"}
+
+
+def test_waiver_bad_line_raises(tmp_path):
+    w = tmp_path / "waivers.txt"
+    w.write_text("JUSTONEWORD\n")
+    with pytest.raises(ValueError, match="bad waiver line"):
+        load_waivers(str(w))
+
+
+def test_gate_waives_and_reports_stale(tmp_path):
+    root = tmp_path / "repo"
+    (root / "cxxnet_tpu").mkdir(parents=True)
+    (root / "tools").mkdir()
+    (root / "cxxnet_tpu" / "m.py").write_text(textwrap.dedent("""
+        import threading, time
+        class C:
+            def __init__(self):
+                self.lock = threading.Lock()
+            def bad(self):
+                with self.lock:
+                    time.sleep(0.1)
+        """))
+    wf = root / "waivers.txt"
+    # unwaived: the finding fails the gate
+    wf.write_text("")
+    _, unwaived, stale = run_gate(str(root), str(wf))
+    assert [f.rule for f in unwaived] == ["CONC002"] and stale == []
+    # waived: clean; a dangling waiver turns up as stale
+    wf.write_text(
+        "CONC002 cxxnet_tpu/m.py::C.bad deliberate\n"
+        "OBS001 cxxnet_tpu/gone.py::f old\n")
+    _, unwaived, stale = run_gate(str(root), str(wf))
+    assert unwaived == [] and stale == ["OBS001 cxxnet_tpu/gone.py::f"]
+
+
+def test_tree_gate_is_clean():
+    """THE standing gate: the whole tree lints clean against the
+    committed baseline, with no stale waivers. A new finding means
+    fix it or waive it with a justification in
+    docs/analysis_waivers.txt; a stale waiver means delete the line
+    whose code is gone."""
+    findings_all, unwaived, stale = run_gate(REPO)
+    assert unwaived == [], \
+        "unwaived analysis findings:\n  %s" % "\n  ".join(
+            map(repr, unwaived))
+    assert stale == [], "stale waivers (remove them): %s" % stale
+    # the baseline itself stays justified: every waiver carries text
+    waivers = load_waivers(os.path.join(REPO, "docs",
+                                        "analysis_waivers.txt"))
+    assert waivers, "gate running against an empty baseline?"
+    assert all(v.strip() for v in waivers.values()), \
+        "every waiver needs a one-line justification"
+    # and the hot-path markers are actually deployed
+    assert any(f.rule.startswith("SYNC") for f in findings_all), \
+        "no SYNC findings at all — did @hot_path marking disappear?"
+
+
+# ----------------------------------------------------------------------
+# trace_report --check-spans (runtime complement of OBS001)
+
+
+def test_check_spans_on_committed_chaos_trace():
+    from trace_report import check_spans, load_events
+    events = load_events(os.path.join(REPO, "docs",
+                                      "chaos_trace_r07.json"))
+    chk = check_spans(events)
+    # every with-managed span nests like a call stack on its lane
+    assert chk["unbalanced"] == []
+    assert chk["spans_checked"] == 271
+    # exactly the 3 flow starts of attempts that died on the killed
+    # replica never land — the expected chaos signature, bounded
+    assert chk["flows_started"] == 75
+    assert chk["open_flows"] == 3
+
+
+def test_check_spans_detects_unbalanced():
+    events = [
+        {"ph": "X", "tid": 1, "ts": 0.0, "dur": 100.0, "name": "outer"},
+        {"ph": "X", "tid": 1, "ts": 50.0, "dur": 100.0,
+         "name": "straddler"},       # exits AFTER its parent: broken
+        {"ph": "X", "tid": 2, "ts": 0.0, "dur": 10.0, "name": "fine"},
+        {"ph": "s", "tid": 1, "ts": 1.0, "id": 7},
+    ]
+    from trace_report import check_spans
+    chk = check_spans(events)
+    assert len(chk["unbalanced"]) == 1
+    assert chk["unbalanced"][0]["name"] == "straddler"
+    assert chk["open_flows"] == 1
+    # properly nested child: clean
+    events[1]["dur"] = 40.0
+    chk = check_spans(events)
+    assert chk["unbalanced"] == []

@@ -441,3 +441,81 @@ def test_committed_chaos_trace_has_retry_and_swap_flows():
     assert "router.retry" in names, sorted(names)
     assert "router.swap" in names
     assert "replica.drain" in names
+
+
+# ----------------------------------------------------------------------
+# r8 analysis-audit fixes (docs/analysis.md): regression tests
+
+def test_probe_hang_does_not_stall_sibling_probes():
+    """r8 audit finding: probes ran serially ON the supervisor thread,
+    so one hung replica's probe (up to probe_timeout_s) stalled its
+    siblings' probes and dead-thread detection for the whole window.
+    tick(block=False) — the supervisor's mode — runs each due probe on
+    its own thread: here r1's probe hangs ~1.2s while r2 must be
+    re-admitted in a fraction of that."""
+    inj = FaultInjector(seed=0)
+    with make_set(n=3, fault=inj, fail_threshold=1, backoff_s=0.05,
+                  probe_timeout_s=2.0) as rs:
+        inj.hang("r1", delay_s=1.2, times=1000)
+        rs.report_failure(rs.by_name("r1"), RuntimeError("boom"))
+        rs.report_failure(rs.by_name("r2"), RuntimeError("boom"))
+        assert rs.by_name("r1").state == DEGRADED
+        assert rs.by_name("r2").state == DEGRADED
+        time.sleep(0.06)                 # both probe gates open
+        t0 = time.monotonic()
+        rs.tick(block=False)
+        while time.monotonic() - t0 < 1.0 \
+                and rs.by_name("r2").state != HEALTHY:
+            time.sleep(0.01)
+        took = time.monotonic() - t0
+        assert rs.by_name("r2").state == HEALTHY, \
+            "r2 not re-admitted within 1s — waiting behind r1's hang?"
+        assert took < 1.0
+        # r1 is still out (its probe is still hanging or just failed)
+        assert rs.by_name("r1").state == DEGRADED
+        # the in-flight flag keeps a second tick from stacking probes:
+        # r1's first probe is still inside its ~1.2s hang, so a second
+        # tick must NOT spawn a duplicate probe thread for it
+        assert rs.by_name("r1").probe_inflight
+        rs.tick(block=False)
+        probes = [t for t in threading.enumerate()
+                  if t.name == "replica-r1-probe" and t.is_alive()]
+        assert len(probes) == 1, \
+            "second tick stacked a duplicate probe: %s" % probes
+
+
+def test_replica_snapshot_is_locked_copy_used_by_router_surfaces():
+    """r8 audit finding: router healthz/metrics/drain/swap iterated
+    rs.replicas while spawn/detach mutate it. They now read
+    rs.snapshot() — a locked copy — so surface reads stay consistent
+    under concurrent membership changes."""
+    with make_set(n=2) as rs:
+        r = Router(rs, timeout_ms=5000)
+        snap = rs.snapshot()
+        assert [rep.name for rep in snap] == ["r1", "r2"]
+        snap.append("sentinel")          # a COPY: the set is untouched
+        assert [rep.name for rep in rs.snapshot()] == ["r1", "r2"]
+        stop = threading.Event()
+        errs = []
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    r.healthz()
+                    r.metrics()
+                except Exception as e:   # pragma: no cover
+                    errs.append(e)
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            for _ in range(3):
+                rep = rs.spawn(block=True)
+                rs.kill(rep.name)
+                rs.detach(rep.name)
+        finally:
+            stop.set()
+            t.join(5)
+        assert errs == []
+        h = r.healthz()
+        assert set(h["replicas"]) == {"r1", "r2"}

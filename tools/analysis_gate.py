@@ -1,0 +1,119 @@
+"""Run the analysis lint checkers over the tree against the committed
+waiver baseline — the standing CI gate (docs/analysis.md).
+
+Usage:
+  python tools/analysis_gate.py                # gate: exit 1 if dirty
+  python tools/analysis_gate.py --list         # every finding, waived
+                                               # ones marked
+  python tools/analysis_gate.py --json         # one JSON line
+
+The baseline lives at ``docs/analysis_waivers.txt``; one waiver per
+line::
+
+    RULE path::Qualified.name   one-line justification
+
+A waiver key is (rule, file, qualified function) — stable across
+unrelated edits, unlike line numbers. The gate fails on any UNWAIVED
+finding, and warns on STALE waivers (a waiver matching nothing — the
+code it excused is gone, so the excuse must go too;
+tests/test_analysis.py fails on stale entries to keep the baseline
+honest).
+
+``run_gate()`` is the in-process entry point the tier-1 test uses —
+the same check, no subprocess."""
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from cxxnet_tpu.analysis import lint  # noqa: E402
+
+WAIVER_FILE = os.path.join("docs", "analysis_waivers.txt")
+
+
+def load_waivers(path):
+    """{waiver key: justification} from the baseline file (missing
+    file = empty baseline)."""
+    waivers = {}
+    if not os.path.exists(path):
+        return waivers
+    with open(path, "r", encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(None, 2)
+            if len(parts) < 2:
+                raise ValueError(
+                    "bad waiver line (need 'RULE path::qualname "
+                    "justification'): %r" % line)
+            key = "%s %s" % (parts[0], parts[1])
+            waivers[key] = parts[2] if len(parts) > 2 else ""
+    return waivers
+
+
+def run_gate(root=None, waiver_path=None, extra_hot=()):
+    """Lint the tree; returns (findings, unwaived, stale_waiver_keys).
+
+    ``findings`` is every finding (waived or not), ``unwaived`` the
+    subset not covered by the baseline, ``stale`` the waiver keys that
+    matched nothing."""
+    root = root or _ROOT
+    wpath = waiver_path or os.path.join(root, WAIVER_FILE)
+    waivers = load_waivers(wpath)
+    findings = lint.check_tree(root, extra_hot=extra_hot)
+    used = set()
+    unwaived = []
+    for f in findings:
+        if f.key in waivers:
+            used.add(f.key)
+        else:
+            unwaived.append(f)
+    stale = sorted(set(waivers) - used)
+    return findings, unwaived, stale
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--list", action="store_true",
+                    help="print every finding (waived marked), not "
+                         "just failures")
+    ap.add_argument("--json", action="store_true",
+                    help="print the result as one JSON line")
+    ap.add_argument("--root", default=_ROOT)
+    ap.add_argument("--waivers", default=None,
+                    help="waiver file (default docs/analysis_waivers"
+                         ".txt under --root)")
+    args = ap.parse_args(argv)
+
+    findings, unwaived, stale = run_gate(args.root, args.waivers)
+    waived_n = len(findings) - len(unwaived)
+    if args.json:
+        print(json.dumps({
+            "findings": len(findings),
+            "waived": waived_n,
+            "unwaived": [repr(f) for f in unwaived],
+            "stale_waivers": stale,
+        }))
+    else:
+        shown = findings if args.list else unwaived
+        wkeys = {f.key for f in findings} - {f.key for f in unwaived}
+        for f in shown:
+            mark = "  [waived]" if f.key in wkeys \
+                and f not in unwaived else ""
+            print("%r%s" % (f, mark))
+        print("analysis_gate: %d finding(s), %d waived, %d unwaived, "
+              "%d stale waiver(s)"
+              % (len(findings), waived_n, len(unwaived), len(stale)))
+        for k in stale:
+            print("  STALE waiver (matches nothing, remove it): %s"
+                  % k)
+    return 1 if unwaived else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

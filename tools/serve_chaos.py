@@ -131,6 +131,11 @@ def main():
         full = np.asarray(model(pool))
 
         obs_trace.start(args.trace_out)
+        # lockdep-style validation of the whole run: every lock the
+        # replicas/router/engines create from here on is instrumented
+        # (docs/analysis.md), so the chaos run doubles as a race check
+        from cxxnet_tpu.analysis import lockcheck
+        monitor = lockcheck.enable(held_warn_s=2.0)
         from cxxnet_tpu import serving
         inj = FaultInjector(seed=7)
         replicas = ReplicaSet(
@@ -231,6 +236,7 @@ def main():
         srv.server_close()
         router.close()
         trace_path = obs_trace.stop()
+        lockcheck.disable()
 
         # ---- assertions ---------------------------------------------
         checks = []
@@ -268,6 +274,14 @@ def main():
         check("trace_retry_flow", "router.retry" in names)
         check("trace_swap_span", "router.swap" in names)
         check("trace_drain_span", "replica.drain" in names)
+        from tools.trace_report import check_spans
+        chk = check_spans(load_events(trace_path))
+        check("trace_spans_balanced", not chk["unbalanced"],
+              chk["unbalanced"][:3])
+        check("lockcheck_clean", not monitor.violations(),
+              monitor.violations()[:5])
+        check("lockcheck_instrumented", monitor.created >= 10,
+              "locks created through the seam: %d" % monitor.created)
 
         for name, ok, detail in checks:
             print("serve_chaos[%s]: %s %s"

@@ -14,12 +14,24 @@ Usage:
   python tools/trace_report.py trace.json --json       # one JSON line
   python tools/trace_report.py trace.json --min-lanes 3 --require-flow
                                                        # CI assertions
+  python tools/trace_report.py trace.json --check-spans
+                                                       # span hygiene
 
 ``--min-lanes N`` exits 2 unless >= N lanes carry at least one span;
 ``--require-flow`` exits 2 unless at least one flow start has a
 matching finish. tools/obs_smoke.py runs both assertions over its
 end-to-end artifact.
-"""
+
+``--check-spans`` is the runtime complement of the static OBS lint
+(analysis/lint.py OBS001): spans recorded by one thread must nest
+like a call stack — a span partially overlapping another on its own
+lane means some span was NOT with-managed (its exit was recorded by
+hand, out of order). It also counts UNCLOSED flows (a flow start with
+no finish: the request arrow entered a tier and never landed —
+expected exactly for attempts that failed over, so the count is
+reported and bounded by ``--max-open-flows N`` rather than forced to
+zero). Exits 2 on any unbalanced span, or when open flows exceed the
+bound."""
 
 import argparse
 import json
@@ -108,6 +120,51 @@ def report(events):
     }
 
 
+def check_spans(events, eps_us: float = 0.5):
+    """Span-hygiene report: per-lane nesting discipline + unclosed
+    flows. Returns {"spans_checked", "unbalanced": [...],
+    "flows_started", "flows_finished", "open_flows"}."""
+    lanes = {}
+    starts, ends = set(), set()
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "X":
+            lanes.setdefault(ev.get("tid", 0), []).append(
+                (float(ev.get("ts", 0.0)),
+                 float(ev.get("dur", 0.0)),
+                 ev.get("name", "?")))
+        elif ph == "s":
+            starts.add(ev.get("id"))
+        elif ph == "f":
+            ends.add(ev.get("id"))
+    unbalanced = []
+    n = 0
+    for tid, spans in sorted(lanes.items()):
+        # parents sort before their children: earlier start first,
+        # longer duration first on ties
+        spans.sort(key=lambda s: (s[0], -s[1]))
+        stack = []   # (end_ts, name) of currently-open spans
+        for ts, dur, name in spans:
+            n += 1
+            end = ts + dur
+            while stack and stack[-1][0] <= ts + eps_us:
+                stack.pop()
+            if stack and end > stack[-1][0] + eps_us:
+                unbalanced.append({
+                    "tid": tid, "name": name, "ts": ts,
+                    "overlaps": stack[-1][1],
+                    "by_us": round(end - stack[-1][0], 3)})
+            else:
+                stack.append((end, name))
+    return {
+        "spans_checked": n,
+        "unbalanced": unbalanced,
+        "flows_started": len(starts),
+        "flows_finished": len(ends),
+        "open_flows": len(starts - ends),
+    }
+
+
 def _human(rep):
     out = ["trace: %.1f ms wall, %d lanes"
            % (rep["wall_ms"], rep["nonempty_lanes"])]
@@ -143,9 +200,44 @@ def main():
     ap.add_argument("--require-flow", action="store_true",
                     help="exit 2 unless >= 1 flow start has a matching "
                          "finish")
+    ap.add_argument("--check-spans", action="store_true",
+                    help="verify per-lane span nesting discipline and "
+                         "report unclosed flows; exit 2 on any "
+                         "unbalanced span")
+    ap.add_argument("--max-open-flows", type=int, default=None,
+                    help="with --check-spans: exit 2 when more than N "
+                         "flow starts never finish")
     args = ap.parse_args()
-    rep = report(load_events(args.trace))
+    events = load_events(args.trace)
+    rep = report(events)
+    if args.check_spans:
+        chk = check_spans(events)
+        rep["span_check"] = chk
     print(json.dumps(rep) if args.json else _human(rep))
+    if args.check_spans:
+        chk = rep["span_check"]
+        if not args.json:
+            print("span check: %d spans, %d unbalanced; flows %d "
+                  "started / %d finished, %d never closed"
+                  % (chk["spans_checked"], len(chk["unbalanced"]),
+                     chk["flows_started"], chk["flows_finished"],
+                     chk["open_flows"]))
+        if chk["unbalanced"]:
+            for u in chk["unbalanced"][:6]:
+                sys.stderr.write(
+                    "trace_report: UNBALANCED span %r on lane %d "
+                    "overlaps %r by %.1fus — a span was not "
+                    "with-managed\n"
+                    % (u["name"], u["tid"], u["overlaps"],
+                       u["by_us"]))
+            return 2
+        if args.max_open_flows is not None \
+                and chk["open_flows"] > args.max_open_flows:
+            sys.stderr.write(
+                "trace_report: %d flow(s) started but never finished "
+                "(bound %d)\n"
+                % (chk["open_flows"], args.max_open_flows))
+            return 2
     if args.min_lanes and rep["nonempty_lanes"] < args.min_lanes:
         sys.stderr.write("trace_report: only %d non-empty lanes "
                          "(need %d)\n"
