@@ -183,6 +183,8 @@ def main() -> None:
         return serve_main(args)
     if args.mode == "chaos":
         return chaos_main(args)
+    if args.mode == "scenario":
+        return scenario_main(args)
     if args.devices:
         return scaling_main(args)
     iters, n_trials = args.iters, args.trials
@@ -486,7 +488,7 @@ def _parse_args():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "mode", nargs="?", default="train",
-        choices=("train", "feed", "serve", "chaos"),
+        choices=("train", "feed", "serve", "chaos", "scenario"),
         help="train (default): the AlexNet step/staging protocol. "
              "feed: the host-feed pipeline benchmark — decode-only, "
              "stage-only, serialized decode->stage->step, and the "
@@ -502,7 +504,20 @@ def _parse_args():
              "through the 3-replica router scored per wall window "
              "for SLO attainment, run twice: undisturbed, and with a "
              "replica killed + a hot artifact swap mid-window "
-             "(net=chaos in the ledger).")
+             "(net=chaos in the ledger). "
+             "scenario: the production trace-replay bench — the "
+             "serve/loadgen.py catalog (bursty, mixed-priority, "
+             "mixed predict+generate, slow-client) replayed OPEN-LOOP "
+             "against real engines with the flight recorder on, "
+             "scored per scenario for p99 + SLO attainment "
+             "(net=scenario in the ledger, docs/scenarios.md).")
+    ap.add_argument("--scenario", default="",
+                    help="comma list restricting scenario mode to "
+                         "these catalog names (default: all)")
+    ap.add_argument("--scenario-rps", type=float, default=120.0,
+                    help="mean offered arrival rate per scenario")
+    ap.add_argument("--scenario-duration", type=float, default=3.0,
+                    help="seconds of replayed traffic per scenario")
     ap.add_argument("--serve-requests", type=int, default=96,
                     help="requests per serve-bench window")
     ap.add_argument("--serve-threads", type=int, default=8,
@@ -825,6 +840,23 @@ def feed_main(args) -> None:
     }))
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _flight_on(max_events=65536):
+    """Install the always-on flight recorder for a bench window and
+    GUARANTEE it uninstalls — a mid-bench exception must not leave a
+    process-global sink behind."""
+    from cxxnet_tpu.obs import trace as obs_trace
+    from cxxnet_tpu.obs.flight import FlightRecorder
+    fr = obs_trace.set_flight(FlightRecorder(max_events))
+    try:
+        yield fr
+    finally:
+        obs_trace.set_flight(None)
+
+
 # serve bench: shapes chosen so a full-batch forward costs visibly
 # more than a 1-row one (the quantity the bucket ladder recovers) while
 # still compiling in seconds on CPU
@@ -927,9 +959,13 @@ def serve_main(args) -> None:
 
     platform = jax.devices()[0].platform
     nreq, threads = args.serve_requests, args.serve_threads
+    # flight recorder ON for every window: serving now runs the
+    # always-on recorder (obs/flight.py) in production posture, so the
+    # headline p50/throughput MUST include its append cost — the
+    # acceptance bound holds it to the pre-recorder range
     rs = np.random.RandomState(0)
     data = rs.randn(SERVE_BATCH, 1, 1, SERVE_DIM).astype(np.float32)
-    with tempfile.TemporaryDirectory() as td:
+    with _flight_on() as flight, tempfile.TemporaryDirectory() as td:
         tr = _serve_trainer(platform)
         fixed_path = os.path.join(td, "fixed.export")
         ladder_path = os.path.join(td, "ladder.export")
@@ -1031,6 +1067,8 @@ def serve_main(args) -> None:
         "p50_1row_ms_bucketed": round(p50_ladder, 3),
         "p50_1row_ms_fixed": round(p50_fixed, 3),
         "bucket_p50_speedup": round(ladder_ratio, 3),
+        "flight_recorder_on": True,
+        "flight_events_recorded": flight.recorded,
         "obs": best_obs,
     }
     best = _update_history(entry, net="serve", metric="rows_per_sec")
@@ -1067,6 +1105,12 @@ def serve_main(args) -> None:
                          "dispatch, completion thread trims) vs "
                          "serial dispatch; > 1 means gather+pack of "
                          "batch N+1 overlapped execution of batch N",
+        "flight_recorder_on": True,
+        "flight_events_recorded": flight.recorded,
+        "flight_note": "every window ran with the always-on flight "
+                       "recorder (obs/flight.py) installed — the "
+                       "production posture; p50/throughput include "
+                       "its ring-append cost",
         "latency_trials": lat_trials,
         "throughput_trials": thr_trials,
         "bucket_dispatches_best_window": (best_m or {}).get(
@@ -1262,6 +1306,213 @@ def chaos_main(args) -> None:
                     "— non-shed failures in the chaos run are the "
                     "red flag, and per-window ok/sec > 0 everywhere "
                     "means the kill + swap never stopped service",
+        "best_recorded": best,
+    }))
+
+
+# scenario bench: the trace-replay yardstick. Small models (cheap
+# per-scenario engine builds), open-loop arrivals, SLO scored at
+# SCEN_SLO_MS over ANSWERED requests — the honest number bursts and
+# slow clients actually move (closed-loop benches can't see it).
+SCEN_SLO_MS = 250.0
+SCEN_TARGET = 0.99
+SCEN_LADDER = [1, 4, 16]
+
+
+def _scenario_decoder(platform, td):
+    """A tiny trained LM exported as a decode artifact (the generate
+    half of the mixed predict+generate scenario)."""
+    import numpy as np
+
+    from cxxnet_tpu import config as cfg_mod
+    from cxxnet_tpu import models, serving
+    from cxxnet_tpu.io import DataBatch
+    from cxxnet_tpu.trainer import Trainer
+
+    tr = Trainer()
+    for k, v in cfg_mod.parse_string(models.tiny_lm(
+            seq_len=16, vocab=16, embed=16, nlayer=1, nhead=2)):
+        tr.set_param(k, v)
+    for k, v in (("batch_size", "4"), ("dev", platform + ":0"),
+                 ("eta", "0.3"), ("seed", "0"),
+                 ("metric", "token_error")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    for _ in range(3):
+        start = rs.randint(0, 16, size=(4, 1))
+        seq = (start + np.arange(17)) % 16
+        tr.update(DataBatch(
+            data=seq[:, :16].astype(np.float32).reshape(4, 1, 16, 1),
+            label=seq[:, 1:].astype(np.float32)))
+    path = os.path.join(td, "scen_lm.export")
+    serving.export_generate(tr, path, max_new=4, temperature=0.0,
+                            prompt_len=8, platforms=[platform])
+    return serving.load_exported(path)
+
+
+def _run_scenario(name, entries, forward_path, decoder, data, args):
+    """One scenario replay against fresh engines + a fresh registry,
+    with a multi-window burn-rate SLO engine evaluating live. Returns
+    the ledger stanza: loadgen score + SLO-engine verdicts."""
+    from cxxnet_tpu import serving
+    from cxxnet_tpu.obs import trace as obs_trace
+    from cxxnet_tpu.obs.registry import Registry
+    from cxxnet_tpu.obs.slo import SLOEngine, latency_slo
+    from cxxnet_tpu.serve import ServingEngine
+    from cxxnet_tpu.serve.loadgen import EngineTarget, LoadGen, score
+
+    reg = Registry()
+    engine_kw = dict(max_wait_ms=2.0, queue_limit=256,
+                     slo_ms=SCEN_SLO_MS, registry=reg)
+    router = rs_set = None
+    decode_eng = None
+    if name == "mixed_priority":
+        # priorities only mean something behind the router's shedding
+        # policy: 2 replicas, each labelled, one shared registry
+        from cxxnet_tpu.serve.replica import ReplicaSet
+        from cxxnet_tpu.serve.router import Router
+        rs_set = ReplicaSet(
+            lambda: serving.load_exported(forward_path), n=2,
+            registry=reg, version="v1",
+            engine_kw=dict(max_wait_ms=2.0, queue_limit=256,
+                           slo_ms=SCEN_SLO_MS))
+        rs_set.start()
+        router = Router(rs_set, max_retries=1)
+        fwd_target = router
+    else:
+        if name == "mixed_kinds":
+            # two engines on one registry need distinct labels (the
+            # shared-registry contract in serve/engine.py)
+            engine_kw["obs_labels"] = {"kind": "forward"}
+        fwd_target = ServingEngine(
+            serving.load_exported(forward_path), warmup=True,
+            **engine_kw)
+    if name == "mixed_kinds":
+        decode_eng = ServingEngine(decoder, max_wait_ms=2.0,
+                                   queue_limit=256, warmup=True,
+                                   registry=reg, slo_ms=SCEN_SLO_MS,
+                                   obs_labels={"kind": "decode"})
+    slo = SLOEngine(reg, [latency_slo(SCEN_SLO_MS, SCEN_TARGET)],
+                    windows_s=(2.0, 0.5),
+                    flight=obs_trace.flight())
+    slo.start(period_s=0.2)
+    try:
+        lg = LoadGen(entries,
+                     EngineTarget(forward=fwd_target,
+                                  decode=decode_eng, data=data),
+                     workers=48)
+        results = lg.run()
+        time.sleep(0.3)          # let the SLO engine see the tail
+        slo.tick()
+    finally:
+        slo.stop()
+        if router is not None:
+            router.close()
+            rs_set.close()
+        else:
+            fwd_target.close()
+        if decode_eng is not None:
+            decode_eng.close()
+    sc = score(results, slo_ms=SCEN_SLO_MS,
+               duration_s=args.scenario_duration)
+    sc["slo_incidents"] = slo.incident_count
+    burn = reg.get_value("cxxnet_slo_burn_rate",
+                         slo="latency_p%g_under_%gms"
+                         % (100.0 * SCEN_TARGET, SCEN_SLO_MS),
+                         window="2s")
+    sc["burn_rate_2s_final"] = round(burn, 3) if burn is not None \
+        else None
+    return sc
+
+
+def scenario_main(args) -> None:
+    """The production trace-replay benchmark (``python bench.py
+    scenario``; docs/scenarios.md).
+
+    Replays the serve/loadgen.py catalog OPEN-LOOP — arrivals fire on
+    schedule whatever the server is doing, so queueing compounds like
+    production — against real exported-artifact engines with the
+    flight recorder installed (the always-on posture every serving
+    deployment now runs): bursty on/off arrivals, mixed-priority
+    through the 2-replica router, mixed predict+generate across a
+    forward and a decode engine, and slow clients. Each scenario is
+    scored for p50/p99 latency, SLO attainment at SCEN_SLO_MS, shed/
+    timeout counts, and live burn-rate SLO-engine verdicts; one ledger
+    row (net=scenario) carries the whole catalog."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from cxxnet_tpu import serving
+    from cxxnet_tpu.serve.loadgen import SCENARIOS, make_scenario
+
+    platform = jax.devices()[0].platform
+    names = [s.strip() for s in args.scenario.split(",") if s.strip()] \
+        or [s for s in SCENARIOS if s != "steady"]
+    for n in names:
+        if n not in SCENARIOS:
+            raise SystemExit("unknown scenario %r (know %s)"
+                             % (n, ", ".join(SCENARIOS)))
+    rs_data = np.random.RandomState(0)
+    data = rs_data.randn(CHAOS_BATCH, 1, 1, CHAOS_DIM).astype(
+        np.float32)
+    with _flight_on() as fr, tempfile.TemporaryDirectory() as td:
+        tr = _chaos_trainer(platform)
+        fwd_path = os.path.join(td, "scen.export")
+        serving.export_model(tr, fwd_path,
+                             batch_ladder=SCEN_LADDER,
+                             platforms=[platform])
+        del tr
+        decoder = _scenario_decoder(platform, td) \
+            if "mixed_kinds" in names else None
+        per_scenario = {}
+        for name in names:
+            entries = make_scenario(
+                name, duration_s=args.scenario_duration,
+                rps=args.scenario_rps, seed=7)
+            per_scenario[name] = _run_scenario(
+                name, entries, fwd_path, decoder, data, args)
+
+    entry = {
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "slo_ms": SCEN_SLO_MS,
+        "slo_target": SCEN_TARGET,
+        "offered_rps": args.scenario_rps,
+        "duration_s": args.scenario_duration,
+        "scenarios": per_scenario,
+    }
+    # metric="timestamp": scenario rows are catalog snapshots — newest
+    # wins, same convention as the net=obs rows
+    best = _update_history(entry, net="scenario", metric="timestamp")
+    print(json.dumps({
+        "metric": "scenario_slo_attainment_min",
+        "value": min(s["slo_attainment"]
+                     for s in per_scenario.values()),
+        "unit": "min over scenarios of answered-in-SLO fraction",
+        "platform": platform,
+        "host_cores": os.cpu_count() or 1,
+        "measured_as": "open-loop replay of the loadgen catalog (%s) "
+                       "at %g req/s mean for %gs each, MLP %dx%dx%d "
+                       "ladder %s exported artifacts (+tiny-LM "
+                       "decoder for mixed_kinds), flight recorder "
+                       "on, SLO %gms at p%g"
+                       % (",".join(names), args.scenario_rps,
+                          args.scenario_duration, CHAOS_DIM,
+                          CHAOS_HIDDEN, CHAOS_NCLASS, SCEN_LADDER,
+                          SCEN_SLO_MS, 100.0 * SCEN_TARGET),
+        "slo_ms": SCEN_SLO_MS,
+        "scenarios": per_scenario,
+        "flight_recorder": {"max_events": fr.max_events,
+                            "recorded_total": fr.recorded},
+        "scenario_note": "open-loop: arrivals fire on schedule "
+                         "whatever the server is doing (no "
+                         "coordinated omission); slo_attainment "
+                         "counts ANSWERED requests inside %gms; "
+                         "max_lag_ms > 0 means the generator itself "
+                         "fell behind and the burst was UNDERstated"
+                         % SCEN_SLO_MS,
         "best_recorded": best,
     }))
 

@@ -65,6 +65,8 @@ class LearnTask:
         self.trace_out = ""
         self.telemetry_port: Optional[int] = None
         self._telemetry = None
+        self._flight = None          # task=serve's flight recorder
+        self._slo = None             # task=serve's SLO engine
         self._obs_hooks: List = []   # global-registry hooks this run
                                      # registered; removed at run end
                                      # so repeated in-process runs do
@@ -183,6 +185,19 @@ class LearnTask:
             for h in self._obs_hooks:
                 get_registry().remove_hook(h)
             self._obs_hooks = []
+            # serve-task observability: torn down HERE, not inside
+            # task_serve — a setup failure between installing the
+            # recorder and entering serve_forever must not leak a
+            # process-global sink or a ticking daemon thread
+            if self._slo is not None:
+                try:
+                    self._slo.stop()
+                except Exception as e:
+                    sys.stderr.write("slo shutdown failed: %s\n" % e)
+                self._slo = None
+            if self._flight is not None:
+                obs_trace.set_flight(None)
+                self._flight = None
             if self._telemetry is not None:
                 try:
                     self._telemetry.shutdown()
@@ -290,7 +305,11 @@ class LearnTask:
                             "serve_access_log",
                             # multi-replica front end (serve/router.py)
                             "serve_replicas", "serve_max_retries",
-                            "serve_priority_default", "serve_swap"]),
+                            "serve_priority_default", "serve_swap",
+                            # SLO engine + flight recorder (obs/slo.py,
+                            # obs/flight.py, docs/observability.md)
+                            "slo_p99_ms", "slo_target", "slo_windows",
+                            "flight_events", "flight_dump_dir"]),
     }
 
     def _iter_section_keys(self) -> set:
@@ -858,7 +877,18 @@ class LearnTask:
         "normal"), deadline-aware shedding, graceful drain, and the
         POST /swap hot-artifact-swap endpoint (serve_swap = 0
         disables). Needs export_in (a live trainer cannot be
-        replicated). Blocks until interrupted."""
+        replicated). Blocks until interrupted.
+
+        Observability knobs (docs/observability.md): flight_events
+        (default 65536; 0 disables) keeps an always-on bounded ring of
+        trace events (obs/flight.py) that SLO incidents dump
+        retroactively; slo_p99_ms = T (0 = off) runs the burn-rate SLO
+        engine (obs/slo.py) over the request-latency histogram —
+        slo_target (default 0.99) the good fraction, slo_windows
+        (default "60,5" seconds) the multi-window rule, incident dumps
+        land in flight_dump_dir (default "flight"). With the engine on,
+        GET /slo reports objectives/burn/incidents and /healthz carries
+        the incident count."""
         from . import serving
         from .serve import ServingEngine
         from .serve.server import build_server
@@ -866,12 +896,24 @@ class LearnTask:
         from .obs.registry import get_registry
         timeout_ms = float(d.get("serve_timeout_ms", "30000"))
         n_rep = int(d.get("serve_replicas", "1"))
+        slo_ms = float(d.get("slo_p99_ms", "0"))
         engine_kw = dict(
             max_wait_ms=float(d.get("serve_max_wait_ms", "5")),
             max_batch=int(d.get("serve_max_batch", "0")) or None,
             queue_limit=int(d.get("serve_queue_limit", "64")),
             timeout_ms=timeout_ms,
-            dispatch_depth=int(d.get("serve_dispatch_depth", "2")))
+            dispatch_depth=int(d.get("serve_dispatch_depth", "2")),
+            slo_ms=slo_ms or None)
+        # always-on flight recorder: negligible append cost, and any
+        # SLO incident (or operator request) can dump the last N
+        # seconds as a Chrome trace after the fact
+        flight_events = int(d.get("flight_events", "65536"))
+        flight = None
+        if flight_events > 0:
+            from .obs import trace as obs_trace
+            from .obs.flight import FlightRecorder
+            flight = self._flight = obs_trace.set_flight(
+                FlightRecorder(flight_events))
         if n_rep > 1:
             if "export_in" not in d:
                 raise RuntimeError(
@@ -908,6 +950,27 @@ class LearnTask:
                 # and a telemetry_port endpoint in the same process
                 # render one shared view
                 registry=get_registry(), **engine_kw)
+        slo_eng = None
+        if slo_ms > 0:
+            from .obs.slo import (SLOEngine, availability_slo,
+                                  latency_slo)
+            windows = [float(x)
+                       for x in d.get("slo_windows", "60,5").split(",")
+                       if x.strip()]
+            slo_eng = SLOEngine(
+                get_registry(),
+                [latency_slo(slo_ms,
+                             float(d.get("slo_target", "0.99"))),
+                 availability_slo()],
+                windows_s=windows or (60.0, 5.0), flight=flight,
+                dump_dir=d.get("flight_dump_dir", "flight"))
+            self._slo = slo_eng
+            slo_eng.start(period_s=max(min(windows or [5.0]) / 4.0,
+                                       0.25))
+            if self._telemetry is not None:
+                # the telemetry endpoint (started before the task ran)
+                # gains /slo + the healthz incident count too
+                self._telemetry.slo = slo_eng
         srv = build_server(
             backend, d.get("serve_host", "127.0.0.1"),
             int(d.get("serve_port", "8080")),
@@ -917,7 +980,8 @@ class LearnTask:
                              else None),
             verbose=not self.silent,
             access_log=bool(int(d.get("serve_access_log", "0"))),
-            allow_swap=bool(int(d.get("serve_swap", "1"))))
+            allow_swap=bool(int(d.get("serve_swap", "1"))),
+            slo=slo_eng)
         host, port = srv.server_address[:2]
         if not self.silent:
             print("serving %s on http://%s:%d (buckets %s, "
@@ -934,6 +998,8 @@ class LearnTask:
         except KeyboardInterrupt:
             pass
         finally:
+            # slo/flight teardown lives in run()'s finally (it must
+            # also cover setup failures before this point)
             srv.server_close()
             backend.close()
 

@@ -313,7 +313,7 @@ class DevicePrefetchIterator:
         q.put(item)
         dt = time.perf_counter() - t0
         self.put_wait.add_wait(dt)
-        tr = _trace.active()
+        tr = _trace.sink()
         if tr is not None and dt > 1e-4:
             # only materialized waits become spans: an uncontended put
             # is sub-100us and would bury the lane in noise

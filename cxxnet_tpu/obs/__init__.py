@@ -25,7 +25,16 @@ shared backbone:
   exactly one tracing module in the tree.
 * :mod:`.telemetry` — the lightweight HTTP endpoint (``telemetry_port``
   in cli.py) exposing the global registry (JSON + Prometheus) plus
-  per-device memory during training.
+  per-device memory during training (+ ``/slo`` when an SLO engine is
+  attached).
+* :mod:`.flight` — the always-on flight recorder: a bounded ring of
+  trace events on the same seam as the tracer, dumping any recent
+  window retroactively as a Chrome trace (the post-hoc evidence an
+  SLO incident ships with).
+* :mod:`.slo` — declarative latency/availability objectives evaluated
+  by multi-window burn rate over the registry, emitting
+  ``cxxnet_slo_*`` series and incident records that quote histogram
+  exemplar request ids and trigger flight dumps.
 
 See docs/observability.md for the full contract (metric naming, trace
 format, request-id semantics).
@@ -37,12 +46,13 @@ from .registry import (Counter, Gauge, Histogram, Registry,
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "get_registry",
            "watch_quantile", "watch_stallclock", "watch_steptimer",
-           "trace", "telemetry"]
+           "trace", "telemetry", "flight", "slo"]
 
 
 def __getattr__(name):
-    # trace/telemetry load lazily (telemetry pulls in http.server)
-    if name in ("trace", "telemetry"):
+    # trace/telemetry/flight/slo load lazily (telemetry pulls in
+    # http.server; slo pulls in the lockcheck seam)
+    if name in ("trace", "telemetry", "flight", "slo"):
         import importlib
         return importlib.import_module("." + name, __name__)
     raise AttributeError(name)

@@ -60,6 +60,17 @@ def _esc(s: str) -> str:
             .replace("\n", "\\n"))
 
 
+def _safe_list(dq) -> list:
+    """Copy a deque another thread may be appending to (CPython raises
+    RuntimeError when an append lands mid-iteration; retry converges
+    immediately — appends are O(1))."""
+    while True:
+        try:
+            return list(dq)
+        except RuntimeError:
+            continue
+
+
 def _labels_text(names: Tuple[str, ...], values: Tuple[str, ...],
                  extra: str = "") -> str:
     parts = ['%s="%s"' % (n, _esc(v)) for n, v in zip(names, values)]
@@ -153,6 +164,20 @@ class Counter(_Metric):
         with self._lock:
             return float(self._series.get(self._key(labels), 0.0))
 
+    def sum_values(self, subset: Optional[Dict[str, str]] = None
+                   ) -> float:
+        """Total across every series whose labels include ``subset``
+        (the SLO engine aggregates per-replica counters this way)."""
+        total = 0.0
+        with self._lock:
+            for key, v in self._series.items():
+                have = dict(zip(self.labelnames, key))
+                if subset and any(have.get(k) != str(x)
+                                  for k, x in subset.items()):
+                    continue
+                total += float(v)   # type: ignore[arg-type]
+        return total
+
 
 class Gauge(_Metric):
     """Point-in-time value; may go up or down."""
@@ -180,37 +205,99 @@ class Gauge(_Metric):
 class Histogram(_Metric):
     """Cumulative-bucket histogram (Prometheus semantics): observe()
     increments every bucket whose upper bound covers the value, plus
-    ``_sum`` and ``_count``."""
+    ``_sum`` and ``_count``.
+
+    Each series also keeps a small ring of recent **exemplars** —
+    ``(exemplar_id, value)`` pairs passed to ``observe(...,
+    exemplar=...)`` — so an aggregate number stays linked to concrete
+    events: the serving engine stamps request ids here, and an SLO
+    incident (obs/slo.py) quotes the ids behind a bad p99, which are
+    also the trace flow ids in a flight-recorder dump. Exemplar writes
+    ride the series lock the observation already holds (the exemplar
+    race-freedom test pins this)."""
 
     kind = "histogram"
+    EXEMPLARS = 16      # recent exemplars kept per series
 
     def __init__(self, name, help="", labelnames=(),
                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
         super().__init__(name, help, labelnames)
-        bs = sorted(float(b) for b in buckets)
+        bs = sorted(set(float(b) for b in buckets))
         if not bs:
             raise ValueError("histogram needs at least one bucket")
         if bs[-1] != float("inf"):
             bs.append(float("inf"))
         self.buckets = tuple(bs)
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels) -> None:
         key = self._key(labels)
         v = float(value)
         with self._lock:
             st = self._series.get(key)
             if st is None:
-                st = [[0] * len(self.buckets), 0.0, 0]
+                from collections import deque
+                st = [[0] * len(self.buckets), 0.0, 0,
+                      deque(maxlen=self.EXEMPLARS)]
                 self._series[key] = st
-            counts, _, _ = st
+            counts = st[0]
             for i, b in enumerate(self.buckets):
                 if v <= b:
                     counts[i] += 1
             st[1] += v
             st[2] += 1
+            if exemplar is not None:
+                st[3].append((str(exemplar), v))
+
+    def exemplars(self, min_value: Optional[float] = None,
+                  subset: Optional[Dict[str, str]] = None
+                  ) -> List[Tuple[str, float]]:
+        """Recent (exemplar_id, value) pairs across every series whose
+        labels include ``subset``; ``min_value`` keeps only exemplars
+        at or above it (the SLO engine asks for the over-threshold
+        ones). Newest last within each series."""
+        out: List[Tuple[str, float]] = []
+        with self._lock:
+            for key, st in sorted(self._series.items()):
+                if not self._match(key, subset):
+                    continue
+                for ex, v in list(st[3]):
+                    if min_value is None or v >= min_value:
+                        out.append((ex, v))
+        return out
+
+    def _match(self, key: Tuple[str, ...],
+               subset: Optional[Dict[str, str]]) -> bool:
+        if not subset:
+            return True
+        have = dict(zip(self.labelnames, key))
+        return all(have.get(k) == str(v) for k, v in subset.items())
+
+    def counts_under(self, bound: float,
+                     subset: Optional[Dict[str, str]] = None
+                     ) -> Tuple[int, int]:
+        """(good, total) summed across matching series, where good =
+        observations <= the largest bucket bound not exceeding
+        ``bound`` — conservative when ``bound`` falls between buckets
+        (values in the straddling bucket count as bad). Callers that
+        need an exact threshold include it in ``buckets`` at creation;
+        the serving engine does exactly that with its SLO threshold."""
+        idx = -1
+        for i, b in enumerate(self.buckets):
+            if b <= float(bound) * (1.0 + 1e-9):
+                idx = i
+        good = total = 0
+        with self._lock:
+            for key, st in self._series.items():
+                if not self._match(key, subset):
+                    continue
+                if idx >= 0:
+                    good += st[0][idx]
+                total += st[2]
+        return good, total
 
     def _render_series(self, key, st, out: List[str]) -> None:
-        counts, total, n = st
+        counts, total, n = st[0], st[1], st[2]
         for b, c in zip(self.buckets, counts):
             le = "+Inf" if math.isinf(b) else _fmt(b)
             out.append("%s_bucket%s %d" % (
@@ -222,12 +309,13 @@ class Histogram(_Metric):
             self.name, _labels_text(self.labelnames, key), n))
 
     def _snapshot_value(self, st):
-        counts, total, n = st
+        counts, total, n = st[0], st[1], st[2]
         return {
             "sum": total, "count": n,
             "buckets": {
                 ("+Inf" if math.isinf(b) else _fmt(b)): c
                 for b, c in zip(self.buckets, counts)},
+            "exemplars": [[e, v] for e, v in _safe_list(st[3])],
         }
 
 
@@ -307,6 +395,13 @@ class Registry:
         if errs:
             self.counter("cxxnet_obs_hook_errors_total",
                          "collection hooks that raised").inc(errs)
+
+    def get_metric(self, name: str) -> Optional[_Metric]:
+        """The registered metric object for ``name`` (None when
+        absent) — the SLO engine reads histogram bucket counts and
+        counter totals through this without re-declaring families."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def get_value(self, name: str, **labels) -> Optional[float]:
         """Convenience: collect, then read one counter/gauge series

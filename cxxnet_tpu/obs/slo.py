@@ -1,0 +1,423 @@
+"""Declarative SLOs evaluated by multi-window burn rate, with
+incident records that carry their own evidence.
+
+An :class:`Objective` states what good looks like — "99% of requests
+answer under 250 ms", "99.9% of requests succeed" — against the
+metrics the registry already carries (the serving engine's request
+latency histogram, the ServeStats counters). The :class:`SLOEngine`
+samples those cumulative series on a tick, keeps a short history, and
+computes the **burn rate** per window:
+
+    burn(w) = bad_fraction_over_window(w) / (1 - target)
+
+1.0 means the error budget is being consumed exactly as fast as the
+objective allows; 10 means ten times too fast. A violation opens only
+when the burn exceeds the objective's threshold over **every**
+configured window (the multi-window AND rule from the SRE workbook:
+the long window proves the burn is sustained, the short window proves
+it is still happening — a recovered blip never pages, a fresh spike
+doesn't page until it has burned long enough to matter).
+
+On violation the engine opens an **incident**: a JSON-able record with
+the burn rates, the window attainment, the over-threshold
+``(request_id, value)`` exemplars from the latency histogram
+(obs/registry.py), and — when a flight recorder (obs/flight.py) is
+installed — a retroactive trace dump of the offending window, so the
+request ids in the record are greppable flow arrows in the dump. The
+incident closes when the short window drops back under threshold.
+
+Everything also publishes as registry series (``cxxnet_slo_*``) so
+the same burn rates are scrapeable, and ``status()`` is the JSON the
+``/slo`` endpoint (serve/server.py, obs/telemetry.py) returns.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..analysis import lockcheck as _lockcheck
+from .registry import Counter, Histogram, Registry
+
+# the serving engine's request-latency histogram (serve/engine.py
+# observes into it with request-id exemplars); latency objectives
+# default to this family
+SERVE_LATENCY_METRIC = "cxxnet_serve_request_latency_seconds"
+
+
+class Objective:
+    """One declarative SLO.
+
+    kind="latency": ``target`` fraction of requests complete within
+    ``threshold_ms``, read from histogram ``metric`` (bucket counts;
+    include the threshold in the histogram's buckets for an exact
+    boundary — the serving engine does when given ``slo_ms``).
+
+    kind="availability": ``target`` fraction of requests succeed,
+    read as good=``good_metric`` vs bad=``bad_metric`` counters
+    (bad is added to good for the total).
+
+    ``labels`` restricts evaluation to series carrying that label
+    subset (e.g. one replica); ``burn_threshold`` is the paging bar on
+    the burn rate (1.0 = budget consumed exactly at the allowed rate).
+    """
+
+    def __init__(self, name: str, kind: str, target: float,
+                 metric: str = SERVE_LATENCY_METRIC,
+                 threshold_ms: Optional[float] = None,
+                 good_metric: Optional[str] = None,
+                 bad_metric: Optional[str] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 burn_threshold: float = 1.0) -> None:
+        if kind not in ("latency", "availability"):
+            raise ValueError("kind must be latency or availability")
+        if not (0.0 < float(target) < 1.0):
+            raise ValueError("target must be a fraction in (0, 1)")
+        if kind == "latency" and not threshold_ms:
+            raise ValueError("latency objective needs threshold_ms")
+        if kind == "availability" and not (good_metric and bad_metric):
+            raise ValueError(
+                "availability objective needs good_metric + bad_metric")
+        self.name = name
+        self.kind = kind
+        self.target = float(target)
+        self.metric = metric
+        self.threshold_ms = float(threshold_ms) if threshold_ms else None
+        self.good_metric = good_metric
+        self.bad_metric = bad_metric
+        self.labels = dict(labels or {})
+        self.burn_threshold = float(burn_threshold)
+
+    def describe(self) -> dict:
+        d = {"name": self.name, "kind": self.kind,
+             "target": self.target,
+             "burn_threshold": self.burn_threshold}
+        if self.kind == "latency":
+            d["metric"] = self.metric
+            d["threshold_ms"] = self.threshold_ms
+        else:
+            d["good_metric"] = self.good_metric
+            d["bad_metric"] = self.bad_metric
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        return d
+
+
+def latency_slo(threshold_ms: float, target: float = 0.99,
+                name: Optional[str] = None,
+                metric: str = SERVE_LATENCY_METRIC,
+                **kw) -> Objective:
+    """"``target`` of requests answer under ``threshold_ms``" — the
+    p-quantile SLO (target 0.99 = a p99 bound)."""
+    return Objective(
+        name or "latency_p%g_under_%gms" % (100.0 * target,
+                                            threshold_ms),
+        "latency", target, metric=metric, threshold_ms=threshold_ms,
+        **kw)
+
+
+def availability_slo(target: float = 0.999,
+                     name: str = "availability",
+                     good_metric: str = "cxxnet_serve_requests_total",
+                     bad_metric: str = "cxxnet_serve_errors_total",
+                     **kw) -> Objective:
+    """"``target`` of requests succeed" over the serving counters."""
+    return Objective(name, "availability", target,
+                     good_metric=good_metric, bad_metric=bad_metric,
+                     **kw)
+
+
+class SLOEngine:
+    """Samples the registry, computes multi-window burn rates, opens/
+    closes incidents, and (optionally) dumps the flight recorder on
+    every opening.
+
+    ``windows_s`` orders long-to-short by convention but any order
+    works — the AND rule is symmetric. ``tick(now=...)`` takes an
+    injectable clock for deterministic tests; ``start(period_s)`` runs
+    ticks on a daemon thread for real deployments.
+    """
+
+    def __init__(self, registry: Registry,
+                 objectives: Sequence[Objective],
+                 windows_s: Sequence[float] = (60.0, 5.0),
+                 flight=None,
+                 dump_dir: Optional[str] = None,
+                 dump_pad_s: float = 1.0,
+                 max_incidents: int = 64,
+                 on_incident: Optional[Callable[[dict], None]] = None
+                 ) -> None:
+        if not objectives:
+            raise ValueError("need at least one objective")
+        ws = sorted({float(w) for w in windows_s}, reverse=True)
+        if not ws or ws[-1] <= 0:
+            raise ValueError("windows_s must be positive")
+        self.registry = registry
+        self.objectives = list(objectives)
+        self.windows_s = tuple(ws)
+        self.flight = flight
+        self.dump_dir = dump_dir
+        self.dump_pad_s = float(dump_pad_s)
+        self.max_incidents = int(max_incidents)
+        self.on_incident = on_incident
+        self._lock = _lockcheck.make_lock("obs.slo.lock")
+        # serializes whole evaluation passes: the start() daemon thread
+        # and manual tick() callers (the bench, the smoke, tests) may
+        # overlap, and two concurrent passes over one violating
+        # objective would open duplicate incidents / race the seq
+        self._tick_lock = _lockcheck.make_lock("obs.slo.tick")
+        # per objective: deque of (t, good, total) cumulative samples
+        self._samples: Dict[str, deque] = {
+            o.name: deque() for o in self.objectives}
+        self._open: Dict[str, dict] = {}      # name -> open incident
+        self._incidents: List[dict] = []
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        names = set()
+        for o in self.objectives:
+            if o.name in names:
+                raise ValueError("duplicate objective %r" % o.name)
+            names.add(o.name)
+        self._g_burn = registry.gauge(
+            "cxxnet_slo_burn_rate",
+            "error-budget burn rate per evaluation window",
+            ("slo", "window"))
+        self._g_att = registry.gauge(
+            "cxxnet_slo_attainment",
+            "good fraction per evaluation window", ("slo", "window"))
+        self._g_target = registry.gauge(
+            "cxxnet_slo_target", "objective target fraction", ("slo",))
+        self._g_viol = registry.gauge(
+            "cxxnet_slo_violation",
+            "1 while the objective is in violation", ("slo",))
+        self._c_inc = registry.counter(
+            "cxxnet_slo_incidents_total",
+            "incidents opened for this objective", ("slo",))
+        for o in self.objectives:
+            self._g_target.set(o.target, slo=o.name)
+            self._g_viol.set(0.0, slo=o.name)
+
+    # ------------------------------------------------------------------
+    def _counts(self, obj: Objective):
+        """Cumulative (good, total) for an objective right now."""
+        if obj.kind == "latency":
+            m = self.registry.get_metric(obj.metric)
+            if not isinstance(m, Histogram):
+                return 0, 0
+            return m.counts_under(obj.threshold_ms / 1000.0,
+                                  obj.labels or None)
+        good_m = self.registry.get_metric(obj.good_metric)
+        bad_m = self.registry.get_metric(obj.bad_metric)
+        good = good_m.sum_values(obj.labels or None) \
+            if isinstance(good_m, Counter) else 0.0
+        bad = bad_m.sum_values(obj.labels or None) \
+            if isinstance(bad_m, Counter) else 0.0
+        return good, good + bad
+
+    def _window_delta(self, samples, now: float, w: float):
+        """(dgood, dtotal) against the newest sample at or before
+        ``now - w`` — or the oldest sample while history is still
+        shorter than the window (a cold engine evaluates over what it
+        has instead of staying silent for a full window)."""
+        _, g1, n1 = samples[-1]
+        base = samples[0]
+        for s in samples:
+            if s[0] <= now - w:
+                base = s
+            else:
+                break
+        _, g0, n0 = base
+        return g1 - g0, n1 - n0
+
+    # ------------------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> List[dict]:
+        """One evaluation pass; returns incidents OPENED this tick.
+        ``now`` is a monotonic-clock override for tests. Passes are
+        serialized — a manual tick overlapping the start() thread's
+        evaluates after it, never interleaved with it."""
+        with self._tick_lock:
+            return self._tick_locked(now)
+
+    def _tick_locked(self, now: Optional[float]) -> List[dict]:
+        self.registry.collect()     # pull-adapters publish first
+        now = time.monotonic() if now is None else float(now)
+        keep = self.windows_s[0] * 2.0 + 1.0
+        opened: List[dict] = []
+        for obj in self.objectives:
+            good, total = self._counts(obj)
+            with self._lock:
+                samples = self._samples[obj.name]
+                samples.append((now, good, total))
+                while samples and samples[0][0] < now - keep:
+                    samples.popleft()
+                burns, atts = {}, {}
+                violating = True
+                for w in self.windows_s:
+                    dg, dn = self._window_delta(samples, now, w)
+                    bad_frac = (dn - dg) / dn if dn > 0 else 0.0
+                    burn = bad_frac / max(1.0 - obj.target, 1e-9)
+                    burns[w] = burn
+                    atts[w] = 1.0 - bad_frac
+                    if dn <= 0 or burn < obj.burn_threshold:
+                        violating = False
+                was_open = obj.name in self._open
+            for w in self.windows_s:
+                wl = "%gs" % w
+                self._g_burn.set(burns[w], slo=obj.name, window=wl)
+                self._g_att.set(atts[w], slo=obj.name, window=wl)
+            if violating and not was_open:
+                inc = self._open_incident(obj, now, burns, atts)
+                opened.append(inc)
+            elif not violating and was_open:
+                self._close_incident(obj, now)
+        return opened
+
+    def _open_incident(self, obj: Objective, now: float,
+                       burns: dict, atts: dict) -> dict:
+        self._seq += 1
+        inc = {
+            "seq": self._seq,
+            "slo": obj.name,
+            "objective": obj.describe(),
+            "opened_unix": time.time(),
+            "burn": {"%gs" % w: round(b, 4)
+                     for w, b in burns.items()},
+            "attainment": {"%gs" % w: round(a, 6)
+                           for w, a in atts.items()},
+            "windows_s": list(self.windows_s),
+            "closed_unix": None,
+        }
+        if obj.kind == "latency":
+            m = self.registry.get_metric(obj.metric)
+            if isinstance(m, Histogram):
+                inc["exemplars"] = [
+                    {"request_id": e, "value_ms": round(v * 1e3, 3)}
+                    for e, v in m.exemplars(
+                        min_value=obj.threshold_ms / 1000.0,
+                        subset=obj.labels or None)]
+        if self.flight is not None:
+            window = self.windows_s[0] + self.dump_pad_s
+            path = None
+            if self.dump_dir:
+                path = os.path.join(
+                    self.dump_dir,
+                    "incident-%s-%03d.json" % (obj.name, self._seq))
+            try:
+                fd = self.flight.dump_last(window, path)
+                # no dump_dir = no destination: keep the counts stanza
+                # but never pin the full trace document in the
+                # incident list (64 retained incidents x a 65536-event
+                # ring would be tens of MB of dead weight)
+                fd.pop("doc", None)
+                inc["flight_dump"] = fd
+            except Exception as e:   # an undumpable ring must not
+                inc["flight_dump"] = {"error": str(e)}   # mask paging
+        if self.dump_dir:
+            # persist the record beside its dump so the incident is a
+            # self-contained artifact (tools/trace_report.py
+            # --incident renders + verifies the pair)
+            rec_path = os.path.join(
+                self.dump_dir,
+                "incident-%s-%03d.incident.json" % (obj.name,
+                                                    self._seq))
+            try:
+                os.makedirs(self.dump_dir, exist_ok=True)
+                import json
+                with open(rec_path, "w") as f:
+                    json.dump(inc, f, indent=1)
+                inc["record_path"] = rec_path
+            except OSError:
+                pass
+        with self._lock:
+            self._open[obj.name] = inc
+            self._incidents.append(inc)
+            del self._incidents[:-self.max_incidents]
+        self._c_inc.inc(slo=obj.name)
+        self._g_viol.set(1.0, slo=obj.name)
+        from . import trace as _trace
+        _trace.instant("slo.incident", "slo",
+                       {"slo": obj.name, "seq": inc["seq"]})
+        if self.on_incident is not None:
+            try:
+                self.on_incident(inc)
+            except Exception:
+                pass
+        return inc
+
+    def _close_incident(self, obj: Objective, now: float) -> None:
+        with self._lock:
+            inc = self._open.pop(obj.name, None)
+        if inc is not None:
+            inc["closed_unix"] = time.time()
+        self._g_viol.set(0.0, slo=obj.name)
+
+    # ------------------------------------------------------------------
+    @property
+    def incident_count(self) -> int:
+        with self._lock:
+            return len(self._incidents)
+
+    def incidents(self, last: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            incs = list(self._incidents)
+        return incs[-last:] if last else incs
+
+    def status(self) -> dict:
+        """The ``/slo`` endpoint payload: objectives, current burn
+        rates/attainment (last tick's gauges), open + recent
+        incidents. Incident flight dumps are referenced by path, not
+        inlined."""
+        out = {"windows_s": list(self.windows_s),
+               "objectives": [], "incidents": []}
+        with self._lock:
+            open_names = set(self._open)
+            incs = list(self._incidents)[-16:]
+        for obj in self.objectives:
+            o = obj.describe()
+            o["violating"] = obj.name in open_names
+            o["burn_rate"] = {
+                "%gs" % w: self._g_burn.value(slo=obj.name,
+                                              window="%gs" % w)
+                for w in self.windows_s}
+            o["attainment"] = {
+                "%gs" % w: self._g_att.value(slo=obj.name,
+                                             window="%gs" % w)
+                for w in self.windows_s}
+            out["objectives"].append(o)
+        for inc in incs:
+            rec = {k: v for k, v in inc.items() if k != "flight_dump"}
+            fd = inc.get("flight_dump")
+            if isinstance(fd, dict):
+                rec["flight_dump"] = {
+                    k: v for k, v in fd.items() if k != "doc"}
+            out["incidents"].append(rec)
+        out["incident_count"] = len(self._incidents)
+        return out
+
+    # ------------------------------------------------------------------
+    def start(self, period_s: float = 1.0) -> "SLOEngine":
+        """Tick on a daemon thread every ``period_s`` (idempotent)."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(period_s):
+                try:
+                    self.tick()
+                except Exception:   # a broken scrape must not kill
+                    pass            # evaluation forever
+        self._thread = threading.Thread(target=loop, name="slo-engine",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)

@@ -10,7 +10,11 @@ Endpoints:
   GET /metrics               JSON snapshot of the registry plus a
                              ``device_memory`` summary string
   GET /metrics?format=prom   Prometheus text exposition (0.0.4)
-  GET /healthz               {"ok": true}
+  GET /healthz               {"ok": true} (+ ``incidents`` when an
+                             SLO engine is attached)
+  GET /slo                   objectives / burn rates / incidents from
+                             the attached obs/slo.py engine (404 when
+                             none is configured)
 
 Stdlib-only (ThreadingHTTPServer) like serve/server.py; one daemon
 thread, silent request logging. Device memory also publishes as the
@@ -81,8 +85,22 @@ class _TelemetryHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         parts = urlsplit(self.path)
+        slo = getattr(self.server, "slo", None)
         if parts.path == "/healthz":
-            self._send(200, b'{"ok": true}', "application/json")
+            body = {"ok": True}
+            if slo is not None:
+                body["incidents"] = slo.incident_count
+            self._send(200, json.dumps(body).encode("utf-8"),
+                       "application/json")
+            return
+        if parts.path == "/slo":
+            if slo is None:
+                self._send(404, b'{"error": "no SLO engine attached"}',
+                           "application/json")
+            else:
+                self._send(200,
+                           json.dumps(slo.status()).encode("utf-8"),
+                           "application/json")
             return
         if parts.path != "/metrics":
             self._send(404, b'{"error": "no such path"}',
@@ -117,8 +135,12 @@ class TelemetryServer(ThreadingHTTPServer):
     allow_reuse_address = True
 
     def __init__(self, registry: Optional[Registry] = None,
-                 host: str = "127.0.0.1", port: int = 0) -> None:
+                 host: str = "127.0.0.1", port: int = 0,
+                 slo=None) -> None:
         self.registry = registry or get_registry()
+        # obs/slo.py SLOEngine: enables /slo + the /healthz incident
+        # count (None = endpoint absent)
+        self.slo = slo
         super().__init__((host, port), _TelemetryHandler)
 
     def start_background(self) -> threading.Thread:
@@ -133,11 +155,12 @@ class TelemetryServer(ThreadingHTTPServer):
 
 
 def start_telemetry(port: int, registry: Optional[Registry] = None,
-                    host: str = "127.0.0.1") -> TelemetryServer:
+                    host: str = "127.0.0.1",
+                    slo=None) -> TelemetryServer:
     """Build + start the endpoint on a daemon thread; registers the
     device-memory hook so /metrics?format=prom carries HBM gauges."""
     reg = registry or get_registry()
     watch_device_memory(reg)
-    srv = TelemetryServer(reg, host, port)
+    srv = TelemetryServer(reg, host, port, slo=slo)
     srv.start_background()
     return srv

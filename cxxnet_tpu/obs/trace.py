@@ -56,11 +56,13 @@ NOOP_SPAN = _NoopSpan()
 
 
 class _Span:
-    """One live span: records an ``X`` complete event on exit."""
+    """One live span: records an ``X`` complete event on exit. ``tr``
+    is any event sink with ``complete()`` — the Tracer, a flight
+    recorder (obs/flight.py), or the _Fanout over both."""
 
     __slots__ = ("_tr", "name", "cat", "args", "_t0")
 
-    def __init__(self, tr: "Tracer", name: str, cat: str,
+    def __init__(self, tr, name: str, cat: str,
                  args: Optional[dict]) -> None:
         self._tr = tr
         self.name = name
@@ -212,8 +214,67 @@ class Tracer:
 
 # ----------------------------------------------------------------------
 # module-level API: the one branch every call site pays when disabled
+#
+# Two independently-installable sinks share the seam: the TRACER
+# (trace_out=, full-run file) and the FLIGHT RECORDER (obs/flight.py,
+# always-on bounded ring). ``_sink`` caches their composition —
+# None / the one active sink / a _Fanout over both — so every helper
+# still pays exactly one module-global read and one branch when
+# everything is off, and call sites that cached ``active()`` to avoid
+# per-event overhead use ``sink()`` the same way.
 
 _active: Optional[Tracer] = None
+_flight = None                 # Optional[flight.FlightRecorder]
+_sink = None                   # cached composition of the two
+
+
+class _Fanout:
+    """Both sinks installed: every event goes to tracer AND recorder.
+    Built once at install time (start/set_flight), not per event."""
+
+    __slots__ = ("a", "b")
+
+    def __init__(self, a, b) -> None:
+        self.a = a
+        self.b = b
+
+    def span(self, name: str, cat: str = "app",
+             args: Optional[dict] = None) -> "_Span":
+        return _Span(self, name, cat, args)
+
+    def complete(self, name, cat, t0, t1, args=None) -> None:
+        self.a.complete(name, cat, t0, t1, args)
+        self.b.complete(name, cat, t0, t1, args)
+
+    def instant(self, name, cat="app", args=None) -> None:
+        self.a.instant(name, cat, args)
+        self.b.instant(name, cat, args)
+
+    def counter(self, name, values, cat="app") -> None:
+        self.a.counter(name, values, cat)
+        self.b.counter(name, values, cat)
+
+    def flow_start(self, name, fid, cat="flow") -> None:
+        self.a.flow_start(name, fid, cat)
+        self.b.flow_start(name, fid, cat)
+
+    def flow_step(self, name, fid, cat="flow") -> None:
+        self.a.flow_step(name, fid, cat)
+        self.b.flow_step(name, fid, cat)
+
+    def flow_end(self, name, fid, cat="flow") -> None:
+        self.a.flow_end(name, fid, cat)
+        self.b.flow_end(name, fid, cat)
+
+
+def _recompose() -> None:
+    global _sink
+    if _active is None:
+        _sink = _flight
+    elif _flight is None:
+        _sink = _active
+    else:
+        _sink = _Fanout(_active, _flight)
 
 
 def active() -> Optional[Tracer]:
@@ -224,10 +285,35 @@ def enabled() -> bool:
     return _active is not None
 
 
+def sink():
+    """The composed event sink (tracer, flight recorder, both, or
+    None). Hot call sites that emit several events per request cache
+    this once per request instead of branching per event — the same
+    pattern they used with ``active()``, now flight-aware."""
+    return _sink
+
+
+def set_flight(recorder):
+    """Install (or with ``None`` remove) the process flight recorder
+    (obs/flight.py). Returns the recorder. Independent of the tracer:
+    serving runs keep the recorder on permanently while ``trace_out=``
+    comes and goes."""
+    global _flight
+    _flight = recorder
+    _recompose()
+    return recorder
+
+
+def flight():
+    """The installed flight recorder, or None."""
+    return _flight
+
+
 def start(path: Optional[str] = None, **kw) -> Tracer:
     """Install the process tracer (replacing any previous one)."""
     global _active
     _active = Tracer(path, **kw)
+    _recompose()
     return _active
 
 
@@ -237,6 +323,7 @@ def stop(path: Optional[str] = None) -> Optional[str]:
     global _active
     tr = _active
     _active = None
+    _recompose()
     if tr is None:
         return None
     if path or tr.path:
@@ -247,42 +334,42 @@ def stop(path: Optional[str] = None) -> Optional[str]:
 def span(name: str, cat: str = "app", args: Optional[dict] = None):
     """A context manager timing one span. Disabled: the shared no-op
     singleton (same object every call — no allocation)."""
-    tr = _active
-    if tr is None:
+    s = _sink
+    if s is None:
         return NOOP_SPAN
-    return _Span(tr, name, cat, args)
+    return _Span(s, name, cat, args)
 
 
 def instant(name: str, cat: str = "app",
             args: Optional[dict] = None) -> None:
-    tr = _active
-    if tr is not None:
-        tr.instant(name, cat, args)
+    s = _sink
+    if s is not None:
+        s.instant(name, cat, args)
 
 
 def counter(name: str, values: Dict[str, float],
             cat: str = "app") -> None:
-    tr = _active
-    if tr is not None:
-        tr.counter(name, values, cat)
+    s = _sink
+    if s is not None:
+        s.counter(name, values, cat)
 
 
 def flow_start(name: str, fid: int, cat: str = "flow") -> None:
-    tr = _active
-    if tr is not None:
-        tr.flow_start(name, fid, cat)
+    s = _sink
+    if s is not None:
+        s.flow_start(name, fid, cat)
 
 
 def flow_step(name: str, fid: int, cat: str = "flow") -> None:
-    tr = _active
-    if tr is not None:
-        tr.flow_step(name, fid, cat)
+    s = _sink
+    if s is not None:
+        s.flow_step(name, fid, cat)
 
 
 def flow_end(name: str, fid: int, cat: str = "flow") -> None:
-    tr = _active
-    if tr is not None:
-        tr.flow_end(name, fid, cat)
+    s = _sink
+    if s is not None:
+        s.flow_end(name, fid, cat)
 
 
 # ----------------------------------------------------------------------

@@ -24,7 +24,11 @@ that artifact into a trafficable service —
   bounded deadline-respecting failover, priority + deadline shedding
   with computed Retry-After, graceful drain, zero-downtime hot swap;
 * :mod:`.faults` — :class:`FaultInjector`: the deterministic fault
-  seam every robustness claim above is tested against.
+  seam every robustness claim above is tested against;
+* :mod:`.loadgen` — open-loop trace replay: the scenario catalog
+  (bursty / mixed-priority / mixed predict+generate / slow-client),
+  a replayable JSONL trace format the access log can produce, and the
+  scoring behind ``bench.py scenario`` (docs/scenarios.md).
 
 CLI: ``task = serve`` (+ ``serve_replicas = N`` for the router
 topology) — docs/serving.md, docs/tasks.md.
@@ -40,13 +44,16 @@ __all__ = ["QueueFullError", "Request", "RequestExpired", "DrainError",
            "Router", "RouterRequest", "ShedError", "NoReplicaError",
            "FailoverExhausted",
            "ReplicaSet", "Replica",
-           "FaultInjector", "FaultError", "ReplicaDead"]
+           "FaultInjector", "FaultError", "ReplicaDead",
+           "LoadGen", "EngineTarget", "HTTPTarget", "make_scenario"]
 
 # lazily-resolved names -> defining submodule: server.py pulls in
 # http.server, router/replica/faults are only needed by multi-replica
 # deployments — engine-only users (and the package import) stay light
 _LAZY = {
     "ServeHTTPServer": "server", "build_server": "server",
+    "LoadGen": "loadgen", "EngineTarget": "loadgen",
+    "HTTPTarget": "loadgen", "make_scenario": "loadgen",
     "Router": "router", "RouterRequest": "router",
     "ShedError": "router", "NoReplicaError": "router",
     "FailoverExhausted": "router",

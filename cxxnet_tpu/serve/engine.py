@@ -401,6 +401,11 @@ class ServingEngine:
                       the fault-injection seam (serve/faults.py). A
                       raising hook fails the batch through the real
                       error path; a sleeping hook is a real stall.
+      slo_ms          latency-SLO threshold: added as an exact bucket
+                      bound to the request-latency histogram
+                      (cxxnet_serve_request_latency_seconds, request-
+                      id exemplars) so an obs/slo.py objective at this
+                      threshold evaluates on a real boundary
       start=False     leaves the dispatch thread stopped (tests use it
                       to saturate the queue deterministically)
     """
@@ -412,7 +417,7 @@ class ServingEngine:
                  stats: Optional[ServeStats] = None, seed: int = 0,
                  registry: Optional[Registry] = None,
                  obs_labels: Optional[dict] = None,
-                 fault_hook=None,
+                 fault_hook=None, slo_ms: Optional[float] = None,
                  start: bool = True):
         self.callee = _wrap_callee(callee)
         self.batch = self.callee.batch
@@ -437,6 +442,32 @@ class ServingEngine:
         g_q = self.registry.gauge("cxxnet_serve_queue_depth",
                                   "requests pending admission",
                                   tuple(self.obs_labels))
+        # per-request latency histogram with request-id exemplars: the
+        # series the SLO engine (obs/slo.py) evaluates by burn rate.
+        # slo_ms lands as an explicit bucket bound so the objective's
+        # threshold is an exact histogram boundary, not interpolated
+        buckets = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0]
+        if slo_ms:
+            buckets.append(float(slo_ms) / 1000.0)
+        self._h_latency = self.registry.histogram(
+            "cxxnet_serve_request_latency_seconds",
+            "per-request completion latency (submit to answer)",
+            tuple(self.obs_labels), buckets=buckets)
+        self.slo_ms = float(slo_ms) if slo_ms else None
+        if slo_ms and not any(
+                abs(b - float(slo_ms) / 1000.0) < 1e-12
+                for b in self._h_latency.buckets):
+            # a shared registry returns the FIRST creation's histogram
+            # and ignores later bucket args — an SLO at this threshold
+            # would silently evaluate on the nearest lower bound
+            import sys
+            sys.stderr.write(
+                "warning: cxxnet_serve_request_latency_seconds was "
+                "already registered without a %gms bucket; the SLO "
+                "threshold will round down to the nearest bound — "
+                "create all engines on one registry with the same "
+                "slo_ms\n" % float(slo_ms))
         # keep the hook handles: close() detaches them, so a closed
         # engine on a SHARED registry (the CLI passes the global one)
         # neither stays pinned in memory nor keeps writing its series
@@ -667,7 +698,7 @@ class ServingEngine:
             with self._live_lock:
                 self._live.add(req)
             self._q.append(req)
-            tr = _trace.active()
+            tr = _trace.sink()
             if tr is not None:
                 # the flow arrow starts on the SUBMITTING thread (an
                 # HTTP handler, a bench client): admission → dispatch
@@ -746,7 +777,7 @@ class ServingEngine:
                 live.append(r)
         if not live:
             return
-        tr = _trace.active()
+        tr = _trace.sink()
         rows = sum(r.rows for r in live)
         if rows > self.batch:
             # one oversize request (coalescing is capped at max_batch
@@ -815,7 +846,7 @@ class ServingEngine:
     def _finish_batch(self, pend: _Pending) -> None:
         """Materialize the device result, trim, answer every request.
         Runs on the completion thread (pipelined) or inline (serial)."""
-        tr = _trace.active()
+        tr = _trace.sink()
         try:
             with _trace.span("serve.materialize", "serve",
                              {"rows": pend.rows,
@@ -842,6 +873,9 @@ class ServingEngine:
                 # a drain may have failed this request already — only
                 # the winning outcome reaches the completion stats
                 self.stats.on_complete(done - r.t_submit, r.rows)
+                self._h_latency.observe(done - r.t_submit,
+                                        exemplar=r.id,
+                                        **self.obs_labels)
             lo += r.rows
         if tr is not None:
             # the flow ends where the answer was handed back: one

@@ -1,0 +1,466 @@
+"""Open-loop workload generator: replay recorded traffic traces
+against a serving engine, router, or live HTTP server.
+
+Every serving claim so far was measured under closed-loop steady
+uniform load — each client waits for its answer before sending the
+next request, so a slow server conveniently slows its own offered
+load. Production traffic does not do that. This module drives the
+**open-loop** protocol: requests fire at their scheduled instants
+whatever the server is doing, so queueing delay compounds exactly as
+it would for real users, and p99/SLO-attainment under bursts is an
+honest number (the coordinated-omission trap closed-loop benches fall
+into).
+
+**Trace format** — one JSON object per line (JSONL), replayable and
+recordable:
+
+    {"t": 0.0125,            # seconds since trace start (arrival)
+     "kind": "predict",      # or "generate"
+     "rows": 1,              # request batch rows / prompt count
+     "priority": "normal",   # high | normal | batch (router classes)
+     "timeout_ms": 250.0,    # per-request deadline (optional)
+     "slow_ms": 0,           # slow-client stall (optional, see below)
+     "id": "..."}            # optional provenance (e.g. request_id)
+
+``serve/server.py``'s access log is itself a recorder:
+``trace_from_access_log`` turns the structured access-log records of a
+real serving run into this format (arrival offsets from the first
+record; rows default to 1 — the log does not carry body sizes), so
+yesterday's production traffic is today's regression scenario.
+
+**Scenario catalog** (``make_scenario``) — synthesized traces for the
+shapes production traffic actually takes; all deterministic in
+``seed``:
+
+* ``steady``          — uniform arrivals (the old bench, for contrast)
+* ``bursty``          — on/off arrivals: bursts at several times the
+                        mean rate, then silence (queue drain test)
+* ``mixed_priority``  — 1-row latency-sensitive ``high`` traffic
+                        interleaved with multi-row ``batch`` bulk
+                        (shedding must protect the former)
+* ``mixed_kinds``     — predict + generate in one stream (two engines
+                        in one process; decoder dispatches are slow
+                        and lumpy next to forwards)
+* ``slow_client``     — a fraction of clients stall mid-request
+                        (``slow_ms``): over HTTP the body dribbles in
+                        two halves (pins a handler thread), in-process
+                        the answer is collected late (holds the
+                        response buffer)
+
+Replay (:class:`LoadGen`) schedules arrivals on one pacer thread and
+hands each request to a worker pool; ``score()`` turns the outcomes
+into the ledger row fields — p50/p99 latency, SLO attainment
+(answered requests inside ``slo_ms``), shed/timeout/error counts, and
+the max pacer lag (a nonzero lag means the generator itself fell
+behind and the numbers understate the burst).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+from ..obs import trace as _trace
+
+SCENARIOS = ("steady", "bursty", "mixed_priority", "mixed_kinds",
+             "slow_client")
+
+
+# ----------------------------------------------------------------------
+# trace format
+
+def write_trace(path: str, entries: Sequence[dict]) -> str:
+    """Write entries as JSONL, sorted by arrival time."""
+    with open(path, "w") as f:
+        for e in sorted(entries, key=lambda e: e["t"]):
+            f.write(json.dumps(e) + "\n")
+    return path
+
+
+def read_trace(path: str) -> List[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            e = json.loads(line)
+            if "t" not in e:
+                raise ValueError("trace entry missing 't': %r" % line)
+            out.append(e)
+    out.sort(key=lambda e: e["t"])
+    return out
+
+
+def trace_from_access_log(records: Sequence[Union[dict, str]]
+                          ) -> List[dict]:
+    """Convert serve/server.py access-log records (dicts from an
+    ``access_log=callable`` sink, or the ``access ...`` JSON lines it
+    writes to stderr) into a replayable trace. Only /predict and
+    /generate POSTs become entries. The log stamps ``ts`` at response
+    COMPLETION, so each request's wall time (``ms``) is subtracted to
+    recover its arrival instant — without that a slow request would
+    replay later (and possibly reordered) relative to fast requests
+    that really arrived after it. Offsets are measured from the first
+    recovered arrival. Rows default to 1 — the log records status and
+    wall time, not body sizes — so a replay reproduces the arrival
+    process and the row mix approximately."""
+    entries: List[dict] = []
+    for rec in records:
+        if isinstance(rec, str):
+            line = rec.strip()
+            if line.startswith("access "):
+                line = line[len("access "):]
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+        path = rec.get("path", "")
+        if path not in ("/predict", "/generate"):
+            continue
+        arrival = float(rec.get("ts", 0.0)) \
+            - float(rec.get("ms", 0.0)) / 1000.0
+        entries.append({
+            "t": arrival,
+            "kind": "generate" if path == "/generate" else "predict",
+            "rows": int(rec.get("rows", 1)),
+            "id": rec.get("request_id"),
+        })
+    if entries:
+        t0 = min(e["t"] for e in entries)
+        for e in entries:
+            e["t"] = round(e["t"] - t0, 6)
+    entries.sort(key=lambda e: e["t"])
+    return entries
+
+
+# ----------------------------------------------------------------------
+# scenario catalog
+
+def _lcg(seed: int):
+    """Tiny deterministic PRNG (no global random state touched)."""
+    state = (seed * 2654435761 + 1) & 0xffffffff
+
+    def rnd() -> float:
+        nonlocal state
+        state = (state * 1664525 + 1013904223) & 0xffffffff
+        return state / 2 ** 32
+    return rnd
+
+
+def make_scenario(name: str, duration_s: float = 4.0,
+                  rps: float = 100.0, seed: int = 0,
+                  timeout_ms: Optional[float] = None,
+                  slow_ms: float = 120.0,
+                  burst_period_s: float = 1.0,
+                  burst_duty: float = 0.3) -> List[dict]:
+    """Synthesize one catalog scenario as a trace (see module doc).
+    ``rps`` is the MEAN arrival rate; bursty packs the same volume
+    into ``burst_duty`` of each ``burst_period_s``."""
+    if name not in SCENARIOS:
+        raise ValueError("unknown scenario %r (know %s)"
+                         % (name, ", ".join(SCENARIOS)))
+    rnd = _lcg(seed + 1)
+    n = max(int(duration_s * rps), 1)
+    entries: List[dict] = []
+    for i in range(n):
+        # uniform-jittered arrivals: mean spacing 1/rps with +-40%
+        # jitter (deterministic; Poisson-ish without heavy tails)
+        t = (i + 0.8 * (rnd() - 0.5)) / rps
+        t = min(max(t, 0.0), duration_s)
+        e = {"t": t, "kind": "predict", "rows": 1,
+             "priority": "normal"}
+        if timeout_ms:
+            e["timeout_ms"] = float(timeout_ms)
+        if name == "bursty":
+            # map the uniform arrival into the ON fraction of its
+            # period: same request count, several-x peak rate
+            phase = t % burst_period_s
+            e["t"] = (t - phase) + phase * burst_duty
+        elif name == "mixed_priority":
+            if i % 3 == 2:
+                e.update(rows=8, priority="batch")
+            else:
+                e.update(rows=1, priority="high")
+        elif name == "mixed_kinds":
+            if i % 3 == 2:
+                e["kind"] = "generate"
+        elif name == "slow_client":
+            if i % 4 == 0:
+                e["slow_ms"] = float(slow_ms)
+        entries.append(e)
+    entries.sort(key=lambda e: e["t"])
+    return entries
+
+
+# ----------------------------------------------------------------------
+# targets
+
+class EngineTarget:
+    """Submit entries to in-process engines (ServingEngine or Router —
+    anything with ``submit`` / ``submit_tokens``). ``forward`` serves
+    "predict" entries over ``data`` (a row pool cycled per request);
+    ``decode`` serves "generate" entries over synthesized short
+    prompts. ``slow_ms`` is modelled as collecting the answer late —
+    the request still completes, its response buffer is just held."""
+
+    def __init__(self, forward=None, decode=None, data=None,
+                 prompt_len: int = 4) -> None:
+        if forward is None and decode is None:
+            raise ValueError("need a forward and/or decode target")
+        self.forward = forward
+        self.decode = decode
+        self.data = data
+        self.prompt_len = int(prompt_len)
+
+    def _prompts(self, rows: int, i: int):
+        import numpy as np
+        c = self.decode.callee
+        toks = np.zeros((rows, c.seq_len), np.int32)
+        L = min(self.prompt_len, c.max_prompt_len)
+        for r in range(rows):
+            toks[r, :L] = [(i + r + j) % 7 + 1 for j in range(L)]
+        return toks, [L] * rows
+
+    def __call__(self, entry: dict, i: int):
+        kind = entry.get("kind", "predict")
+        rows = int(entry.get("rows", 1))
+        kw = {}
+        if entry.get("timeout_ms") is not None:
+            kw["timeout_ms"] = float(entry["timeout_ms"])
+        if entry.get("priority") is not None:
+            kw["priority"] = entry["priority"]
+        if kind == "generate":
+            if self.decode is None:
+                raise RuntimeError("scenario has generate entries but "
+                                   "no decode target")
+            toks, lens = self._prompts(rows, i)
+            req = self.decode.submit_tokens(toks, lens, **kw)
+        else:
+            if self.forward is None:
+                raise RuntimeError("scenario has predict entries but "
+                                   "no forward target")
+            n = len(self.data)
+            lo = i % n
+            d = self.data[lo:lo + rows]
+            if len(d) < rows:            # wrap the pool
+                import numpy as np
+                d = np.concatenate([d, self.data[:rows - len(d)]])
+            req = self.forward.submit(d, **kw)
+        slow = float(entry.get("slow_ms", 0) or 0)
+        if slow > 0:
+            time.sleep(slow / 1000.0)
+        req.result(120.0)
+        return getattr(req, "id", None)
+
+
+class HTTPTarget:
+    """POST entries to a live serve/server.py endpoint. One keep-alive
+    connection per worker thread (thread-local). ``slow_ms`` entries
+    upload their body in two halves with a stall between — a real
+    slow client pinning a handler thread mid-read."""
+
+    def __init__(self, url: str, data=None, prompt_len: int = 4,
+                 seq_len: int = 16, timeout_s: float = 120.0) -> None:
+        from urllib.parse import urlsplit
+        p = urlsplit(url)
+        self.host, self.port = p.hostname, p.port
+        self.data = data
+        self.prompt_len = int(prompt_len)
+        self.seq_len = int(seq_len)
+        self.timeout_s = float(timeout_s)
+        self._local = threading.local()
+
+    def _conn(self):
+        import http.client
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = http.client.HTTPConnection(self.host, self.port,
+                                           timeout=self.timeout_s)
+            self._local.conn = c
+        return c
+
+    def _body(self, entry: dict, i: int):
+        kind = entry.get("kind", "predict")
+        rows = int(entry.get("rows", 1))
+        if kind == "generate":
+            L = self.prompt_len
+            prompts = [[(i + r + j) % 7 + 1 for j in range(L)]
+                       for r in range(rows)]
+            obj = {"prompts": prompts}
+            path = "/generate"
+        else:
+            n = len(self.data)
+            lo = i % n
+            d = list(self.data[lo:lo + rows])
+            while len(d) < rows:
+                d.append(self.data[(lo + len(d)) % n])
+            obj = {"data": [x.tolist() for x in d]}
+            path = "/predict"
+        if entry.get("timeout_ms") is not None:
+            obj["timeout_ms"] = float(entry["timeout_ms"])
+        if entry.get("priority") is not None:
+            obj["priority"] = entry["priority"]
+        return path, json.dumps(obj).encode()
+
+    def __call__(self, entry: dict, i: int):
+        path, body = self._body(entry, i)
+        slow = float(entry.get("slow_ms", 0) or 0)
+        conn = self._conn()
+        try:
+            if slow > 0 and len(body) > 2:
+                half = len(body) // 2
+                conn.putrequest("POST", path)
+                conn.putheader("Content-Type", "application/json")
+                conn.putheader("Content-Length", str(len(body)))
+                conn.endheaders()
+                conn.send(body[:half])
+                time.sleep(slow / 1000.0)   # the slow-client stall
+                conn.send(body[half:])
+            else:
+                conn.request("POST", path, body,
+                             {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = resp.read()
+            st = resp.status
+        except Exception:
+            try:
+                conn.close()
+            finally:
+                self._local.conn = None
+            raise
+        if st == 200:
+            try:
+                return json.loads(payload).get("request_id")
+            except ValueError:
+                return None
+        if st == 429:
+            raise _HTTPShed(st)
+        if st == 503:
+            raise _HTTPUnavailable(st)
+        if st == 504:
+            raise TimeoutError("HTTP 504")
+        raise RuntimeError("HTTP %d: %s" % (st, payload[:200]))
+
+
+class _HTTPShed(RuntimeError):
+    pass
+
+
+class _HTTPUnavailable(RuntimeError):
+    pass
+
+
+# ----------------------------------------------------------------------
+# replay + scoring
+
+def _classify(exc: BaseException) -> str:
+    from .engine import DrainError, QueueFullError, RequestExpired
+    try:
+        from .router import NoReplicaError, ShedError
+    except Exception:                    # router never imported
+        NoReplicaError = ShedError = ()
+    if isinstance(exc, (QueueFullError, ShedError, _HTTPShed)):
+        return "shed"
+    if isinstance(exc, (DrainError, NoReplicaError, _HTTPUnavailable)):
+        return "unavailable"
+    if isinstance(exc, (RequestExpired, TimeoutError)):
+        return "timeout"
+    return "error"
+
+
+class LoadGen:
+    """Replay a trace open-loop: a pacer thread fires each entry at
+    ``t0 + entry.t`` into a worker pool; workers run the target and
+    record the outcome. The pacer never waits on completions — that is
+    the open loop. ``workers`` bounds concurrency; when all workers
+    are busy an arrival queues in the pool and its recorded ``lag_ms``
+    says by how much the generator itself fell behind."""
+
+    def __init__(self, entries: Sequence[dict],
+                 target: Callable[[dict, int], Optional[str]],
+                 workers: int = 32) -> None:
+        self.entries = sorted(entries, key=lambda e: e["t"])
+        self.target = target
+        self.workers = int(workers)
+        self.results: List[dict] = []
+        self._rlock = threading.Lock()
+
+    def _fire(self, entry: dict, i: int, sched_t: float,
+              t0: float) -> None:
+        ts = time.perf_counter()
+        rec = {"t": sched_t, "kind": entry.get("kind", "predict"),
+               "rows": int(entry.get("rows", 1)),
+               "priority": entry.get("priority"),
+               "lag_ms": round((ts - t0 - sched_t) * 1000.0, 3)}
+        try:
+            with _trace.span("loadgen.request", "loadgen",
+                             {"kind": rec["kind"], "i": i}):
+                rid = self.target(entry, i)
+            rec["status"] = "ok"
+            rec["request_id"] = rid
+        except Exception as e:
+            rec["status"] = _classify(e)
+            rec["error"] = "%s: %s" % (type(e).__name__, e)
+        rec["latency_ms"] = round(
+            (time.perf_counter() - ts) * 1000.0, 3)
+        with self._rlock:
+            self.results.append(rec)
+
+    def run(self) -> List[dict]:
+        from concurrent.futures import ThreadPoolExecutor
+        self.results = []
+        futures = []
+        with ThreadPoolExecutor(self.workers,
+                                thread_name_prefix="loadgen") as ex:
+            t0 = time.perf_counter()
+            for i, e in enumerate(self.entries):
+                delay = t0 + float(e["t"]) - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                futures.append(ex.submit(self._fire, e, i,
+                                         float(e["t"]), t0))
+            for f in futures:
+                f.result()
+        return self.results
+
+
+def score(results: Sequence[dict], slo_ms: float,
+          duration_s: Optional[float] = None) -> Dict:
+    """Ledger-row fields for one replay: latency percentiles over
+    ANSWERED requests, SLO attainment (answered within ``slo_ms``),
+    outcome counts, throughput, and the worst pacer lag."""
+    lats = sorted(r["latency_ms"] for r in results
+                  if r["status"] == "ok")
+    counts: Dict[str, int] = {}
+    for r in results:
+        counts[r["status"]] = counts.get(r["status"], 0) + 1
+    n = len(lats)
+
+    def pct(p: float) -> Optional[float]:
+        if not n:
+            return None
+        return lats[min(int(p * n), n - 1)]
+    if duration_s is None:
+        duration_s = max((r["t"] for r in results), default=0.0) or 1.0
+    within = sum(1 for v in lats if v <= slo_ms)
+    return {
+        "requests": len(results),
+        "ok": n,
+        "shed": counts.get("shed", 0),
+        "unavailable": counts.get("unavailable", 0),
+        "timeouts": counts.get("timeout", 0),
+        "errors": counts.get("error", 0),
+        "p50_ms": round(pct(0.50), 3) if n else None,
+        "p90_ms": round(pct(0.90), 3) if n else None,
+        "p99_ms": round(pct(0.99), 3) if n else None,
+        "slo_ms": float(slo_ms),
+        "slo_attainment": round(within / n, 4) if n else 0.0,
+        "ok_per_sec": round(n / duration_s, 1),
+        "max_lag_ms": round(max((r["lag_ms"] for r in results),
+                                default=0.0), 3),
+    }

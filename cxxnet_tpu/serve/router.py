@@ -412,7 +412,7 @@ class Router:
         with self._lock:
             self._outstanding += 1
             self.counts["requests"] += 1
-        tr = _trace.active()
+        tr = _trace.sink()
         if tr is not None:
             with tr.span("router.admit", "router",
                          {"request_id": req.id, "priority": pr}):
@@ -434,7 +434,7 @@ class Router:
         excluded = set()
         failures = 0
         last: Optional[BaseException] = None
-        tr = _trace.active()
+        tr = _trace.sink()
         while True:
             now = time.monotonic()
             # the binding budget is the TIGHTER of the request deadline

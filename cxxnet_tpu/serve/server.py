@@ -12,10 +12,13 @@ Endpoints:
   POST /generate   {"prompts": [[token ids] ...], "seed": optional} ->
                    {"tokens": [[prompt + completion] ...],
                     "request_id", "timing"}
-  GET  /healthz    liveness + the artifact contract
+  GET  /healthz    liveness + the artifact contract (+ SLO incident
+                   count when an SLO engine is attached)
   GET  /metrics    engine.metrics() JSON (see serve/stats.py);
                    ?format=prom renders the engine registry as
                    Prometheus text exposition instead
+  GET  /slo        current SLO objectives, burn rates, incident list
+                   (obs/slo.py; 404 unless slo_p99_ms configured)
 
 Per-request observability (docs/observability.md): every admitted
 request carries an engine-assigned ``request_id``, echoed in the JSON
@@ -201,7 +204,22 @@ class ServeHandler(BaseHTTPRequestHandler):
             # or still-warming backend answers 503 so load balancers
             # stop sending traffic BEFORE requests start bouncing
             info = eng.healthz()
+            if self.server.slo is not None:
+                # SLO visibility rides the health check: a probe that
+                # already polls /healthz sees incidents without a
+                # second endpoint, and "healthy but burning" is
+                # distinguishable from plain healthy
+                info["incidents"] = self.server.slo.incident_count
             self._send(200 if info.get("ok") else 503, info)
+        elif parts.path == "/slo":
+            # current objectives, burn rates, incident list (JSON) —
+            # the obs/slo.py engine's status(); 404 when no SLO engine
+            # is configured (slo_p99_ms unset)
+            if self.server.slo is None:
+                self._send(404, {"error": "no SLO engine configured "
+                                 "(set slo_p99_ms)"})
+            else:
+                self._send(200, self.server.slo.status())
         elif parts.path == "/metrics":
             fmt = parse_qs(parts.query).get("format", ["json"])[0]
             if fmt == "prom":
@@ -426,7 +444,8 @@ class ServeHTTPServer(ThreadingHTTPServer):
                  port: int = 8080,
                  request_timeout: Optional[float] = 30.0,
                  max_body: int = 64 << 20, verbose: bool = False,
-                 access_log=False, allow_swap: bool = True):
+                 access_log=False, allow_swap: bool = True,
+                 slo=None):
         self.engine = engine
         self.request_timeout = request_timeout
         self.max_body = max_body
@@ -436,6 +455,9 @@ class ServeHTTPServer(ThreadingHTTPServer):
         self.access_log = access_log
         # POST /swap (router topology): serve_swap = 0 turns it off
         self.allow_swap = allow_swap
+        # obs/slo.py SLOEngine: enables GET /slo and the incident
+        # count in /healthz (None = endpoint absent)
+        self.slo = slo
         super().__init__((host, port), ServeHandler)
 
     def start_background(self) -> threading.Thread:

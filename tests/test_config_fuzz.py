@@ -6,7 +6,13 @@ malformed structure with GraphConfigError — not arbitrary exceptions.
 """
 import string
 
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# optional dev dependency: without it this module must SKIP at
+# collection, not error — tier-1 red means regression, not environment
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from cxxnet_tpu import config
 from cxxnet_tpu.graph import GraphConfigError, NetConfig

@@ -1,0 +1,533 @@
+"""Flight recorder + SLO engine (obs/flight.py, obs/slo.py):
+
+* ring semantics under threads — bounded memory, oldest-first
+  eviction, dump-while-appending safety (lockcheck-instrumented);
+* the trace-module seam: NOOP singleton identity with everything off,
+  flight-only recording, tracer+flight fanout;
+* histogram exemplar race-freedom (obs/registry.py);
+* multi-window burn-rate math, incident open/close, and the
+  acceptance loop: a forced burn-rate violation on a REAL serving
+  engine produces an incident record plus a flight dump whose spans
+  carry the exemplar request ids;
+* the /slo + /healthz endpoint surfaces (serve + telemetry).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.analysis import lockcheck
+from cxxnet_tpu.obs import trace as obs_trace
+from cxxnet_tpu.obs.flight import FlightRecorder
+from cxxnet_tpu.obs.registry import Registry
+from cxxnet_tpu.obs.slo import (SLOEngine, availability_slo,
+                                latency_slo)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.trace_report import (check_spans, incident_view,  # noqa: E402
+                                load_events, report)
+
+
+@pytest.fixture
+def no_flight():
+    """Guarantee the module seam is restored whatever a test does —
+    a leaked recorder would break the NOOP-identity contract other
+    tests (test_obs) pin."""
+    yield
+    obs_trace.set_flight(None)
+
+
+# ----------------------------------------------------------------------
+# ring semantics
+
+
+def test_ring_bounded_and_oldest_first_eviction():
+    fr = FlightRecorder(max_events=16)
+    for i in range(100):
+        fr.instant("ev%d" % i)
+    assert len(fr) == 16
+    assert fr.recorded == 100
+    names = [e[1] for e in fr.events_last(60.0)]
+    # the ring kept exactly the NEWEST 16, still in append order
+    assert names == ["ev%d" % i for i in range(84, 100)]
+
+
+def test_window_filter_drops_old_events():
+    fr = FlightRecorder(64)
+    fr.instant("old")
+    time.sleep(0.08)
+    fr.instant("new")
+    names = [e[1] for e in fr.events_last(0.04)]
+    assert names == ["new"]
+    assert {e[1] for e in fr.events_last(10.0)} == {"old", "new"}
+
+
+def test_dump_while_appending_under_threads(no_flight):
+    """Appenders never block on a dumper and vice versa; every dump
+    taken mid-traffic is a valid, span-balanced Chrome trace. Run
+    under the lockcheck seam (the SLO engine's lock is created through
+    it) so any ordering violation in the obs stack would surface."""
+    monitor = lockcheck.enable(held_warn_s=5.0)
+    try:
+        fr = obs_trace.set_flight(FlightRecorder(512))
+        # an SLO engine evaluating live puts a seam-instrumented lock
+        # (obs.slo.lock) plus the registry traffic into the same run
+        reg = Registry()
+        h = reg.histogram("cxxnet_t_dump_seconds", "t",
+                          buckets=(0.5,))
+        slo = SLOEngine(reg, [latency_slo(500.0, 0.9)],
+                        windows_s=(2.0, 0.5), flight=fr)
+        stop = threading.Event()
+
+        def appender(wi):
+            i = 0
+            while not stop.is_set():
+                i += 1
+                with obs_trace.span("work", "t",
+                                    {"w": wi, "i": i}):
+                    pass
+                fr.flow_start("f", wi * 1000000 + i)
+                fr.flow_end("f", wi * 1000000 + i)
+        threads = [threading.Thread(target=appender, args=(wi,))
+                   for wi in range(4)]
+        for t in threads:
+            t.start()
+        docs = []
+        for k in range(20):
+            h.observe(0.1, exemplar="req-%d" % k)
+            slo.tick()
+            docs.append(fr.dump_last(5.0)["doc"])
+        stop.set()
+        for t in threads:
+            t.join()
+        assert len(fr) <= 512
+        for doc in docs[-3:]:
+            rep = report(doc["traceEvents"])
+            chk = check_spans(doc["traceEvents"])
+            assert not chk["unbalanced"], chk["unbalanced"][:3]
+            assert rep["nonempty_lanes"] >= 1
+        monitor.assert_clean()
+    finally:
+        lockcheck.disable()
+
+
+def test_dump_file_readable_by_trace_report(tmp_path, no_flight):
+    fr = obs_trace.set_flight(FlightRecorder(256))
+    for i in range(5):
+        with obs_trace.span("serve.complete", "serve",
+                            {"request_id": "req-t-%d" % i}):
+            fr.flow_start("request", i)
+            fr.flow_end("request", i)
+    path = str(tmp_path / "dump.json")
+    info = fr.dump_last(10.0, path)
+    assert info["path"] == path and info["events"] == 15
+    events = load_events(path)
+    rep = report(events)
+    assert rep["flows"]["matched"] == 5
+    assert {s["name"] for s in rep["spans"]} == {"serve.complete"}
+    assert not check_spans(events)["unbalanced"]
+
+
+def test_dump_lane_names_survive_thread_death(no_flight):
+    fr = obs_trace.set_flight(FlightRecorder(64))
+
+    def work():
+        fr.instant("from-short-lived")
+    t = threading.Thread(target=work, name="short-lived")
+    t.start()
+    t.join()
+    doc = fr.dump_last(10.0)["doc"]
+    lanes = [e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"]
+    assert "short-lived" in lanes
+
+
+# ----------------------------------------------------------------------
+# the trace-module seam
+
+
+def test_noop_singleton_identity_with_everything_off():
+    assert obs_trace.active() is None and obs_trace.flight() is None
+    s1 = obs_trace.span("x")
+    s2 = obs_trace.span("y")
+    assert s1 is s2 is obs_trace.NOOP_SPAN
+
+
+def test_flight_only_records_through_module_helpers(no_flight):
+    fr = obs_trace.set_flight(FlightRecorder(64))
+    assert obs_trace.sink() is fr
+    with obs_trace.span("hello", "t"):
+        pass
+    obs_trace.instant("mark")
+    obs_trace.flow_start("f", 7)
+    obs_trace.flow_end("f", 7)
+    kinds = [(e[0], e[1]) for e in fr.events_last(10.0)]
+    assert ("X", "hello") in kinds and ("i", "mark") in kinds
+    assert ("s", "f") in kinds and ("f", "f") in kinds
+    obs_trace.set_flight(None)
+    assert obs_trace.sink() is None
+    assert obs_trace.span("x") is obs_trace.NOOP_SPAN
+
+
+def test_fanout_records_into_tracer_and_flight(tmp_path, no_flight):
+    fr = obs_trace.set_flight(FlightRecorder(64))
+    obs_trace.start(str(tmp_path / "t.json"))
+    try:
+        with obs_trace.span("both", "t"):
+            pass
+        assert any(e[1] == "both" for e in fr.events_last(10.0))
+        tr_names = [e["name"] for e in obs_trace.active()._events]
+        assert "both" in tr_names
+    finally:
+        obs_trace.stop()
+    # tracer gone, flight still installed: sink collapses back
+    assert obs_trace.sink() is fr
+
+
+# ----------------------------------------------------------------------
+# histogram exemplars
+
+
+def test_histogram_exemplars_recorded_capped_and_snapshotted():
+    reg = Registry()
+    h = reg.histogram("cxxnet_t_lat_seconds", "t",
+                      buckets=(0.01, 0.1))
+    for i in range(40):
+        h.observe(0.001 * (i + 1), exemplar="req-%03d" % i)
+    exs = h.exemplars()
+    assert len(exs) == h.EXEMPLARS
+    assert exs[-1] == ("req-039", pytest.approx(0.04))
+    # min_value filters to the over-threshold ones
+    assert all(v >= 0.03 for _, v in h.exemplars(min_value=0.03))
+    snap = reg.snapshot()["cxxnet_t_lat_seconds"]["series"][0]
+    assert snap["value"]["exemplars"][-1][0] == "req-039"
+    # the prom exposition is unchanged by exemplars (no OpenMetrics)
+    assert "req-" not in reg.render_prom()
+
+
+def test_histogram_exemplar_thread_race_freedom():
+    """N writers observing with exemplars while readers snapshot and
+    filter concurrently: no exception, every pair well-formed, totals
+    exact."""
+    reg = Registry()
+    h = reg.histogram("cxxnet_t_race_seconds", "t", buckets=(0.5,),
+                      labelnames=("w",))
+    stop = threading.Event()
+    errs = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                for ex, v in h.exemplars():
+                    assert isinstance(ex, str) and isinstance(v, float)
+                reg.snapshot()
+            except Exception as e:          # pragma: no cover
+                errs.append(e)
+
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for r in readers:
+        r.start()
+    per, nw = 400, 4
+
+    def writer(wi):
+        for i in range(per):
+            h.observe(0.25, exemplar="req-%d-%d" % (wi, i),
+                      w=str(wi))
+    writers = [threading.Thread(target=writer, args=(wi,))
+               for wi in range(nw)]
+    for w in writers:
+        w.start()
+    for w in writers:
+        w.join()
+    stop.set()
+    for r in readers:
+        r.join()
+    assert not errs
+    good, total = h.counts_under(0.5)
+    assert (good, total) == (per * nw, per * nw)
+    assert len(h.exemplars()) == nw * h.EXEMPLARS
+    assert len(h.exemplars(subset={"w": "0"})) == h.EXEMPLARS
+
+
+# ----------------------------------------------------------------------
+# burn-rate math + incidents
+
+
+def _lat_reg(buckets=(0.05, 0.25)):
+    reg = Registry()
+    h = reg.histogram("cxxnet_serve_request_latency_seconds", "lat",
+                      buckets=buckets)
+    return reg, h
+
+
+def test_burn_rate_windows_exact():
+    reg, h = _lat_reg()
+    slo = SLOEngine(reg, [latency_slo(50.0, 0.9)],
+                    windows_s=(10.0, 1.0))
+    t = 1000.0
+    slo.tick(now=t)
+    for _ in range(8):
+        h.observe(0.01)
+    for _ in range(2):
+        h.observe(0.2)      # 20% bad on a 10% budget -> burn 2.0
+    slo.tick(now=t + 1.0)
+    name = "latency_p90_under_50ms"
+    assert reg.get_value("cxxnet_slo_burn_rate", slo=name,
+                         window="10s") == pytest.approx(2.0)
+    assert reg.get_value("cxxnet_slo_burn_rate", slo=name,
+                         window="1s") == pytest.approx(2.0)
+    assert reg.get_value("cxxnet_slo_attainment", slo=name,
+                         window="1s") == pytest.approx(0.8)
+    assert reg.get_value("cxxnet_slo_target",
+                         slo=name) == pytest.approx(0.9)
+
+
+def test_multi_window_and_rule_needs_both_windows():
+    """A burst that has already cleared the short window must NOT open
+    an incident even while the long window still reads hot — and with
+    no traffic at all nothing pages."""
+    reg, h = _lat_reg()
+    slo = SLOEngine(reg, [latency_slo(50.0, 0.9)],
+                    windows_s=(10.0, 1.0))
+    t = 2000.0
+    slo.tick(now=t)
+    assert slo.tick(now=t + 0.5) == []          # no traffic, no burn
+    for _ in range(10):
+        h.observe(0.2)                          # all bad
+    slo.tick(now=t + 1.0)
+    assert slo.incident_count == 1              # both windows hot
+    # drain the burst: only good traffic in the next short window
+    for _ in range(200):
+        h.observe(0.01)
+    opened = slo.tick(now=t + 2.5)
+    assert opened == []
+    # long window still shows burn > 1, short window recovered
+    name = "latency_p90_under_50ms"
+    assert reg.get_value("cxxnet_slo_burn_rate", slo=name,
+                         window="10s") > 0.0
+    assert reg.get_value("cxxnet_slo_violation", slo=name) == 0.0
+    assert slo.incident_count == 1              # no second incident
+
+
+def test_incident_opens_once_and_closes_on_recovery():
+    reg, h = _lat_reg()
+    slo = SLOEngine(reg, [latency_slo(50.0, 0.9)],
+                    windows_s=(4.0, 1.0))
+    name = "latency_p90_under_50ms"
+    t = 3000.0
+    slo.tick(now=t)
+    for _ in range(10):
+        h.observe(0.2)
+    assert len(slo.tick(now=t + 1.0)) == 1
+    # still violating: the SAME incident stays open, no re-count
+    for _ in range(10):
+        h.observe(0.2)
+    assert slo.tick(now=t + 2.0) == []
+    assert reg.get_value("cxxnet_slo_incidents_total", slo=name) == 1.0
+    assert reg.get_value("cxxnet_slo_violation", slo=name) == 1.0
+    inc = slo.incidents()[-1]
+    assert inc["closed_unix"] is None
+    # recovery: good traffic flushes both windows
+    for _ in range(5000):
+        h.observe(0.01)
+    slo.tick(now=t + 6.5)
+    assert reg.get_value("cxxnet_slo_violation", slo=name) == 0.0
+    assert inc["closed_unix"] is not None
+
+
+def test_availability_objective_over_counters():
+    reg = Registry()
+    good = reg.counter("cxxnet_serve_requests_total", "", ())
+    bad = reg.counter("cxxnet_serve_errors_total", "", ())
+    slo = SLOEngine(reg, [availability_slo(0.99)],
+                    windows_s=(10.0, 1.0))
+    t = 4000.0
+    slo.tick(now=t)
+    good.inc(90)
+    bad.inc(10)       # 10% failure on a 1% budget -> burn 10
+    opened = slo.tick(now=t + 1.0)
+    assert len(opened) == 1 and opened[0]["slo"] == "availability"
+    assert reg.get_value("cxxnet_slo_burn_rate", slo="availability",
+                         window="1s") == pytest.approx(10.0)
+
+
+def test_status_payload_shape():
+    reg, h = _lat_reg()
+    slo = SLOEngine(reg, [latency_slo(50.0, 0.9)],
+                    windows_s=(4.0, 1.0))
+    t = 5000.0
+    slo.tick(now=t)
+    h.observe(0.2, exemplar="req-bad-1")
+    slo.tick(now=t + 1.0)
+    st = slo.status()
+    assert st["incident_count"] == 1
+    (obj,) = st["objectives"]
+    assert obj["violating"] and obj["burn_rate"]["1s"] > 1.0
+    (inc,) = st["incidents"]
+    assert inc["slo"] == obj["name"]
+    assert inc["exemplars"][0]["request_id"] == "req-bad-1"
+    assert "doc" not in json.dumps(st)   # dumps referenced, not inlined
+    assert json.loads(json.dumps(st))    # JSON-able throughout
+
+
+# ----------------------------------------------------------------------
+# the acceptance loop: real engine -> forced violation -> incident +
+# dump whose spans carry the exemplar request ids
+
+
+@pytest.fixture(scope="module")
+def tiny_trainer():
+    from cxxnet_tpu import config, models
+    from cxxnet_tpu.trainer import Trainer
+    tr = Trainer()
+    for k, v in config.parse_string(models.mnist_mlp(nhidden=16,
+                                                     nclass=4)):
+        tr.set_param(k, v)
+    for k, v in (("dev", "cpu:0"), ("batch_size", "8"),
+                 ("eta", "0.1"), ("input_shape", "1,1,16")):
+        tr.set_param(k, v)
+    tr.init_model()
+    return tr
+
+
+def test_forced_violation_dumps_flight_with_exemplars(
+        tmp_path, tiny_trainer, no_flight):
+    from cxxnet_tpu.serve import ServingEngine
+    fr = obs_trace.set_flight(FlightRecorder(4096))
+    reg = Registry()
+    eng = ServingEngine(tiny_trainer, max_wait_ms=1.0, registry=reg,
+                        slo_ms=0.001)
+    slo = SLOEngine(reg, [latency_slo(0.001, 0.9)],
+                    windows_s=(4.0, 0.5), flight=fr,
+                    dump_dir=str(tmp_path))
+    data = np.random.RandomState(0).randn(4, 1, 1, 16).astype(
+        np.float32)
+    try:
+        slo.tick()
+        reqs = [eng.submit(data[:1]) for _ in range(6)]
+        for r in reqs:
+            r.result(30)
+        time.sleep(0.05)
+        opened = slo.tick()
+    finally:
+        eng.close()
+    assert len(opened) == 1
+    inc = opened[0]
+    exemplar_ids = {e["request_id"] for e in inc["exemplars"]}
+    assert {r.id for r in reqs} <= exemplar_ids
+    dump = inc["flight_dump"]
+    assert dump["path"] and os.path.exists(dump["path"])
+    events = load_events(dump["path"])
+    span_ids = {e.get("args", {}).get("request_id") for e in events
+                if e.get("ph") == "X"}
+    assert exemplar_ids <= span_ids     # every exemplar has its span
+    assert not check_spans(events)["unbalanced"]
+    # the record file + incident view agree
+    rec, verdicts = incident_view(inc["record_path"])
+    assert verdicts["dump_present"] and verdicts["exemplars_in_dump"] \
+        and verdicts["dump_spans_balanced"]
+
+
+# ----------------------------------------------------------------------
+# endpoint surfaces
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_serve_slo_endpoint_and_healthz(tmp_path, tiny_trainer,
+                                        no_flight):
+    from cxxnet_tpu.serve import ServingEngine
+    from cxxnet_tpu.serve.server import build_server
+    fr = obs_trace.set_flight(FlightRecorder(1024))
+    reg = Registry()
+    eng = ServingEngine(tiny_trainer, max_wait_ms=1.0, registry=reg,
+                        slo_ms=0.001)
+    slo = SLOEngine(reg, [latency_slo(0.001, 0.9)],
+                    windows_s=(4.0, 0.5), flight=fr,
+                    dump_dir=str(tmp_path))
+    srv = build_server(eng, port=0, slo=slo)
+    srv.start_background()
+    url = "http://127.0.0.1:%d" % srv.server_address[1]
+    data = np.random.RandomState(0).randn(1, 1, 1, 16).astype(
+        np.float32)
+    try:
+        slo.tick()
+        eng.submit(data).result(30)
+        time.sleep(0.05)
+        slo.tick()
+        st, body = _get(url + "/slo")
+        assert st == 200 and body["incident_count"] == 1
+        assert body["objectives"][0]["violating"]
+        st, body = _get(url + "/healthz")
+        assert st == 200 and body["incidents"] == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+
+def test_serve_slo_endpoint_404_without_engine(tiny_trainer):
+    from cxxnet_tpu.serve import ServingEngine
+    from cxxnet_tpu.serve.server import build_server
+    eng = ServingEngine(tiny_trainer, max_wait_ms=1.0)
+    srv = build_server(eng, port=0)
+    srv.start_background()
+    url = "http://127.0.0.1:%d" % srv.server_address[1]
+    try:
+        st, body = _get(url + "/slo")
+        assert st == 404 and "slo_p99_ms" in body["error"]
+        st, body = _get(url + "/healthz")
+        assert "incidents" not in body
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.close()
+
+
+def test_telemetry_slo_endpoint():
+    from cxxnet_tpu.obs.telemetry import TelemetryServer
+    reg, h = _lat_reg()
+    slo = SLOEngine(reg, [latency_slo(50.0, 0.9)],
+                    windows_s=(4.0, 1.0))
+    t = 6000.0
+    slo.tick(now=t)
+    h.observe(0.2)
+    slo.tick(now=t + 1.0)
+    srv = TelemetryServer(reg, port=0, slo=slo)
+    srv.start_background()
+    url = "http://127.0.0.1:%d" % srv.port
+    try:
+        st, body = _get(url + "/slo")
+        assert st == 200 and body["incident_count"] == 1
+        st, body = _get(url + "/healthz")
+        assert body == {"ok": True, "incidents": 1}
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    # without an SLO engine the endpoint 404s and healthz stays bare
+    srv2 = TelemetryServer(reg, port=0)
+    srv2.start_background()
+    url = "http://127.0.0.1:%d" % srv2.port
+    try:
+        st, _ = _get(url + "/slo")
+        assert st == 404
+        st, body = _get(url + "/healthz")
+        assert body == {"ok": True}
+    finally:
+        srv2.shutdown()
+        srv2.server_close()

@@ -1,4 +1,6 @@
 """NetConfig DAG builder tests (semantics of reference src/nnet/nnet_config.h)."""
+import os
+
 import pytest
 
 from cxxnet_tpu import config
@@ -243,6 +245,9 @@ def test_structure_roundtrip():
         assert a.same_structure(b)
 
 
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/example/MNIST/MNIST_CONV.conf"),
+    reason="reference checkout not mounted at /root/reference")
 def test_reference_mnist_conv_conf():
     entries = config.parse_file("/root/reference/example/MNIST/MNIST_CONV.conf")
     net = NetConfig()

@@ -145,6 +145,7 @@ def test_two_process_training_agrees(tmp_path, mode):
             [sys.executable, str(script), str(rank), port, out, mode],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env={**os.environ, "PALLAS_AXON_POOL_IPS": ""}))
+    errs = []
     for p in procs:
         try:
             _, err = p.communicate(timeout=300)
@@ -152,6 +153,16 @@ def test_two_process_training_agrees(tmp_path, mode):
             for q in procs:
                 q.kill()
             pytest.fail("multi-process run timed out")
+        errs.append(err)
+    if any("Multiprocess computations aren't implemented" in e
+           for e in errs):
+        # this jax build's CPU backend has no multi-process collective
+        # support — an environment limit, not a regression; tier-1 red
+        # must mean regression (every real multihost path is still
+        # exercised wherever the backend supports it)
+        pytest.skip("CPU backend lacks multiprocess collectives "
+                    "in this environment")
+    for p, err in zip(procs, errs):
         assert p.returncode == 0, err[-3000:]
 
     w0 = np.load(outs[0])

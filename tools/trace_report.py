@@ -35,7 +35,9 @@ bound."""
 
 import argparse
 import json
+import os
 import sys
+import time
 
 STALL_MARKERS = ("wait", "stall", "backpressure", ".get")
 
@@ -165,6 +167,65 @@ def check_spans(events, eps_us: float = 0.5):
     }
 
 
+def incident_view(path):
+    """Load an SLO incident record (obs/slo.py writes
+    ``*.incident.json`` beside its flight dump), verify the pair, and
+    return ``(record, verdicts)``: the dump must exist, pass the span
+    check, and contain a span carrying each exemplar request id — the
+    "bad p99 links straight to its trace" contract. Relative dump
+    paths resolve against the record's directory."""
+    with open(path) as f:
+        rec = json.load(f)
+    verdicts = {}
+    fd = rec.get("flight_dump") or {}
+    dump = fd.get("path")
+    if dump and not os.path.isabs(dump):
+        cand = os.path.join(os.path.dirname(os.path.abspath(path)),
+                            os.path.basename(dump))
+        dump = dump if os.path.exists(dump) else cand
+    verdicts["dump_present"] = bool(dump and os.path.exists(dump))
+    if verdicts["dump_present"]:
+        events = load_events(dump)
+        chk = check_spans(events)
+        verdicts["dump_spans_balanced"] = not chk["unbalanced"]
+        span_ids = {ev.get("args", {}).get("request_id")
+                    for ev in events if ev.get("ph") == "X"}
+        exemplars = [e.get("request_id")
+                     for e in rec.get("exemplars", [])]
+        missing = [e for e in exemplars if e not in span_ids]
+        verdicts["exemplars_in_dump"] = not missing
+        verdicts["exemplars_missing"] = missing
+        verdicts["dump_path"] = dump
+    return rec, verdicts
+
+
+def _human_incident(rec, verdicts):
+    out = ["incident #%s on %r opened %s"
+           % (rec.get("seq"), rec.get("slo"),
+              time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                            time.gmtime(rec.get("opened_unix", 0))))]
+    obj = rec.get("objective", {})
+    out.append("  objective: %s target=%s %s"
+               % (obj.get("kind"), obj.get("target"),
+                  ("threshold %sms" % obj.get("threshold_ms"))
+                  if obj.get("kind") == "latency" else ""))
+    out.append("  burn rates: %s" % rec.get("burn"))
+    out.append("  attainment: %s" % rec.get("attainment"))
+    exs = rec.get("exemplars", [])
+    if exs:
+        out.append("  exemplar requests (over threshold):")
+        for e in exs[:8]:
+            out.append("    %-20s %8.2f ms"
+                       % (e.get("request_id"), e.get("value_ms", 0)))
+    for k, v in verdicts.items():
+        if k in ("exemplars_missing", "dump_path"):
+            continue
+        out.append("  check %-22s %s" % (k, "ok" if v else "FAIL"))
+    if verdicts.get("dump_path"):
+        out.append("  dump: %s" % verdicts["dump_path"])
+    return "\n".join(out)
+
+
 def _human(rep):
     out = ["trace: %.1f ms wall, %d lanes"
            % (rep["wall_ms"], rep["nonempty_lanes"])]
@@ -192,7 +253,14 @@ def _human(rep):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("trace", help="Chrome trace-event JSON file")
+    ap.add_argument("trace", help="Chrome trace-event JSON file, or "
+                                  "with --incident an *.incident.json "
+                                  "record written by obs/slo.py")
+    ap.add_argument("--incident", action="store_true",
+                    help="incident view: render the SLO incident "
+                         "record, verify its flight dump exists, "
+                         "passes the span check, and contains every "
+                         "exemplar request id; exit 2 on any failure")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as one JSON line")
     ap.add_argument("--min-lanes", type=int, default=0,
@@ -208,6 +276,17 @@ def main():
                     help="with --check-spans: exit 2 when more than N "
                          "flow starts never finish")
     args = ap.parse_args()
+    if args.incident:
+        rec, verdicts = incident_view(args.trace)
+        if args.json:
+            print(json.dumps({"incident": rec, "verdicts": {
+                k: v for k, v in verdicts.items()
+                if k != "dump_path"}}))
+        else:
+            print(_human_incident(rec, verdicts))
+        ok = all(v for k, v in verdicts.items()
+                 if k not in ("exemplars_missing", "dump_path"))
+        return 0 if ok else 2
     events = load_events(args.trace)
     rep = report(events)
     if args.check_spans:
