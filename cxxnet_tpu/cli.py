@@ -297,12 +297,21 @@ class LearnTask:
                                    "temperature", "export_prompt_len",
                                    "export_out", "export_batch",
                                    "export_batch_ladder",
-                                   "export_platform"]),
+                                   "export_platform",
+                                   # split-phase (paged) decoder
+                                   # (export_decode = step)
+                                   "export_kv_block",
+                                   "export_pool_blocks",
+                                   "export_prefill_rows",
+                                   "export_prefill_widths"]),
         "serve": frozenset(["export_in", "serve_host", "serve_port",
                             "serve_max_wait_ms", "serve_max_batch",
                             "serve_queue_limit", "serve_timeout_ms",
                             "serve_dispatch_depth", "serve_warmup",
                             "serve_access_log",
+                            # continuous batching (serve/continuous.py)
+                            "serve_stream", "serve_prefill_split",
+                            "serve_kv_blocks",
                             # multi-replica front end (serve/router.py)
                             "serve_replicas", "serve_max_retries",
                             "serve_priority_default", "serve_swap",
@@ -822,7 +831,12 @@ class LearnTask:
         is exported instead (serving.export_generate): max_new /
         temperature / export_prompt_len shape the artifact; the
         decode_layout and decode_kv knobs resolve exactly as
-        task=generate would."""
+        task=generate would. export_decode=step exports the
+        SPLIT-PHASE decoder for continuous batching instead
+        (serving.export_decode_step — paged KV pool + width-bucketed
+        prefills): export_kv_block / export_pool_blocks size the pool
+        pages, export_prefill_rows / export_prefill_widths (comma
+        lists) override the prefill bucket ladders."""
         from . import serving
         d = dict(self.cfg)
         out = d.get("export_out", "model.export")
@@ -837,7 +851,27 @@ class LearnTask:
             ladder = [int(x) for x in ladder_s.split(",") if x.strip()]
         else:
             ladder = None
-        if int(d.get("export_decode", "0")):
+        dec = d.get("export_decode", "0").strip()
+        if dec == "step":
+            rows_s = d.get("export_prefill_rows", "").strip()
+            widths_s = d.get("export_prefill_widths", "").strip()
+            serving.export_decode_step(
+                self.trainer, out,
+                max_new=int(d.get("max_new", "32")),
+                temperature=float(d.get("temperature", "0")),
+                prompt_len=int(d.get("export_prompt_len", "0")) or None,
+                batch_size=bs,
+                prefill_rows=[int(x) for x in rows_s.split(",")
+                              if x.strip()] or None,
+                prefill_widths=[int(x) for x in widths_s.split(",")
+                                if x.strip()] or None,
+                kv_block=int(d.get("export_kv_block", "128")),
+                pool_blocks=int(d.get("export_pool_blocks", "0"))
+                or None,
+                platforms=platforms)
+            print("exported split-phase decoder to %s (+.meta)" % out)
+            return
+        if int(dec or "0"):
             serving.export_generate(
                 self.trainer, out,
                 max_new=int(d.get("max_new", "32")),
@@ -868,6 +902,16 @@ class LearnTask:
         first-call compile), serve_access_log (default 0: one
         structured JSON line per request on stderr — method, path,
         status, request_id, wall ms; docs/observability.md).
+
+        A generate_step artifact (export_decode=step) serves through
+        the CONTINUOUS-BATCHING engine instead (serve/continuous.py):
+        paged KV pool, prefill/decode phase split, per-token SSE
+        streaming on /generate ({"stream": true}). Its knobs:
+        serve_stream (default 1; 0 returns 403 on stream requests),
+        serve_prefill_split (default 1; 0 = coupled legacy scheduling
+        for A/B measurement), serve_kv_blocks (default 0 = the whole
+        exported pool; fewer pages = admission control without a
+        re-export).
 
         serve_replicas = N (default 1) runs the resilient multi-
         replica topology instead: N supervised ServingEngine replicas
@@ -923,6 +967,17 @@ class LearnTask:
             from .serve.replica import ReplicaSet
             from .serve.router import Router
             path = d["export_in"]
+            meta_path = path + ".meta"
+            if os.path.exists(meta_path):
+                import json as _json
+                with open(meta_path) as f:
+                    if _json.load(f).get("kind") == "generate_step":
+                        raise RuntimeError(
+                            "serve_replicas > 1 does not support "
+                            "generate_step artifacts: the continuous-"
+                            "batching engine is single-replica (set "
+                            "serve_replicas=1, or export a monolithic "
+                            "decoder for the router topology)")
             rs = ReplicaSet(
                 lambda: serving.load_exported(path), n=n_rep,
                 engine_kw=engine_kw, registry=get_registry(),
@@ -943,13 +998,29 @@ class LearnTask:
                 raise RuntimeError(
                     "task=serve needs export_in=<artifact> or "
                     "model_in=<ckpt>")
-            backend = ServingEngine(
-                callee,
-                warmup=bool(int(d.get("serve_warmup", "1"))),
-                # the process-global registry: /metrics?format=prom
-                # and a telemetry_port endpoint in the same process
-                # render one shared view
-                registry=get_registry(), **engine_kw)
+            if isinstance(callee, serving.ExportedStepDecoder):
+                # a split-phase artifact serves through the
+                # continuous-batching engine: paged KV pool, prefill/
+                # decode split, per-token streaming (docs/serving.md)
+                from .serve.continuous import ContinuousDecodeEngine
+                backend = ContinuousDecodeEngine(
+                    callee,
+                    queue_limit=int(d.get("serve_queue_limit", "64")),
+                    timeout_ms=timeout_ms,
+                    prefill_split=bool(
+                        int(d.get("serve_prefill_split", "1"))),
+                    kv_blocks=int(d.get("serve_kv_blocks", "0")),
+                    slo_ms=slo_ms or None,
+                    warmup=bool(int(d.get("serve_warmup", "1"))),
+                    registry=get_registry())
+            else:
+                backend = ServingEngine(
+                    callee,
+                    warmup=bool(int(d.get("serve_warmup", "1"))),
+                    # the process-global registry: /metrics?format=prom
+                    # and a telemetry_port endpoint in the same process
+                    # render one shared view
+                    registry=get_registry(), **engine_kw)
         slo_eng = None
         if slo_ms > 0:
             from .obs.slo import (SLOEngine, availability_slo,
@@ -981,6 +1052,7 @@ class LearnTask:
             verbose=not self.silent,
             access_log=bool(int(d.get("serve_access_log", "0"))),
             allow_swap=bool(int(d.get("serve_swap", "1"))),
+            allow_stream=bool(int(d.get("serve_stream", "1"))),
             slo=slo_eng)
         host, port = srv.server_address[:2]
         if not self.silent:

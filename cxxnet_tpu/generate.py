@@ -167,6 +167,302 @@ def prompt_slots(max_len: int, seq_len: int) -> int:
     return min(seq_len, max(64, -(-max_len // 64) * 64))
 
 
+# ----------------------------------------------------------------------
+# shared net math: the per-layer building blocks used by BOTH the
+# monolithic decoder (build) and the split prefill/step programs
+# (build_prefill / build_step). One implementation per op is what keeps
+# the contiguous and paged decode paths greedy-identical: they must
+# differ only in where the cache lives, never in the math.
+
+def _sample_at(logits, rng, temperature):
+    if temperature == 0.0:
+        return jnp.argmax(logits, -1), rng
+    rng, k = jax.random.split(rng)
+    return jax.random.categorical(k, logits / temperature), rng
+
+
+def _embed_one(params, p, emb, dt, ids, pos):
+    """ids (B,), pos (B,) -> (B, e) embedding (+position)."""
+    lp = params[p["embed"]]
+    out = jnp.take(lp["wmat"], ids, axis=0).astype(dt)
+    if emb.learn_pos:
+        out = out + jnp.take(lp["pos"], pos, axis=0).astype(dt)
+    return out
+
+
+def _head_logits(params, p, dt, h):
+    lp = params[p["head"]]
+    out = jnp.dot(h.astype(dt),
+                  lp["wmat"].T.astype(dt)).astype(jnp.float32)
+    if "bias" in lp:
+        out = out + lp["bias"]
+    return out                                    # (B, V) logits
+
+
+def _mlp_block(st, layer_p, x, dt):
+    """MLP residual branch on (..., e) activations, dense or MoE —
+    mirrors TransformerStackLayer._block_fn.mlp. At decode the MoE
+    route sees only the B new tokens (capacity over B instead of
+    B*S); gating is per-token so this matches the full-forward path
+    exactly as long as no token is capacity-dropped on either path
+    (capacity_factor >= nexpert/moe_topk guarantees that)."""
+    if not st.moe:
+        y = jax.nn.relu(
+            jnp.einsum("...e,me->...m", x, layer_p["w1"].astype(dt)))
+        return jnp.einsum("...m,em->...e", y,
+                          layer_p["w2"].astype(dt))
+    shape = x.shape
+    y, _ = L.moe_mlp(x.reshape(-1, shape[-1]), layer_p, st.topk,
+                     st.nexpert, st.capacity_factor, dt)
+    return y.reshape(shape)
+
+
+def _embed_prompt(params, p, emb, dt, toks, width):
+    lp0 = params[p["embed"]]
+    h = jnp.take(lp0["wmat"], toks[:, :width],
+                 axis=0).astype(dt)                # (B, width, e)
+    if emb.learn_pos:
+        h = h + lp0["pos"][:width].astype(dt)[None]
+    return h
+
+
+def _stack_prefill(st, lp, h, B, sl, e, dt, platform):
+    """Prompt-wide pass that ALSO returns per-layer K/V.
+
+    Mirrors _block_fn's dense block, UNROLLED over depth (the
+    training recipe's own finding: full unroll beats the scan's
+    sliced-stack weight access), with the attend routed the way
+    the training step routes it — the flat zero-relayout flash
+    kernel when the shape supports it, generic flash otherwise,
+    exact XLA attend off-TPU. When the flat kernel runs, K/V for
+    the cache are sliced from the flat projection (one relayout
+    per layer instead of the attend's three).
+
+    ``sl`` is the sequence width of ``h``: the slot layouts run
+    prefill on just the P prompt slots instead of the net's full
+    seq_len (only [0, P) ever enters the cache, and rows past a
+    prompt's ``lens`` are masked out of attention either way) —
+    at P = S/2 that halves the prefill matmul FLOPs and quarters
+    the attend. ``blend`` passes the full S (its cache is indexed
+    by absolute position)."""
+    from .ops import flash_attention as fa
+    nh = st.nhead
+    d = e // nh
+
+    impl = fa.resolve_impl(st.attn_impl, platform, sl)
+    # honor the stack's attn_flat=off escape hatch exactly like
+    # the training dispatch (layers._block_fn) does
+    flat = impl == "pallas" \
+        and getattr(st, "attn_flat", "auto") != "off" and bool(
+            fa.supports_flat(sl, nh, d)
+            or fa.flat_blocked_plan(sl, nh, d))
+    interp = platform != "tpu"
+    nlayer = lp["wqkv"].shape[0]
+    ks, vs = [], []
+    for li in range(nlayer):
+        layer_p = {kk: vv[li] for kk, vv in lp.items()}
+        x = _rmsnorm(h, layer_p["norm1"], dt)
+        qkv = jnp.einsum("bse,fe->bsf", x,
+                         layer_p["wqkv"].astype(dt))
+        if flat:
+            out4 = fa.flash_attention_flat(qkv, nh, causal=True,
+                                           interpret=interp)
+            kv4 = qkv.reshape(B, sl, 3, nh, d)
+            k = kv4[:, :, 1].transpose(0, 2, 1, 3)
+            v = kv4[:, :, 2].transpose(0, 2, 1, 3)
+            out = out4
+        else:
+            qkv4 = qkv.reshape(B, sl, 3, nh, d).transpose(
+                2, 0, 3, 1, 4)
+            q, k, v = qkv4[0], qkv4[1], qkv4[2]
+            if impl == "pallas":
+                out = fa.flash_attention(q, k, v, causal=True,
+                                         interpret=interp)
+            else:
+                # f32 score accumulation + d^-0.5 scale, matching
+                # ops.ring_attention.attention (the exact attend)
+                scores = jnp.einsum(
+                    "bhqd,bhkd->bhqk", q, k,
+                    preferred_element_type=jnp.float32) \
+                    * (d ** -0.5)
+                mask = jnp.tril(jnp.ones((sl, sl), bool))
+                att = jax.nn.softmax(
+                    jnp.where(mask, scores, NEG), -1)
+                out = jnp.einsum("bhqk,bhkd->bhqd",
+                                 att.astype(dt), v)
+            out = out.transpose(0, 2, 1, 3).reshape(B, sl, e)
+        h = h + jnp.einsum("bse,fe->bsf", out,
+                           layer_p["wo"].astype(dt))
+        x = _rmsnorm(h, layer_p["norm2"], dt)
+        h = h + _mlp_block(st, layer_p, x, dt)
+        ks.append(k)
+        vs.append(v)
+    return h, jnp.stack(ks), jnp.stack(vs)  # (L, B, nh, sl, d)
+
+
+def uniform_heads_or_reason(net, p):
+    """The split prefill/step programs keep ONE paged K/V pool shaped
+    (blocks, layers, nh, block_size, d) across every stack, so all
+    stacks must agree on the head geometry. Returns (nh, d) on
+    success, raises ValueError with the mismatch otherwise."""
+    emb = net.modules[p["embed"]]
+    e = emb.param.num_hidden
+    nhs = {net.modules[i].nhead for i in p["stacks"]}
+    if len(nhs) != 1:
+        raise ValueError(
+            "stepwise (paged) decode export needs every "
+            "transformer_stack to share nhead (found %s); the paged "
+            "pool is one (blocks, layers, nh, bs, d) tensor"
+            % sorted(nhs))
+    nh = nhs.pop()
+    return nh, e // nh
+
+
+def build_prefill(net, p, temperature: float, B: int, W: int,
+                  platform: str = "cpu"):
+    """Build the jitted PREFILL half of the split decode:
+
+        (params, toks (B, W) int32, lens (B,) int32, rng)
+            -> (first (B,) int32, k (Ltot, B, nh, W, d), v (same))
+
+    One causal pass over a ``W``-slot prompt window (W is a
+    prompt-width bucket — prompt_slots granularity — so short prompts
+    run a narrow program instead of the artifact-wide one), returning
+    the prompt K/V for the host to scatter into the paged pool plus
+    the first sampled token (logits at ``lens - 1``). The math is
+    byte-for-byte ``build``'s prefill: same _stack_prefill, same head,
+    same sampling — only the cache hand-off differs."""
+    emb = net.modules[p["embed"]]
+    stacks = [net.modules[i] for i in p["stacks"]]
+    dt = net.compute_dtype
+    e = emb.param.num_hidden
+    uniform_heads_or_reason(net, p)
+
+    def prefill(params, toks, lens, rng):
+        h = _embed_prompt(params, p, emb, dt, toks, W)
+        ks, vs = [], []
+        for si, st in zip(p["stacks"], stacks):
+            h, k, v = _stack_prefill(st, params[si], h, B, W, e, dt,
+                                     platform)
+            ks.append(k)
+            vs.append(v)
+        last = jnp.take_along_axis(
+            h, (lens - 1)[:, None, None], axis=1)[:, 0]      # (B, e)
+        logits = _head_logits(params, p, dt, last)
+        first, _ = _sample_at(logits, rng, temperature)
+        k_all = ks[0] if len(ks) == 1 else jnp.concatenate(ks, 0)
+        v_all = vs[0] if len(vs) == 1 else jnp.concatenate(vs, 0)
+        return first.astype(jnp.int32), k_all, v_all
+
+    return jax.jit(prefill)
+
+
+def build_step(net, p, temperature: float, B: int, P: int, Sl: int,
+               block: int, platform: str = "cpu", steps: int = 1):
+    """Build the jitted DECODE STEP over a paged KV pool — ``steps``
+    tokens per call (multi-step scheduling):
+
+        (params, pool_k (NB, Ltot, nh, block, d), pool_v (same),
+         bt (B, nblk) int32, lens (B,), step (B,), last (B,), rng)
+            -> (pool_k', pool_v', next (B, steps) int32)
+
+    ``steps > 1`` amortizes the per-call host dispatch + sync over
+    several tokens (the monolithic decoder amortizes it over ALL of
+    max_new; per-token calls pay it per token — measured ~1.2 ms/call
+    on the CPU rig, comparable to the whole step's compute). Each of
+    the ``steps`` tokens runs the exact single-token math in sequence,
+    so greedy outputs are unchanged; a slot that completes mid-call
+    simply has its overshoot tokens discarded by the engine (its pages
+    are freed right after, so the overshoot writes die with it).
+
+    ``B`` is the slot count (requests currently decoding), ``bt`` each
+    slot's BLOCK TABLE: logical cache slot ``j`` of slot ``s`` lives in
+    pool block ``bt[s, j // block]`` at offset ``j % block``. Per slot
+    the geometry is the slot layout's: prompt K/V at logical [0, lens),
+    decode K/V at [P, P + step]; this step embeds ``last`` (the slot's
+    previously emitted token) at position ``lens + step``, writes its
+    K/V at logical slot ``P + step`` — a per-slot scatter through the
+    block table, since unlike the monolithic loop each slot is at its
+    OWN step — then attends over the block-gathered cache and samples
+    the next token.
+
+    The attend gathers each slot's blocks and SLICES to exactly
+    ``Sl = P + max_new`` slots before the einsums, so the attend
+    shapes (and reduction orders) match the monolithic ``slot`` layout
+    program exactly — that is what keeps greedy outputs bitwise
+    identical between the contiguous and paged paths (pinned by
+    tests/test_continuous.py and tools/decode_quality.py --paged).
+    Pool pages past Sl are never read; pad slots inside Sl are masked
+    (exp(NEG) underflows to exactly 0.0).
+
+    Slots not bound to a request point their whole block table at pool
+    block 0 — the reserved TRASH block (serve/kvpool.py never hands it
+    out) — so their writes land somewhere harmless and their sampled
+    token is ignored by the engine."""
+    emb = net.modules[p["embed"]]
+    stacks = [net.modules[i] for i in p["stacks"]]
+    dt = net.compute_dtype
+    e = emb.param.num_hidden
+    nh, d = uniform_heads_or_reason(net, p)
+
+    def one(params, pool_k, pool_v, bt, lens, stepv, last, rng):
+        pos = lens + stepv                 # absolute embed position
+        h = _embed_one(params, p, emb, dt, last, pos)
+        sl = P + stepv                     # (B,) logical write slot
+        bcol = sl // block
+        offs = sl % block
+        b_ids = jnp.take_along_axis(bt, bcol[:, None], axis=1)[:, 0]
+        Sp = bt.shape[1] * block           # gathered pool-view width
+        pos_k = jnp.arange(Sl)[None, :]
+        keep = (pos_k < lens[:, None]) \
+            | ((pos_k >= P) & (pos_k <= sl[:, None]))
+        li = 0
+        for si, st in zip(p["stacks"], stacks):
+            lp = params[si]
+            nlayer = lp["wqkv"].shape[0]
+            for l in range(nlayer):
+                layer_p = {kk: vv[l] for kk, vv in lp.items()}
+                x = _rmsnorm(h, layer_p["norm1"], dt)
+                qkv = jnp.dot(x, layer_p["wqkv"].T.astype(dt))
+                qkv = qkv.reshape(B, 3, nh, d)
+                q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+                # write-then-gather: the new token's K/V must be
+                # visible to its own attend, exactly like the
+                # monolithic dynamic_update_slice-then-attend order
+                pool_k = pool_k.at[b_ids, li, :, offs, :].set(
+                    k_new.astype(pool_k.dtype))
+                pool_v = pool_v.at[b_ids, li, :, offs, :].set(
+                    v_new.astype(pool_v.dtype))
+                k_c = pool_k[bt, li].transpose(0, 2, 1, 3, 4) \
+                    .reshape(B, nh, Sp, d)[:, :, :Sl]
+                v_c = pool_v[bt, li].transpose(0, 2, 1, 3, 4) \
+                    .reshape(B, nh, Sp, d)[:, :, :Sl]
+                scores = jnp.einsum(
+                    "bhd,bhkd->bhk", q, k_c,
+                    preferred_element_type=jnp.float32) * (d ** -0.5)
+                att = jax.nn.softmax(
+                    jnp.where(keep[:, None, :], scores, NEG), -1)
+                out = jnp.einsum("bhk,bhkd->bhd",
+                                 att.astype(dt), v_c).reshape(B, e)
+                h = h + jnp.dot(out, layer_p["wo"].T.astype(dt))
+                x = _rmsnorm(h, layer_p["norm2"], dt)
+                h = h + _mlp_block(st, layer_p, x, dt)
+                li += 1
+        logits = _head_logits(params, p, dt, h)
+        nxt, rng = _sample_at(logits, rng, temperature)
+        return pool_k, pool_v, nxt.astype(jnp.int32), rng
+
+    def step(params, pool_k, pool_v, bt, lens, stepv, last, rng):
+        toks = []
+        for t in range(int(steps)):
+            pool_k, pool_v, last, rng = one(
+                params, pool_k, pool_v, bt, lens, stepv + t, last, rng)
+            toks.append(last)
+        return pool_k, pool_v, jnp.stack(toks, axis=1)  # (B, steps)
+
+    return jax.jit(step)
+
+
 def build(net, p, max_new: int, temperature: float, B: int, S: int,
           P: Optional[int] = None, layout: str = "slot",
           platform: str = "cpu", kv: str = "native"):
@@ -196,7 +492,6 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
         raise ValueError(
             "decode_kv=int8 requires decode_layout slot or slotk "
             "(got %s)" % layout)
-    from .ops import flash_attention as fa
     emb = net.modules[p["embed"]]
     stacks = [net.modules[i] for i in p["stacks"]]
     head = net.modules[p["head"]]
@@ -220,108 +515,20 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
 
     def embed_at(params, ids, pos):
         """ids (B,), pos (B,) -> (B, e) embedding (+position)."""
-        lp = params[p["embed"]]
-        out = jnp.take(lp["wmat"], ids, axis=0).astype(dt)
-        if emb.learn_pos:
-            out = out + jnp.take(lp["pos"], pos, axis=0).astype(dt)
-        return out
+        return _embed_one(params, p, emb, dt, ids, pos)
 
     def head_at(params, h):
-        lp = params[p["head"]]
-        out = jnp.dot(h.astype(dt),
-                      lp["wmat"].T.astype(dt)).astype(jnp.float32)
-        if "bias" in lp:
-            out = out + lp["bias"]
-        return out                                    # (B, V) logits
+        return _head_logits(params, p, dt, h)
 
     def mlp_at(st, layer_p, x):
-        """MLP residual branch on (..., e) activations, dense or MoE —
-        mirrors TransformerStackLayer._block_fn.mlp. At decode the MoE
-        route sees only the B new tokens (capacity over B instead of
-        B*S); gating is per-token so this matches the full-forward path
-        exactly as long as no token is capacity-dropped on either path
-        (capacity_factor >= nexpert/moe_topk guarantees that)."""
-        if not st.moe:
-            y = jax.nn.relu(
-                jnp.einsum("...e,me->...m", x, layer_p["w1"].astype(dt)))
-            return jnp.einsum("...m,em->...e", y,
-                              layer_p["w2"].astype(dt))
-        shape = x.shape
-        y, _ = L.moe_mlp(x.reshape(-1, shape[-1]), layer_p, st.topk,
-                         st.nexpert, st.capacity_factor, dt)
-        return y.reshape(shape)
+        return _mlp_block(st, layer_p, x, dt)
 
     def stack_prefill(st, lp, h, sl=S):
-        """Prompt-wide pass that ALSO returns per-layer K/V.
-
-        Mirrors _block_fn's dense block, UNROLLED over depth (the
-        training recipe's own finding: full unroll beats the scan's
-        sliced-stack weight access), with the attend routed the way
-        the training step routes it — the flat zero-relayout flash
-        kernel when the shape supports it, generic flash otherwise,
-        exact XLA attend off-TPU. When the flat kernel runs, K/V for
-        the cache are sliced from the flat projection (one relayout
-        per layer instead of the attend's three).
-
-        ``sl`` is the sequence width of ``h``: the slot layouts run
-        prefill on just the P prompt slots instead of the net's full
-        seq_len (only [0, P) ever enters the cache, and rows past a
-        prompt's ``lens`` are masked out of attention either way) —
-        at P = S/2 that halves the prefill matmul FLOPs and quarters
-        the attend. ``blend`` passes the full S (its cache is indexed
-        by absolute position)."""
-        nh = st.nhead
-        d = e // nh
-
-        impl = fa.resolve_impl(st.attn_impl, platform, sl)
-        # honor the stack's attn_flat=off escape hatch exactly like
-        # the training dispatch (layers._block_fn) does
-        flat = impl == "pallas" \
-            and getattr(st, "attn_flat", "auto") != "off" and bool(
-                fa.supports_flat(sl, nh, d)
-                or fa.flat_blocked_plan(sl, nh, d))
-        interp = platform != "tpu"
-        L = lp["wqkv"].shape[0]
-        ks, vs = [], []
-        for li in range(L):
-            layer_p = {kk: vv[li] for kk, vv in lp.items()}
-            x = _rmsnorm(h, layer_p["norm1"], dt)
-            qkv = jnp.einsum("bse,fe->bsf", x,
-                             layer_p["wqkv"].astype(dt))
-            if flat:
-                out4 = fa.flash_attention_flat(qkv, nh, causal=True,
-                                               interpret=interp)
-                kv4 = qkv.reshape(B, sl, 3, nh, d)
-                k = kv4[:, :, 1].transpose(0, 2, 1, 3)
-                v = kv4[:, :, 2].transpose(0, 2, 1, 3)
-                out = out4
-            else:
-                qkv4 = qkv.reshape(B, sl, 3, nh, d).transpose(
-                    2, 0, 3, 1, 4)
-                q, k, v = qkv4[0], qkv4[1], qkv4[2]
-                if impl == "pallas":
-                    out = fa.flash_attention(q, k, v, causal=True,
-                                             interpret=interp)
-                else:
-                    # f32 score accumulation + d^-0.5 scale, matching
-                    # ops.ring_attention.attention (the exact attend)
-                    scores = jnp.einsum(
-                        "bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) \
-                        * (d ** -0.5)
-                    mask = jnp.tril(jnp.ones((sl, sl), bool))
-                    att = jax.nn.softmax(
-                        jnp.where(mask, scores, NEG), -1)
-                    out = jnp.einsum("bhqk,bhkd->bhqd",
-                                     att.astype(dt), v)
-                out = out.transpose(0, 2, 1, 3).reshape(B, sl, e)
-            h = h + jnp.einsum("bse,fe->bsf", out,
-                               layer_p["wo"].astype(dt))
-            x = _rmsnorm(h, layer_p["norm2"], dt)
-            h = h + mlp_at(st, layer_p, x)
-            ks.append(k)
-            vs.append(v)
-        return h, jnp.stack(ks), jnp.stack(vs)  # (L, B, nh, sl, d)
+        """Prompt-wide pass that ALSO returns per-layer K/V — the
+        shared module-level _stack_prefill (also the split prefill
+        program's body: one implementation is what keeps the
+        contiguous and paged decode paths greedy-identical)."""
+        return _stack_prefill(st, lp, h, B, sl, e, dt, platform)
 
     # ------------------------------------------------------ blend (r4)
     def stack_decode_blend(st, lp, h, ks, vs, pos):
@@ -365,18 +572,10 @@ def build(net, p, max_new: int, temperature: float, B: int, S: int,
         return h, ks, vs
 
     def sample(logits, rng):
-        if temperature == 0.0:
-            return jnp.argmax(logits, -1), rng
-        rng, k = jax.random.split(rng)
-        return jax.random.categorical(k, logits / temperature), rng
+        return _sample_at(logits, rng, temperature)
 
     def prefill_h(params, toks, width=S):
-        lp0 = params[p["embed"]]
-        h = jnp.take(lp0["wmat"], toks[:, :width],
-                     axis=0).astype(dt)                # (B, width, e)
-        if emb.learn_pos:
-            h = h + lp0["pos"][:width].astype(dt)[None]
-        return h
+        return _embed_prompt(params, p, emb, dt, toks, width)
 
     def gen_blend(params, toks, lens, rng):
         # ---- prefill: one full causal forward building the caches ----

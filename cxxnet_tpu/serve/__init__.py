@@ -26,9 +26,16 @@ that artifact into a trafficable service —
 * :mod:`.faults` — :class:`FaultInjector`: the deterministic fault
   seam every robustness claim above is tested against;
 * :mod:`.loadgen` — open-loop trace replay: the scenario catalog
-  (bursty / mixed-priority / mixed predict+generate / slow-client),
-  a replayable JSONL trace format the access log can produce, and the
-  scoring behind ``bench.py scenario`` (docs/scenarios.md).
+  (bursty / mixed-priority / mixed predict+generate / slow-client /
+  mixed-prompt-length), a replayable JSONL trace format the access log
+  can produce, and the scoring behind ``bench.py scenario``
+  (docs/scenarios.md);
+* :mod:`.continuous` — :class:`ContinuousDecodeEngine`: iteration-
+  level continuous batching over a split-phase ``export_decode_step``
+  artifact — paged KV pool (:mod:`.kvpool`), prefill/decode phase
+  split, per-token streaming (:class:`StreamRequest`);
+* :mod:`.kvpool` — :class:`BlockPool`: the host-side page allocator
+  behind the paged KV pool (block tables, trash page, leak checks).
 
 CLI: ``task = serve`` (+ ``serve_replicas = N`` for the router
 topology) — docs/serving.md, docs/tasks.md.
@@ -40,6 +47,8 @@ from .stats import ServeStats
 
 __all__ = ["QueueFullError", "Request", "RequestExpired", "DrainError",
            "ServingEngine", "ServeStats",
+           "ContinuousDecodeEngine", "StreamRequest",
+           "BlockPool", "PoolExhausted",
            "ServeHTTPServer", "build_server",
            "Router", "RouterRequest", "ShedError", "NoReplicaError",
            "FailoverExhausted",
@@ -51,6 +60,9 @@ __all__ = ["QueueFullError", "Request", "RequestExpired", "DrainError",
 # http.server, router/replica/faults are only needed by multi-replica
 # deployments — engine-only users (and the package import) stay light
 _LAZY = {
+    "ContinuousDecodeEngine": "continuous",
+    "StreamRequest": "continuous",
+    "BlockPool": "kvpool", "PoolExhausted": "kvpool",
     "ServeHTTPServer": "server", "build_server": "server",
     "LoadGen": "loadgen", "EngineTarget": "loadgen",
     "HTTPTarget": "loadgen", "make_scenario": "loadgen",

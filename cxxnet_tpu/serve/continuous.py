@@ -1,0 +1,863 @@
+"""Iteration-level continuous batching over a split-phase decoder.
+
+The fixed-shape serving path (serve/engine.py over an
+``export_generate`` artifact) batches at REQUEST granularity: every
+dispatch runs the whole monolithic prefill+decode program, so a
+request arriving mid-generation waits for the entire previous batch to
+finish, empty slots burn dummy decode work, and the first token only
+exists when the last one does. This module schedules the
+``export_decode_step`` artifact (serving.ExportedStepDecoder) at TOKEN
+granularity instead — Orca-style iteration-level scheduling over a
+paged KV pool:
+
+* PAGED KV POOL — the decoder owns a device pool of ``kv_block``-slot
+  pages (the 128-multiple ``cache_slots`` granule from
+  ops/decode_attend.py); each decoding request holds a block table of
+  ``blocks_per_seq`` pages (serve/kvpool.BlockPool allots them, page 0
+  reserved as the trash page unbound slots write into). Pages rebind
+  the moment a request leaves, with no device copies.
+* PREFILL/DECODE SPLIT — prompts prefill in their OWN dispatch at the
+  narrowest exported width bucket that holds them, then join the
+  per-token decode loop; at most one prefill runs between decode
+  steps, so a long prompt never stalls tokens already streaming
+  (``prefill_split=False`` restores the coupled behavior — new
+  requests only join once every slot is idle — as the measured
+  contrast).
+* CONTINUOUS DECODE — every :meth:`_decode_step` advances whichever
+  requests currently occupy slots by one token; requests join and
+  leave between steps, and a request that asked for fewer tokens
+  (``max_new`` per request) frees its slot early.
+* STREAMING — each emitted token is pushed to the request's event
+  queue immediately (:class:`StreamRequest`), so time-to-first-token
+  is one prefill away regardless of time-to-last-token;
+  serve/server.py renders the events as SSE chunks.
+
+Greedy outputs are bitwise-identical to the fixed-shape path from the
+same weights (the step program's attend is shape-identical to the
+monolithic slot layout); at temperature > 0 the sampled stream depends
+on which slots/steps a request lands in, exactly as it already depends
+on the batch it shares a dispatch with.
+
+The engine mirrors ServingEngine's operational surface — admission
+queue + shedding, per-request deadlines, drain, state machine,
+registry metrics — and adds the streaming observability the ROADMAP
+asks for: TTFT and TPOT histograms with request-id exemplars, a
+slot-occupancy gauge, and dummy-slot-step counters (serve/stats.py).
+"""
+
+from __future__ import annotations
+
+import queue as _qmod
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..analysis import hot_path
+from ..analysis import lockcheck as _lockcheck
+from ..obs import trace as _trace
+from ..obs.registry import Registry
+from .engine import (DrainError, QueueFullError, Request, RequestExpired,
+                     coerce_tokens)
+from .kvpool import BlockPool
+from .stats import ServeStats
+
+
+class StreamRequest(Request):
+    """A decode request whose tokens stream out as they are emitted.
+
+    ``events()`` yields dicts in emission order: token chunks
+    ``{"row": r, "i": i, "tokens": [t, ...]}`` — ``i`` the 0-based
+    index of the chunk's first completion token; one chunk per decode
+    call per row (only when the request was submitted with
+    ``stream=True``) — and exactly one terminal ``{"done": True}`` /
+    ``{"error": msg}``. ``result()`` keeps the fixed-path contract:
+    the completed (rows, seq_len) token matrix."""
+
+    __slots__ = ("stream", "n_new", "row_tokens", "_events",
+                 "rows_left", "t_first")
+
+    def __init__(self, rows: int, payload, timeout_s, n_new: int,
+                 stream: bool):
+        super().__init__(rows, payload, timeout_s)
+        self.stream = bool(stream)
+        self.n_new = int(n_new)
+        self.row_tokens: List[list] = [[] for _ in range(rows)]
+        self.rows_left = rows
+        self.t_first: Optional[float] = None
+        self._events: _qmod.Queue = _qmod.Queue()
+
+    def push_event(self, ev: dict) -> None:
+        self._events.put(ev)
+
+    def events(self, timeout: Optional[float] = None):
+        """Iterate events until the terminal one; raises TimeoutError
+        if ``timeout`` seconds pass without a new event."""
+        while True:
+            try:
+                ev = self._events.get(timeout=timeout)
+            except _qmod.Empty:
+                raise TimeoutError(
+                    "no stream event within %.3fs"
+                    % (timeout if timeout is not None else -1.0))
+            yield ev
+            if "done" in ev or "error" in ev:
+                return
+
+    def timing(self) -> dict:
+        t = super().timing()
+        t["ttft_ms"] = (None if self.t_first is None else
+                        round(1000.0 * (self.t_first - self.t_submit),
+                              3))
+        return t
+
+
+class _Row:
+    """One admitted prompt row waiting for (or bound to) a slot."""
+
+    __slots__ = ("req", "ridx", "toks", "plen", "blocks",
+                 "ntok", "last")
+
+    def __init__(self, req: StreamRequest, ridx: int,
+                 toks: np.ndarray, plen: int):
+        self.req = req
+        self.ridx = ridx
+        self.toks = toks            # (plen,) prompt ids
+        self.plen = int(plen)
+        self.blocks: Optional[list] = None
+        self.ntok = 0               # tokens emitted so far
+        self.last = 0               # last emitted token id
+
+
+class ContinuousDecodeEngine:
+    """Continuous-batching scheduler over an ExportedStepDecoder.
+
+    Knobs:
+      queue_limit     admitted-but-unslotted prompt ROWS before
+                      admission sheds (429)
+      timeout_ms      per-request deadline (0 disables); enforced at
+                      admission sweep and prefill pick-up (a request
+                      already decoding finishes its stream)
+      prefill_split   True (default): prefills interleave with decode
+                      steps, at most one per step. False: new requests
+                      only join when every slot is idle — the coupled
+                      legacy behavior, kept for paired benchmarking
+      kv_blocks       runtime clamp on live pool pages (<= the
+                      exported pool; 0 = whole pool) — admission
+                      control without a re-export
+      step_hook       callable invoked before every decode step — the
+                      fault-injection / test-throttle seam (raising
+                      fails the step's requests through the real error
+                      path, sleeping is a real stall)
+      warmup          pre-run every prefill bucket + one decode step
+                      inside start() so no user request eats a
+                      first-call cost
+      registry / obs_labels / slo_ms / stats / seed / start as in
+      ServingEngine.
+    """
+
+    kind = "decode"
+    supports_stream = True
+
+    def __init__(self, decoder, queue_limit: int = 64,
+                 timeout_ms: float = 30000.0,
+                 prefill_split: bool = True, kv_blocks: int = 0,
+                 max_wait_ms: float = 0.0, max_batch=None,
+                 dispatch_depth: int = 0,
+                 stats: Optional[ServeStats] = None, seed: int = 0,
+                 registry: Optional[Registry] = None,
+                 obs_labels: Optional[dict] = None,
+                 step_hook=None, slo_ms: Optional[float] = None,
+                 warmup: bool = False, start: bool = True):
+        from ..serving import ExportedStepDecoder
+        if not isinstance(decoder, ExportedStepDecoder):
+            raise TypeError(
+                "ContinuousDecodeEngine needs an export_decode_step "
+                "artifact (kind=generate_step); got %r — serve "
+                "monolithic decoders through ServingEngine" % (decoder,))
+        self.callee = decoder
+        self.batch = decoder.batch
+        self.buckets = list(decoder.buckets)
+        self.max_batch = self.batch
+        self.queue_limit = int(queue_limit)
+        self.timeout_s = float(timeout_ms) / 1000.0
+        self.prefill_split = bool(prefill_split)
+        self.dispatch_depth = 0      # surface parity with ServingEngine
+        self.stats = stats or ServeStats()
+        self.step_hook = step_hook
+        self.obs_labels = dict(obs_labels or {})
+        self.registry = registry if registry is not None else Registry()
+        self.pool = BlockPool(decoder.pool_blocks, decoder.kv_block,
+                              limit=int(kv_blocks))
+        self._pool_k, self._pool_v = decoder.new_pool()
+        self._slots: List[Optional[_Row]] = [None] * self.batch
+        self._nlive = 0
+        self._seed = int(seed)
+        self._greedy_key = None
+        self._nstep = 0
+        self._nprefill = 0
+        self._warmup_on_start = bool(warmup)
+        self._warmed = False
+        self.warmup_runs = 0
+        if self.pool.limit - 1 < decoder.blocks_per_seq:
+            raise ValueError(
+                "kv_blocks=%d leaves %d usable pages; one sequence "
+                "needs %d" % (kv_blocks, self.pool.limit - 1,
+                              decoder.blocks_per_seq))
+        from collections import deque
+        self._q = deque()        # rows waiting for PREFILL
+        # rows already prefilled (pages + first token emitted) parked
+        # until a decode lane frees: decoupling prefill from lane
+        # availability is what lets prefills batch — lanes free one at
+        # a time, so a lane-coupled prefill degenerates to singleton
+        # dispatches and its fixed cost swamps the schedule
+        self._ready = deque()
+        self._cond = _lockcheck.make_condition("serve.continuous.cond")
+        self._live_lock = _lockcheck.make_lock("serve.continuous.live")
+        self._live: set = set()      # admitted, unanswered requests
+        self._closed = False
+        self._draining = False
+        self._started = False
+        g_q = self.registry.gauge("cxxnet_serve_queue_depth",
+                                  "requests pending admission",
+                                  tuple(self.obs_labels))
+        g_slots = self.registry.gauge(
+            "cxxnet_serve_slots_live",
+            "decode slots currently bound to a request",
+            tuple(self.obs_labels))
+        g_blocks = self.registry.gauge(
+            "cxxnet_serve_kv_blocks_in_use",
+            "paged KV pool pages currently held by requests",
+            tuple(self.obs_labels))
+        buckets = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0]
+        if slo_ms:
+            buckets.append(float(slo_ms) / 1000.0)
+        self._h_latency = self.registry.histogram(
+            "cxxnet_serve_request_latency_seconds",
+            "per-request completion latency (submit to answer)",
+            tuple(self.obs_labels), buckets=buckets)
+        self._h_ttft = self.registry.histogram(
+            "cxxnet_serve_ttft_seconds",
+            "submit to first streamed token",
+            tuple(self.obs_labels), buckets=buckets)
+        self._h_tpot = self.registry.histogram(
+            "cxxnet_serve_tpot_seconds",
+            "mean per-output-token time after the first token",
+            tuple(self.obs_labels),
+            buckets=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                     0.05, 0.1, 0.25])
+        self.slo_ms = float(slo_ms) if slo_ms else None
+        self._registry_hooks = [
+            self.stats.bind_registry(self.registry,
+                                     labels=self.obs_labels),
+            self.registry.add_hook(lambda: (
+                g_q.set(self.queue_depth, **self.obs_labels),
+                g_slots.set(self._nlive, **self.obs_labels),
+                g_blocks.set(self.pool.in_use, **self.obs_labels))),
+        ]
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-continuous", daemon=True)
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if not self._started:
+            if self._warmup_on_start:
+                self.warmup()
+            self._started = True
+            self._thread.start()
+
+    def warmup(self) -> None:
+        """Pre-run every prefill bucket (INCLUDING its pool-scatter —
+        the jitted donated scatter compiles per (rows, width) shape),
+        one decode step, and the key fold, so every first-call cost on
+        the serving path lands before traffic. All warmup writes go
+        through trash block tables, so the pool stays clean."""
+        from ..serving import scatter_prefill_kv
+        c = self.callee
+        key = self._fold_key(0)
+        maxr = c.prefill_rows[-1]
+        for w in c.prefill_widths:
+            nb = -(-w // c.kv_block)
+            k = v = None
+            for r in c.prefill_rows:
+                toks = np.zeros((r, w), np.int32)
+                lens = np.ones((r,), np.int32)
+                first, k, v = c._pre[(r, w)].call(toks, lens, key)
+                np.asarray(first)
+                self.warmup_runs += 1
+            for n in range(1, maxr + 1):
+                # the scatter jit-caches per (rows, width): warm every
+                # group size a dispatch can arrive with
+                self._pool_k, self._pool_v = scatter_prefill_kv(
+                    self._pool_k, self._pool_v, k[:, :n], v[:, :n],
+                    [[0] * nb for _ in range(n)], c.kv_block)
+        B, nblk = self.batch, c.blocks_per_seq
+        pk, pv, nxt = c.step(
+            self._pool_k, self._pool_v,
+            np.zeros((B, nblk), np.int32), np.ones((B,), np.int32),
+            np.zeros((B,), np.int32), np.zeros((B,), np.int32), key)
+        np.asarray(nxt)
+        self._pool_k, self._pool_v = pk, pv
+        self.warmup_runs += 1
+        self._warmed = True
+
+    # ------------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        if self._closed:
+            return "closed"
+        if self._draining:
+            return "draining"
+        if self._warmup_on_start and not self._warmed:
+            return "warming"
+        return "serving"
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    @property
+    def live_requests(self) -> int:
+        with self._live_lock:
+            return len(self._live)
+
+    @property
+    def slots_live(self) -> int:
+        return self._nlive
+
+    def retry_after_s(self) -> float:
+        if self._closed or self._draining \
+                or (self._warmup_on_start and not self._warmed):
+            return 2.0
+        est = self.stats.estimate_clear_s(self.queue_depth)
+        return min(max(est, 1.0), 30.0)
+
+    def healthz(self) -> dict:
+        c = self.callee
+        return {"ok": self.state == "serving", "state": self.state,
+                "kind": self.kind, "batch": self.batch,
+                "buckets": list(self.buckets),
+                "dispatch_depth": 0, "queue_depth": self.queue_depth,
+                "seq_len": c.seq_len,
+                "max_prompt_len": c.max_prompt_len,
+                "max_new": c.max_new,
+                "continuous": True, "stream": True,
+                "prefill_split": self.prefill_split,
+                "slots_live": self._nlive,
+                "ready_rows": len(self._ready),
+                "kv_pool": self.pool.snapshot()}
+
+    def metrics(self) -> dict:
+        snap = self.stats.snapshot()
+        snap["queue_depth"] = self.queue_depth
+        snap["state"] = self.state
+        snap["kind"] = self.kind
+        snap["exported_batch"] = self.batch
+        snap["buckets"] = list(self.buckets)
+        snap["max_batch"] = self.max_batch
+        snap["queue_limit"] = self.queue_limit
+        snap["dispatch_depth"] = 0
+        snap["warmup_runs"] = self.warmup_runs
+        snap["continuous"] = True
+        snap["prefill_split"] = self.prefill_split
+        snap["slots_live"] = self._nlive
+        snap["ready_rows"] = len(self._ready)
+        snap["kv_pool"] = self.pool.snapshot()
+        return snap
+
+    # ------------------------------------------------------------------
+    def submit_tokens(self, tokens: np.ndarray, lens: Sequence[int],
+                      seed: Optional[int] = None,
+                      timeout_ms: Optional[float] = None,
+                      priority=None, max_new: Optional[int] = None,
+                      stream: bool = False) -> StreamRequest:
+        """Enqueue a generate request (same contract as
+        ServingEngine.submit_tokens) plus the continuous extras:
+        ``max_new`` caps this request's emitted tokens at fewer than
+        the artifact's (its slot frees early); ``stream=True`` pushes
+        per-token events (StreamRequest.events). ``seed`` folds into
+        the shared per-step sampling keys — irrelevant at the greedy
+        temperature-0 export."""
+        c = self.callee
+        toks, lens = coerce_tokens(c, tokens, lens)
+        n_new = c.max_new if max_new is None else int(max_new)
+        if not 1 <= n_new <= c.max_new:
+            raise ValueError("max_new must be in [1, %d], got %d"
+                             % (c.max_new, n_new))
+        t = self.timeout_s if timeout_ms is None \
+            else float(timeout_ms) / 1000.0
+        req = StreamRequest(toks.shape[0], (toks, lens, seed),
+                            t if t and t > 0 else None, n_new, stream)
+        self._admit(req)
+        return req
+
+    def submit(self, *a, **kw):
+        raise RuntimeError("this engine serves a decoder; "
+                           "use submit_tokens")
+
+    def _finish_req(self, req: StreamRequest, value=None,
+                    error: Optional[BaseException] = None) -> bool:
+        if req._finish(value, error):
+            with self._live_lock:
+                self._live.discard(req)
+            req.push_event({"done": True} if error is None
+                           else {"error": str(error)})
+            return True
+        return False
+
+    def _sweep_expired_locked(self) -> int:
+        now = time.monotonic()
+        dead = []
+        alive = []
+        for r in self._q:
+            (dead if r.req.deadline is not None
+             and now > r.req.deadline else alive).append(r)
+        if not dead:
+            return 0
+        self._q.clear()
+        self._q.extend(alive)
+        failed = set()
+        for r in dead:
+            if r.req not in failed:
+                failed.add(r.req)
+                self.stats.on_timeout()
+                self._finish_req(r.req, error=RequestExpired(
+                    "request expired after %.0f ms in queue (swept at "
+                    "admission)"
+                    % (1000.0 * (now - r.req.t_submit))))
+        return len(dead)
+
+    @hot_path
+    def _admit(self, req: StreamRequest) -> None:
+        toks, lens, _ = req.payload
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            if self._draining:
+                raise DrainError("engine is draining — not admitting")
+            if len(self._q) + req.rows > self.queue_limit:
+                self._sweep_expired_locked()
+            if len(self._q) + req.rows > self.queue_limit:
+                self.stats.on_reject()
+                raise QueueFullError(
+                    "admission queue full (%d pending rows)"
+                    % len(self._q))
+            with self._live_lock:
+                self._live.add(req)
+            for r, pl in enumerate(lens.tolist()):
+                self._q.append(_Row(req, r, toks[r, :pl].copy(), pl))
+            tr = _trace.sink()
+            if tr is not None:
+                with tr.span("serve.admit", "serve",
+                             {"request_id": req.id, "rows": req.rows}):
+                    tr.flow_start("request", req.seq, "serve")
+            self._cond.notify()
+
+    # ------------------------------------------------------------------
+    def _free_slot_ids(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _fold_key(self, tag: int):
+        import jax
+        if float(self.callee.meta.get("temperature", 0.0)) == 0.0:
+            # greedy artifact: the key is dead weight — skip the
+            # per-step fold_in dispatch on the hot loop
+            if self._greedy_key is None:
+                self._greedy_key = np.asarray(
+                    jax.random.PRNGKey(self._seed), np.uint32)
+            return self._greedy_key
+        base = jax.random.PRNGKey(self._seed)
+        return np.asarray(jax.random.fold_in(base, tag), np.uint32)
+
+    @hot_path
+    def _prefill_dispatch(self) -> bool:
+        """Prefill waiting rows: one prefill program run at the head
+        row's width bucket, prompt K/V scattered into the pool, first
+        token emitted (the TTFT moment — it streams NOW, even if every
+        decode lane is busy), rows parked on the ready queue until a
+        lane frees. Returns whether anything was prefilled."""
+        c = self.callee
+        maxr = c.prefill_rows[-1]
+        take: List[_Row] = []
+        with self._cond:
+            # one pass: drop dead rows, fail expired ones, and collect
+            # candidates of the OLDEST waiter's width class from
+            # anywhere in the queue — widths must not mix in one
+            # dispatch (a long prompt prefills in its own dispatch,
+            # never dragging short ones to the wide program), and
+            # head-run-only gathering would cap batches at the
+            # short/long interleave's run length
+            now = time.monotonic()
+            kept: List[_Row] = []
+            cand: List[_Row] = []
+            head_w = None
+            for row in self._q:
+                if row.req.done:           # failed by drain/sweep
+                    continue
+                if row.req.deadline is not None \
+                        and now > row.req.deadline:
+                    self.stats.on_timeout()
+                    self._finish_req(row.req, error=RequestExpired(
+                        "request expired after %.0f ms before prefill"
+                        % (1000.0 * (now - row.req.t_submit))))
+                    continue
+                w = c.pick_width(row.plen)
+                if head_w is None:
+                    head_w = w
+                if w == head_w and len(cand) < maxr:
+                    cand.append(row)
+                else:
+                    kept.append(row)
+            if not cand:
+                self._q.clear()
+                self._q.extend(kept)
+                return False
+            if self._nlive and self._ready:
+                # batch formation, starvation-keyed: while the ready
+                # queue holds prefilled rows the lanes CANNOT starve,
+                # so the prefill holds until the full candidate bucket
+                # fits in free pool pages. A saturated pool frees one
+                # sequence per completion, and prefilling at that
+                # granularity degenerates to singleton dispatches
+                # whose fixed cost swamps the schedule — the 4x pool
+                # (serving.export_decode_step default) keeps the ready
+                # backlog deep enough that this hold is free. The
+                # moment the ready queue drains, prefill runs with
+                # whatever fits (an idle lane always gets fed)
+                want = min(len(cand), maxr)
+                fit = min(want,
+                          self.pool.free_blocks // c.blocks_per_seq)
+                if fit < want:
+                    self._q.clear()
+                    self._q.extend(sorted(
+                        cand + kept, key=lambda r: r.req.t_submit))
+                    return False
+            for row in cand:
+                if not self.pool.can_alloc(c.blocks_per_seq):
+                    kept.append(row)
+                    continue
+                row.blocks = self.pool.alloc(c.blocks_per_seq)
+                take.append(row)
+            self._q.clear()
+            self._q.extend(sorted(kept,
+                                  key=lambda r: r.req.t_submit))
+        if not take:
+            return False
+        w = c.pick_width(max(r.plen for r in take))
+        n = len(take)
+        toks = np.zeros((n, w), np.int32)
+        lens = np.zeros((n,), np.int32)
+        for i, row in enumerate(take):
+            toks[i, :row.plen] = row.toks
+            lens[i] = row.plen
+        self._nprefill += 1
+        tr = _trace.sink()
+        try:
+            with _trace.span("serve.prefill", "serve",
+                             {"rows": n, "width": w}):
+                if tr is not None:
+                    for row in take:
+                        tr.flow_step("request", row.req.seq, "serve")
+                first, k, v = c.prefill(
+                    toks, lens, self._fold_key(self._nprefill))
+                # the sanctioned materialize: first tokens must reach
+                # the host to stream out — this wait IS the TTFT
+                first = np.asarray(first)
+                from ..serving import scatter_prefill_kv
+                self._pool_k, self._pool_v = scatter_prefill_kv(
+                    self._pool_k, self._pool_v, k, v,
+                    [row.blocks for row in take], c.kv_block)
+        except Exception as e:
+            self.stats.on_error(len({r.req for r in take}))
+            for row in take:
+                self.pool.free(row.blocks)
+                row.blocks = None
+                self._finish_req(row.req, error=e)
+            # the scatter donates the pool buffers; after a failure
+            # partway through them nothing in the pool can be trusted
+            self._fail_all_inflight(e)
+            return True
+        self.stats.on_prefill(n)
+        now = time.monotonic()
+        first = first.tolist()
+        for i, row in enumerate(take):
+            req = row.req
+            if req.t_dispatch is None:
+                req.t_dispatch = now
+            req.t_infer = now
+            self._emit(row, [first[i]], now)
+            if row.ntok >= req.n_new:
+                self._row_done(row, now)
+            else:
+                self._ready.append(row)
+        self._bind_ready()
+        return True
+
+    def _bind_ready(self) -> None:
+        """Move prefilled rows from the ready queue into free decode
+        lanes (requests failed while parked just give their pages
+        back)."""
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                continue
+            row = None
+            while self._ready:
+                cand = self._ready.popleft()
+                if cand.req.done:
+                    if cand.blocks is not None:
+                        self.pool.free(cand.blocks)
+                        cand.blocks = None
+                    continue
+                row = cand
+                break
+            if row is None:
+                return
+            self._slots[i] = row
+            self._nlive += 1
+
+    def _emit(self, row: _Row, toks: List[int], now: float) -> None:
+        """Hand ``toks`` (this call's chunk) to the request: one event
+        per decode call per row, not per token — per-token queue
+        wake-ups against a few hundred blocked client threads are real
+        scheduler load on the hot loop."""
+        req = row.req
+        i0 = len(req.row_tokens[row.ridx])
+        req.row_tokens[row.ridx].extend(toks)
+        row.ntok += len(toks)
+        row.last = toks[-1]
+        if req.t_first is None:
+            req.t_first = now
+            self._h_ttft.observe(now - req.t_submit, exemplar=req.id,
+                                 **self.obs_labels)
+        if req.stream:
+            req.push_event({"row": row.ridx, "i": i0,
+                            "tokens": list(toks)})
+
+    def _row_done(self, row: _Row, now: float) -> None:
+        """Row finished: free its pages, complete the request when it
+        was the last row out."""
+        if row.blocks is not None:
+            self.pool.free(row.blocks)
+            row.blocks = None
+        req = row.req
+        req.rows_left -= 1
+        if req.rows_left > 0:
+            return
+        toks, lens, _ = req.payload
+        out = np.array(toks, copy=True)
+        for r in range(req.rows):
+            got = req.row_tokens[r]
+            out[r, int(lens[r]):int(lens[r]) + len(got)] = got
+        req.t_done = now
+        if self._finish_req(req, value=out):
+            self.stats.on_complete(now - req.t_submit, req.rows)
+            self._h_latency.observe(now - req.t_submit,
+                                    exemplar=req.id, **self.obs_labels)
+            ntok = max(len(t) for t in req.row_tokens)
+            if ntok > 1 and req.t_first is not None:
+                self._h_tpot.observe(
+                    (now - req.t_first) / (ntok - 1),
+                    exemplar=req.id, **self.obs_labels)
+            tr = _trace.sink()
+            if tr is not None:
+                with tr.span("serve.complete", "serve",
+                             {"request_id": req.id}):
+                    tr.flow_end("request", req.seq, "serve")
+
+    def _fail_all_inflight(self, error: BaseException) -> None:
+        """Pool-integrity reset after a failed donated call: every row
+        with K/V in the (now untrustworthy or consumed) pool fails,
+        pages return, and the pool is rebuilt from scratch. Queued
+        rows (no pool state yet) are untouched."""
+        for i, row in enumerate(self._slots):
+            if row is None:
+                continue
+            if row.blocks is not None:
+                self.pool.free(row.blocks)
+                row.blocks = None
+            self._slots[i] = None
+            self._nlive -= 1
+            self._finish_req(row.req, error=error)
+        while self._ready:
+            row = self._ready.popleft()
+            if row.blocks is not None:
+                self.pool.free(row.blocks)
+                row.blocks = None
+            self._finish_req(row.req, error=error)
+        self._pool_k, self._pool_v = self.callee.new_pool()
+
+    def _reap_dead_slots(self) -> None:
+        """Release slots whose request was already failed externally
+        (drain straggler window, close) — their pages go back and the
+        slot rebinds next prefill."""
+        for i, row in enumerate(self._slots):
+            if row is not None and row.req.done:
+                if row.blocks is not None:
+                    self.pool.free(row.blocks)
+                    row.blocks = None
+                self._slots[i] = None
+                self._nlive -= 1
+
+    @hot_path
+    def _decode_step(self) -> None:
+        """One token for every live slot: build the step inputs from
+        the slot table, run the step program, fan the sampled tokens
+        out to their requests."""
+        self._reap_dead_slots()
+        self._bind_ready()
+        live = [(i, s) for i, s in enumerate(self._slots)
+                if s is not None]
+        if not live:
+            return   # all slots idle: no dispatch at all
+        c = self.callee
+        B, nblk = self.batch, c.blocks_per_seq
+        bt = np.zeros((B, nblk), np.int32)      # 0 = trash page
+        lens = np.ones((B,), np.int32)
+        stepv = np.zeros((B,), np.int32)
+        last = np.zeros((B,), np.int32)
+        for i, row in live:
+            bt[i] = row.blocks
+            lens[i] = row.plen
+            stepv[i] = row.ntok - 1
+            last[i] = row.last
+        self._nstep += 1
+        T = c.step_tokens
+        try:
+            if self.step_hook is not None:
+                self.step_hook()
+            with _trace.span("serve.decode_step", "serve",
+                             {"live": len(live),
+                              "dummy": B - len(live),
+                              "step_tokens": T}):
+                pk, pv, nxt = c.step(self._pool_k, self._pool_v, bt,
+                                     lens, stepv, last,
+                                     self._fold_key(1 << 20
+                                                    | self._nstep))
+                # the sanctioned materialize: the sampled tokens must
+                # reach the host every step — they are the stream
+                toks = np.asarray(nxt)     # (B, step_tokens)
+        except Exception as e:
+            reqs = {row.req for _, row in live}
+            self.stats.on_error(len(reqs))
+            for i, row in live:
+                if row.blocks is not None:
+                    self.pool.free(row.blocks)
+                    row.blocks = None
+                self._slots[i] = None
+                self._nlive -= 1
+            for req in reqs:
+                self._finish_req(req, error=e)
+            # the step call donates the pool buffers — a failure may
+            # have consumed them, and the ready rows' prefilled K/V
+            # lived there: fail everything in flight, rebuild fresh
+            self._fail_all_inflight(e)
+            return
+        self._pool_k, self._pool_v = pk, pv
+        now = time.monotonic()
+        emitted = 0
+        toks = toks.tolist()
+        for i, row in live:
+            # a row completing mid-call discards its overshoot tokens
+            # (their pool writes die with the row's pages)
+            take = min(T, row.req.n_new - row.ntok)
+            self._emit(row, toks[i][:take], now)
+            emitted += take
+            if row.ntok >= row.req.n_new:
+                self._slots[i] = None
+                self._nlive -= 1
+                self._row_done(row, now)
+        self.stats.on_step(emitted, B * T - emitted)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._closed and not self._q \
+                        and not self._ready and self._nlive == 0:
+                    self._cond.wait(0.05)
+                if self._closed:
+                    return
+            if self._q and (self.prefill_split or self._nlive == 0):
+                self._prefill_dispatch()
+            if self._nlive or self._ready:
+                self._decode_step()
+
+    # ------------------------------------------------------------------
+    def drain(self, timeout: float = 10.0) -> int:
+        """Stop admitting, keep decoding what's in flight, fail the
+        stragglers after ``timeout`` seconds (their slots and pool
+        pages are reaped on the next scheduler pass)."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = time.monotonic() + max(float(timeout), 0.0)
+        while time.monotonic() < deadline:
+            if self.live_requests == 0:
+                return 0
+            time.sleep(0.005)
+        with self._live_lock:
+            stragglers = list(self._live)
+        n = 0
+        for r in stragglers:
+            if self._finish_req(r, error=DrainError(
+                    "request %s unanswered after %.1fs drain window"
+                    % (r.id, timeout))):
+                self.stats.on_drained()
+                n += 1
+        with self._cond:
+            self._q.clear()
+        if n:
+            _trace.instant("serve.drain_stragglers", "serve",
+                           {"failed": n})
+        return n
+
+    def close(self, timeout: float = 10.0) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._started:
+            self._thread.join(timeout)
+        with self._cond:
+            while self._q:
+                row = self._q.popleft()
+                if row.blocks is not None:
+                    self.pool.free(row.blocks)
+                    row.blocks = None
+                self._finish_req(row.req,
+                                 error=RuntimeError("engine closed"))
+        while self._ready:
+            row = self._ready.popleft()
+            if row.blocks is not None:
+                self.pool.free(row.blocks)
+                row.blocks = None
+            self._finish_req(row.req,
+                             error=RuntimeError("engine closed"))
+        for i, row in enumerate(self._slots):
+            # rows a drain failed while they sat in a lane: the
+            # scheduler thread is gone, so their pages reap here
+            if row is not None:
+                if row.blocks is not None:
+                    self.pool.free(row.blocks)
+                    row.blocks = None
+                self._slots[i] = None
+                self._nlive -= 1
+                self._finish_req(row.req,
+                                 error=RuntimeError("engine closed"))
+        with self._live_lock:
+            leftovers = list(self._live)
+        for req in leftovers:
+            self._finish_req(req, error=RuntimeError("engine closed"))
+        self.registry.collect()
+        for h in self._registry_hooks:
+            self.registry.remove_hook(h)
+        self._registry_hooks = []
+
+    def __enter__(self) -> "ContinuousDecodeEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -865,6 +865,16 @@ class ServingEngine:
                 self._put_buf(pend.bucket, pend.buf)
         self.stats.on_dispatch(len(pend.live),
                                min(pend.rows, pend.bucket), pend.bucket)
+        if self.callee.kind == "decode":
+            # wasted decode work made visible: every dispatched slot
+            # runs the full exported decode loop whether a request
+            # occupies it or not, so padding slots burn max_new
+            # slot-steps each. (_dispatch already skips the callee
+            # entirely when every gathered request expired — a batch
+            # of zero live slots never reaches the decoder.)
+            rows = min(pend.rows, pend.bucket)
+            per = self.callee.max_new
+            self.stats.on_step(rows * per, (pend.bucket - rows) * per)
         done = time.monotonic()
         lo = 0
         for r in pend.live:

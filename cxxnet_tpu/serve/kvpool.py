@@ -1,0 +1,133 @@
+"""Host-side page allocator for the paged KV pool.
+
+The split-phase decoder (serving.export_decode_step) owns a device
+pool of fixed-size KV pages — ``kv_block`` cache slots each, on the
+128-multiple ``cache_slots`` granule from ops/decode_attend.py. This
+module is the HOST half of the design: which request owns which pages.
+Each decoding request holds ``blocks_per_seq`` pages listed in its
+block table; pages return to the free list the moment the request
+leaves its slot, so the next admission reuses them without touching
+device memory. vLLM's PagedAttention allocator, minus copy-on-write —
+requests never share pages here.
+
+Block 0 is the reserved TRASH page: slots not bound to a request point
+their whole block table at it, so the step program's writes for dead
+slots land somewhere harmless. ``alloc`` never hands it out.
+
+Thread-safe through the lockcheck seam (the scheduler thread allocates
+while admission/drain paths free). Double frees and leaked pages are
+hard errors — a page in two block tables means cross-request KV
+leakage, exactly the bug the pool tests hunt."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ..analysis import lockcheck as _lockcheck
+
+
+class PoolExhausted(RuntimeError):
+    """No free pages — the caller must wait for a request to leave."""
+
+
+class BlockPool:
+    """Free-list allocator over ``num_blocks`` pool pages (page 0
+    reserved as the trash page)."""
+
+    def __init__(self, num_blocks: int, block_size: int = 128,
+                 limit: int = 0) -> None:
+        num_blocks = int(num_blocks)
+        if num_blocks < 2:
+            raise ValueError(
+                "BlockPool needs >= 2 blocks (trash page + one real), "
+                "got %d" % num_blocks)
+        self.num_blocks = num_blocks
+        self.block_size = int(block_size)
+        # runtime clamp: serve_kv_blocks can keep fewer pages live
+        # than the exported pool carries (admission control without a
+        # re-export); 0 = use the whole pool
+        self.limit = min(int(limit) or num_blocks, num_blocks)
+        if self.limit < 2:
+            raise ValueError("block limit must leave >= 1 usable page")
+        self._lock = _lockcheck.make_lock("serve.kvpool.lock")
+        # LIFO free list: the page a request just released is the
+        # hottest candidate for the next admission
+        self._free: List[int] = list(range(self.limit - 1, 0, -1))
+        self._in_use = 0
+        self.high_water = 0
+        self.allocs = 0
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        with self._lock:
+            return self._in_use
+
+    def can_alloc(self, n: int) -> bool:
+        with self._lock:
+            return len(self._free) >= n
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` pages; raises :class:`PoolExhausted` (taking
+        none) when fewer are free — partial grants would deadlock two
+        half-admitted requests against each other."""
+        n = int(n)
+        if n < 1:
+            raise ValueError("alloc needs n >= 1")
+        with self._lock:
+            if len(self._free) < n:
+                raise PoolExhausted(
+                    "%d pages requested, %d free (pool %d, limit %d)"
+                    % (n, len(self._free), self.num_blocks, self.limit))
+            out = [self._free.pop() for _ in range(n)]
+            self._in_use += n
+            self.allocs += 1
+            self.high_water = max(self.high_water, self._in_use)
+            return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Return pages to the free list. Freeing the trash page, an
+        out-of-range id, or a page that is already free raises — any
+        of those means a block table went stale while the step program
+        could still write through it."""
+        blocks = [int(b) for b in blocks]
+        with self._lock:
+            # seen covers the free list AND earlier entries of this
+            # very call: free([3, 3]) is as much a double free as two
+            # calls are
+            seen = set(self._free)
+            for b in blocks:
+                if not 1 <= b < self.limit:
+                    raise ValueError(
+                        "free of page %d outside the usable pool "
+                        "[1, %d)" % (b, self.limit))
+                if b in seen:
+                    raise ValueError(
+                        "double free of pool page %d" % b)
+                seen.add(b)
+            for b in blocks:
+                self._free.append(b)
+            self._in_use -= len(blocks)
+
+    def assert_empty(self) -> None:
+        """Test hook: every page handed out has come back."""
+        with self._lock:
+            if self._in_use:
+                raise AssertionError(
+                    "%d pool pages still held (leak)" % self._in_use)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "limit": self.limit,
+                "in_use": self._in_use,
+                "free": len(self._free),
+                "high_water": self.high_water,
+                "allocs": self.allocs,
+            }

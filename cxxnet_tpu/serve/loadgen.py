@@ -46,6 +46,23 @@ shapes production traffic actually takes; all deterministic in
                         two halves (pins a handler thread), in-process
                         the answer is collected late (holds the
                         response buffer)
+* ``mixed_prompt_len``— all-generate streaming traffic interleaving
+                        short and long prompts (``prompt_len`` per
+                        entry, ``stream`` set) AND short and long
+                        completions (``max_new`` per entry) — the
+                        continuous-batching yardstick: a fixed-shape
+                        decoder stalls short prompts behind long
+                        ones' prefill+decode program and burns its
+                        full exported max_new on requests that asked
+                        for a few tokens, an iteration-level
+                        scheduler must not (TTFT and goodput tell)
+
+Generate entries may carry ``prompt_len`` (tokens; clamped to the
+target artifact), ``max_new`` (per-request cap, continuous engines
+only) and ``stream`` (consume per-token events; TTFT/TPOT are then
+honest first-token numbers instead of completion latency). ``score``
+reports ``ttft_p50/p99_ms``, ``tpot_p50_ms``, ``tokens_out`` and
+``tok_per_sec`` whenever the results carry them.
 
 Replay (:class:`LoadGen`) schedules arrivals on one pacer thread and
 hands each request to a worker pool; ``score()`` turns the outcomes
@@ -65,7 +82,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 from ..obs import trace as _trace
 
 SCENARIOS = ("steady", "bursty", "mixed_priority", "mixed_kinds",
-             "slow_client")
+             "slow_client", "mixed_prompt_len")
 
 
 # ----------------------------------------------------------------------
@@ -157,10 +174,17 @@ def make_scenario(name: str, duration_s: float = 4.0,
                   timeout_ms: Optional[float] = None,
                   slow_ms: float = 120.0,
                   burst_period_s: float = 1.0,
-                  burst_duty: float = 0.3) -> List[dict]:
+                  burst_duty: float = 0.3,
+                  short_prompt_len: int = 4,
+                  long_prompt_len: int = 48,
+                  short_max_new: int = 4) -> List[dict]:
     """Synthesize one catalog scenario as a trace (see module doc).
     ``rps`` is the MEAN arrival rate; bursty packs the same volume
-    into ``burst_duty`` of each ``burst_period_s``."""
+    into ``burst_duty`` of each ``burst_period_s``;
+    ``short_prompt_len`` / ``long_prompt_len`` shape the
+    mixed_prompt_len interleave (2 short : 1 long), whose short
+    entries also ask for only ``short_max_new`` completion tokens
+    (long entries take the artifact's full max_new)."""
     if name not in SCENARIOS:
         raise ValueError("unknown scenario %r (know %s)"
                          % (name, ", ".join(SCENARIOS)))
@@ -192,6 +216,14 @@ def make_scenario(name: str, duration_s: float = 4.0,
         elif name == "slow_client":
             if i % 4 == 0:
                 e["slow_ms"] = float(slow_ms)
+        elif name == "mixed_prompt_len":
+            e["kind"] = "generate"
+            e["stream"] = 1
+            if i % 3 == 2:
+                e["prompt_len"] = int(long_prompt_len)
+            else:
+                e["prompt_len"] = int(short_prompt_len)
+                e["max_new"] = int(short_max_new)
         entries.append(e)
     entries.sort(key=lambda e: e["t"])
     return entries
@@ -217,14 +249,63 @@ class EngineTarget:
         self.data = data
         self.prompt_len = int(prompt_len)
 
-    def _prompts(self, rows: int, i: int):
+    def _prompts(self, rows: int, i: int, plen: Optional[int] = None):
         import numpy as np
         c = self.decode.callee
         toks = np.zeros((rows, c.seq_len), np.int32)
-        L = min(self.prompt_len, c.max_prompt_len)
+        L = min(int(plen or self.prompt_len), c.max_prompt_len)
         for r in range(rows):
             toks[r, :L] = [(i + r + j) % 7 + 1 for j in range(L)]
         return toks, [L] * rows
+
+    def _generate(self, entry: dict, i: int, rows: int, kw: dict):
+        """One generate entry; returns the result-record fields.
+        Streaming entries consume the request's event stream so
+        ttft_ms is the honest first-token time; non-streaming targets
+        (the fixed-shape decoder) only have an answer at completion,
+        so their ttft EQUALS their latency — which is exactly the
+        comparison the continuous-batching bench draws."""
+        toks, lens = self._prompts(rows, i, entry.get("prompt_len"))
+        streamable = getattr(self.decode, "supports_stream", False)
+        if entry.get("max_new") is not None and streamable:
+            kw["max_new"] = int(entry["max_new"])
+        t0 = time.perf_counter()
+        ttft = None
+        ntok = 0
+        if entry.get("stream") and streamable:
+            req = self.decode.submit_tokens(toks, lens, stream=True,
+                                            **kw)
+            for ev in req.events(timeout=120.0):
+                if "error" in ev:
+                    break            # result() below raises it
+                if "done" in ev:
+                    break
+                if ttft is None:
+                    ttft = (time.perf_counter() - t0) * 1000.0
+                ntok += len(ev.get("tokens") or ())
+            req.result(5.0)
+        else:
+            req = self.decode.submit_tokens(toks, lens, **kw)
+            slow = float(entry.get("slow_ms", 0) or 0)
+            if slow > 0:
+                time.sleep(slow / 1000.0)
+            req.result(120.0)
+            ttft = (time.perf_counter() - t0) * 1000.0
+            # GOODPUT: count the tokens the client asked for. A
+            # fixed-shape decoder that cannot honor a per-request
+            # max_new still burns its full exported loop — that waste
+            # must not inflate its tokens/s
+            want = entry.get("max_new")
+            art = int(getattr(self.decode.callee, "max_new", 0))
+            ntok = rows * (min(int(want), art) if want else art)
+        total = (time.perf_counter() - t0) * 1000.0
+        rec = {"request_id": getattr(req, "id", None),
+               "tokens_out": ntok}
+        if ttft is not None:
+            rec["ttft_ms"] = round(ttft, 3)
+            if ntok > 1:
+                rec["tpot_ms"] = round((total - ttft) / (ntok - 1), 3)
+        return rec
 
     def __call__(self, entry: dict, i: int):
         kind = entry.get("kind", "predict")
@@ -238,19 +319,17 @@ class EngineTarget:
             if self.decode is None:
                 raise RuntimeError("scenario has generate entries but "
                                    "no decode target")
-            toks, lens = self._prompts(rows, i)
-            req = self.decode.submit_tokens(toks, lens, **kw)
-        else:
-            if self.forward is None:
-                raise RuntimeError("scenario has predict entries but "
-                                   "no forward target")
-            n = len(self.data)
-            lo = i % n
-            d = self.data[lo:lo + rows]
-            if len(d) < rows:            # wrap the pool
-                import numpy as np
-                d = np.concatenate([d, self.data[:rows - len(d)]])
-            req = self.forward.submit(d, **kw)
+            return self._generate(entry, i, rows, kw)
+        if self.forward is None:
+            raise RuntimeError("scenario has predict entries but "
+                               "no forward target")
+        n = len(self.data)
+        lo = i % n
+        d = self.data[lo:lo + rows]
+        if len(d) < rows:            # wrap the pool
+            import numpy as np
+            d = np.concatenate([d, self.data[:rows - len(d)]])
+        req = self.forward.submit(d, **kw)
         slow = float(entry.get("slow_ms", 0) or 0)
         if slow > 0:
             time.sleep(slow / 1000.0)
@@ -288,10 +367,14 @@ class HTTPTarget:
         kind = entry.get("kind", "predict")
         rows = int(entry.get("rows", 1))
         if kind == "generate":
-            L = self.prompt_len
+            L = int(entry.get("prompt_len") or self.prompt_len)
             prompts = [[(i + r + j) % 7 + 1 for j in range(L)]
                        for r in range(rows)]
             obj = {"prompts": prompts}
+            if entry.get("stream"):
+                obj["stream"] = True
+            if entry.get("max_new") is not None:
+                obj["max_new"] = int(entry["max_new"])
             path = "/generate"
         else:
             n = len(self.data)
@@ -307,10 +390,43 @@ class HTTPTarget:
             obj["priority"] = entry["priority"]
         return path, json.dumps(obj).encode()
 
+    def _read_stream(self, resp, t0: float):
+        """Consume a chunked SSE /generate response; ttft_ms is the
+        client-observed arrival of the FIRST token event."""
+        ttft = None
+        ntok = 0
+        rid = None
+        while True:
+            line = resp.readline()
+            if not line:
+                raise RuntimeError("SSE stream ended without a "
+                                   "terminal event")
+            if not line.startswith(b"data: "):
+                continue
+            ev = json.loads(line[6:])
+            if "error" in ev:
+                resp.read()
+                raise RuntimeError("stream error: %s" % ev["error"])
+            if "done" in ev:
+                rid = ev.get("request_id")
+                resp.read()       # drain to the terminal chunk
+                break
+            if ttft is None:
+                ttft = (time.perf_counter() - t0) * 1000.0
+            ntok += len(ev.get("tokens") or ())
+        total = (time.perf_counter() - t0) * 1000.0
+        rec = {"request_id": rid, "tokens_out": ntok}
+        if ttft is not None:
+            rec["ttft_ms"] = round(ttft, 3)
+            if ntok > 1:
+                rec["tpot_ms"] = round((total - ttft) / (ntok - 1), 3)
+        return rec
+
     def __call__(self, entry: dict, i: int):
         path, body = self._body(entry, i)
         slow = float(entry.get("slow_ms", 0) or 0)
         conn = self._conn()
+        t0 = time.perf_counter()
         try:
             if slow > 0 and len(body) > 2:
                 half = len(body) // 2
@@ -325,6 +441,10 @@ class HTTPTarget:
                 conn.request("POST", path, body,
                              {"Content-Type": "application/json"})
             resp = conn.getresponse()
+            ctype = resp.getheader("Content-Type", "")
+            if resp.status == 200 and ctype.startswith(
+                    "text/event-stream"):
+                return self._read_stream(resp, t0)
             payload = resp.read()
             st = resp.status
         except Exception:
@@ -388,6 +508,7 @@ class LoadGen:
         self.target = target
         self.workers = int(workers)
         self.results: List[dict] = []
+        self.wall_s = 0.0
         self._rlock = threading.Lock()
 
     def _fire(self, entry: dict, i: int, sched_t: float,
@@ -402,7 +523,10 @@ class LoadGen:
                              {"kind": rec["kind"], "i": i}):
                 rid = self.target(entry, i)
             rec["status"] = "ok"
-            rec["request_id"] = rid
+            if isinstance(rid, dict):   # streaming targets return the
+                rec.update(rid)         # ttft/tokens fields directly
+            else:
+                rec["request_id"] = rid
         except Exception as e:
             rec["status"] = _classify(e)
             rec["error"] = "%s: %s" % (type(e).__name__, e)
@@ -426,6 +550,11 @@ class LoadGen:
                                          float(e["t"]), t0))
             for f in futures:
                 f.result()
+            # first fire to last completion: normalizing throughput by
+            # the TRACE duration would credit the drain tail after the
+            # last arrival as free capacity (overload windows would
+            # all report tok/s == offered)
+            self.wall_s = time.perf_counter() - t0
         return self.results
 
 
@@ -448,7 +577,32 @@ def score(results: Sequence[dict], slo_ms: float,
     if duration_s is None:
         duration_s = max((r["t"] for r in results), default=0.0) or 1.0
     within = sum(1 for v in lats if v <= slo_ms)
-    return {
+
+    def _series(field):
+        return sorted(r[field] for r in results
+                      if r["status"] == "ok"
+                      and r.get(field) is not None)
+
+    def _pctl(vals, q):
+        return round(vals[min(int(q * len(vals)), len(vals) - 1)], 3)
+    extra = {}
+    ttfts = _series("ttft_ms")
+    if ttfts:
+        # token-streaming targets: first-token latency percentiles —
+        # for a non-streaming decode target ttft equals total latency
+        # (the first token only exists at completion), which is the
+        # honest number for that path
+        extra["ttft_p50_ms"] = _pctl(ttfts, 0.50)
+        extra["ttft_p99_ms"] = _pctl(ttfts, 0.99)
+    tpots = _series("tpot_ms")
+    if tpots:
+        extra["tpot_p50_ms"] = _pctl(tpots, 0.50)
+    toks = sum(r.get("tokens_out", 0) for r in results
+               if r["status"] == "ok")
+    if toks:
+        extra["tokens_out"] = toks
+        extra["tok_per_sec"] = round(toks / duration_s, 1)
+    return dict({
         "requests": len(results),
         "ok": n,
         "shed": counts.get("shed", 0),
@@ -463,4 +617,4 @@ def score(results: Sequence[dict], slo_ms: float,
         "ok_per_sec": round(n / duration_s, 1),
         "max_lag_ms": round(max((r["lag_ms"] for r in results),
                                 default=0.0), 3),
-    }
+    }, **extra)

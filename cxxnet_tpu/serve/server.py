@@ -9,9 +9,19 @@ Endpoints:
   POST /predict    {"data": nested list (n, *item_shape)} ->
                    {"output": probs, "pred": task=pred convention,
                     "request_id", "timing"}
-  POST /generate   {"prompts": [[token ids] ...], "seed": optional} ->
+  POST /generate   {"prompts": [[token ids] ...], "seed": optional,
+                    "max_new": optional (continuous engine),
+                    "stream": optional bool} ->
                    {"tokens": [[prompt + completion] ...],
                     "request_id", "timing"}
+                   With ``"stream": true`` against a continuous-
+                   batching engine (serve/continuous.py) the response
+                   is chunked ``text/event-stream``: one
+                   ``data: {"row", "i", "token"}`` SSE event per
+                   emitted token AS IT IS EMITTED — time-to-first-
+                   token decoupled from time-to-last — then a terminal
+                   ``data: {"done": true, "tokens": [...],
+                   "request_id", "timing"}`` event.
   GET  /healthz    liveness + the artifact contract (+ SLO incident
                    count when an SLO engine is attached)
   GET  /metrics    engine.metrics() JSON (see serve/stats.py);
@@ -128,6 +138,34 @@ class ServeHandler(BaseHTTPRequestHandler):
             self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
+
+    # -- chunked SSE streaming (POST /generate {"stream": true}) ------
+    def _start_stream(self, req_id: str) -> None:
+        """Response head for a chunked text/event-stream body: no
+        Content-Length (the token count is the future), chunked
+        framing keeps the keep-alive connection reusable after the
+        terminal chunk."""
+        self._status = 200
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("X-Request-Id", req_id)
+        self.end_headers()
+
+    def _write_chunk(self, data: bytes) -> None:
+        self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        self.wfile.flush()
+
+    def _sse(self, obj: dict) -> None:
+        """One SSE frame as one HTTP chunk, flushed immediately —
+        the flush is what makes TTFT real for the client."""
+        self._write_chunk(b"data: " + json.dumps(obj).encode("utf-8")
+                          + b"\n\n")
+
+    def _end_stream(self) -> None:
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
 
     def _send_text(self, code: int, text: str, ctype: str) -> None:
         body = text.encode("utf-8")
@@ -383,9 +421,43 @@ class ServeHandler(BaseHTTPRequestHandler):
         kw = self._submit_kwargs(payload)
         if kw is None:
             return
+        stream = bool(payload.get("stream", False))
+        n_new = c.max_new
+        if payload.get("max_new") is not None:
+            if not getattr(eng, "supports_stream", False):
+                self._send(400, {"error":
+                                 "per-request max_new needs a "
+                                 "continuous-batching decode engine"})
+                return
+            try:
+                n_new = int(payload["max_new"])
+            except (TypeError, ValueError):
+                self._send(400, {"error": "max_new must be an int"})
+                return
+            if not 1 <= n_new <= c.max_new:
+                self._send(400, {"error": "max_new must be in [1, %d]"
+                                 % c.max_new})
+                return
+            kw["max_new"] = n_new
+        if stream:
+            if not self.server.allow_stream:
+                self._send(403, {"error": "streaming disabled "
+                                 "(serve_stream = 0)"})
+                return
+            if not getattr(eng, "supports_stream", False):
+                self._send(409, {"error":
+                                 "streaming needs a continuous-"
+                                 "batching decode artifact "
+                                 "(export_decode=step); this engine "
+                                 "serves a monolithic decoder"})
+                return
+            kw["stream"] = True
         req = self._submit(eng.submit_tokens, toks, lens,
                            None if seed is None else int(seed), **kw)
         if req is None:
+            return
+        if stream:
+            self._stream_generate(req, lens, n_new)
             return
         out = self._wait(req)
         if out is None:
@@ -393,10 +465,48 @@ class ServeHandler(BaseHTTPRequestHandler):
         extra = req.response_meta() if hasattr(req, "response_meta") \
             else {}
         self._send(200, dict({"tokens": [
-            [int(t) for t in out[i, :int(lens[i]) + c.max_new]]
+            [int(t) for t in out[i, :int(lens[i]) + n_new]]
             for i in range(len(prompts))],
             "request_id": req.id,
             "timing": req.timing()}, **extra))
+
+    def _stream_generate(self, req, lens, n_new: int) -> None:
+        """Render a StreamRequest as chunked SSE: token events as they
+        are emitted, then the terminal event with the assembled
+        completion (same fields the non-streaming response carries)."""
+        self._req_id = req.id
+        self._start_stream(req.id)
+        try:
+            for ev in req.events(timeout=self.server.request_timeout):
+                if "error" in ev:
+                    self._sse({"error": ev["error"],
+                               "request_id": req.id})
+                    break
+                if "done" in ev:
+                    out = req.result(0)
+                    self._sse({"done": True, "tokens": [
+                        [int(t) for t in out[i, :int(lens[i]) + n_new]]
+                        for i in range(out.shape[0])],
+                        "request_id": req.id,
+                        "timing": req.timing()})
+                    break
+                self._sse(ev)
+            self._end_stream()
+        except TimeoutError:
+            # mid-stream deadline: the chunked framing cannot carry a
+            # late status code, so emit a terminal error event and
+            # close the (now unframed) connection
+            try:
+                self._sse({"error": "stream timed out",
+                           "request_id": req.id})
+                self._end_stream()
+            except OSError:
+                pass
+            self.close_connection = True
+        except OSError:
+            # client went away mid-stream: nothing to answer; the
+            # engine still finishes the request and frees its slot
+            self.close_connection = True
 
     def _post_swap(self):
         """Hot artifact swap (router topology only): {"artifact":
@@ -445,7 +555,7 @@ class ServeHTTPServer(ThreadingHTTPServer):
                  request_timeout: Optional[float] = 30.0,
                  max_body: int = 64 << 20, verbose: bool = False,
                  access_log=False, allow_swap: bool = True,
-                 slo=None):
+                 allow_stream: bool = True, slo=None):
         self.engine = engine
         self.request_timeout = request_timeout
         self.max_body = max_body
@@ -455,6 +565,9 @@ class ServeHTTPServer(ThreadingHTTPServer):
         self.access_log = access_log
         # POST /swap (router topology): serve_swap = 0 turns it off
         self.allow_swap = allow_swap
+        # SSE token streaming ({"stream": true}): serve_stream = 0
+        # turns it off (403) without touching the engine
+        self.allow_stream = allow_stream
         # obs/slo.py SLOEngine: enables GET /slo and the incident
         # count in /healthz (None = endpoint absent)
         self.slo = slo

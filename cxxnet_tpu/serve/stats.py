@@ -59,6 +59,17 @@ class ServeStats:
         self.drained = 0         # failed by a drain window expiring
         self._fill_sum = 0.0
         self.bucket_dispatches: Dict[int, int] = {}
+        # decode-phase accounting (decoder callees only): slot-steps
+        # burned on DUMMY slots make wasted decode work visible — a
+        # fixed-shape decoder pads every partial batch with 1-token
+        # dummy rows, the continuous engine leaves unbound slots idle;
+        # either way the waste must show in /metrics, not hide in the
+        # dispatch count
+        self.decode_steps = 0        # step/dispatch invocations
+        self.live_slot_steps = 0     # slot-steps carrying a request
+        self.dummy_slot_steps = 0    # slot-steps burned on padding
+        self.prefills = 0            # prefill dispatches (split phase)
+        self.prefill_rows = 0        # prompt rows prefilled
 
     # ------------------------------------------------------------------
     def on_reject(self) -> None:
@@ -88,6 +99,21 @@ class ServeStats:
             self._fill_sum += rows / float(capacity) if capacity else 0.0
             self.bucket_dispatches[int(capacity)] = \
                 self.bucket_dispatches.get(int(capacity), 0) + 1
+
+    def on_step(self, live_slots: int, dummy_slots: int) -> None:
+        """One decode-step (or monolithic decode dispatch) advancing
+        ``live_slots`` request-bound slots and burning ``dummy_slots``
+        padding slots."""
+        with self._lock:
+            self.decode_steps += 1
+            self.live_slot_steps += live_slots
+            self.dummy_slot_steps += dummy_slots
+
+    def on_prefill(self, rows: int) -> None:
+        """One prefill dispatch covering ``rows`` prompt rows."""
+        with self._lock:
+            self.prefills += 1
+            self.prefill_rows += rows
 
     def on_complete(self, latency_s: float, rows: int) -> None:
         """One request answered (dispatch + result handed back)."""
@@ -139,7 +165,9 @@ class ServeStats:
                                   names)
               for f in ("requests", "rows", "dispatches",
                         "dispatched_requests", "rejected", "timeouts",
-                        "errors", "drained")}
+                        "errors", "drained", "decode_steps",
+                        "live_slot_steps", "dummy_slot_steps",
+                        "prefills", "prefill_rows")}
         c_bucket = registry.counter(
             prefix + "_bucket_dispatches_total",
             "dispatches per exported bucket", names + ("bucket",))
@@ -197,6 +225,11 @@ class ServeStats:
                 "bucket_dispatches": {
                     str(b): n for b, n
                     in sorted(self.bucket_dispatches.items())},
+                "decode_steps": self.decode_steps,
+                "live_slot_steps": self.live_slot_steps,
+                "dummy_slot_steps": self.dummy_slot_steps,
+                "prefills": self.prefills,
+                "prefill_rows": self.prefill_rows,
                 "rows_per_sec": self.rows / elapsed,
                 "requests_per_sec": n / elapsed,
                 "latency_ms": {

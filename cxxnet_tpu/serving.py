@@ -245,6 +245,491 @@ def export_generate(trainer, path: str, max_new: int = 32,
         json.dump(meta, f)
 
 
+def default_prefill_widths(max_prompt_len: int, seq_len: int) -> list:
+    """The default prompt-width bucket ladder for a stepwise decoder:
+    doubling 64-multiples (prompt_slots granularity) below the max
+    prompt length, topped by the full prompt region P — so a short
+    prompt runs a narrow prefill program instead of the artifact-wide
+    one (the "long prompts must not tax short ones" half of the
+    prefill/decode split)."""
+    from . import generate as G
+    P = G.prompt_slots(int(max_prompt_len), int(seq_len))
+    widths, w = {P}, 64
+    while w < max_prompt_len:
+        widths.add(G.prompt_slots(w, seq_len))
+        w *= 2
+    return sorted(x for x in widths if x <= P)
+
+
+def export_decode_step(trainer, path: str, max_new: int = 32,
+                       temperature: float = 0.0,
+                       prompt_len: Optional[int] = None,
+                       batch_size: Optional[int] = None,
+                       prefill_rows: Optional[Sequence[int]] = None,
+                       prefill_widths: Optional[Sequence[int]] = None,
+                       kv_block: int = 128,
+                       pool_blocks: Optional[int] = None,
+                       step_tokens: int = 4,
+                       platforms: Optional[Sequence[str]] = None) -> None:
+    """Serialize the SPLIT-PHASE decoder for continuous batching:
+    instead of ``export_generate``'s one monolithic prefill+decode
+    loop, the artifact carries
+
+    * PREFILL programs, one per (rows, width) bucket — a causal pass
+      over a width-bucketed prompt window returning the prompt K/V
+      (for the serving engine to scatter into its paged pool) and the
+      first sampled token. Short prompts run narrow programs; a long
+      prompt prefills in its own dispatch and never rides along with
+      (or stalls) anyone else's.
+    * ONE decode-step program over a paged KV pool — ``batch`` slots,
+      each slot addressing its cache through a per-slot BLOCK TABLE
+      into a shared pool of ``kv_block``-slot pages (the 128-multiple
+      ``cache_slots`` granule from ops/decode_attend.py). Each call
+      advances every slot by ``step_tokens`` tokens (multi-step
+      scheduling: the per-call host dispatch amortizes over several
+      tokens; a slot completing mid-call has its overshoot discarded);
+      the serving engine rebinds slots between calls, which is what
+      lets requests join and leave per call (Orca-style
+      iteration-level scheduling).
+
+    Pool geometry (recorded in the meta): logical per-slot cache =
+    ``prompt_slots(prompt_len) + max_new`` attend slots, padded to the
+    128-multiple ``cache_slots`` granule and cut into
+    ``blocks_per_seq = cache_slots / kv_block`` pages;
+    ``pool_blocks`` (default: full occupancy + 1) sizes the shared
+    pool, with block 0 reserved as the trash page unbound slots write
+    into. ``decode_kv = int8`` is not supported on this path (the
+    paged attend is the XLA slot attend; the int8 win needs the fused
+    kernel — see docs/serving.md); exports with the knob set fail
+    loudly rather than silently serving a different cache dtype.
+
+    Greedy outputs are bitwise-identical to the monolithic
+    ``export_generate`` artifact built from the same trainer (the
+    step program slices its gathered pages to exactly the slot
+    layout's attend width) — pinned by tests and by
+    ``tools/decode_quality.py --paged``. Multi-host: collective,
+    process 0 writes, like ``export_model``."""
+    import jax
+    from jax import export as jexport
+
+    from . import generate as G
+
+    plan, why = G.plan_or_reason(trainer.net)
+    if plan is None:
+        raise ValueError(
+            "export_decode_step needs the canonical LM graph "
+            "(embed -> causal stack(s) -> head): " + why)
+    if getattr(trainer, "decode_kv", "native") == "int8":
+        raise ValueError(
+            "export_decode_step supports decode_kv=native only: the "
+            "paged step program attends through the XLA slot attend, "
+            "where the int8 cache is a recorded perf negative — use "
+            "export_generate (the monolithic decoder) for int8")
+    net = trainer.net
+    S = int(net.node_shapes[0][2])
+    B = int(batch_size or trainer.batch_size)
+    if B < 1:
+        raise ValueError("batch_size must be >= 1")
+    max_new = int(max_new)
+    if max_new < 1:
+        raise ValueError("max_new must be >= 1, got %d" % max_new)
+    if prompt_len is None:
+        prompt_len = max(1, S - max_new)
+    prompt_len = int(prompt_len)
+    if prompt_len < 1:
+        raise ValueError("prompt_len must be >= 1")
+    if prompt_len + max_new > S:
+        raise ValueError(
+            "prompt_len %d + max_new %d exceeds seq_len %d"
+            % (prompt_len, max_new, S))
+    step_tokens = int(step_tokens)
+    if step_tokens < 1:
+        raise ValueError("step_tokens must be >= 1")
+    step_tokens = min(step_tokens, max_new)
+    P = G.prompt_slots(prompt_len, S)
+    Sl = P + max_new                       # exact attend width
+    from .ops.decode_attend import cache_slots
+    # pool width on the 128-granule, with step_tokens - 1 slots of
+    # headroom: a slot completing mid-call writes (discarded) K/V up
+    # to step_tokens - 1 past its last real token, and those writes
+    # must stay inside the slot's own pages
+    Sp = cache_slots(P, max_new + step_tokens - 1)
+    kv_block = int(kv_block)
+    if kv_block < 1 or kv_block % 128 or Sp % kv_block:
+        raise ValueError(
+            "kv_block must be a 128-multiple dividing the %d-slot "
+            "cache_slots granule, got %d" % (Sp, kv_block))
+    nblk = Sp // kv_block
+    if pool_blocks is None:
+        # trash page + 4x occupancy: prefill is decoupled from lane
+        # availability (serve/continuous.py prefills ahead into the
+        # pool and parks rows on a ready queue until a slot frees —
+        # that is what lets prefill dispatches batch at saturation),
+        # and the ready backlog must be deep enough that holding a
+        # prefill for a full rows bucket never starves a lane. Pages
+        # are cheap; a too-small pool silently degrades the scheduler
+        # to singleton prefills
+        pool_blocks = 1 + 4 * B * nblk
+    pool_blocks = int(pool_blocks)
+    if pool_blocks < 1 + nblk:
+        raise ValueError(
+            "pool_blocks must hold at least the trash page plus one "
+            "sequence (%d blocks), got %d" % (1 + nblk, pool_blocks))
+    if prefill_widths is None:
+        widths = default_prefill_widths(prompt_len, S)
+    else:
+        widths = sorted({int(w) for w in prefill_widths})
+        if not widths or widths[0] < 1 or widths[-1] > S:
+            raise ValueError("prefill_widths must be in [1, %d], got %s"
+                             % (S, widths))
+        if widths[-1] < P:
+            raise ValueError(
+                "the widest prefill bucket (%d) must cover the prompt "
+                "region P=%d" % (widths[-1], P))
+    if prefill_rows is None:
+        rows = auto_ladder(min(B, 4))
+    else:
+        rows = sorted({int(r) for r in prefill_rows})
+        if not rows or rows[0] < 1 or rows[-1] > B:
+            raise ValueError("prefill_rows must be in [1, %d], got %s"
+                             % (B, rows))
+    nh, d = G.uniform_heads_or_reason(net, plan)
+    params = jax.tree.map(
+        lambda w: trainer._fetch_global(w) if w is not None else None,
+        trainer.params)
+    if jax.process_index() != 0:
+        return
+    trainer._warn_moe_capacity(plan, "export_decode_step")
+    import jax.numpy as jnp
+    Ltot = sum(int(params[si]["wqkv"].shape[0])
+               for si in plan["stacks"])
+    pool_dt = jnp.dtype(net.compute_dtype)
+    platform = trainer.mesh.devices.flat[0].platform
+    if platforms is None:
+        platforms = [platform]
+    SDS = jax.ShapeDtypeStruct
+    programs = []
+    # one program serialized and written at a time (see export_model):
+    # no whole-artifact blob list resident at once
+    with open(path, "wb") as f:
+        for w in widths:
+            for r in rows:
+                fn = G.build_prefill(net, plan, float(temperature),
+                                     r, w, platform)
+
+                def pre(toks, lens, key, _fn=fn):
+                    return _fn(params, toks, lens, key)
+
+                blob = jexport.export(
+                    jax.jit(pre), platforms=list(platforms))(
+                        SDS((r, w), np.int32), SDS((r,), np.int32),
+                        SDS((2,), np.uint32)).serialize()
+                f.write(blob)
+                programs.append({"kind": "prefill", "rows": r,
+                                 "width": w, "bytes": len(blob)})
+        fn = G.build_step(net, plan, float(temperature), B, P, Sl,
+                          kv_block, platform, steps=step_tokens)
+
+        def stp(pk, pv, bt, lens, stepv, last, key, _fn=fn):
+            return _fn(params, pk, pv, bt, lens, stepv, last, key)
+
+        pool_shape = (pool_blocks, Ltot, nh, kv_block, d)
+        # pool buffers donated: the exported program carries the
+        # input-output aliasing, so each step updates the pool in
+        # place instead of copying it through twice per token
+        blob = jexport.export(
+            jax.jit(stp, donate_argnums=(0, 1)),
+            platforms=list(platforms))(
+                SDS(pool_shape, pool_dt), SDS(pool_shape, pool_dt),
+                SDS((B, nblk), np.int32), SDS((B,), np.int32),
+                SDS((B,), np.int32), SDS((B,), np.int32),
+                SDS((2,), np.uint32)).serialize()
+        f.write(blob)
+        programs.append({"kind": "step", "bytes": len(blob)})
+    meta = {
+        "magic": MAGIC,
+        "kind": "generate_step",
+        "batch": B, "seq_len": S, "max_new": max_new,
+        "max_prompt_len": prompt_len, "prompt_slots": P,
+        "temperature": float(temperature),
+        "attend_slots": Sl, "pool_slots": Sp,
+        "step_tokens": step_tokens,
+        "kv_block": kv_block, "blocks_per_seq": nblk,
+        "pool_blocks": pool_blocks,
+        "pool_dtype": pool_dt.name,
+        "layers": Ltot, "nhead": nh, "head_dim": d,
+        "prefill_rows": rows, "prefill_widths": widths,
+        "decode_layout": "paged", "decode_kv": "native",
+        "programs": programs,
+        "platforms": list(platforms),
+    }
+    with open(path + ".meta", "w") as f:
+        json.dump(meta, f)
+
+
+class ExportedStepDecoder:
+    """A deserialized ``export_decode_step`` artifact: the split-phase
+    decoder the continuous-batching engine
+    (serve/continuous.ContinuousDecodeEngine) schedules per token.
+
+    * :meth:`prefill` runs the smallest (rows, width) bucket holding a
+      request's prompt rows and returns ``(first_tokens, k, v)`` with
+      the prompt K/V for the caller to scatter into the paged pool.
+    * :meth:`step` advances every slot by one token against the pool
+      (async: returns un-materialized device arrays; ``np.asarray``
+      the token vector to block).
+    * :meth:`generate` is the sequential reference driver — same
+      contract as ``ExportedDecoder.__call__`` — used by the parity
+      tests and ``tools/decode_quality.py --paged``; serving goes
+      through the engine instead."""
+
+    def __init__(self, path: str, meta: dict):
+        from jax import export as jexport
+        self.meta = meta
+        progs = meta.get("programs") or []
+        with open(path, "rb") as f:
+            blob = f.read()
+        if sum(int(pr["bytes"]) for pr in progs) != len(blob):
+            raise ValueError(
+                "%s: generate_step meta does not match the blob "
+                "(%d programs, %d bytes on disk)"
+                % (path, len(progs), len(blob)))
+        self._pre = {}
+        self._step = None
+        self._step_call = None
+        lo = 0
+        for pr in progs:
+            exp = jexport.deserialize(blob[lo:lo + int(pr["bytes"])])
+            lo += int(pr["bytes"])
+            if pr["kind"] == "prefill":
+                self._pre[(int(pr["rows"]), int(pr["width"]))] = exp
+            else:
+                self._step = exp
+        if self._step is None or not self._pre:
+            raise ValueError(
+                "%s: generate_step artifact needs at least one "
+                "prefill program and the step program" % path)
+
+    # -- artifact contract -------------------------------------------
+    @property
+    def batch(self) -> int:
+        return int(self.meta["batch"])
+
+    @property
+    def seq_len(self) -> int:
+        return int(self.meta["seq_len"])
+
+    @property
+    def max_prompt_len(self) -> int:
+        return int(self.meta["max_prompt_len"])
+
+    @property
+    def max_new(self) -> int:
+        return int(self.meta["max_new"])
+
+    @property
+    def prompt_slots(self) -> int:
+        return int(self.meta["prompt_slots"])
+
+    @property
+    def step_tokens(self) -> int:
+        return int(self.meta.get("step_tokens", 1))
+
+    @property
+    def kv_block(self) -> int:
+        return int(self.meta["kv_block"])
+
+    @property
+    def blocks_per_seq(self) -> int:
+        return int(self.meta["blocks_per_seq"])
+
+    @property
+    def pool_blocks(self) -> int:
+        return int(self.meta["pool_blocks"])
+
+    @property
+    def buckets(self) -> list:
+        return [self.batch]
+
+    @property
+    def prefill_rows(self) -> list:
+        return sorted({r for r, _ in self._pre})
+
+    @property
+    def prefill_widths(self) -> list:
+        return sorted({w for _, w in self._pre})
+
+    def pick_width(self, prompt_len: int) -> int:
+        """Smallest exported prompt-width bucket holding the prompt."""
+        for w in self.prefill_widths:
+            if w >= prompt_len:
+                return w
+        raise ValueError(
+            "prompt of %d tokens exceeds the widest prefill bucket %d"
+            % (prompt_len, self.prefill_widths[-1]))
+
+    def pick_rows(self, n: int) -> int:
+        """Smallest exported prefill row bucket holding n rows whole;
+        the max bucket when none does (the caller then chunks)."""
+        return _pick_bucket(self.prefill_rows, n)
+
+    def new_pool(self):
+        """Fresh zeroed (pool_k, pool_v) device arrays at the exported
+        pool geometry (blocks, layers, nh, kv_block, head_dim)."""
+        import jax.numpy as jnp
+        shape = (self.pool_blocks, int(self.meta["layers"]),
+                 int(self.meta["nhead"]), self.kv_block,
+                 int(self.meta["head_dim"]))
+        dt = jnp.dtype(self.meta["pool_dtype"])
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    def prefill(self, tokens: np.ndarray, lens: np.ndarray, key):
+        """Run the smallest (rows, width) prefill bucket holding
+        ``tokens (n, >= width)``: pads rows (1-token dummies), trims
+        the outputs back to ``n``. Returns ``(first (n,) int32,
+        k (L, n, nh, width, d), v (same))`` — K/V materialization is
+        the caller's (it scatters them into its pool)."""
+        n = int(tokens.shape[0])
+        w = self.pick_width(int(lens.max(initial=1)))
+        r = self.pick_rows(n)
+        if r < n:
+            raise ValueError(
+                "prefill of %d rows exceeds the largest exported "
+                "prefill bucket %d — chunk the request" % (n, r))
+        toks = np.zeros((r, w), np.int32)
+        toks[:n] = tokens[:, :w]
+        ls = np.ones((r,), np.int32)
+        ls[:n] = lens
+        first, k, v = self._pre[(r, w)].call(toks, ls, key)
+        return first[:n], k[:, :n], v[:, :n]
+
+    def step(self, pool_k, pool_v, bt, lens, stepv, last, key):
+        """One decode call over the paged pool, advancing every slot
+        by ``step_tokens`` tokens — async (no host sync): returns
+        (pool_k', pool_v', next_tokens (batch, step_tokens)) device
+        arrays.
+
+        The pool arguments are DONATED: export serialization drops the
+        program's input-output aliasing, so the call goes through an
+        outer donating jit that restores it — without this every step
+        round-trips both pool buffers through a copy (measured 10.5 ->
+        3.9 ms/step at the bench shape). The caller must drop its old
+        pool references and use the returned ones, even on failure."""
+        if self._step_call is None:
+            import jax
+            self._step_call = jax.jit(self._step.call,
+                                      donate_argnums=(0, 1))
+        return self._step_call(pool_k, pool_v, bt, lens, stepv, last,
+                               key)
+
+    def generate(self, tokens: np.ndarray, lens: np.ndarray,
+                 seed: int = 0,
+                 max_new: Optional[int] = None) -> np.ndarray:
+        """Sequential reference driver: decode ``tokens (n, S)`` /
+        ``lens (n,)`` through prefill + per-token steps with a local
+        block table, mirroring what the continuous engine does one
+        request at a time. Same output contract as
+        ``ExportedDecoder.__call__``."""
+        import jax
+        m = self.meta
+        S, B = self.seq_len, self.batch
+        nblk = self.blocks_per_seq
+        toks = np.asarray(tokens, np.int32)
+        lens = np.asarray(lens, np.int32)
+        if toks.ndim != 2 or toks.shape[1] != S:
+            raise ValueError(
+                "tokens must be (n, %d), got %s" % (S, toks.shape))
+        n = toks.shape[0]
+        if n == 0:
+            raise ValueError("tokens must carry at least one row")
+        if lens.shape != (n,) or int(lens.min(initial=1)) < 1:
+            raise ValueError(
+                "lens must be (%d,) with every prompt >= 1 token" % n)
+        if int(lens.max(initial=0)) > m["max_prompt_len"]:
+            raise ValueError(
+                "a prompt exceeds the exported max_prompt_len %d"
+                % m["max_prompt_len"])
+        n_new = self.max_new if max_new is None else int(max_new)
+        if not 1 <= n_new <= self.max_new:
+            raise ValueError("max_new must be in [1, %d], got %d"
+                             % (self.max_new, n_new))
+        base = jax.random.PRNGKey(int(seed))
+        out = np.array(toks, copy=True)
+        rows_fit = min(B, (self.pool_blocks - 1) // nblk)
+        for lo in range(0, n, rows_fit):
+            t = toks[lo:lo + rows_fit]
+            l = lens[lo:lo + rows_fit]
+            mrows = t.shape[0]
+            pool_k, pool_v = self.new_pool()
+            bt = np.zeros((B, nblk), np.int32)       # 0 = trash page
+            for r in range(mrows):
+                bt[r] = 1 + r * nblk + np.arange(nblk)
+            emitted = np.zeros((mrows, n_new), np.int32)
+            # per-row prefill: row-independent, so grouping does not
+            # change values — one row at a time keeps this driver
+            # trivially correct for mixed prompt lengths
+            for r in range(mrows):
+                key = np.asarray(jax.random.fold_in(base, lo + r),
+                                 np.uint32)
+                first, k, v = self.prefill(t[r:r + 1], l[r:r + 1], key)
+                emitted[r, 0] = int(np.asarray(first)[0])
+                pool_k, pool_v = scatter_prefill_kv(
+                    pool_k, pool_v, k, v, [list(bt[r])], self.kv_block)
+            blens = np.ones((B,), np.int32)
+            blens[:mrows] = l
+            T = self.step_tokens
+            i = 0
+            while i < n_new - 1:
+                stepv = np.full((B,), i, np.int32)
+                last = np.zeros((B,), np.int32)
+                last[:mrows] = emitted[:, i]
+                key = np.asarray(jax.random.fold_in(base, 1 << 20 | i),
+                                 np.uint32)
+                pool_k, pool_v, nxt = self.step(
+                    pool_k, pool_v, bt, blens, stepv, last, key)
+                take = min(T, n_new - 1 - i)   # overshoot discarded
+                emitted[:, i + 1:i + 1 + take] = \
+                    np.asarray(nxt)[:mrows, :take]
+                i += take
+            for r in range(mrows):
+                out[lo + r, l[r]:l[r] + n_new] = emitted[r]
+        return out
+
+
+_SCATTER_CACHE: dict = {}
+
+
+def scatter_prefill_kv(pool_k, pool_v, k, v, block_tables,
+                       kv_block: int):
+    """Scatter prefill K/V ``(L, n, nh, W, d)`` into the paged pool at
+    each row's block table (logical prompt slot ``j`` maps to page
+    ``bt[j // kv_block]`` offset ``j % kv_block``). One jitted scatter
+    with the pool arrays DONATED, so XLA updates the pool in place
+    (the caller must drop its old references — the returned
+    (pool_k, pool_v) replace them); without donation every prefill
+    would memcpy the whole pool twice."""
+    import jax
+    bt = np.asarray(block_tables, np.int32)          # (n, nb)
+    n = bt.shape[0]
+    W = int(k.shape[3])
+    key = (W, n, tuple(pool_k.shape), str(pool_k.dtype))
+    fn = _SCATTER_CACHE.get(key)
+    if fn is None:
+        def _scat(pk, pv, kk, vv, b_idx, off):
+            kt = kk.transpose(1, 3, 0, 2, 4)         # (n, W, L, nh, d)
+            vt = vv.transpose(1, 3, 0, 2, 4)
+            pk = pk.at[b_idx, :, :, off, :].set(kt.astype(pk.dtype))
+            pv = pv.at[b_idx, :, :, off, :].set(vt.astype(pv.dtype))
+            return pk, pv
+        fn = jax.jit(_scat, donate_argnums=(0, 1))
+        _SCATTER_CACHE[key] = fn
+    cols = np.arange(W)
+    b_idx = bt[:, cols // kv_block].astype(np.int32)      # (n, W)
+    off = np.ascontiguousarray(np.broadcast_to(
+        cols % kv_block, (n, W))).astype(np.int32)
+    return fn(pool_k, pool_v, k, v, b_idx, off)
+
+
 def _load_exps(path: str, meta: Optional[dict]):
     """Deserialize an artifact's program(s): a ``batch_ladder`` meta
     splits the blob into per-bucket programs (``{bucket: exported}``),
@@ -482,6 +967,8 @@ def load_exported(path: str):
             meta = json.load(f)
         if meta.get("magic") != MAGIC:
             raise ValueError("%s: not a cxxnet_tpu export" % path)
+        if meta.get("kind") == "generate_step":
+            return ExportedStepDecoder(path, meta)
         if meta.get("kind") == "generate":
             return ExportedDecoder(path, meta)
         return ExportedModel(path, meta)

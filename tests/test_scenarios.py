@@ -118,7 +118,8 @@ def test_access_log_from_live_server_replays(tmp_path):
 
 def test_catalog_names_and_determinism():
     assert set(("bursty", "mixed_priority", "mixed_kinds",
-                "slow_client", "steady")) == set(SCENARIOS)
+                "slow_client", "steady",
+                "mixed_prompt_len")) == set(SCENARIOS)
     for name in SCENARIOS:
         a = make_scenario(name, duration_s=2.0, rps=50, seed=11)
         b = make_scenario(name, duration_s=2.0, rps=50, seed=11)
@@ -261,8 +262,35 @@ def test_committed_ledger_has_scenario_baseline():
         hist = json.load(f)
     row = hist["best_by_net"]["scenario"]
     for name in ("bursty", "mixed_priority", "mixed_kinds",
-                 "slow_client"):
+                 "slow_client", "mixed_prompt_len"):
         s = row["scenarios"][name]
         assert s["p99_ms"] is not None
         assert 0.0 <= s["slo_attainment"] <= 1.0
         assert s["requests"] > 0
+        # the capacity frontier: attainment vs offered load, recorded
+        # past the steady point (the r10 sweep satellite)
+        fr = s["frontier"]
+        assert len(fr) >= 2
+        assert fr[-1]["offered_rps"] > row["offered_rps"]
+        assert all(0.0 <= f["slo_attainment"] <= 1.0 for f in fr)
+    # streaming scenarios carry honest first-token numbers
+    s = row["scenarios"]["mixed_prompt_len"]
+    assert s["ttft_p99_ms"] is not None and s["tok_per_sec"] > 0
+
+
+def test_committed_ledger_has_decode_serve_baseline():
+    """The net=decode_serve row: the paged continuous path beats the
+    fixed-shape decoder on the mixed-prompt-length trace in BOTH
+    sustained goodput tokens/s and p99 TTFT (the r10 acceptance), with
+    the capacity frontier recorded for both paths."""
+    with open(os.path.join(REPO, "docs", "bench_history.json")) as f:
+        hist = json.load(f)
+    row = hist["best_by_net"]["decode_serve"]
+    assert row["tok_per_sec_speedup"] > 1.0
+    assert row["ttft_p99_speedup"] > 1.0
+    assert row["tok_per_sec"] > row["tok_per_sec_fixed"] > 0
+    assert row["ttft_p99_ms"] < row["ttft_p99_ms_fixed"]
+    for path in ("fixed", "paged"):
+        fr = row["frontier"][path]
+        assert len(fr) >= 3
+        assert all(f["tok_per_sec"] > 0 for f in fr)

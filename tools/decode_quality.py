@@ -1,13 +1,14 @@
 #!/usr/bin/env python
-"""End-task quality of the int8 KV cache (decode_kv=int8).
+"""End-task decode quality: int8 KV cache, and contiguous-vs-paged
+decode parity.
 
-The int8 cache is an APPROXIMATE decode (0.9% relative attend error,
-docs/performance.md) — this tool measures what that costs on-task,
-not just in operand norms. Recipe: train gpt2-small on the streamed
-Markov oracle (the convergence_r5 recipe — every token has 4 uniform
-successors, so a trained model's greedy continuations should walk the
-chain), then decode the SAME prompts through the exact (bf16) and
-int8 cache paths and report:
+Default mode — the int8 cache is an APPROXIMATE decode (0.9% relative
+attend error, docs/performance.md): this tool measures what that costs
+on-task, not just in operand norms. Recipe: train gpt2-small on the
+streamed Markov oracle (the convergence_r5 recipe — every token has 4
+uniform successors, so a trained model's greedy continuations should
+walk the chain), then decode the SAME prompts through the exact (bf16)
+and int8 cache paths and report:
 
 * ``agreement`` — fraction of generated tokens identical between the
   two paths (greedy; ties are the only legitimate divergence source);
@@ -16,9 +17,25 @@ int8 cache paths and report:
   end-task metric. If int8 validity matches exact validity, the
   quantization costs nothing a user of the model can observe.
 
+``--paged`` mode — the continuous-batching serving path
+(serving.export_decode_step + the paged KV pool) must be EXACT, not
+approximate: it exports BOTH the monolithic fixed-shape decoder
+(export_generate — the legacy path, kept behind the export_decode knob
+for exactly this comparison) and the split-phase paged decoder from
+the same trained weights, decodes the same oracle prompts through
+each, and demands greedy agreement 1.0 bit-for-bit (the oracle shape
+keeps prompt_slots + max_new on the 128 granule, where the paged
+attend width equals the slot layout's — docs/serving.md). Chain
+validity is reported for both as the end-task cross-check.
+
+``--net tiny`` swaps the gpt2-small recipe for a small LM at the same
+oracle (seq 128, prompt 64, max_new 64 — still 128-granule aligned)
+so the parity gate runs in minutes on a CPU rig.
+
 One JSON line per run; paste-ready for docs/performance.md.
 
 Usage: python tools/decode_quality.py [--rounds 4] [--batch 32]
+       python tools/decode_quality.py --paged [--net tiny]
 """
 import argparse
 import json
@@ -43,18 +60,33 @@ def main():
                          "corpus before measuring")
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--n-train", type=int, default=8192)
+    ap.add_argument("--paged", action="store_true",
+                    help="compare the monolithic (contiguous-cache) "
+                         "exported decoder against the paged "
+                         "split-phase one instead of int8 vs exact — "
+                         "greedy outputs must match bitwise")
+    ap.add_argument("--net", choices=("gpt2", "tiny"), default="gpt2",
+                    help="tiny: a small LM at a 128-granule-aligned "
+                         "oracle shape (CPU-rig friendly)")
     args = ap.parse_args()
+
+    global SEQ, VOCAB, PROMPT, MAX_NEW
+    if args.net == "tiny":
+        SEQ, VOCAB, PROMPT, MAX_NEW = 128, 256, 64, 64
 
     import perf_lab
 
     from cxxnet_tpu import models
     from cxxnet_tpu.io import DataBatch
 
+    net_cfg = (models.gpt2_small(seq_len=SEQ, vocab=VOCAB)
+               if args.net == "gpt2" else
+               models.tiny_lm(seq_len=SEQ, vocab=VOCAB, embed=64,
+                              nlayer=2, nhead=2))
     tr = perf_lab.build(
         [("eta", "0.0003"), ("metric", "token_error"),
          ("fuse_steps", "8"), ("updater", "adam")],
-        models.gpt2_small(seq_len=SEQ, vocab=VOCAB),
-        nclass=VOCAB, batch=args.batch)
+        net_cfg, nclass=VOCAB, batch=args.batch)
 
     rs = np.random.RandomState(3)
     succ = rs.randint(0, VOCAB, size=(VOCAB, 4))
@@ -86,16 +118,7 @@ def main():
     toks[:, :PROMPT] = xp[:, :PROMPT]
     lens = np.full(args.batch, PROMPT, np.int32)
 
-    outs = {}
-    for kv in ("native", "int8"):
-        tr.set_param("decode_kv", kv)
-        tr.set_param("decode_layout", "slotk")
-        outs[kv] = np.asarray(
-            tr.generate(toks, lens, MAX_NEW, temperature=0.0))
-
     gen_slice = slice(PROMPT, PROMPT + MAX_NEW)
-    a, b = outs["native"][:, gen_slice], outs["int8"][:, gen_slice]
-    agreement = float((a == b).mean())
 
     def validity(o):
         # every generated transition (incl. prompt->first token) must
@@ -105,9 +128,47 @@ def main():
         ok = (succ[prev] == nxt[..., None]).any(-1)
         return float(ok.mean())
 
+    if args.paged:
+        import tempfile
+
+        from cxxnet_tpu import serving
+        td = tempfile.mkdtemp(prefix="decq_")
+        mono_p = os.path.join(td, "mono.export")
+        step_p = os.path.join(td, "step.export")
+        serving.export_generate(tr, mono_p, max_new=MAX_NEW,
+                                temperature=0.0, prompt_len=PROMPT)
+        serving.export_decode_step(tr, step_p, max_new=MAX_NEW,
+                                   temperature=0.0, prompt_len=PROMPT)
+        mono = serving.load_exported(mono_p)
+        paged = serving.load_exported(step_p)
+        a = np.asarray(mono(toks, lens))
+        b = np.asarray(paged.generate(toks, lens))
+        agreement = float((a[:, gen_slice] == b[:, gen_slice]).mean())
+        print(json.dumps({
+            "experiment": "decode_quality_paged_parity",
+            "net": args.net, "rounds_trained": args.rounds,
+            "batch": args.batch, "prompt": PROMPT, "max_new": MAX_NEW,
+            "greedy_agreement_paged_vs_contiguous": round(agreement, 5),
+            "bitwise_identical": bool(np.array_equal(a, b)),
+            "chain_validity_contiguous": round(validity(a), 5),
+            "chain_validity_paged": round(validity(b), 5),
+            "train_wall_s": round(time.time() - t0, 1),
+        }), flush=True)
+        return
+
+    outs = {}
+    for kv in ("native", "int8"):
+        tr.set_param("decode_kv", kv)
+        tr.set_param("decode_layout", "slotk")
+        outs[kv] = np.asarray(
+            tr.generate(toks, lens, MAX_NEW, temperature=0.0))
+
+    a, b = outs["native"][:, gen_slice], outs["int8"][:, gen_slice]
+    agreement = float((a == b).mean())
+
     print(json.dumps({
         "experiment": "decode_quality_int8",
-        "net": "gpt2_small", "rounds_trained": args.rounds,
+        "net": args.net, "rounds_trained": args.rounds,
         "batch": args.batch, "prompt": PROMPT, "max_new": MAX_NEW,
         "greedy_agreement_int8_vs_exact": round(agreement, 5),
         "chain_validity_exact": round(validity(outs["native"]), 5),
