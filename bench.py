@@ -955,6 +955,23 @@ def _serve_window(model, nreq, threads, rows_of, max_wait_ms,
     return rows / dt, m
 
 
+def _jit_gate(jit_mon, label: str, **extra) -> dict:
+    """HARD GATE shared by the serve/decode benches, applied before
+    anything is recorded: a run that compiled in steady state is a
+    serving regression, and a failed bench must not leave its window
+    in the committed ledger as a "best". Returns the
+    ``recompile_sentinel`` summary dict for the ledger entry
+    (``extra`` carries the per-bench fields)."""
+    if jit_mon.steady_compiles:
+        sys.stderr.write(
+            "bench %s: RECOMPILE SENTINEL TRIPPED — %d steady-"
+            "state compile(s); nothing recorded:\n  %s\n"
+            % (label, jit_mon.steady_compiles,
+               "\n  ".join(map(repr, jit_mon.violations()))))
+        sys.exit(1)
+    return jit_mon.summary(donation_validator_on=True, **extra)
+
+
 def serve_main(args) -> None:
     """The serving fast-path benchmark (``python bench.py serve``).
 
@@ -985,103 +1002,115 @@ def serve_main(args) -> None:
     # flight recorder ON for every window: serving now runs the
     # always-on recorder (obs/flight.py) in production posture, so the
     # headline p50/throughput MUST include its append cost — the
-    # acceptance bound holds it to the pre-recorder range
+    # acceptance bound holds it to the pre-recorder range.
+    # r10: BOTH jitcheck sentinels installed too (recompile counting +
+    # donation validation, docs/analysis.md) — same production-posture
+    # argument, and the sentinel is ARMED after warmup: a single
+    # steady-state compile in any window fails this bench hard.
+    from cxxnet_tpu.analysis import jitcheck
     rs = np.random.RandomState(0)
     data = rs.randn(SERVE_BATCH, 1, 1, SERVE_DIM).astype(np.float32)
-    with _flight_on() as flight, tempfile.TemporaryDirectory() as td:
-        tr = _serve_trainer(platform)
-        fixed_path = os.path.join(td, "fixed.export")
-        ladder_path = os.path.join(td, "ladder.export")
-        serving.export_model(tr, fixed_path, platforms=[platform])
-        serving.export_model(
-            tr, ladder_path,
-            batch_ladder=serving.auto_ladder(SERVE_BATCH),
-            platforms=[platform])
-        fixed = serving.load_exported(fixed_path)
-        ladder = serving.load_exported(ladder_path)
-        del tr
+    jit_mon = jitcheck.enable()
+    try:
+        with _flight_on() as flight, \
+                tempfile.TemporaryDirectory() as td:
+            tr = _serve_trainer(platform)
+            fixed_path = os.path.join(td, "fixed.export")
+            ladder_path = os.path.join(td, "ladder.export")
+            serving.export_model(tr, fixed_path, platforms=[platform])
+            serving.export_model(
+                tr, ladder_path,
+                batch_ladder=serving.auto_ladder(SERVE_BATCH),
+                platforms=[platform])
+            fixed = serving.load_exported(fixed_path)
+            ladder = serving.load_exported(ladder_path)
+            del tr
 
-        # compile every bucket outside the clocks
-        from cxxnet_tpu.serve import ServingEngine
-        for m in (fixed, ladder):
-            ServingEngine(m, start=False).warmup()
+            # compile every bucket outside the clocks
+            from cxxnet_tpu.serve import ServingEngine
+            for m in (fixed, ladder):
+                ServingEngine(m, start=False).warmup()
+            jit_mon.arm()      # steady state: no compile from here on
 
-        one = lambda i: 1
-        mixed = lambda i: 1 + i % 4
+            one = lambda i: 1
+            mixed = lambda i: 1 + i % 4
 
-        # ---- leg 1: 1-row p50, ladder vs fixed (paired windows) ----
-        p50_fixed, p50_ladder, ladder_ratio = float("inf"), \
-            float("inf"), 0.0
-        deadline = time.perf_counter() + SERVE_BUDGET_S / 2
-        lat_trials = 0
-        while True:
-            _, mf = _serve_window(fixed, nreq, 1, one, 0.0, 2, data)
-            _, ml = _serve_window(ladder, nreq, 1, one, 0.0, 2, data)
-            f50 = mf["latency_ms"]["p50"]
-            l50 = ml["latency_ms"]["p50"]
-            p50_fixed = min(p50_fixed, f50)
-            p50_ladder = min(p50_ladder, l50)
-            if l50 > 0:
-                ladder_ratio = max(ladder_ratio, f50 / l50)
-            lat_trials += 1
-            if lat_trials >= max(3, args.trials) \
-                    and ladder_ratio >= 1.5:
-                break
-            if time.perf_counter() >= deadline:
-                break
+            # ---- leg 1: 1-row p50, ladder vs fixed (paired windows) ----
+            p50_fixed, p50_ladder, ladder_ratio = float("inf"), \
+                float("inf"), 0.0
+            deadline = time.perf_counter() + SERVE_BUDGET_S / 2
+            lat_trials = 0
+            while True:
+                _, mf = _serve_window(fixed, nreq, 1, one, 0.0, 2, data)
+                _, ml = _serve_window(ladder, nreq, 1, one, 0.0, 2, data)
+                f50 = mf["latency_ms"]["p50"]
+                l50 = ml["latency_ms"]["p50"]
+                p50_fixed = min(p50_fixed, f50)
+                p50_ladder = min(p50_ladder, l50)
+                if l50 > 0:
+                    ladder_ratio = max(ladder_ratio, f50 / l50)
+                lat_trials += 1
+                if lat_trials >= max(3, args.trials) \
+                        and ladder_ratio >= 1.5:
+                    break
+                if time.perf_counter() >= deadline:
+                    break
 
-        # ---- leg 2: throughput, pipelined vs serial (paired) ----
-        from cxxnet_tpu.obs.registry import Registry
-        serial_rps, pipe_rps, pipe_ratio = 0.0, 0.0, 0.0
-        best_m, best_obs = None, None
-        deadline = time.perf_counter() + SERVE_BUDGET_S / 2
-        thr_trials = 0
-        while True:
-            s_rate, _ = _serve_window(ladder, nreq, threads, mixed,
-                                      2.0, 0, data)
-            # fresh registry per window: the ledger's obs fields come
-            # from the registry snapshot of the winning window, same
-            # numbers /metrics?format=prom would have exported
-            reg = Registry()
-            p_rate, pm = _serve_window(ladder, nreq, threads, mixed,
-                                       2.0, 2, data, registry=reg)
-            serial_rps = max(serial_rps, s_rate)
-            if p_rate > pipe_rps:
-                pipe_rps, best_m = p_rate, pm
-                best_obs = {
-                    "batch_fill": reg.get_value(
-                        "cxxnet_serve_batch_fill"),
-                    "batch_occupancy": reg.get_value(
-                        "cxxnet_serve_batch_occupancy"),
-                    "requests_total": reg.get_value(
-                        "cxxnet_serve_requests_total"),
-                    "timeouts_total": reg.get_value(
-                        "cxxnet_serve_timeouts_total"),
-                }
-            pipe_ratio = max(pipe_ratio, p_rate / s_rate)
-            thr_trials += 1
-            if thr_trials >= max(3, args.trials) and pipe_ratio >= 1.1:
-                break
-            if time.perf_counter() >= deadline:
-                break
+            # ---- leg 2: throughput, pipelined vs serial (paired) ----
+            from cxxnet_tpu.obs.registry import Registry
+            serial_rps, pipe_rps, pipe_ratio = 0.0, 0.0, 0.0
+            best_m, best_obs = None, None
+            deadline = time.perf_counter() + SERVE_BUDGET_S / 2
+            thr_trials = 0
+            while True:
+                s_rate, _ = _serve_window(ladder, nreq, threads, mixed,
+                                          2.0, 0, data)
+                # fresh registry per window: the ledger's obs fields come
+                # from the registry snapshot of the winning window, same
+                # numbers /metrics?format=prom would have exported
+                reg = Registry()
+                p_rate, pm = _serve_window(ladder, nreq, threads, mixed,
+                                           2.0, 2, data, registry=reg)
+                serial_rps = max(serial_rps, s_rate)
+                if p_rate > pipe_rps:
+                    pipe_rps, best_m = p_rate, pm
+                    best_obs = {
+                        "batch_fill": reg.get_value(
+                            "cxxnet_serve_batch_fill"),
+                        "batch_occupancy": reg.get_value(
+                            "cxxnet_serve_batch_occupancy"),
+                        "requests_total": reg.get_value(
+                            "cxxnet_serve_requests_total"),
+                        "timeouts_total": reg.get_value(
+                            "cxxnet_serve_timeouts_total"),
+                    }
+                pipe_ratio = max(pipe_ratio, p_rate / s_rate)
+                thr_trials += 1
+                if thr_trials >= max(3, args.trials) and pipe_ratio >= 1.1:
+                    break
+                if time.perf_counter() >= deadline:
+                    break
 
-        # ---- leg 3: offered-load sweep on the default engine ----
-        # powers of two up to the client cap, plus the cap itself when
-        # it is not one (the throughput leg's load must appear) —
-        # exactly the bucket-ladder shape
-        sweep = []
-        for conc in serving.auto_ladder(threads):
-            rate, m = _serve_window(ladder, nreq, conc, mixed, 2.0, 2,
-                                    data)
-            sweep.append({
-                "clients": conc,
-                "rows_per_sec": round(rate, 1),
-                "p50_ms": round(m["latency_ms"]["p50"], 3),
-                "p99_ms": round(m["latency_ms"]["p99"], 3),
-                "batch_occupancy": round(m["batch_occupancy"], 2),
-                "batch_fill": round(m["batch_fill"], 3),
-            })
+            # ---- leg 3: offered-load sweep on the default engine ----
+            # powers of two up to the client cap, plus the cap itself when
+            # it is not one (the throughput leg's load must appear) —
+            # exactly the bucket-ladder shape
+            sweep = []
+            for conc in serving.auto_ladder(threads):
+                rate, m = _serve_window(ladder, nreq, conc, mixed, 2.0, 2,
+                                        data)
+                sweep.append({
+                    "clients": conc,
+                    "rows_per_sec": round(rate, 1),
+                    "p50_ms": round(m["latency_ms"]["p50"], 3),
+                    "p99_ms": round(m["latency_ms"]["p99"], 3),
+                    "batch_occupancy": round(m["batch_occupancy"], 2),
+                    "batch_fill": round(m["batch_fill"], 3),
+                })
+    finally:
+        jitcheck.disable()
 
+    sentinel = _jit_gate(jit_mon, "serve", armed=True)
     entry = {
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "rows_per_sec": round(pipe_rps, 1),
@@ -1092,6 +1121,7 @@ def serve_main(args) -> None:
         "bucket_p50_speedup": round(ladder_ratio, 3),
         "flight_recorder_on": True,
         "flight_events_recorded": flight.recorded,
+        "recompile_sentinel": sentinel,
         "obs": best_obs,
     }
     best = _update_history(entry, net="serve", metric="rows_per_sec")
@@ -1143,6 +1173,13 @@ def serve_main(args) -> None:
                     "best window's metrics registry snapshot "
                     "(obs/registry.py) — the same series "
                     "/metrics?format=prom exports",
+        "recompile_sentinel": sentinel,
+        "recompile_note": "jitcheck sentinel armed after the explicit "
+                          "bucket warmups: every measured window ran "
+                          "under the steady-state no-compile contract "
+                          "(and the donation validator); a run with "
+                          "steady_state_compiles > 0 hard-fails "
+                          "before recording anything",
         "offered_load_sweep": sweep,
         "best_recorded": best,
     }))
@@ -1715,63 +1752,83 @@ def decode_main(args) -> None:
     from cxxnet_tpu import serving
     from cxxnet_tpu.serve.loadgen import make_scenario
 
+    from cxxnet_tpu.analysis import jitcheck
+
     platform = jax.devices()[0].platform
-    with tempfile.TemporaryDirectory() as td:
-        tr = _decode_lm_trainer(platform)
-        mono_path = os.path.join(td, "dec_mono.export")
-        step_path = os.path.join(td, "dec_step.export")
-        serving.export_generate(
-            tr, mono_path, max_new=DECODE_MAX_NEW, temperature=0.0,
-            prompt_len=DECODE_PROMPT,
-            batch_ladder=[1, 2, 4, DECODE_SLOTS],
-            platforms=[platform])
-        serving.export_decode_step(
-            tr, step_path, max_new=DECODE_MAX_NEW, temperature=0.0,
-            prompt_len=DECODE_PROMPT, batch_size=DECODE_SLOTS,
-            prefill_rows=[1, 2, 4, DECODE_SLOTS],
-            platforms=[platform])
-        del tr
-        mono = serving.load_exported(mono_path)
-        stepd = serving.load_exported(step_path)
-        entries = make_scenario(
-            "mixed_prompt_len", duration_s=args.decode_duration,
-            rps=args.decode_rps, seed=7,
-            timeout_ms=DECODE_TIMEOUT_MS,
-            short_prompt_len=DECODE_SHORT,
-            long_prompt_len=DECODE_PROMPT,
-            short_max_new=DECODE_SHORT_MAX_NEW)
-        # paired adjacent windows: fixed, paged, fixed, paged — the
-        # best window per path is the headline (window weather on a
-        # shared host otherwise decides the comparison)
-        windows = {"fixed": [], "paged": []}
-        for _ in range(2):
-            windows["fixed"].append(_decode_window(
-                "fixed", mono, entries, args.decode_duration))
-            windows["paged"].append(_decode_window(
-                "paged", stepd, entries, args.decode_duration))
-        best = {p: max(w, key=lambda s: s.get("tok_per_sec") or 0.0)
-                for p, w in windows.items()}
-        # capacity frontier: offered load raised past the knee
-        frontier = {"fixed": [], "paged": []}
-        fr_dur = min(args.decode_duration, 2.0)
-        for mult in (0.5, 1.0, 1.5):
-            rps = args.decode_rps * mult
-            e2 = make_scenario("mixed_prompt_len", duration_s=fr_dur,
-                               rps=rps, seed=7,
-                               timeout_ms=DECODE_TIMEOUT_MS,
-                               short_prompt_len=DECODE_SHORT,
-                               long_prompt_len=DECODE_PROMPT,
-                               short_max_new=DECODE_SHORT_MAX_NEW)
-            for p, dec in (("fixed", mono), ("paged", stepd)):
-                s2 = _decode_window(p, dec, e2, fr_dur)
-                frontier[p].append({
-                    "offered_rps": rps,
-                    "slo_attainment": s2["slo_attainment"],
-                    "tok_per_sec": s2.get("tok_per_sec"),
-                    "ok_per_sec": s2["ok_per_sec"],
-                    "ttft_p99_ms": s2.get("ttft_p99_ms"),
-                    "p99_ms": s2["p99_ms"],
-                    "shed": s2["shed"]})
+    # both jitcheck sentinels on for the WHOLE bench (production
+    # posture, docs/analysis.md): the donation validator wraps the
+    # paged pool's donating step/scatter calls live, and the recompile
+    # sentinel arms after the first paired window (which carries every
+    # first-call compile of the shared decoder artifacts) — any
+    # compile in the later windows or the frontier sweep fails hard
+    jit_mon = jitcheck.enable()
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            tr = _decode_lm_trainer(platform)
+            mono_path = os.path.join(td, "dec_mono.export")
+            step_path = os.path.join(td, "dec_step.export")
+            serving.export_generate(
+                tr, mono_path, max_new=DECODE_MAX_NEW, temperature=0.0,
+                prompt_len=DECODE_PROMPT,
+                batch_ladder=[1, 2, 4, DECODE_SLOTS],
+                platforms=[platform])
+            serving.export_decode_step(
+                tr, step_path, max_new=DECODE_MAX_NEW, temperature=0.0,
+                prompt_len=DECODE_PROMPT, batch_size=DECODE_SLOTS,
+                prefill_rows=[1, 2, 4, DECODE_SLOTS],
+                platforms=[platform])
+            del tr
+            mono = serving.load_exported(mono_path)
+            stepd = serving.load_exported(step_path)
+            entries = make_scenario(
+                "mixed_prompt_len", duration_s=args.decode_duration,
+                rps=args.decode_rps, seed=7,
+                timeout_ms=DECODE_TIMEOUT_MS,
+                short_prompt_len=DECODE_SHORT,
+                long_prompt_len=DECODE_PROMPT,
+                short_max_new=DECODE_SHORT_MAX_NEW)
+            # paired adjacent windows: fixed, paged, fixed, paged —
+            # the best window per path is the headline (window weather
+            # on a shared host otherwise decides the comparison)
+            windows = {"fixed": [], "paged": []}
+            for wi in range(2):
+                windows["fixed"].append(_decode_window(
+                    "fixed", mono, entries, args.decode_duration))
+                windows["paged"].append(_decode_window(
+                    "paged", stepd, entries, args.decode_duration))
+                if wi == 0:
+                    # window pair 1 compiled every program on the
+                    # shared artifacts (engine warmups run in allow
+                    # windows anyway); steady state starts here
+                    jit_mon.arm()
+            best = {p: max(w, key=lambda s: s.get("tok_per_sec") or 0.0)
+                    for p, w in windows.items()}
+            # capacity frontier: offered load raised past the knee
+            frontier = {"fixed": [], "paged": []}
+            fr_dur = min(args.decode_duration, 2.0)
+            for mult in (0.5, 1.0, 1.5):
+                rps = args.decode_rps * mult
+                e2 = make_scenario("mixed_prompt_len", duration_s=fr_dur,
+                                   rps=rps, seed=7,
+                                   timeout_ms=DECODE_TIMEOUT_MS,
+                                   short_prompt_len=DECODE_SHORT,
+                                   long_prompt_len=DECODE_PROMPT,
+                                   short_max_new=DECODE_SHORT_MAX_NEW)
+                for p, dec in (("fixed", mono), ("paged", stepd)):
+                    s2 = _decode_window(p, dec, e2, fr_dur)
+                    frontier[p].append({
+                        "offered_rps": rps,
+                        "slo_attainment": s2["slo_attainment"],
+                        "tok_per_sec": s2.get("tok_per_sec"),
+                        "ok_per_sec": s2["ok_per_sec"],
+                        "ttft_p99_ms": s2.get("ttft_p99_ms"),
+                        "p99_ms": s2["p99_ms"],
+                        "shed": s2["shed"]})
+    finally:
+        jitcheck.disable()
+
+    sentinel = _jit_gate(jit_mon, "decode", armed_after_window_pair=1,
+                         donating_calls_validated=jit_mon.donating_calls)
 
     def ratio(field, lo_better=False):
         a = best["paged"].get(field)
@@ -1796,6 +1853,7 @@ def decode_main(args) -> None:
         "ttft_p99_ms": best["paged"].get("ttft_p99_ms"),
         "ttft_p99_ms_fixed": best["fixed"].get("ttft_p99_ms"),
         "ttft_p99_speedup": ratio("ttft_p99_ms", lo_better=True),
+        "recompile_sentinel": sentinel,
         "windows": windows,
         "frontier": frontier,
     }
@@ -1818,6 +1876,14 @@ def decode_main(args) -> None:
         "fixed": best["fixed"],
         "tok_per_sec_speedup": entry["tok_per_sec_speedup"],
         "ttft_p99_speedup": entry["ttft_p99_speedup"],
+        "recompile_sentinel": sentinel,
+        "recompile_note": "jitcheck sentinel armed after window pair "
+                          "1: windows 2+ and the whole frontier sweep "
+                          "ran under the steady-state no-compile "
+                          "contract, with the donation validator "
+                          "checking every donating pool call; a run "
+                          "with steady_state_compiles > 0 hard-fails "
+                          "before recording anything",
         "frontier": frontier,
         "best_recorded": best_rec,
     }))

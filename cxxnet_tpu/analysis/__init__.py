@@ -1,13 +1,16 @@
 """Concurrency & hot-path correctness tooling (docs/analysis.md).
 
-Three pieces, one goal — prove lock discipline and keep host syncs out
-of hot paths as the serving/feed tier grows threads:
+Four pieces, one goal — prove lock discipline, keep host syncs out of
+hot paths, and hold the JAX jit/donation contracts as the serving/feed
+tier grows threads:
 
 * :mod:`.lint` — an AST-based checker framework run over the whole
   tree by ``tools/analysis_gate.py`` (a standing tier-1 gate via
   ``tests/test_analysis.py``). Checker families: CONC (lock-acquisition
   graph cycles, blocking calls under a held lock), SYNC (host-sync
-  constructs inside functions marked hot), OBS (span/metric
+  constructs inside functions marked hot), JIT (use-after-donate
+  dataflow, jit construction in loops/hot paths, static-argnums
+  recompile storms, discarded donating results), OBS (span/metric
   conventions from obs/).
 * :mod:`.lockcheck` — a lockdep-style runtime validator: instrumented
   ``Lock``/``RLock``/``Condition``/``Queue`` factories that record
@@ -17,16 +20,24 @@ of hot paths as the serving/feed tier grows threads:
   enabling the monitor instruments the real code paths; disabled (the
   default) the seam returns plain ``threading`` primitives — one
   branch at lock *creation*, nothing on acquire/release.
-* :func:`hot_path` — the marker the SYNC checker keys on. Zero
+* :mod:`.jitcheck` — the runtime half of the JIT rules: a recompile
+  sentinel on JAX's compile-event seam (per-program counts, armed
+  steady-state contract, ``cxxnet_recompiles_total``) and a donation
+  validator that turns use-after-donate into an immediate diagnostic
+  naming the donating call site + argnum. Same creation-time seam
+  discipline as lockcheck (``make_donating``, ``allow`` warmup
+  regions).
+* :func:`hot_path` — the marker the SYNC/JIT checkers key on. Zero
   runtime cost: it stamps an attribute and returns the function.
 
-This package must stay import-light (stdlib only, no jax/numpy): the
-serving engine and the feed import the seam at module import time.
+This package must stay import-light (stdlib only, no jax/numpy at
+module level): the serving engine and the feed import the seams at
+module import time.
 """
 
 from __future__ import annotations
 
-from . import lockcheck  # noqa: F401  (the seam modules import)
+from . import jitcheck, lockcheck  # noqa: F401  (the seam modules import)
 
 _HOT_ATTR = "__cxxnet_hot_path__"
 

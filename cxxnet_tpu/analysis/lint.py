@@ -32,6 +32,43 @@ adding a class to :data:`ALL_CHECKERS`:
   * SYNC004 — ``float(...)``/``int(...)`` of a computed value (a call
     or subscript — ``float(x[0])`` syncs; ``float(timeout_ms)`` of a
     plain name does not and is not flagged).
+  * SYNC005 — ``.tolist()`` / ``jax.device_get(...)`` (whole-array
+    host transfers the SYNC001-004 set misses).
+  * SYNC006 — ``.copy_to_host_async()`` immediately awaited: the next
+    statement materializes the same value (``np.asarray``/``.item()``/
+    ``float``/``block_until_ready``), so the async copy bought no
+    overlap — checked everywhere, not just hot paths (the call is
+    always deliberate, so a hit is always misuse).
+
+**JIT — jax jit/donation hygiene** (the static half of
+analysis/jitcheck.py)
+  The checker models *donating* and *static-arged* jitted callables it
+  can see: a local/module name or ``self.X`` attribute assigned from
+  ``jax.jit(fn, donate_argnums=...)`` / ``pjit`` / the
+  ``jitcheck.make_donating`` seam, a method that directly returns such
+  a call with its own params at donated positions (argnums mapped
+  through), and the gate's ``extra_donating`` config for cross-module
+  APIs (leaf name + donated argnums + a minimum call arity so e.g.
+  ``trace.step(n)`` never matches ``decoder.step(pool_k, ...)``):
+
+  * JIT001 — use-after-donate: a name passed at a donated position of
+    a known donating call and then READ later in the same function
+    without being rebound first (jax's deferred "Array has been
+    deleted" made immediate and attributable). Intra-function
+    dataflow: branches fork/merge, loop bodies are walked twice so a
+    donate-at-bottom/read-at-top back edge is caught; metadata reads
+    (``.shape``/``.dtype``/...) of a donated array are legal and
+    exempt.
+  * JIT002 — ``jax.jit``/``pjit`` CONSTRUCTION inside a loop or a
+    hot-path function: every call re-traces and re-compiles; build
+    once outside, or cache-guard the construction.
+  * JIT003 — recompile storm: a loop-varying name passed at a
+    ``static_argnums`` position of a known jitted callable — each new
+    value is a fresh trace + compile, per iteration.
+  * JIT004 — a known donating call whose result is DISCARDED (a bare
+    expression statement): the donated inputs are consumed but
+    nothing rebinds the outputs — the caller is left holding dead
+    buffers (the drop-aliasing-on-export bug class).
 
 **OBS — observability conventions** (obs/registry.py, obs/trace.py)
   * OBS001 — a ``span(...)`` call that is not the context expression
@@ -483,6 +520,10 @@ class SyncChecker(Checker):
     def check(self, mod: Module) -> List[Finding]:
         findings: List[Finding] = []
 
+        # SYNC006 needs pair scans per statement list — only pay for
+        # them in modules that mention the call at all
+        scan_async = "copy_to_host_async" in mod.source
+
         def visit(node, qual):
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, ast.ClassDef):
@@ -490,6 +531,8 @@ class SyncChecker(Checker):
                 elif isinstance(child, (ast.FunctionDef,
                                         ast.AsyncFunctionDef)):
                     q = ".".join(qual + [child.name])
+                    if scan_async:
+                        self._check_async_copy(mod, q, child, findings)
                     if self._is_hot(child) \
                             or "%s::%s" % (mod.path, q) \
                             in self.extra_hot:
@@ -539,6 +582,17 @@ class SyncChecker(Checker):
                 findings.append(Finding(
                     "SYNC003", mod.path, sub.lineno, qual,
                     ".item() host sync in hot path"))
+            elif leaf == "tolist" and not sub.args \
+                    and isinstance(sub.func, ast.Attribute):
+                findings.append(Finding(
+                    "SYNC005", mod.path, sub.lineno, qual,
+                    ".tolist() whole-array host transfer in hot "
+                    "path"))
+            elif d in ("jax.device_get", "device_get"):
+                findings.append(Finding(
+                    "SYNC005", mod.path, sub.lineno, qual,
+                    "%s(...) forces a device->host transfer in hot "
+                    "path" % d))
             elif isinstance(sub.func, ast.Name) \
                     and sub.func.id in ("float", "int") and sub.args:
                 arg = sub.args[0]
@@ -548,6 +602,609 @@ class SyncChecker(Checker):
                         "SYNC004", mod.path, sub.lineno, qual,
                         "%s(...) of a computed value syncs in hot "
                         "path" % sub.func.id))
+
+    # -- SYNC006: copy_to_host_async immediately awaited ---------------
+    @staticmethod
+    def _async_copy_recv(stmt) -> Optional[Tuple[str, int]]:
+        """(receiver name, line) when ``stmt`` contains
+        ``X.copy_to_host_async()``."""
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "copy_to_host_async":
+                recv = dotted(sub.func.value)
+                if recv is not None:
+                    return recv, sub.lineno
+        return None
+
+    @staticmethod
+    def _materializes(stmt, name: str) -> bool:
+        """``stmt`` forces ``name`` to host: np.asarray/np.array of
+        it, ``.item()``/``.block_until_ready()`` on it, or
+        float()/int() over an expression reading it."""
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = _call_name(sub)
+            leaf = d.rsplit(".", 1)[-1] if d else None
+            if leaf in ("item", "block_until_ready") \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and dotted(sub.func.value) == name:
+                return True
+            if (d in ("np.asarray", "numpy.asarray", "np.array",
+                      "numpy.array")
+                    or (isinstance(sub.func, ast.Name)
+                        and sub.func.id in ("float", "int"))) \
+                    and sub.args:
+                for x in ast.walk(sub.args[0]):
+                    if dotted(x) == name:
+                        return True
+        return False
+
+    def _check_async_copy(self, mod, qual, fn, findings) -> None:
+        # own statements only: nested defs are visited on their own
+        stack = list(ast.iter_child_nodes(fn))
+        nodes = [fn]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            nodes.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for node in nodes:
+            for field in ("body", "orelse", "finalbody"):
+                body = getattr(node, field, None)
+                if not isinstance(body, list):
+                    continue
+                for a, b in zip(body, body[1:]):
+                    hit = self._async_copy_recv(a)
+                    if hit and self._materializes(b, hit[0]):
+                        findings.append(Finding(
+                            "SYNC006", mod.path, hit[1], qual,
+                            "%s.copy_to_host_async() is materialized "
+                            "by the very next statement — the async "
+                            "copy bought no overlap" % hit[0]))
+
+
+# ----------------------------------------------------------------------
+# JIT
+
+JIT_CONSTRUCTORS = {"jax.jit", "jit", "pjit"}
+
+# attribute reads that are metadata, legal on a donated (deleted) array
+_METADATA_ATTRS = {"shape", "dtype", "ndim", "size", "sharding",
+                   "aval", "nbytes"}
+
+# cross-module donating APIs the per-module model cannot see:
+# (callable leaf name, donated argnums, minimum positional arity).
+# The arity floor keeps generic leaves from matching unrelated calls
+# (trace.step(n) is 1-ary; ExportedStepDecoder.step(pool_k, ...) is 7).
+DEFAULT_EXTRA_DONATING = (
+    ("scatter_prefill_kv", (0, 1), 4),
+    ("step", (0, 1), 7),
+)
+
+
+def _is_jit_ctor(call: ast.Call) -> bool:
+    d = _call_name(call)
+    if d is None:
+        return False
+    return d in JIT_CONSTRUCTORS or d.rsplit(".", 1)[-1] == "pjit"
+
+
+def _int_tuple(node) -> Optional[Tuple[int, ...]]:
+    """Every int constant found inside ``node`` (handles ``(0, 1)``,
+    ``3``, and ``(0, 1) + extra`` — the dynamic part is simply not
+    seen; the model stays conservative)."""
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) \
+                and isinstance(sub.value, int) \
+                and not isinstance(sub.value, bool):
+            out.add(int(sub.value))
+    return tuple(sorted(out)) if out else None
+
+
+def _jit_specs(call: ast.Call):
+    """(donate_argnums, static_argnums) declared on a jit/pjit
+    construction, ints only; (None, None) when absent."""
+    don = stat = None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            don = _int_tuple(kw.value)
+        elif kw.arg == "static_argnums":
+            stat = _int_tuple(kw.value)
+    return don, stat
+
+
+def _ctor_specs(expr):
+    """Walk an assignment RHS for a jit/pjit construction (or a
+    ``jitcheck.make_donating`` wrap) and return its (donate, static)
+    argnums — sees through wrappers like ``make_donating(jax.jit(...,
+    donate_argnums=(0, 1)), ...)``."""
+    for sub in ast.walk(expr):
+        if not isinstance(sub, ast.Call):
+            continue
+        d = _call_name(sub)
+        if d is None:
+            continue
+        if _is_jit_ctor(sub):
+            don, stat = _jit_specs(sub)
+            if don is not None or stat is not None:
+                return don, stat
+        elif d.rsplit(".", 1)[-1] == "make_donating":
+            for kw in sub.keywords:
+                if kw.arg == "argnums":
+                    t = _int_tuple(kw.value)
+                    if t is not None:
+                        return t, None
+    return None, None
+
+
+def _track(node) -> Optional[str]:
+    """The dataflow-tracked name of an expression: a bare ``Name`` or
+    a ``self.<attr...>`` chain (as a dotted string), else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        d = dotted(node)
+        if d is not None and d.startswith("self."):
+            return d
+    return None
+
+
+def _flat_targets(targets) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    stack = list(targets)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Starred):
+            stack.append(t.value)
+        else:
+            out.append(t)
+    return out
+
+
+class _JitScope:
+    """Known jitted callables of one scope: name -> argnums."""
+
+    __slots__ = ("donating", "static")
+
+    def __init__(self) -> None:
+        self.donating: Dict[str, Tuple[int, ...]] = {}
+        self.static: Dict[str, Tuple[int, ...]] = {}
+
+
+class JitChecker(Checker):
+    name = "JIT"
+
+    def __init__(self, extra_hot: Sequence[str] = (),
+                 extra_donating=DEFAULT_EXTRA_DONATING) -> None:
+        self.extra_hot = set(extra_hot)
+        self.extra_donating = tuple(extra_donating)
+
+    # -- scope models --------------------------------------------------
+    @staticmethod
+    def _scan_assigns(root, scope: _JitScope, self_attrs: bool) -> None:
+        """Collect ``NAME = jit-ctor`` (or ``self.X = jit-ctor`` when
+        ``self_attrs``) assignments anywhere under ``root``."""
+        for sub in ast.walk(root):
+            if not (isinstance(sub, ast.Assign) and sub.targets):
+                continue
+            for tgt in _flat_targets(sub.targets):
+                if self_attrs:
+                    name = _track(tgt)
+                    if name is None or not name.startswith("self."):
+                        continue
+                else:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    name = tgt.id
+                don, stat = _ctor_specs(sub.value)
+                if don is not None:
+                    scope.donating[name] = don
+                if stat is not None:
+                    scope.static[name] = stat
+
+    @staticmethod
+    def _local_scope(fn) -> _JitScope:
+        scope = _JitScope()
+        JitChecker._scan_assigns(fn, scope, self_attrs=False)
+        return scope
+
+    def _propagate(self, fns, scope: _JitScope, method: bool) -> None:
+        """A function that directly returns a known donating call with
+        its own params at donated positions is itself donating (the
+        ``ExportedStepDecoder.step`` shape): map the argnums through
+        and register it in ``scope``."""
+        for fn in fns:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            local = self._local_scope(fn)
+            params = [a.arg for a in fn.args.args]
+            off = 1 if method and params[:1] == ["self"] else 0
+            for stmt in ast.walk(fn):
+                if not (isinstance(stmt, ast.Return)
+                        and isinstance(stmt.value, ast.Call)):
+                    continue
+                call = stmt.value
+                d = dotted(call.func)
+                argnums = (local.donating.get(d)
+                           or scope.donating.get(d)) if d else None
+                if argnums is None \
+                        or any(isinstance(a, ast.Starred)
+                               for a in call.args):
+                    continue
+                mapped = []
+                for i in argnums:
+                    if i < len(call.args) \
+                            and isinstance(call.args[i], ast.Name) \
+                            and call.args[i].id in params:
+                        p = params.index(call.args[i].id) - off
+                        if p >= 0:
+                            mapped.append(p)
+                if mapped:
+                    key = ("self." + fn.name) if method else fn.name
+                    scope.donating.setdefault(
+                        key, tuple(sorted(mapped)))
+
+    def _class_scope(self, node: ast.ClassDef) -> _JitScope:
+        scope = _JitScope()
+        self._scan_assigns(node, scope, self_attrs=True)
+        self._propagate(node.body, scope, method=True)
+        return scope
+
+    def _module_scope(self, tree) -> _JitScope:
+        scope = _JitScope()
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                self._scan_assigns(node, scope, self_attrs=False)
+        self._propagate(tree.body, scope, method=False)
+        return scope
+
+    # -- callee resolution --------------------------------------------
+    def _resolve(self, call: ast.Call, ctx, kind: str):
+        """(argnums, description) when ``call`` targets a known
+        donating (kind='donating') or static-arged (kind='static')
+        callable visible from ``ctx = (module, cls, local)``."""
+        module, cls, local = ctx
+        d = dotted(call.func)
+        if d is None:
+            # immediate jit(fn, ...)(args)
+            if isinstance(call.func, ast.Call) \
+                    and _is_jit_ctor(call.func):
+                don, stat = _jit_specs(call.func)
+                spec = don if kind == "donating" else stat
+                if spec is not None:
+                    return spec, _call_name(call.func.func) or "jit"
+            return None, None
+        for scope in (local, cls, module):
+            if scope is None:
+                continue
+            spec = getattr(scope, kind).get(d)
+            if spec is not None:
+                return spec, d
+        if kind == "donating":
+            leaf = d.rsplit(".", 1)[-1]
+            for lf, argnums, min_args in self.extra_donating:
+                if leaf == lf and len(call.args) >= min_args:
+                    return argnums, d
+        return None, None
+
+    # -- JIT001/JIT004: use-after-donate dataflow ---------------------
+    def _flow_body(self, body, state, mod, qual, ctx, findings):
+        for stmt in body:
+            self._flow_stmt(stmt, state, mod, qual, ctx, findings)
+
+    def _flow_stmt(self, stmt, state, mod, qual, ctx, findings):
+        flow_expr = self._flow_expr
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return            # runs later / visited on its own
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = _flat_targets(
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target])
+            names = {n for n in map(_track, targets) if n}
+            if stmt.value is not None:
+                flow_expr(stmt.value, state, names, False, mod, qual,
+                          ctx, findings)
+            for n in names:
+                state.pop(n, None)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            flow_expr(stmt.value, state, set(), False, mod, qual, ctx,
+                      findings)
+            # reads nested INSIDE the target (x[i] += 1 reads x and i
+            # with Load ctx) go through the normal walk ...
+            flow_expr(stmt.target, state, set(), False, mod, qual,
+                      ctx, findings)
+            n = _track(stmt.target)
+            # ... but the target name itself carries Store ctx, so the
+            # read half of the read-write needs a direct check
+            if n is not None and n in state:
+                ln, desc, argnum = state.pop(n)
+                findings.append(Finding(
+                    "JIT001", mod.path, stmt.target.lineno, qual,
+                    "%r read after being donated to %s (argnum %d, "
+                    "line %d) — use-after-donate" % (n, desc, argnum,
+                                                     ln)))
+            if n:
+                state.pop(n, None)
+            return
+        if isinstance(stmt, ast.Expr):
+            flow_expr(stmt.value, state, set(), True, mod, qual, ctx,
+                      findings)
+            return
+        if isinstance(stmt, ast.If):
+            flow_expr(stmt.test, state, set(), False, mod, qual, ctx,
+                      findings)
+            s1, s2 = dict(state), dict(state)
+            self._flow_body(stmt.body, s1, mod, qual, ctx, findings)
+            self._flow_body(stmt.orelse, s2, mod, qual, ctx, findings)
+            state.clear()
+            state.update(s2)
+            state.update(s1)          # union: donated on either path
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            flow_expr(stmt.iter, state, set(), False, mod, qual, ctx,
+                      findings)
+            tnames = {n for n in map(_track,
+                                     _flat_targets([stmt.target]))
+                      if n}
+            for _ in range(2):        # pass 2 catches back-edge reads
+                # the back edge REBINDS the loop target from the
+                # iterator, so clear it at the top of EVERY pass:
+                # donating the loop variable each iteration (the
+                # donate-each-batch pattern) is legal and must not
+                # flag on pass 2
+                for n in tnames:
+                    state.pop(n, None)
+                self._flow_body(stmt.body, state, mod, qual, ctx,
+                                findings)
+            self._flow_body(stmt.orelse, state, mod, qual, ctx,
+                            findings)
+            return
+        if isinstance(stmt, ast.While):
+            for _ in range(2):
+                flow_expr(stmt.test, state, set(), False, mod, qual,
+                          ctx, findings)
+                self._flow_body(stmt.body, state, mod, qual, ctx,
+                                findings)
+            self._flow_body(stmt.orelse, state, mod, qual, ctx,
+                            findings)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                flow_expr(item.context_expr, state, set(), False, mod,
+                          qual, ctx, findings)
+                if item.optional_vars is not None:
+                    for t in _flat_targets([item.optional_vars]):
+                        n = _track(t)
+                        if n:
+                            state.pop(n, None)
+            self._flow_body(stmt.body, state, mod, qual, ctx, findings)
+            return
+        if isinstance(stmt, ast.Try):
+            entry = dict(state)
+            self._flow_body(stmt.body, state, mod, qual, ctx, findings)
+            merged = dict(state)
+            for h in stmt.handlers:
+                hs = dict(entry)
+                hs.update(state)      # may throw anywhere in the body
+                self._flow_body(h.body, hs, mod, qual, ctx, findings)
+                merged.update(hs)
+            so = dict(state)
+            self._flow_body(stmt.orelse, so, mod, qual, ctx, findings)
+            merged.update(so)
+            state.clear()
+            state.update(merged)
+            self._flow_body(stmt.finalbody, state, mod, qual, ctx,
+                            findings)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                n = _track(t)
+                if n:
+                    state.pop(n, None)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                flow_expr(stmt.value, state, set(), False, mod, qual,
+                          ctx, findings)
+            return
+        for field in ("test", "value", "exc", "cause", "msg"):
+            sub = getattr(stmt, field, None)
+            if isinstance(sub, ast.AST):
+                flow_expr(sub, state, set(), False, mod, qual, ctx,
+                          findings)
+
+    def _flow_expr(self, expr, state, targets, discard, mod, qual,
+                   ctx, findings):
+        """One expression: reads are checked against the donated set
+        FIRST (argument evaluation precedes the call), then this
+        expression's donating calls update the set. ``targets`` are
+        names being simultaneously rebound by the enclosing assignment
+        (``pool, out = step(pool, x)`` is the sanctioned shape);
+        ``discard`` marks a bare expression statement (JIT004)."""
+        calls: List[ast.Call] = []
+        stack = [expr]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.Lambda, ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                continue          # runs later, on its own frame
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr in _METADATA_ATTRS:
+                inner = _track(sub.value)
+                if inner is not None and inner in state:
+                    continue      # metadata of a donated array: legal
+            if isinstance(sub, ast.Call):
+                calls.append(sub)
+            n = _track(sub)
+            if n is not None and n in state \
+                    and isinstance(getattr(sub, "ctx", None), ast.Load):
+                ln, desc, argnum = state.pop(n)
+                findings.append(Finding(
+                    "JIT001", mod.path, sub.lineno, qual,
+                    "%r read after being donated to %s (argnum %d, "
+                    "line %d) — use-after-donate" % (n, desc, argnum,
+                                                     ln)))
+                continue          # don't re-flag via the chain's parts
+            stack.extend(ast.iter_child_nodes(sub))
+        for call in calls:
+            argnums, desc = self._resolve(call, ctx, "donating")
+            if argnums is None \
+                    or any(isinstance(a, ast.Starred)
+                           for a in call.args):
+                continue
+            if discard and call is expr:
+                findings.append(Finding(
+                    "JIT004", mod.path, call.lineno, qual,
+                    "donating call %s(...) discards its result — the "
+                    "donated inputs are consumed but nothing rebinds "
+                    "the outputs (the drop-aliasing shape)" % desc))
+            for i in argnums:
+                if i < len(call.args):
+                    n = _track(call.args[i])
+                    if n is not None and n not in targets:
+                        state[n] = (call.lineno, desc, i)
+
+    # -- JIT002/JIT003: constructions + static-arg storms -------------
+    def _scan_ctor(self, mod, qual, fn, hot, findings):
+        def visit(node, depth):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(node, ast.Call) and _is_jit_ctor(node):
+                if depth > 0:
+                    findings.append(Finding(
+                        "JIT002", mod.path, node.lineno, qual,
+                        "jit/pjit constructed inside a loop — "
+                        "every iteration re-traces and "
+                        "re-compiles"))
+                elif hot:
+                    findings.append(Finding(
+                        "JIT002", mod.path, node.lineno, qual,
+                        "jit/pjit constructed inside a hot-path "
+                        "function — every call re-traces; build "
+                        "once outside or cache-guard it"))
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                # only what re-runs per iteration deepens the loop
+                # depth: the body, and a While's test; a For's iter
+                # and either loop's orelse evaluate exactly once
+                for stmt in node.body:
+                    visit(stmt, depth + 1)
+                if isinstance(node, ast.While):
+                    visit(node.test, depth + 1)
+                else:
+                    visit(node.iter, depth)
+                for stmt in node.orelse:
+                    visit(stmt, depth)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, depth)
+        for child in ast.iter_child_nodes(fn):
+            visit(child, 0)
+
+    def _scan_static_loops(self, mod, qual, fn, ctx, findings):
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.For, ast.AsyncFor,
+                                     ast.While)):
+                continue
+            varying: Set[str] = set()
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                for t in _flat_targets([node.target]):
+                    n = _track(t)
+                    if n:
+                        varying.add(n)
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Name, ast.Attribute)) \
+                        and isinstance(getattr(sub, "ctx", None),
+                                       ast.Store):
+                    n = _track(sub)
+                    if n:
+                        varying.add(n)
+            if not varying:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                argnums, desc = self._resolve(sub, ctx, "static")
+                if argnums is None:
+                    continue
+                for i in argnums:
+                    if i >= len(sub.args):
+                        continue
+                    reads = {_track(x)
+                             for x in ast.walk(sub.args[i])}
+                    hit = sorted((reads & varying) - {None})
+                    if hit:
+                        findings.append(Finding(
+                            "JIT003", mod.path, sub.lineno, qual,
+                            "loop-varying %s passed at static_argnums "
+                            "position %d of %s — every new value is a "
+                            "fresh trace + compile (recompile storm)"
+                            % (", ".join(map(repr, hit)), i, desc)))
+
+    # -- drive ---------------------------------------------------------
+    def check(self, mod: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        module_scope = self._module_scope(mod.tree)
+
+        def visit(node, stack, cls_scope):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, stack + [child.name],
+                          self._class_scope(child))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    qual = ".".join(stack + [child.name])
+                    hot = SyncChecker._is_hot(child) \
+                        or "%s::%s" % (mod.path, qual) in self.extra_hot
+                    ctx = (module_scope, cls_scope,
+                           self._local_scope(child))
+                    # the dataflow walk is the expensive pass: run it
+                    # only when this function can actually reach a
+                    # donating callable (one cheap call scan)
+                    if self._any_donating_call(child, ctx):
+                        self._flow_fn(mod, qual, child, ctx, findings)
+                    self._scan_ctor(mod, qual, child, hot, findings)
+                    if module_scope.static or ctx[2].static \
+                            or (cls_scope is not None
+                                and cls_scope.static):
+                        self._scan_static_loops(mod, qual, child, ctx,
+                                                findings)
+                    # nested defs keep the class scope: closures
+                    # capture self
+                    visit(child, stack + [child.name], cls_scope)
+
+        visit(mod.tree, [], None)
+        seen: Set[tuple] = set()
+        out: List[Finding] = []
+        for f in findings:          # loops are walked twice: dedupe
+            k = (f.rule, f.line, f.func, f.msg)
+            if k not in seen:
+                seen.add(k)
+                out.append(f)
+        return out
+
+    def _any_donating_call(self, fn, ctx) -> bool:
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) \
+                    and self._resolve(sub, ctx, "donating")[0] \
+                    is not None:
+                return True
+        return False
+
+    def _flow_fn(self, mod, qual, fn, ctx, findings):
+        state: Dict[str, tuple] = {}
+        self._flow_body(fn.body, state, mod, qual, ctx, findings)
 
 
 # ----------------------------------------------------------------------
@@ -624,25 +1281,35 @@ class ObsChecker(Checker):
 
 # ----------------------------------------------------------------------
 
-def all_checkers(extra_hot: Sequence[str] = ()) -> List[Checker]:
-    return [ConcChecker(), SyncChecker(extra_hot), ObsChecker()]
+def all_checkers(extra_hot: Sequence[str] = (),
+                 extra_donating=DEFAULT_EXTRA_DONATING
+                 ) -> List[Checker]:
+    return [ConcChecker(), SyncChecker(extra_hot),
+            JitChecker(extra_hot, extra_donating), ObsChecker()]
 
 
 def check_source(source: str, path: str = "<snippet>.py",
-                 extra_hot: Sequence[str] = ()) -> List[Finding]:
+                 extra_hot: Sequence[str] = (),
+                 extra_donating=DEFAULT_EXTRA_DONATING
+                 ) -> List[Finding]:
     """Lint one source string (the fixture-test entry point)."""
     mod = Module(path, source)
     out: List[Finding] = []
-    for c in all_checkers(extra_hot):
+    for c in all_checkers(extra_hot, extra_donating):
         out.extend(c.check(mod))
     return sorted(out, key=lambda f: (f.path, f.line, f.rule))
 
 
 def iter_py_files(root: str,
-                  subdirs: Sequence[str] = ("cxxnet_tpu", "tools"),
+                  subdirs: Sequence[str] = ("cxxnet_tpu", "tools",
+                                            "tests"),
                   extra_files: Sequence[str] = ("bench.py",)
                   ) -> List[str]:
-    """Repo-relative paths of the tree the gate lints."""
+    """Repo-relative paths of the tree the gate lints. ``tests/`` is
+    scanned too (r10): conftest + fixture helpers ship real seams
+    (locks, engines) and the test modules themselves must not rot —
+    sanctioned test-only constructs carry waivers like everything
+    else."""
     out: List[str] = []
     for sub in subdirs:
         base = os.path.join(root, sub)
@@ -660,12 +1327,14 @@ def iter_py_files(root: str,
 
 
 def check_tree(root: str, paths: Optional[Sequence[str]] = None,
-               extra_hot: Sequence[str] = ()) -> List[Finding]:
+               extra_hot: Sequence[str] = (),
+               extra_donating=DEFAULT_EXTRA_DONATING
+               ) -> List[Finding]:
     """Lint every file (repo-relative ``paths``, default the standard
     tree) under ``root``; unparseable files become a PARSE finding
     rather than an exception."""
     findings: List[Finding] = []
-    checkers = all_checkers(extra_hot)
+    checkers = all_checkers(extra_hot, extra_donating)
     for rel in (paths if paths is not None else iter_py_files(root)):
         full = os.path.join(root, rel)
         try:

@@ -521,3 +521,37 @@ def watch_quantile(q, name: str, registry: Optional[Registry] = None,
         c.set_total(q.count, **labels)
 
     return reg.add_hook(pull)
+
+
+def watch_jitcheck(monitor, registry: Optional[Registry] = None
+                   ) -> Callable[[], None]:
+    """Publish an ``analysis.jitcheck.JitMonitor``:
+    ``cxxnet_jit_compiles_total`` (every jax compilation the sentinel
+    observed), ``cxxnet_recompiles_total`` (compiles in armed steady
+    state outside a sanctioned warmup window — must stay zero), and
+    ``cxxnet_jit_programs`` (distinct programs compiled).
+
+    Each scrape reads the ACTIVE monitor when one is enabled (falling
+    back to ``monitor``): cycling the sentinel (disable + enable, e.g.
+    around a new bench window in the same process) must not freeze
+    the exported series on a defunct monitor — the same per-call
+    resolution ``jitcheck.make_donating`` wrappers use."""
+    reg = registry or get_registry()
+    c_all = reg.counter("cxxnet_jit_compiles_total",
+                        "jax programs compiled (jitcheck sentinel)")
+    c_re = reg.counter("cxxnet_recompiles_total",
+                       "steady-state compiles while the recompile "
+                       "sentinel was armed — any nonzero value is a "
+                       "serving regression")
+    g_prog = reg.gauge("cxxnet_jit_programs",
+                       "distinct jax programs the sentinel has seen "
+                       "compile")
+
+    def pull():
+        from cxxnet_tpu.analysis import jitcheck
+        mon = jitcheck.active() or monitor
+        c_all.set_total(mon.total_compiles)
+        c_re.set_total(mon.steady_compiles)
+        g_prog.set(len(mon.compiles))
+
+    return reg.add_hook(pull)

@@ -275,34 +275,49 @@ class ContinuousDecodeEngine:
         the jitted donated scatter compiles per (rows, width) shape),
         one decode step, and the key fold, so every first-call cost on
         the serving path lands before traffic. All warmup writes go
-        through trash block tables, so the pool stays clean."""
+        through trash block tables, so the pool stays clean. Runs
+        inside a ``jitcheck.allow`` window: with the recompile
+        sentinel armed these compiles are sanctioned warmup
+        (docs/analysis.md)."""
+        from ..analysis import jitcheck as _jitcheck
         from ..serving import scatter_prefill_kv
         c = self.callee
-        key = self._fold_key(0)
-        maxr = c.prefill_rows[-1]
-        for w in c.prefill_widths:
-            nb = -(-w // c.kv_block)
-            k = v = None
-            for r in c.prefill_rows:
-                toks = np.zeros((r, w), np.int32)
-                lens = np.ones((r,), np.int32)
-                first, k, v = c._pre[(r, w)].call(toks, lens, key)
-                np.asarray(first)
-                self.warmup_runs += 1
-            for n in range(1, maxr + 1):
-                # the scatter jit-caches per (rows, width): warm every
-                # group size a dispatch can arrive with
-                self._pool_k, self._pool_v = scatter_prefill_kv(
-                    self._pool_k, self._pool_v, k[:, :n], v[:, :n],
-                    [[0] * nb for _ in range(n)], c.kv_block)
-        B, nblk = self.batch, c.blocks_per_seq
-        pk, pv, nxt = c.step(
-            self._pool_k, self._pool_v,
-            np.zeros((B, nblk), np.int32), np.ones((B,), np.int32),
-            np.zeros((B,), np.int32), np.zeros((B,), np.int32), key)
-        np.asarray(nxt)
-        self._pool_k, self._pool_v = pk, pv
-        self.warmup_runs += 1
+        with _jitcheck.allow("serve.continuous.warmup"):
+            key = self._fold_key(0)
+            maxr = c.prefill_rows[-1]
+            for w in c.prefill_widths:
+                nb = -(-w // c.kv_block)
+                outs = {}
+                for r in c.prefill_rows:
+                    toks = np.zeros((r, w), np.int32)
+                    lens = np.ones((r,), np.int32)
+                    outs[r] = c._pre[(r, w)].call(toks, lens, key)
+                    np.asarray(outs[r][0])
+                    self.warmup_runs += 1
+                for n in range(1, maxr + 1):
+                    # warm every (bucket, live-rows) combo a dispatch
+                    # can arrive with, FROM the bucket pick_rows would
+                    # really route it to: the prefill trim slices
+                    # (first[:n], k[:, :n]) and the (rows, width)-
+                    # keyed scatter jit each compile per combo — the
+                    # r10 recompile sentinel caught the old maxr-only
+                    # loop leaving the intermediate buckets' slices to
+                    # compile MID-TRAFFIC on the scheduler thread
+                    first, k, v = outs[c.pick_rows(n)]
+                    fn, kn, vn = first[:n], k[:, :n], v[:, :n]
+                    np.asarray(fn)
+                    self._pool_k, self._pool_v = scatter_prefill_kv(
+                        self._pool_k, self._pool_v, kn, vn,
+                        [[0] * nb for _ in range(n)], c.kv_block)
+            B, nblk = self.batch, c.blocks_per_seq
+            pk, pv, nxt = c.step(
+                self._pool_k, self._pool_v,
+                np.zeros((B, nblk), np.int32), np.ones((B,), np.int32),
+                np.zeros((B,), np.int32), np.zeros((B,), np.int32),
+                key)
+            np.asarray(nxt)
+            self._pool_k, self._pool_v = pk, pv
+            self.warmup_runs += 1
         self._warmed = True
 
     # ------------------------------------------------------------------

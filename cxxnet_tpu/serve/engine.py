@@ -523,19 +523,25 @@ class ServingEngine:
     def warmup(self) -> None:
         """Pre-run every exported bucket once (and materialize the
         result) so first-call compile/setup costs land here, not on a
-        user request. Not counted in the serving stats."""
+        user request. Not counted in the serving stats. Runs inside a
+        ``jitcheck.allow`` window: with the recompile sentinel armed
+        (bench/chaos posture), compiles HERE are sanctioned warmup —
+        a replica hot-swapped mid-run warms its programs without
+        tripping the steady-state contract (docs/analysis.md)."""
+        from ..analysis import jitcheck as _jitcheck
         c = self.callee
-        for b in self.buckets:
-            if self.kind == "forward":
-                buf = self._get_buf(b)
-                np.asarray(c.run_exact(buf))
-            else:
-                buf = self._get_buf(b)
-                toks, lens = buf
-                lens[:] = 1
-                np.asarray(c.run_exact(toks, lens, self._seed))
-            self._put_buf(b, buf)
-            self.warmup_runs += 1
+        with _jitcheck.allow("serve.engine.warmup"):
+            for b in self.buckets:
+                if self.kind == "forward":
+                    buf = self._get_buf(b)
+                    np.asarray(c.run_exact(buf))
+                else:
+                    buf = self._get_buf(b)
+                    toks, lens = buf
+                    lens[:] = 1
+                    np.asarray(c.run_exact(toks, lens, self._seed))
+                self._put_buf(b, buf)
+                self.warmup_runs += 1
         self._warmed = True
 
     @property

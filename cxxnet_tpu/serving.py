@@ -614,11 +614,23 @@ class ExportedStepDecoder:
         outer donating jit that restores it — without this every step
         round-trips both pool buffers through a copy (measured 10.5 ->
         3.9 ms/step at the bench shape). The caller must drop its old
-        pool references and use the returned ones, even on failure."""
+        pool references and use the returned ones, even on failure
+        (the donation-validator seam turns a violation into an
+        immediate DonationError naming this site; docs/analysis.md)."""
         if self._step_call is None:
             import jax
-            self._step_call = jax.jit(self._step.call,
-                                      donate_argnums=(0, 1))
+
+            from .analysis import jitcheck as _jitcheck
+
+            def exported_decode_step(*a, _call=self._step.call):
+                return _call(*a)
+
+            # always=True: this wrapper is cached for the decoder's
+            # lifetime, which may start before jitcheck.enable()
+            self._step_call = _jitcheck.make_donating(
+                jax.jit(exported_decode_step, donate_argnums=(0, 1)),
+                argnums=(0, 1), site="ExportedStepDecoder.step",
+                always=True)
         return self._step_call(pool_k, pool_v, bt, lens, stepv, last,
                                key)
 
@@ -715,13 +727,23 @@ def scatter_prefill_kv(pool_k, pool_v, k, v, block_tables,
     key = (W, n, tuple(pool_k.shape), str(pool_k.dtype))
     fn = _SCATTER_CACHE.get(key)
     if fn is None:
+        from .analysis import jitcheck as _jitcheck
+
         def _scat(pk, pv, kk, vv, b_idx, off):
             kt = kk.transpose(1, 3, 0, 2, 4)         # (n, W, L, nh, d)
             vt = vv.transpose(1, 3, 0, 2, 4)
             pk = pk.at[b_idx, :, :, off, :].set(kt.astype(pk.dtype))
             pv = pv.at[b_idx, :, :, off, :].set(vt.astype(pv.dtype))
             return pk, pv
-        fn = jax.jit(_scat, donate_argnums=(0, 1))
+        # per-shape name: the recompile sentinel's per-program counts
+        # stay attributable (one compile per (width, rows) is warmup;
+        # a second of the SAME name is a real recompile)
+        _scat.__name__ = "scatter_prefill_w%d_n%d" % (W, n)
+        # always=True: the module-global cache outlives any one
+        # jitcheck.enable() window
+        fn = _jitcheck.make_donating(
+            jax.jit(_scat, donate_argnums=(0, 1)),
+            argnums=(0, 1), site="scatter_prefill_kv", always=True)
         _SCATTER_CACHE[key] = fn
     cols = np.arange(W)
     b_idx = bt[:, cols // kv_block].astype(np.int32)      # (n, W)

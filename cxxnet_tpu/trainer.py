@@ -594,28 +594,41 @@ class Trainer:
         # out_shardings pin params/opt-state to their declared placement:
         # without them XLA's sharding propagation may reshard an output
         # (e.g. over the seq axis), desyncing from in_shardings next step
-        self._train_step = jax.jit(
+        #
+        # every donating step goes through the jitcheck donation seam
+        # (docs/analysis.md): disabled (the default) make_donating
+        # returns the jitted callable untouched; under the monitor a
+        # donated-then-reused buffer raises an immediate DonationError
+        # naming this site instead of jax's deferred buffer-deleted
+        from .analysis import jitcheck as _jitcheck
+        self._train_step = _jitcheck.make_donating(jax.jit(
             train_step, donate_argnums=(0, 1, 2, 3, 4) + don_data,
             in_shardings=(psh, osh, rep, rep, rep, xsh, dsh, dsh),
-            out_shardings=(psh, osh, rep, rep, rep, None))
+            out_shardings=(psh, osh, rep, rep, rep, None)),
+            argnums=(0, 1, 2, 3, 4) + don_data,
+            site="Trainer._train_step")
         # state writes fold back into self.params host-side, so their
         # output shardings must match the params' declared placement
         ssh = {(li, tag): psh[li][tag]
                for li, mod in enumerate(net.modules)
                for tag in getattr(mod, "state_tags", ())
                if psh[li] and tag in psh[li]}
-        self._accum_step = jax.jit(
+        self._accum_step = _jitcheck.make_donating(jax.jit(
             accum_step, donate_argnums=(0, 1, 2) + don_data,
             in_shardings=(gsh, rep, rep, psh, rep, xsh, dsh, dsh),
-            out_shardings=(gsh, rep, rep, None, ssh))
-        self._eval_step = jax.jit(
+            out_shardings=(gsh, rep, rep, None, ssh)),
+            argnums=(0, 1, 2) + don_data,
+            site="Trainer._accum_step")
+        self._eval_step = _jitcheck.make_donating(jax.jit(
             eval_step, donate_argnums=(1,),
             in_shardings=(psh, rep, xsh, dsh, dsh, dsh),
-            out_shardings=rep)
-        self._apply_accum = jax.jit(
+            out_shardings=rep),
+            argnums=(1,), site="Trainer._eval_step")
+        self._apply_accum = _jitcheck.make_donating(jax.jit(
             apply_accum, donate_argnums=(0, 1, 2, 3),
             in_shardings=(psh, osh, gsh, rep),
-            out_shardings=(psh, osh, gsh, rep))
+            out_shardings=(psh, osh, gsh, rep)),
+            argnums=(0, 1, 2, 3), site="Trainer._apply_accum")
         self._forward = jax.jit(
             forward_step, in_shardings=(psh, xsh, dsh),
             static_argnums=(3,))
@@ -714,11 +727,13 @@ class Trainer:
             # may legally be dispatched again (bench cycles a fixed
             # staged set); donate_inputs=1 (the single-dispatch
             # device-prefetch feed) hands the group's HBM to XLA
-            self._train_multi = jax.jit(
+            self._train_multi = _jitcheck.make_donating(jax.jit(
                 train_multi, donate_argnums=(0, 1, 2, 3, 4) + don_data,
                 in_shardings=(psh, osh, rep, rep, rep, xsh_s, dsh_s,
                               dsh_s),
-                out_shardings=(psh, osh, rep, rep, rep, None))
+                out_shardings=(psh, osh, rep, rep, rep, None)),
+                argnums=(0, 1, 2, 3, 4) + don_data,
+                site="Trainer._train_multi")
 
             def eval_multi(params, eaccum, data_s, extras_s, labels_s,
                            mask_s):
@@ -737,10 +752,11 @@ class Trainer:
                                       self.fuse_steps)))
                 return eaccum
 
-            self._eval_multi = jax.jit(
+            self._eval_multi = _jitcheck.make_donating(jax.jit(
                 eval_multi, donate_argnums=(1,),
                 in_shardings=(psh, rep, xsh_s, dsh_s, dsh_s, dsh_s),
-                out_shardings=rep)
+                out_shardings=rep),
+                argnums=(1,), site="Trainer._eval_multi")
 
             def forward_multi(params, data_s, extras_s, node_ids):
                 # the prediction stream fused the same way: one

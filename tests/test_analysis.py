@@ -239,11 +239,40 @@ def cold(x):
     ("y = x.item()", "SYNC003"),
     ("y = float(x[0])", "SYNC004"),
     ("y = int(x.sum())", "SYNC004"),
+    ("y = x.tolist()", "SYNC005"),
+    ("y = jax.device_get(x)", "SYNC005"),
 ])
 def test_sync_constructs_flagged_in_hot_only(stmt, rule):
     out = findings(HOT_TMPL % (stmt, stmt))
     assert [f.rule for f in out] == [rule]
     assert out[0].func == "hot"   # the cold copy stays clean
+
+
+def test_sync006_async_copy_immediately_awaited():
+    bad = """
+    import numpy as np
+    def f(x):
+        x.copy_to_host_async()
+        return np.asarray(x)
+    """
+    assert rules(bad) == ["SYNC006"]
+    # near miss: real work between the async copy and the await —
+    # the overlap the API exists for
+    ok = """
+    import numpy as np
+    def f(x, y):
+        x.copy_to_host_async()
+        z = y * 2
+        return np.asarray(x), z
+    """
+    assert rules(ok) == []
+    # .item()/float() shapes of the await are the same misuse
+    bad2 = """
+    def f(x):
+        x.copy_to_host_async()
+        return float(x[0])
+    """
+    assert rules(bad2) == ["SYNC006"]
 
 
 def test_sync_host_arithmetic_not_flagged():
@@ -263,6 +292,247 @@ def test_sync_config_list_marks_hot_without_decorator():
     assert rules(src) == []
     assert rules(src, path="m.py",
                  extra_hot=["m.py::loop"]) == ["SYNC002"]
+
+
+# ----------------------------------------------------------------------
+# JIT: donation + retrace hygiene
+
+
+def test_jit001_use_after_donate_and_rebind_clean():
+    bad = """
+    import jax
+    def f(pool, x):
+        step = jax.jit(lambda p, y: (p, y), donate_argnums=(0,))
+        out = step(pool, x)
+        return pool.sum()
+    """
+    out = findings(bad)
+    assert [f.rule for f in out] == ["JIT001"]
+    assert "donated to step (argnum 0" in out[0].msg
+    # the sanctioned shape: the donated name is REBOUND from the
+    # result — reading it afterwards reads the new buffer
+    ok = """
+    import jax
+    def f(pool, x):
+        step = jax.jit(lambda p, y: (p, y), donate_argnums=(0,))
+        pool, out = step(pool, x)
+        return pool.sum()
+    """
+    assert rules(ok) == []
+
+
+def test_jit001_metadata_read_is_legal():
+    """.shape/.dtype of a donated array read aval metadata, which jax
+    allows on deleted arrays — must not flag."""
+    ok = """
+    import jax
+    def f(pool, x):
+        step = jax.jit(lambda p, y: p + y, donate_argnums=(0,))
+        out = step(pool, x)
+        return pool.shape, out
+    """
+    assert rules(ok) == []
+
+
+def test_jit001_class_attr_and_method_propagation():
+    """The ExportedStepDecoder shape: self._call is a donating jit, a
+    method returns it with its own params at donated positions, and a
+    SIBLING method calling that method inherits the contract."""
+    bad = """
+    import jax
+    class D:
+        def __init__(self, fn):
+            self._call = jax.jit(fn, donate_argnums=(0, 1))
+        def step(self, pk, pv, x):
+            return self._call(pk, pv, x)
+        def drive(self, pk, pv, xs):
+            out = self.step(pk, pv, xs)
+            return pk
+    """
+    out = [f for f in findings(bad) if f.rule == "JIT001"]
+    assert len(out) == 1 and out[0].func == "D.drive"
+    ok = bad.replace("out = self.step(pk, pv, xs)\n            "
+                     "return pk",
+                     "pk, pv, out = self.step(pk, pv, xs)\n"
+                     "            return pk")
+    assert [f.rule for f in findings(ok)] == []
+
+
+def test_jit001_loop_back_edge():
+    """Donate at the bottom of a loop, read at the top of the next
+    iteration: the second body pass catches the back edge."""
+    bad = """
+    import jax
+    def f(pool, xs):
+        step = jax.jit(lambda p, x: p, donate_argnums=(0,))
+        for x in xs:
+            out = step(pool, x)
+    """
+    assert "JIT001" in rules(bad)
+    ok = bad.replace("out = step(pool, x)", "pool = step(pool, x)")
+    assert rules(ok) == []
+    # donating the LOOP VARIABLE each iteration is legal (the
+    # donate-each-batch pattern: the back edge rebinds it from the
+    # iterator) — pass 2 of the body walk must not re-read pass 1's
+    # donation mark
+    ok2 = """
+    import jax
+    def f(xs, c):
+        step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+        for x in xs:
+            y = step(x, c)
+    """
+    assert rules(ok2) == []
+
+
+def test_jit001_augmented_read_of_donated_name():
+    """``pool += acc`` reads pool through a Store-ctx target — the
+    read half of the read-write must flag (regression: the Load-only
+    walk silently skipped AugAssign targets)."""
+    bad = """
+    import jax
+    def f(pool, x, acc):
+        step = jax.jit(lambda p, y: (p, y), donate_argnums=(0,))
+        out = step(pool, x)
+        pool += acc
+        return out
+    """
+    assert rules(bad) == ["JIT001"]
+    # rebinding from the result first makes the augmented read legal
+    ok = bad.replace("out = step(pool, x)",
+                     "pool, out = step(pool, x)")
+    assert rules(ok) == []
+
+
+def test_jit001_extra_donating_api_with_arity_floor():
+    """Cross-module donating APIs come from the extra_donating config,
+    gated by a minimum arity: decoder.step(pool_k, ... 7 args) is the
+    donating call; trace.step(n) must never match."""
+    bad = """
+    def f(c, pk, pv, bt, lens, stepv, last, key):
+        out = c.step(pk, pv, bt, lens, stepv, last, key)
+        return pk
+    """
+    assert rules(bad) == ["JIT001"]
+    ok = """
+    def f(self, n):
+        with self.trace.step(n):
+            pass
+        return n
+    """
+    assert rules(ok) == []
+
+
+def test_jit002_construction_in_loop_and_hot():
+    bad = """
+    import jax
+    def f(xs):
+        for x in xs:
+            g = jax.jit(lambda a: a + 1)
+            x = g(x)
+    """
+    assert rules(bad) == ["JIT002"]
+    hot = """
+    from cxxnet_tpu.analysis import hot_path
+    import jax
+    @hot_path
+    def f(x):
+        g = jax.jit(lambda a: a + 1)
+        return g(x)
+    """
+    assert "JIT002" in rules(hot)
+    # near miss: built once before the loop
+    ok = """
+    import jax
+    def f(xs):
+        g = jax.jit(lambda a: a + 1)
+        out = []
+        for x in xs:
+            out.append(g(x))
+        return out
+    """
+    assert rules(ok) == []
+
+
+def test_jit002_loop_iter_and_orelse_evaluate_once():
+    # near miss: a For's iter expression and either loop's orelse run
+    # exactly once, not per iteration — building jits there is legal
+    ok = """
+    import jax
+    def f(xs):
+        out = []
+        for g in (jax.jit(lambda a: a), jax.jit(lambda a: a + 1)):
+            out.append(g)
+        else:
+            h = jax.jit(lambda a: a * 2)
+        while xs:
+            xs = xs[1:]
+        else:
+            k = jax.jit(lambda a: a - 1)
+        return out, h, k
+    """
+    assert rules(ok) == []
+    # a While's test re-runs every iteration: still a trigger
+    bad = """
+    import jax
+    def f(x):
+        while jax.jit(lambda a: a)(x) < 3:
+            x = x + 1
+        return x
+    """
+    assert rules(bad) == ["JIT002"]
+
+
+def test_jit003_static_argnums_recompile_storm():
+    bad = """
+    import jax
+    def f(x, n):
+        g = jax.jit(lambda a, k: a, static_argnums=(1,))
+        for i in range(n):
+            x = g(x, i)
+        return x
+    """
+    out = findings(bad)
+    assert [f.rule for f in out] == ["JIT003"]
+    assert "static_argnums position 1" in out[0].msg
+    # near misses: the loop var at a TRACED position, and a
+    # loop-invariant value at the static position
+    ok1 = bad.replace("static_argnums=(1,)", "static_argnums=()")
+    assert rules(ok1) == []
+    ok2 = bad.replace("x = g(x, i)", "x = g(x, n)")
+    assert rules(ok2) == []
+
+
+def test_jit004_discarded_donating_result():
+    bad = """
+    import jax
+    def f(pool):
+        step = jax.jit(lambda p: p * 2, donate_argnums=(0,))
+        step(pool)
+    """
+    out = findings(bad)
+    assert [f.rule for f in out] == ["JIT004"]
+    assert "discards its result" in out[0].msg
+    ok = bad.replace("step(pool)", "pool = step(pool)")
+    assert rules(ok) == []
+
+
+def test_jit_seam_wrapper_seen_through():
+    """jitcheck.make_donating(jax.jit(...), argnums=...) — the seam
+    adoption shape — still models as donating."""
+    bad = """
+    import jax
+    from cxxnet_tpu.analysis import jitcheck
+    class T:
+        def __init__(self, fn):
+            self._step = jitcheck.make_donating(
+                jax.jit(fn, donate_argnums=(0, 1)), argnums=(0, 1),
+                site="T._step")
+        def run(self, a, b):
+            out = self._step(a, b)
+            return a
+    """
+    assert "JIT001" in rules(bad)
 
 
 # ----------------------------------------------------------------------
@@ -344,14 +614,16 @@ def test_gate_waives_and_reports_stale(tmp_path):
     wf = root / "waivers.txt"
     # unwaived: the finding fails the gate
     wf.write_text("")
-    _, unwaived, stale = run_gate(str(root), str(wf))
-    assert [f.rule for f in unwaived] == ["CONC002"] and stale == []
+    res = run_gate(str(root), str(wf))
+    assert [f.rule for f in res.unwaived] == ["CONC002"] \
+        and res.stale == []
     # waived: clean; a dangling waiver turns up as stale
     wf.write_text(
         "CONC002 cxxnet_tpu/m.py::C.bad deliberate\n"
         "OBS001 cxxnet_tpu/gone.py::f old\n")
-    _, unwaived, stale = run_gate(str(root), str(wf))
-    assert unwaived == [] and stale == ["OBS001 cxxnet_tpu/gone.py::f"]
+    res = run_gate(str(root), str(wf))
+    assert res.unwaived == [] \
+        and res.stale == ["OBS001 cxxnet_tpu/gone.py::f"]
 
 
 def test_tree_gate_is_clean():
@@ -360,20 +632,54 @@ def test_tree_gate_is_clean():
     fix it or waive it with a justification in
     docs/analysis_waivers.txt; a stale waiver means delete the line
     whose code is gone."""
-    findings_all, unwaived, stale = run_gate(REPO)
+    findings_all, unwaived, stale, waivers, _ = run_gate(REPO)
     assert unwaived == [], \
         "unwaived analysis findings:\n  %s" % "\n  ".join(
             map(repr, unwaived))
     assert stale == [], "stale waivers (remove them): %s" % stale
     # the baseline itself stays justified: every waiver carries text
-    waivers = load_waivers(os.path.join(REPO, "docs",
-                                        "analysis_waivers.txt"))
     assert waivers, "gate running against an empty baseline?"
     assert all(v.strip() for v in waivers.values()), \
         "every waiver needs a one-line justification"
     # and the hot-path markers are actually deployed
     assert any(f.rule.startswith("SYNC") for f in findings_all), \
         "no SYNC findings at all — did @hot_path marking disappear?"
+    # the JIT family sees the tree (the waived export-loop jits prove
+    # the donating/ctor model is wired in, not silently skipping)
+    assert any(f.rule.startswith("JIT") for f in findings_all), \
+        "no JIT findings at all — did the JIT checker detach?"
+    # tests/ is part of the gated surface (r10)
+    assert any(f.path.startswith("tests/") for f in findings_all), \
+        "tests/ no longer scanned — gate surface shrank"
+
+
+def test_gate_json_summary_shape():
+    """--json machine output: files scanned, per-rule and per-family
+    counts — the fields the net=analysis ledger row records."""
+    from analysis_gate import gate_summary
+    findings_all, unwaived, stale, waivers, files = run_gate(REPO)
+    s = gate_summary(findings_all, unwaived, stale, waivers, files)
+    assert s["files_scanned"] > 100
+    assert s["findings"] == len(findings_all)
+    assert s["waived"] == len(findings_all)       # the tree is clean
+    assert s["waivers"] == len(waivers)
+    assert sum(s["rules"].values()) == s["findings"]
+    assert set(s["families"]) <= {"CONC", "SYNC", "JIT", "OBS",
+                                  "PARSE"}
+    assert sum(s["families"].values()) == s["findings"]
+
+
+def test_ledger_carries_analysis_row():
+    """tools/analysis_gate.py --ledger records the gate surface as a
+    net=analysis row; the committed ledger must carry one so BENCH
+    history tracks checker-surface growth."""
+    import json
+    with open(os.path.join(REPO, "docs", "bench_history.json")) as f:
+        row = json.load(f)["best_by_net"]["analysis"]
+    assert row["files_scanned"] >= 100
+    assert row["waivers"] >= 1 and not row["stale_waivers"]
+    assert sum(row["rules"].values()) == row["findings"]
+    assert "JIT" in row["families"]
 
 
 # ----------------------------------------------------------------------

@@ -5,7 +5,18 @@ Usage:
   python tools/analysis_gate.py                # gate: exit 1 if dirty
   python tools/analysis_gate.py --list         # every finding, waived
                                                # ones marked
-  python tools/analysis_gate.py --json         # one JSON line
+  python tools/analysis_gate.py --json         # one JSON line: files
+                                               # scanned, per-rule and
+                                               # per-family counts,
+                                               # waiver/stale detail
+  python tools/analysis_gate.py --ledger       # also record the gate
+                                               # surface as a
+                                               # net=analysis row in
+                                               # docs/bench_history
+                                               # .json (rule counts,
+                                               # waivers, files) so
+                                               # BENCH history tracks
+                                               # its growth
 
 The baseline lives at ``docs/analysis_waivers.txt``; one waiver per
 line::
@@ -23,6 +34,7 @@ honest).
 the same check, no subprocess."""
 
 import argparse
+import collections
 import json
 import os
 import sys
@@ -56,16 +68,23 @@ def load_waivers(path):
     return waivers
 
 
+GateResult = collections.namedtuple(
+    "GateResult", "findings unwaived stale waivers files")
+
+
 def run_gate(root=None, waiver_path=None, extra_hot=()):
-    """Lint the tree; returns (findings, unwaived, stale_waiver_keys).
+    """Lint the tree; returns a :class:`GateResult`.
 
     ``findings`` is every finding (waived or not), ``unwaived`` the
     subset not covered by the baseline, ``stale`` the waiver keys that
-    matched nothing."""
+    matched nothing; ``waivers`` (the loaded baseline) and ``files``
+    (the scanned tree) ride along so callers building the summary
+    don't re-read/re-walk what the gate just did."""
     root = root or _ROOT
     wpath = waiver_path or os.path.join(root, WAIVER_FILE)
     waivers = load_waivers(wpath)
-    findings = lint.check_tree(root, extra_hot=extra_hot)
+    files = lint.iter_py_files(root)
+    findings = lint.check_tree(root, paths=files, extra_hot=extra_hot)
     used = set()
     unwaived = []
     for f in findings:
@@ -74,7 +93,43 @@ def run_gate(root=None, waiver_path=None, extra_hot=()):
         else:
             unwaived.append(f)
     stale = sorted(set(waivers) - used)
-    return findings, unwaived, stale
+    return GateResult(findings, unwaived, stale, waivers, files)
+
+
+def gate_summary(findings, unwaived, stale, waivers, files):
+    """The machine-readable gate surface: what --json prints and what
+    the net=analysis ledger row records."""
+    rules = {}
+    for f in findings:
+        rules[f.rule] = rules.get(f.rule, 0) + 1
+    families = {}
+    for rule, n in rules.items():
+        fam = rule.rstrip("0123456789")
+        families[fam] = families.get(fam, 0) + n
+    return {
+        "files_scanned": len(files),
+        "findings": len(findings),
+        "waived": len(findings) - len(unwaived),
+        "waivers": len(waivers),
+        "unwaived": [repr(f) for f in unwaived],
+        "stale_waivers": stale,
+        "rules": dict(sorted(rules.items())),
+        "families": dict(sorted(families.items())),
+    }
+
+
+def record_ledger(summary):
+    """Append the gate surface to the bench ledger (net=analysis,
+    newest snapshot wins — the same convention as the net=obs rows):
+    BENCH history then shows the checker surface growing alongside
+    the perf headlines."""
+    import time as _time
+    from bench import _update_history
+    entry = dict(summary,
+                 timestamp=_time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          _time.gmtime()))
+    entry.pop("unwaived", None)          # keys only matter when dirty
+    return _update_history(entry, net="analysis", metric="timestamp")
 
 
 def main(argv=None):
@@ -84,21 +139,24 @@ def main(argv=None):
                          "just failures")
     ap.add_argument("--json", action="store_true",
                     help="print the result as one JSON line")
+    ap.add_argument("--ledger", action="store_true",
+                    help="record the gate surface as a net=analysis "
+                         "row in docs/bench_history.json")
     ap.add_argument("--root", default=_ROOT)
     ap.add_argument("--waivers", default=None,
                     help="waiver file (default docs/analysis_waivers"
                          ".txt under --root)")
     args = ap.parse_args(argv)
 
-    findings, unwaived, stale = run_gate(args.root, args.waivers)
+    res = run_gate(args.root, args.waivers)
+    findings, unwaived, stale = res.findings, res.unwaived, res.stale
     waived_n = len(findings) - len(unwaived)
+    summary = gate_summary(findings, unwaived, stale, res.waivers,
+                           res.files)
+    if args.ledger:
+        record_ledger(summary)
     if args.json:
-        print(json.dumps({
-            "findings": len(findings),
-            "waived": waived_n,
-            "unwaived": [repr(f) for f in unwaived],
-            "stale_waivers": stale,
-        }))
+        print(json.dumps(summary))
     else:
         shown = findings if args.list else unwaived
         wkeys = {f.key for f in findings} - {f.key for f in unwaived}
@@ -106,9 +164,10 @@ def main(argv=None):
             mark = "  [waived]" if f.key in wkeys \
                 and f not in unwaived else ""
             print("%r%s" % (f, mark))
-        print("analysis_gate: %d finding(s), %d waived, %d unwaived, "
-              "%d stale waiver(s)"
-              % (len(findings), waived_n, len(unwaived), len(stale)))
+        print("analysis_gate: %d file(s), %d finding(s), %d waived, "
+              "%d unwaived, %d stale waiver(s)"
+              % (summary["files_scanned"], len(findings), waived_n,
+                 len(unwaived), len(stale)))
         for k in stale:
             print("  STALE waiver (matches nothing, remove it): %s"
                   % k)

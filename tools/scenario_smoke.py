@@ -113,21 +113,33 @@ def run(duration_s: float = 2.0, rps: float = 60.0) -> int:
         checks.append((name, bool(ok), detail))
         return bool(ok)
 
+    from cxxnet_tpu.analysis import jitcheck
+
     with tempfile.TemporaryDirectory() as td:
         path = _artifact(td)
-        # the recorder installs immediately before the try whose
-        # finally uninstalls it: a setup failure (engine compile, port
-        # bind) must not leak the process-global sink into the host
-        # process (the in-process tier-1 test would then poison
-        # unrelated tests' NOOP-identity contract)
-        flight = obs_trace.set_flight(FlightRecorder(32768))
+        # process-global flips (the recompile sentinel's
+        # jax_log_compiles + log filters, the flight sink) must not
+        # leak into the host process on a setup failure (the
+        # in-process tier-1 test would then poison unrelated tests'
+        # NOOP-identity contract): the sentinel enables FIRST — its
+        # enable can itself fail on a jax without the log seam, at
+        # which point nothing else has been flipped — and EVERY
+        # later flip, set_flight included, happens inside the try so
+        # the finally unwinds them all.
+        jit_mon = jitcheck.enable()
         eng = slo = srv = None
         try:
+            flight = obs_trace.set_flight(FlightRecorder(32768))
             reg = Registry()
             eng = ServingEngine(serving.load_exported(path),
                                 max_wait_ms=2.0, queue_limit=256,
                                 warmup=True, registry=reg,
                                 slo_ms=250.0)
+            jit_mon.arm()
+            # live registry export: the /metrics endpoint of this very
+            # run carries cxxnet_recompiles_total (must scrape as 0)
+            from cxxnet_tpu.obs.registry import watch_jitcheck
+            watch_jitcheck(jit_mon, reg)
             slo = SLOEngine(
                 reg,
                 [latency_slo(250.0, 0.99),
@@ -195,6 +207,19 @@ def run(duration_s: float = 2.0, rps: float = 60.0) -> int:
             st, body = _get_json(url + "/healthz")
             check("healthz_incident_count",
                   st == 200 and body.get("incidents", 0) >= 1, body)
+            # the replay window ran with the sentinel armed: zero
+            # steady-state compiles, readable from the SAME registry
+            # /metrics?format=prom exports
+            check("recompile_clean",
+                  jit_mon.steady_compiles == 0
+                  and reg.get_value("cxxnet_recompiles_total") == 0.0,
+                  {"violations": [repr(v) for v in
+                                  jit_mon.violations()[:3]],
+                   "registry": reg.get_value(
+                       "cxxnet_recompiles_total")})
+            check("recompile_instrumented",
+                  jit_mon.total_compiles > 0,
+                  "compiles observed: %d" % jit_mon.total_compiles)
         finally:
             if srv is not None:
                 srv.shutdown()
@@ -204,6 +229,7 @@ def run(duration_s: float = 2.0, rps: float = 60.0) -> int:
             if eng is not None:
                 eng.close()
             obs_trace.set_flight(None)
+            jitcheck.disable()
 
     # the committed baseline: the bench ledger must carry a
     # net=scenario row with every catalog scenario scored

@@ -134,8 +134,14 @@ def main():
         # lockdep-style validation of the whole run: every lock the
         # replicas/router/engines create from here on is instrumented
         # (docs/analysis.md), so the chaos run doubles as a race check
-        from cxxnet_tpu.analysis import lockcheck
+        from cxxnet_tpu.analysis import jitcheck, lockcheck
         monitor = lockcheck.enable(held_warn_s=2.0)
+        # ... and the recompile sentinel runs beside it: armed the
+        # moment the replica set is warm, so the kill + HOT SWAP
+        # window must stay compile-free — swap-spare warmups are
+        # sanctioned (engine.warmup runs in a jitcheck.allow region),
+        # anything else that compiles mid-chaos fails the smoke
+        jit_mon = jitcheck.enable()
         from cxxnet_tpu import serving
         inj = FaultInjector(seed=7)
         replicas = ReplicaSet(
@@ -145,6 +151,7 @@ def main():
             probe_timeout_s=5.0,
             engine_kw=dict(max_wait_ms=2.0, queue_limit=64))
         replicas.start()
+        jit_mon.arm()
         router = Router(replicas, max_retries=2,
                         timeout_ms=args.slo_ms)
         srv = build_server(router, port=0)
@@ -237,6 +244,7 @@ def main():
         router.close()
         trace_path = obs_trace.stop()
         lockcheck.disable()
+        jitcheck.disable()
 
         # ---- assertions ---------------------------------------------
         checks = []
@@ -282,6 +290,11 @@ def main():
               monitor.violations()[:5])
         check("lockcheck_instrumented", monitor.created >= 10,
               "locks created through the seam: %d" % monitor.created)
+        check("recompile_clean", jit_mon.steady_compiles == 0,
+              jit_mon.violations()[:5])
+        check("recompile_instrumented", jit_mon.total_compiles > 0,
+              "compiles observed: %d (warmup should have compiled "
+              "every replica's buckets)" % jit_mon.total_compiles)
 
         for name, ok, detail in checks:
             print("serve_chaos[%s]: %s %s"
@@ -292,6 +305,7 @@ def main():
         print(json.dumps({
             "metric": "serve_chaos",
             "outcomes": outcomes,
+            "recompile_sentinel": jit_mon.summary(),
             "router": {k: m[k] for k in
                        ("retries", "failovers", "completed", "swaps")},
             "shed": m["shed"],
