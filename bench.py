@@ -1639,22 +1639,45 @@ def scenario_main(args) -> None:
 # so the contrasts are real on this rig: long prompts force the full
 # 192-slot prefill region while short ones fit the 64-wide bucket the
 # split-phase artifact also carries, and short requests ask for 4
-# tokens while the fixed path burns its full 32-step exported loop on
-# them (measured here: the monolithic 8-row program is ~118 ms — one
-# long dispatch that also head-of-line blocks every arrival behind it,
-# where the paged step is ~6 ms and requests join/leave between steps).
+# tokens while the fixed path burns its full exported loop on them
+# (one long dispatch that also head-of-line blocks every arrival
+# behind it, where the paged step is milliseconds and requests
+# join/leave between steps). r12: max_new 32 -> 64 (the full P +
+# max_new = seq budget, same pool geometry) — at 32 the windows were
+# ~40% prefill + host dispatch, which diluted any ATTEND-kernel
+# contrast below measurement noise; a decode bench must be
+# decode-bound (closed-loop capacity at 64: fused-paged 1.28x over
+# gather-paged vs 1.10x at 32, the kernel's real margin).
 DECODE_SEQ = 256
 DECODE_VOCAB = 64
 DECODE_EMBED = 128
 DECODE_NLAYER = 4
 DECODE_NHEAD = 4
 DECODE_SLOTS = 8          # decode batch / slot count, both paths
-DECODE_MAX_NEW = 32
+DECODE_MAX_NEW = 64
 DECODE_PROMPT = 160       # P = prompt_slots(160) = 192
 DECODE_SHORT = 4
 DECODE_SHORT_MAX_NEW = 4  # short requests want 4 tokens, not 32
 DECODE_SLO_MS = 500.0
 DECODE_TIMEOUT_MS = 2000.0
+DECODE_STEP_TOKENS = 4    # multi-token decode step, both split paths
+
+
+def _decode_pool_blocks():
+    """The default export pool at this shape: trash page + 4x
+    occupancy of 8 slots x pages-per-seq, with pages-per-seq COMPUTED
+    from the layout rule (Sp = cache_slots(P, max_new + step_tokens -
+    1), kv_block 128) so a max_new/step_tokens change cannot silently
+    skew the A/B — the fused artifact exports 2x this pool and the
+    fused-native window clamps back to it, holding pool geometry
+    equal to the gather baseline's default while the int8 window
+    demonstrates the 2x-state capacity."""
+    from cxxnet_tpu.generate import prompt_slots
+    from cxxnet_tpu.ops.decode_attend import cache_slots
+    P = prompt_slots(DECODE_PROMPT, DECODE_SEQ)
+    nblk = cache_slots(
+        P, DECODE_MAX_NEW + DECODE_STEP_TOKENS - 1) // 128
+    return 1 + 4 * DECODE_SLOTS * nblk
 
 
 def _decode_lm_trainer(platform):
@@ -1687,11 +1710,14 @@ def _decode_lm_trainer(platform):
     return tr
 
 
-def _decode_window(path, decoder, entries, duration_s):
+def _decode_window(path, decoder, entries, duration_s,
+                   kv_dtype="auto", kv_blocks=0):
     """One open-loop replay window against a fresh engine over a
     SHARED (already-compiled) decoder artifact. ``path`` picks the
     engine: "fixed" = ServingEngine over the monolithic decoder,
-    "paged" = ContinuousDecodeEngine over the split-phase one."""
+    anything else = ContinuousDecodeEngine over a split-phase one
+    (``kv_dtype`` picks the artifact rung, ``kv_blocks`` clamps the
+    live pool pages so rung A/Bs can hold pool geometry equal)."""
     from cxxnet_tpu.obs.registry import Registry
     from cxxnet_tpu.serve import ServingEngine
     from cxxnet_tpu.serve.continuous import ContinuousDecodeEngine
@@ -1705,6 +1731,8 @@ def _decode_window(path, decoder, entries, duration_s):
     else:
         eng = ContinuousDecodeEngine(decoder, queue_limit=256,
                                      warmup=True, registry=reg,
+                                     kv_dtype=kv_dtype,
+                                     kv_blocks=kv_blocks,
                                      slo_ms=DECODE_SLO_MS)
     try:
         lg = LoadGen(entries,
@@ -1721,9 +1749,20 @@ def _decode_window(path, decoder, entries, duration_s):
         sc["decode_steps"] = m.get("decode_steps")
         sc["dummy_slot_steps"] = m.get("dummy_slot_steps")
         sc["live_slot_steps"] = m.get("live_slot_steps")
-        if path == "paged":
+        if path != "fixed":
             sc["prefills"] = m.get("prefills")
             sc["kv_pool_high_water"] = m["kv_pool"]["high_water"]
+            sc["kv_pool_pages"] = m["kv_pool"]["limit"] - 1
+            sc["attend_kernel"] = m.get("attend_kernel")
+            sc["kv_dtype"] = m.get("kv_dtype")
+            sc["step_bucket_dispatches"] = \
+                m.get("step_bucket_dispatches")
+            rung = decoder.rung(m.get("kv_dtype"))
+            sc["kv_bytes_per_step"] = rung["kv_bytes_per_step"]
+            sc["kv_bytes_per_seq"] = rung["kv_bytes_per_seq"]
+        else:
+            sc["attend_kernel"] = "monolithic-slot"
+            sc["kv_dtype"] = "native"
     finally:
         eng.close()
     return sc
@@ -1733,18 +1772,25 @@ def decode_main(args) -> None:
     """The continuous-batching decode benchmark (``python bench.py
     decode``; docs/serving.md).
 
-    One tiny trained LM, two exports of the same weights: the
+    One tiny trained LM, three exports of the same weights: the
     monolithic fixed-shape decoder (export_generate, batch ladder —
-    the r5-r9 serving path) and the split-phase paged decoder
-    (export_decode_step). The mixed_prompt_len trace (2 short : 1
-    long prompt, all streaming) replays OPEN-LOOP against each in
-    PAIRED ADJACENT windows — same trace, alternating engines, so
-    window weather hits both paths equally — scored for sustained
-    tokens/s, p99 TTFT (honest first-token for the paged path; equal
-    to completion latency for the fixed path, which only has an
-    answer at the end), and dummy-slot waste. A capacity-frontier
-    sweep then raises offered rps past the knee for both paths
-    (attainment-vs-offered). One net=decode_serve ledger row."""
+    the r5-r9 serving path), the r10 GATHER-attend split-phase
+    decoder (export_decode_step paged_attend=gather — the paged
+    baseline), and the r12 FUSED typed-rung artifact
+    (paged_attend=fused, kv_dtypes native+int8, sub-batch step
+    buckets, a 2x pool). The mixed_prompt_len trace (2 short : 1 long
+    prompt, all streaming) replays OPEN-LOOP against each in PAIRED
+    ADJACENT windows — same trace, rotating engines, so window
+    weather hits every path equally — scored for sustained goodput
+    tokens/s and p99 TTFT, with each ledger row carrying its
+    ``attend_kernel`` and ``kv_bytes_per_step`` so the perf
+    trajectory stays attributable across rungs. The fused-native
+    window serves with its pool CLAMPED to the gather artifact's page
+    count (clean kernel A/B); the int8 window serves the full 2x pool
+    — twice the KV state of the native window in ~0.56x the bytes
+    (the rung's capacity claim, recorded as kv_state_per_byte_ratio).
+    A capacity-frontier sweep then raises offered rps past the knee
+    for the fixed and fused paths. One net=decode_serve ledger row."""
     import tempfile
 
     import jax
@@ -1758,28 +1804,45 @@ def decode_main(args) -> None:
     # both jitcheck sentinels on for the WHOLE bench (production
     # posture, docs/analysis.md): the donation validator wraps the
     # paged pool's donating step/scatter calls live, and the recompile
-    # sentinel arms after the first paired window (which carries every
-    # first-call compile of the shared decoder artifacts) — any
-    # compile in the later windows or the frontier sweep fails hard
+    # sentinel arms after the first paired window round (which carries
+    # every first-call compile of the shared decoder artifacts, ALL
+    # rungs included) — any compile in the later windows or the
+    # frontier sweep fails hard
     jit_mon = jitcheck.enable()
     try:
         with tempfile.TemporaryDirectory() as td:
             tr = _decode_lm_trainer(platform)
             mono_path = os.path.join(td, "dec_mono.export")
-            step_path = os.path.join(td, "dec_step.export")
+            gather_path = os.path.join(td, "dec_gather.export")
+            fused_path = os.path.join(td, "dec_fused.export")
             serving.export_generate(
                 tr, mono_path, max_new=DECODE_MAX_NEW, temperature=0.0,
                 prompt_len=DECODE_PROMPT,
                 batch_ladder=[1, 2, 4, DECODE_SLOTS],
                 platforms=[platform])
+            pool_blocks = _decode_pool_blocks()
             serving.export_decode_step(
-                tr, step_path, max_new=DECODE_MAX_NEW, temperature=0.0,
-                prompt_len=DECODE_PROMPT, batch_size=DECODE_SLOTS,
+                tr, gather_path, max_new=DECODE_MAX_NEW,
+                temperature=0.0, prompt_len=DECODE_PROMPT,
+                batch_size=DECODE_SLOTS,
+                step_tokens=DECODE_STEP_TOKENS,
                 prefill_rows=[1, 2, 4, DECODE_SLOTS],
+                paged_attend="gather", platforms=[platform])
+            serving.export_decode_step(
+                tr, fused_path, max_new=DECODE_MAX_NEW,
+                temperature=0.0, prompt_len=DECODE_PROMPT,
+                batch_size=DECODE_SLOTS,
+                step_tokens=DECODE_STEP_TOKENS,
+                prefill_rows=[1, 2, 4, DECODE_SLOTS],
+                paged_attend="fused",
+                kv_dtypes=["native", "int8"],
+                step_buckets=[2, 4, DECODE_SLOTS],
+                pool_blocks=2 * pool_blocks - 1,
                 platforms=[platform])
             del tr
             mono = serving.load_exported(mono_path)
-            stepd = serving.load_exported(step_path)
+            gatherd = serving.load_exported(gather_path)
+            fusedd = serving.load_exported(fused_path)
             entries = make_scenario(
                 "mixed_prompt_len", duration_s=args.decode_duration,
                 rps=args.decode_rps, seed=7,
@@ -1787,24 +1850,46 @@ def decode_main(args) -> None:
                 short_prompt_len=DECODE_SHORT,
                 long_prompt_len=DECODE_PROMPT,
                 short_max_new=DECODE_SHORT_MAX_NEW)
-            # paired adjacent windows: fixed, paged, fixed, paged —
-            # the best window per path is the headline (window weather
-            # on a shared host otherwise decides the comparison)
-            windows = {"fixed": [], "paged": []}
+            # the four paths, paired-adjacent per round: the
+            # fused-native engine clamps its 2x pool to the gather
+            # artifact's page count so the A/B isolates the kernel;
+            # the q8 engine serves the whole 2x pool (the capacity
+            # demo — same sequences-per-byte math the rung meta pins)
+            paths = {
+                "fixed": dict(dec=mono),
+                "paged": dict(dec=gatherd),
+                "paged_fused": dict(dec=fusedd, kv_dtype="native",
+                                    kv_blocks=pool_blocks),
+                "paged_fused_q8": dict(dec=fusedd, kv_dtype="int8"),
+            }
+
+            def run_window(name, ent, dur):
+                p = paths[name]
+                return _decode_window(
+                    name, p["dec"],
+                    ent, dur, kv_dtype=p.get("kv_dtype", "auto"),
+                    kv_blocks=p.get("kv_blocks", 0))
+
+            windows = {name: [] for name in paths}
             for wi in range(2):
-                windows["fixed"].append(_decode_window(
-                    "fixed", mono, entries, args.decode_duration))
-                windows["paged"].append(_decode_window(
-                    "paged", stepd, entries, args.decode_duration))
+                for name in paths:
+                    windows[name].append(run_window(
+                        name, entries, args.decode_duration))
                 if wi == 0:
-                    # window pair 1 compiled every program on the
-                    # shared artifacts (engine warmups run in allow
-                    # windows anyway); steady state starts here
+                    # round 1 compiled every program on the shared
+                    # artifacts — all four paths, both rungs (engine
+                    # warmups run in allow windows anyway); steady
+                    # state starts here
                     jit_mon.arm()
             best = {p: max(w, key=lambda s: s.get("tok_per_sec") or 0.0)
                     for p, w in windows.items()}
             # capacity frontier: offered load raised past the knee
-            frontier = {"fixed": [], "paged": []}
+            # for the legacy fixed path and the new fused serving
+            # path. The frontier key is the PATHS key ("paged_fused",
+            # not r10's "paged") and each entry carries its
+            # attend_kernel, so cross-ledger comparisons can never
+            # silently mix kernels
+            frontier = {"fixed": [], "paged_fused": []}
             fr_dur = min(args.decode_duration, 2.0)
             for mult in (0.5, 1.0, 1.5):
                 rps = args.decode_rps * mult
@@ -1814,10 +1899,11 @@ def decode_main(args) -> None:
                                    short_prompt_len=DECODE_SHORT,
                                    long_prompt_len=DECODE_PROMPT,
                                    short_max_new=DECODE_SHORT_MAX_NEW)
-                for p, dec in (("fixed", mono), ("paged", stepd)):
-                    s2 = _decode_window(p, dec, e2, fr_dur)
-                    frontier[p].append({
+                for name in frontier:
+                    s2 = run_window(name, e2, fr_dur)
+                    frontier[name].append({
                         "offered_rps": rps,
+                        "attend_kernel": s2.get("attend_kernel"),
                         "slo_attainment": s2["slo_attainment"],
                         "tok_per_sec": s2.get("tok_per_sec"),
                         "ok_per_sec": s2["ok_per_sec"],
@@ -1827,15 +1913,40 @@ def decode_main(args) -> None:
     finally:
         jitcheck.disable()
 
-    sentinel = _jit_gate(jit_mon, "decode", armed_after_window_pair=1,
+    sentinel = _jit_gate(jit_mon, "decode", armed_after_window_round=1,
                          donating_calls_validated=jit_mon.donating_calls)
 
-    def ratio(field, lo_better=False):
-        a = best["paged"].get(field)
-        b = best["fixed"].get(field)
+    def ratio(a_path, b_path, field, lo_better=False):
+        a = best[a_path].get(field)
+        b = best[b_path].get(field)
         if not a or not b:
             return None
         return round(b / a, 3) if lo_better else round(a / b, 3)
+
+    # the rungs' byte/capacity accounting (the int8 claim is bytes
+    # math from the artifact meta, demonstrated live by the q8 window)
+    rung_n = fusedd.rung("native")
+    rung_8 = fusedd.rung("int8")
+    native_pages = best["paged_fused"]["kv_pool_pages"]
+    int8_pages = best["paged_fused_q8"]["kv_pool_pages"]
+    nblk = fusedd.blocks_per_seq
+    page_bytes = {
+        "native": rung_n["kv_bytes_per_seq"] // (2 * nblk),
+        "int8": rung_8["kv_bytes_per_seq"] // (2 * nblk)}
+    int8_pool = {
+        "native_pages": native_pages,
+        "native_pool_bytes": 2 * native_pages * page_bytes["native"],
+        "native_seqs_fit": native_pages // nblk,
+        "int8_pages": int8_pages,
+        "int8_pool_bytes": 2 * int8_pages * page_bytes["int8"],
+        "int8_seqs_fit": int8_pages // nblk,
+        # sequences per pool byte, int8 over native — the ">= 1.9x KV
+        # state in the same pool" acceptance bound
+        "kv_state_per_byte_ratio": round(
+            rung_n["kv_bytes_per_seq"] / rung_8["kv_bytes_per_seq"],
+            3),
+        "seqs_vs_native_ratio": round(int8_pages / native_pages, 3),
+    }
     entry = {
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                    time.gmtime()),
@@ -1847,12 +1958,24 @@ def decode_main(args) -> None:
                  % (DECODE_SEQ, DECODE_VOCAB, DECODE_EMBED,
                     DECODE_NLAYER, DECODE_NHEAD, DECODE_SLOTS,
                     DECODE_MAX_NEW, DECODE_SHORT, DECODE_PROMPT),
-        "tok_per_sec": best["paged"].get("tok_per_sec"),
+        "tok_per_sec": best["paged_fused"].get("tok_per_sec"),
         "tok_per_sec_fixed": best["fixed"].get("tok_per_sec"),
-        "tok_per_sec_speedup": ratio("tok_per_sec"),
-        "ttft_p99_ms": best["paged"].get("ttft_p99_ms"),
+        "tok_per_sec_gather": best["paged"].get("tok_per_sec"),
+        "tok_per_sec_q8": best["paged_fused_q8"].get("tok_per_sec"),
+        "tok_per_sec_speedup": ratio("paged_fused", "fixed",
+                                     "tok_per_sec"),
+        "fused_vs_gather_speedup": ratio("paged_fused", "paged",
+                                         "tok_per_sec"),
+        "ttft_p99_ms": best["paged_fused"].get("ttft_p99_ms"),
         "ttft_p99_ms_fixed": best["fixed"].get("ttft_p99_ms"),
-        "ttft_p99_speedup": ratio("ttft_p99_ms", lo_better=True),
+        "ttft_p99_speedup": ratio("paged_fused", "fixed",
+                                  "ttft_p99_ms", lo_better=True),
+        # per-path kernel + bytes attribution (the rung trajectory)
+        "attend_kernels": {p: best[p].get("attend_kernel")
+                           for p in best},
+        "kv_bytes_per_step": {p: best[p].get("kv_bytes_per_step")
+                              for p in best},
+        "int8_pool": int8_pool,
         "recompile_sentinel": sentinel,
         "windows": windows,
         "frontier": frontier,
@@ -1862,24 +1985,34 @@ def decode_main(args) -> None:
     print(json.dumps({
         "metric": "decode_serve_tok_per_sec",
         "value": entry["tok_per_sec"],
-        "unit": "sustained generated tokens/s, paged continuous path",
+        "unit": "sustained generated tokens/s, fused-paged "
+                "continuous path",
         "platform": platform,
         "host_cores": os.cpu_count() or 1,
         "measured_as": "open-loop mixed_prompt_len replay (%g req/s "
                        "mean, %gs windows, 2 short : 1 long prompts, "
-                       "streaming) against the fixed-shape decoder "
-                       "and the paged continuous engine in paired "
-                       "adjacent windows; ttft honest per path "
-                       "(fixed has no token until completion)"
+                       "streaming) against the fixed-shape decoder, "
+                       "the r10 gather-paged engine, and the fused "
+                       "typed-rung engine (native pool-clamped A/B + "
+                       "int8 2x-pool) in paired adjacent windows; "
+                       "ttft honest per path (fixed has no token "
+                       "until completion)"
                        % (args.decode_rps, args.decode_duration),
-        "paged": best["paged"],
+        "paged_fused": best["paged_fused"],
+        "paged_gather": best["paged"],
+        "paged_fused_q8": best["paged_fused_q8"],
         "fixed": best["fixed"],
         "tok_per_sec_speedup": entry["tok_per_sec_speedup"],
+        "fused_vs_gather_speedup": entry["fused_vs_gather_speedup"],
         "ttft_p99_speedup": entry["ttft_p99_speedup"],
+        "attend_kernels": entry["attend_kernels"],
+        "kv_bytes_per_step": entry["kv_bytes_per_step"],
+        "int8_pool": int8_pool,
         "recompile_sentinel": sentinel,
-        "recompile_note": "jitcheck sentinel armed after window pair "
-                          "1: windows 2+ and the whole frontier sweep "
-                          "ran under the steady-state no-compile "
+        "recompile_note": "jitcheck sentinel armed after window round "
+                          "1 (all four paths, both rungs): later "
+                          "windows and the whole frontier sweep ran "
+                          "under the steady-state no-compile "
                           "contract, with the donation validator "
                           "checking every donating pool call; a run "
                           "with steady_state_compiles > 0 hard-fails "

@@ -681,7 +681,9 @@ _METADATA_ATTRS = {"shape", "dtype", "ndim", "size", "sharding",
 # The arity floor keeps generic leaves from matching unrelated calls
 # (trace.step(n) is 1-ary; ExportedStepDecoder.step(pool_k, ...) is 7).
 DEFAULT_EXTRA_DONATING = (
-    ("scatter_prefill_kv", (0, 1), 4),
+    # r12: scatter_prefill_kv takes the rung's pool-buffer TUPLE at
+    # arg 0 (2 arrays native, 4 on the int8 rung), all donated
+    ("scatter_prefill_kv", (0,), 4),
     ("step", (0, 1), 7),
 )
 

@@ -303,7 +303,11 @@ class LearnTask:
                                    "export_kv_block",
                                    "export_pool_blocks",
                                    "export_prefill_rows",
-                                   "export_prefill_widths"]),
+                                   "export_prefill_widths",
+                                   # typed rungs (docs/serving.md)
+                                   "export_kv_dtype",
+                                   "export_paged_attend",
+                                   "export_step_buckets"]),
         "serve": frozenset(["export_in", "serve_host", "serve_port",
                             "serve_max_wait_ms", "serve_max_batch",
                             "serve_queue_limit", "serve_timeout_ms",
@@ -311,7 +315,7 @@ class LearnTask:
                             "serve_access_log",
                             # continuous batching (serve/continuous.py)
                             "serve_stream", "serve_prefill_split",
-                            "serve_kv_blocks",
+                            "serve_kv_blocks", "serve_kv_dtype",
                             # multi-replica front end (serve/router.py)
                             "serve_replicas", "serve_max_retries",
                             "serve_priority_default", "serve_swap",
@@ -836,7 +840,12 @@ class LearnTask:
         (serving.export_decode_step — paged KV pool + width-bucketed
         prefills): export_kv_block / export_pool_blocks size the pool
         pages, export_prefill_rows / export_prefill_widths (comma
-        lists) override the prefill bucket ladders."""
+        lists) override the prefill bucket ladders,
+        export_kv_dtype (comma list of native|int8, default the
+        trainer's decode_kv) picks the cache-dtype rungs,
+        export_step_buckets (comma list) adds sub-batch decode-step
+        rungs, export_paged_attend (fused|gather, default fused)
+        picks the attend kernel (docs/serving.md rung table)."""
         from . import serving
         d = dict(self.cfg)
         out = d.get("export_out", "model.export")
@@ -855,6 +864,8 @@ class LearnTask:
         if dec == "step":
             rows_s = d.get("export_prefill_rows", "").strip()
             widths_s = d.get("export_prefill_widths", "").strip()
+            kv_s = d.get("export_kv_dtype", "").strip()
+            sb_s = d.get("export_step_buckets", "").strip()
             serving.export_decode_step(
                 self.trainer, out,
                 max_new=int(d.get("max_new", "32")),
@@ -868,6 +879,12 @@ class LearnTask:
                 kv_block=int(d.get("export_kv_block", "128")),
                 pool_blocks=int(d.get("export_pool_blocks", "0"))
                 or None,
+                kv_dtypes=[x.strip() for x in kv_s.split(",")
+                           if x.strip()] or None,
+                step_buckets=[int(x) for x in sb_s.split(",")
+                              if x.strip()] or None,
+                paged_attend=d.get("export_paged_attend",
+                                   "fused").strip() or "fused",
                 platforms=platforms)
             print("exported split-phase decoder to %s (+.meta)" % out)
             return
@@ -909,7 +926,10 @@ class LearnTask:
         streaming on /generate ({"stream": true}). Its knobs:
         serve_stream (default 1; 0 returns 403 on stream requests),
         serve_prefill_split (default 1; 0 = coupled legacy scheduling
-        for A/B measurement), serve_kv_blocks (default 0 = the whole
+        for A/B measurement), serve_kv_dtype (auto|native|int8 —
+        which exported cache-dtype rung to serve; int8 holds ~2x the
+        KV state per pool byte, docs/serving.md rung table),
+        serve_kv_blocks (default 0 = the whole
         exported pool; fewer pages = admission control without a
         re-export).
 
@@ -1010,6 +1030,8 @@ class LearnTask:
                     prefill_split=bool(
                         int(d.get("serve_prefill_split", "1"))),
                     kv_blocks=int(d.get("serve_kv_blocks", "0")),
+                    kv_dtype=d.get("serve_kv_dtype",
+                                   "auto").strip() or "auto",
                     slo_ms=slo_ms or None,
                     warmup=bool(int(d.get("serve_warmup", "1"))),
                     registry=get_registry())

@@ -362,13 +362,16 @@ def build_prefill(net, p, temperature: float, B: int, W: int,
 
 
 def build_step(net, p, temperature: float, B: int, P: int, Sl: int,
-               block: int, platform: str = "cpu", steps: int = 1):
+               block: int, platform: str = "cpu", steps: int = 1,
+               kv: str = "native", attend: str = "gather"):
     """Build the jitted DECODE STEP over a paged KV pool — ``steps``
     tokens per call (multi-step scheduling):
 
         (params, pool_k (NB, Ltot, nh, block, d), pool_v (same),
+         [pool_ks (NB, Ltot, nh, block), pool_vs (same)  — int8 only]
          bt (B, nblk) int32, lens (B,), step (B,), last (B,), rng)
-            -> (pool_k', pool_v', next (B, steps) int32)
+            -> (pool_k', pool_v', [pool_ks', pool_vs',]
+                next (B, steps) int32)
 
     ``steps > 1`` amortizes the per-call host dispatch + sync over
     several tokens (the monolithic decoder amortizes it over ALL of
@@ -390,26 +393,60 @@ def build_step(net, p, temperature: float, B: int, P: int, Sl: int,
     OWN step — then attends over the block-gathered cache and samples
     the next token.
 
-    The attend gathers each slot's blocks and SLICES to exactly
-    ``Sl = P + max_new`` slots before the einsums, so the attend
-    shapes (and reduction orders) match the monolithic ``slot`` layout
-    program exactly — that is what keeps greedy outputs bitwise
-    identical between the contiguous and paged paths (pinned by
-    tests/test_continuous.py and tools/decode_quality.py --paged).
-    Pool pages past Sl are never read; pad slots inside Sl are masked
+    ``attend`` picks how the cache is read:
+
+    * ``gather`` — the r10 form: gather each slot's blocks into a
+      contiguous (B, nh, Sl, d) cache and run the slot attend on it.
+      The attend shapes (and reduction orders) match the monolithic
+      ``slot`` layout program exactly, which keeps greedy outputs
+      bitwise identical between the contiguous and paged paths.
+    * ``fused`` — the r12 form: attend THROUGH the block table via
+      ``ops/paged_attend.py`` (Pallas paged kernel on TPU — pages
+      stream from HBM with no gathered intermediate; the
+      barrier-fenced merged-dot XLA form elsewhere, which is itself
+      bitwise-identical to ``gather``, so the native fused rung keeps
+      the bitwise guarantee on every platform the tests run on).
+
+    Pool pages past ``Sl = P + max_new`` are never attended (sliced by
+    the gather form, bias-masked by the fused form — including the
+    multi-step overshoot headroom); pad slots inside Sl are masked
     (exp(NEG) underflows to exactly 0.0).
+
+    ``kv = "int8"`` (fused attend only — the XLA gather attend on an
+    int8 cache is a recorded perf negative, docs/performance.md)
+    stores the pool as int8 pages with per-(page, head, slot) f32
+    absmax scale planes (``_quant8``): the step quantizes each new
+    token's K/V on write and attends through
+    ``paged_attend_q8`` — half the streamed KV bytes, ~1% relative
+    attend error (the slot-layout int8 bound), double the pool
+    capacity per HBM byte.
 
     Slots not bound to a request point their whole block table at pool
     block 0 — the reserved TRASH block (serve/kvpool.py never hands it
     out) — so their writes land somewhere harmless and their sampled
     token is ignored by the engine."""
+    if kv not in ("native", "int8"):
+        raise ValueError("kv must be 'native' or 'int8', got %r" % kv)
+    if attend not in ("gather", "fused"):
+        raise ValueError("attend must be 'gather' or 'fused', got %r"
+                         % attend)
+    if kv == "int8" and attend != "fused":
+        raise ValueError(
+            "decode_kv=int8 on the paged path requires the fused "
+            "paged attend: the XLA gather attend materializes the "
+            "dequantized cache, a recorded perf negative "
+            "(docs/performance.md) — export with paged_attend='fused'")
     emb = net.modules[p["embed"]]
     stacks = [net.modules[i] for i in p["stacks"]]
     dt = net.compute_dtype
     e = emb.param.num_hidden
     nh, d = uniform_heads_or_reason(net, p)
+    if attend == "fused":
+        from .ops import paged_attend as pga
+        impl = "pallas" if platform == "tpu" else "xla"
+    npools = 4 if kv == "int8" else 2
 
-    def one(params, pool_k, pool_v, bt, lens, stepv, last, rng):
+    def one(params, pools, bt, lens, stepv, last, rng):
         pos = lens + stepv                 # absolute embed position
         h = _embed_one(params, p, emb, dt, last, pos)
         sl = P + stepv                     # (B,) logical write slot
@@ -417,9 +454,20 @@ def build_step(net, p, temperature: float, B: int, P: int, Sl: int,
         offs = sl % block
         b_ids = jnp.take_along_axis(bt, bcol[:, None], axis=1)[:, 0]
         Sp = bt.shape[1] * block           # gathered pool-view width
-        pos_k = jnp.arange(Sl)[None, :]
-        keep = (pos_k < lens[:, None]) \
-            | ((pos_k >= P) & (pos_k <= sl[:, None]))
+        if attend == "fused":
+            # additive mask over the LOGICAL slot axis, masking the
+            # alignment pad + multi-step overshoot headroom in
+            # [Sl, Sp) too — the fused attend masks what the gather
+            # attend slices away
+            pos_k = jnp.arange(Sp)[None, :]
+            keep = ((pos_k < lens[:, None])
+                    | ((pos_k >= P) & (pos_k <= sl[:, None]))) \
+                & (pos_k < Sl)
+            bias = jnp.where(keep, 0.0, NEG).astype(jnp.float32)
+        else:
+            pos_k = jnp.arange(Sl)[None, :]
+            keep = (pos_k < lens[:, None]) \
+                | ((pos_k >= P) & (pos_k <= sl[:, None]))
         li = 0
         for si, st in zip(p["stacks"], stacks):
             lp = params[si]
@@ -430,42 +478,78 @@ def build_step(net, p, temperature: float, B: int, P: int, Sl: int,
                 qkv = jnp.dot(x, layer_p["wqkv"].T.astype(dt))
                 qkv = qkv.reshape(B, 3, nh, d)
                 q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-                # write-then-gather: the new token's K/V must be
+                # write-then-attend: the new token's K/V must be
                 # visible to its own attend, exactly like the
                 # monolithic dynamic_update_slice-then-attend order
-                pool_k = pool_k.at[b_ids, li, :, offs, :].set(
-                    k_new.astype(pool_k.dtype))
-                pool_v = pool_v.at[b_ids, li, :, offs, :].set(
-                    v_new.astype(pool_v.dtype))
-                k_c = pool_k[bt, li].transpose(0, 2, 1, 3, 4) \
-                    .reshape(B, nh, Sp, d)[:, :, :Sl]
-                v_c = pool_v[bt, li].transpose(0, 2, 1, 3, 4) \
-                    .reshape(B, nh, Sp, d)[:, :, :Sl]
-                scores = jnp.einsum(
-                    "bhd,bhkd->bhk", q, k_c,
-                    preferred_element_type=jnp.float32) * (d ** -0.5)
-                att = jax.nn.softmax(
-                    jnp.where(keep[:, None, :], scores, NEG), -1)
-                out = jnp.einsum("bhk,bhkd->bhd",
-                                 att.astype(dt), v_c).reshape(B, e)
+                if kv == "int8":
+                    pool_k, pool_v, pool_ks, pool_vs = pools
+                    kq_new, ks_new = _quant8(k_new)
+                    vq_new, vs_new = _quant8(v_new)
+                    pool_k = pool_k.at[b_ids, li, :, offs, :].set(
+                        kq_new)
+                    pool_v = pool_v.at[b_ids, li, :, offs, :].set(
+                        vq_new)
+                    pool_ks = pool_ks.at[b_ids, li, :, offs].set(
+                        ks_new)
+                    pool_vs = pool_vs.at[b_ids, li, :, offs].set(
+                        vs_new)
+                    pools = (pool_k, pool_v, pool_ks, pool_vs)
+                    out = pga.paged_attend_q8(
+                        q, pool_k, pool_v, pool_ks, pool_vs, bt, bias,
+                        li, attend_slots=Sl, impl=impl,
+                        interpret=platform != "tpu")
+                else:
+                    pool_k, pool_v = pools
+                    pool_k = pool_k.at[b_ids, li, :, offs, :].set(
+                        k_new.astype(pool_k.dtype))
+                    pool_v = pool_v.at[b_ids, li, :, offs, :].set(
+                        v_new.astype(pool_v.dtype))
+                    pools = (pool_k, pool_v)
+                    if attend == "fused":
+                        out = pga.paged_attend(
+                            q, pool_k, pool_v, bt, bias, li,
+                            attend_slots=Sl, impl=impl,
+                            interpret=platform != "tpu")
+                    else:
+                        k_c = pool_k[bt, li].transpose(0, 2, 1, 3, 4) \
+                            .reshape(B, nh, Sp, d)[:, :, :Sl]
+                        v_c = pool_v[bt, li].transpose(0, 2, 1, 3, 4) \
+                            .reshape(B, nh, Sp, d)[:, :, :Sl]
+                        scores = jnp.einsum(
+                            "bhd,bhkd->bhk", q, k_c,
+                            preferred_element_type=jnp.float32) \
+                            * (d ** -0.5)
+                        att = jax.nn.softmax(
+                            jnp.where(keep[:, None, :], scores, NEG),
+                            -1)
+                        out = jnp.einsum("bhk,bhkd->bhd",
+                                         att.astype(dt), v_c)
+                out = out.reshape(B, e)
                 h = h + jnp.dot(out, layer_p["wo"].T.astype(dt))
                 x = _rmsnorm(h, layer_p["norm2"], dt)
                 h = h + _mlp_block(st, layer_p, x, dt)
                 li += 1
         logits = _head_logits(params, p, dt, h)
         nxt, rng = _sample_at(logits, rng, temperature)
-        return pool_k, pool_v, nxt.astype(jnp.int32), rng
+        return pools, nxt.astype(jnp.int32), rng
 
-    def step(params, pool_k, pool_v, bt, lens, stepv, last, rng):
+    def step(params, *args):
+        pools = args[:npools]
+        bt, lens, stepv, last, rng = args[npools:]
         toks = []
         for t in range(int(steps)):
-            pool_k, pool_v, last, rng = one(
-                params, pool_k, pool_v, bt, lens, stepv + t, last, rng)
+            pools, last, rng = one(
+                params, pools, bt, lens, stepv + t, last, rng)
             toks.append(last)
-        return pool_k, pool_v, jnp.stack(toks, axis=1)  # (B, steps)
+        return pools + (jnp.stack(toks, axis=1),)     # (B, steps)
 
-    # named for the recompile sentinel (see build_prefill)
-    step.__name__ = "gen_decode_step_b%d_t%d" % (B, int(steps))
+    # named for the recompile sentinel (see build_prefill); the rung
+    # qualifiers keep each (kv, attend, bucket) step program its own
+    # line item in the per-program compile counts
+    step.__name__ = "gen_decode_step_b%d_t%d%s%s" % (
+        B, int(steps),
+        "_fused" if attend == "fused" else "",
+        "_q8" if kv == "int8" else "")
     return jax.jit(step)
 
 

@@ -146,6 +146,13 @@ class ContinuousDecodeEngine:
       kv_blocks       runtime clamp on live pool pages (<= the
                       exported pool; 0 = whole pool) — admission
                       control without a re-export
+      kv_dtype        which exported cache-dtype rung to serve
+                      ("native" | "int8" | "auto" = native when
+                      exported, else the artifact's first rung). The
+                      int8 rung halves the pool bytes per sequence
+                      (kv_bytes_per_seq in the artifact meta), so the
+                      same byte budget holds ~2x the KV state —
+                      docs/serving.md's rung table
       step_hook       callable invoked before every decode step — the
                       fault-injection / test-throttle seam (raising
                       fails the step's requests through the real error
@@ -163,6 +170,7 @@ class ContinuousDecodeEngine:
     def __init__(self, decoder, queue_limit: int = 64,
                  timeout_ms: float = 30000.0,
                  prefill_split: bool = True, kv_blocks: int = 0,
+                 kv_dtype: str = "auto",
                  max_wait_ms: float = 0.0, max_batch=None,
                  dispatch_depth: int = 0,
                  stats: Optional[ServeStats] = None, seed: int = 0,
@@ -180,6 +188,20 @@ class ContinuousDecodeEngine:
         self.batch = decoder.batch
         self.buckets = list(decoder.buckets)
         self.max_batch = self.batch
+        if kv_dtype == "auto":
+            kvs = decoder.kv_dtypes
+            kv_dtype = "native" if "native" in kvs else kvs[0]
+        if kv_dtype not in decoder.kv_dtypes:
+            raise ValueError(
+                "artifact carries no %r KV rung (exported: %s) — "
+                "re-export with kv_dtypes including it"
+                % (kv_dtype, decoder.kv_dtypes))
+        self.kv_dtype = kv_dtype
+        # step rungs of this kv family: each decode call dispatches at
+        # the smallest exported bucket holding the live rows, so
+        # partial occupancy runs a load-proportional program
+        self._step_buckets = decoder.step_buckets(kv_dtype)
+        self.attend_kernel = decoder.rung(kv_dtype)["attend_kernel"]
         self.queue_limit = int(queue_limit)
         self.timeout_s = float(timeout_ms) / 1000.0
         self.prefill_split = bool(prefill_split)
@@ -190,9 +212,10 @@ class ContinuousDecodeEngine:
         self.registry = registry if registry is not None else Registry()
         self.pool = BlockPool(decoder.pool_blocks, decoder.kv_block,
                               limit=int(kv_blocks))
-        self._pool_k, self._pool_v = decoder.new_pool()
+        self._pools = decoder.new_pool(kv_dtype)
         self._slots: List[Optional[_Row]] = [None] * self.batch
         self._nlive = 0
+        self._bucket_steps = {b: 0 for b in self._step_buckets}
         self._seed = int(seed)
         self._greedy_key = None
         self._nstep = 0
@@ -256,6 +279,9 @@ class ContinuousDecodeEngine:
                 g_q.set(self.queue_depth, **self.obs_labels),
                 g_slots.set(self._nlive, **self.obs_labels),
                 g_blocks.set(self.pool.in_use, **self.obs_labels))),
+            # pool-sizing gauges (live + high-water peak): the peak is
+            # what the docs' pool-sizing guidance is measured against
+            self.pool.bind_registry(self.registry, self.obs_labels),
         ]
         self._thread = threading.Thread(
             target=self._loop, name="serve-continuous", daemon=True)
@@ -272,13 +298,21 @@ class ContinuousDecodeEngine:
 
     def warmup(self) -> None:
         """Pre-run every prefill bucket (INCLUDING its pool-scatter —
-        the jitted donated scatter compiles per (rows, width) shape),
-        one decode step, and the key fold, so every first-call cost on
-        the serving path lands before traffic. All warmup writes go
-        through trash block tables, so the pool stays clean. Runs
-        inside a ``jitcheck.allow`` window: with the recompile
-        sentinel armed these compiles are sanctioned warmup
-        (docs/analysis.md)."""
+        the jitted donated scatter compiles per (rows, width, rung)
+        shape) and EVERY exported step bucket of the engine's KV rung,
+        plus the key fold, so every first-call cost on the serving
+        path lands before traffic. All warmup writes go through trash
+        block tables, so the pool stays clean. Runs inside a
+        ``jitcheck.allow`` window: with the recompile sentinel armed
+        these compiles are sanctioned warmup (docs/analysis.md).
+
+        Coverage is per RUNG dimension deliberately: the r10 sentinel
+        caught intermediate prefill buckets' trim slices compiling
+        mid-traffic, and the rung refactor multiplies the program
+        space by kv_dtype x step bucket — a missed combo here is a
+        guaranteed scheduler-thread compile under load (the gate in
+        tools/analysis_gate.py --rungs replays exactly this
+        contract)."""
         from ..analysis import jitcheck as _jitcheck
         from ..serving import scatter_prefill_kv
         c = self.callee
@@ -306,18 +340,20 @@ class ContinuousDecodeEngine:
                     first, k, v = outs[c.pick_rows(n)]
                     fn, kn, vn = first[:n], k[:, :n], v[:, :n]
                     np.asarray(fn)
-                    self._pool_k, self._pool_v = scatter_prefill_kv(
-                        self._pool_k, self._pool_v, kn, vn,
+                    self._pools = scatter_prefill_kv(
+                        self._pools, kn, vn,
                         [[0] * nb for _ in range(n)], c.kv_block)
-            B, nblk = self.batch, c.blocks_per_seq
-            pk, pv, nxt = c.step(
-                self._pool_k, self._pool_v,
-                np.zeros((B, nblk), np.int32), np.ones((B,), np.int32),
-                np.zeros((B,), np.int32), np.zeros((B,), np.int32),
-                key)
-            np.asarray(nxt)
-            self._pool_k, self._pool_v = pk, pv
-            self.warmup_runs += 1
+            nblk = c.blocks_per_seq
+            for b in self._step_buckets:
+                out = c.step_call(self.kv_dtype, b)(
+                    *self._pools,
+                    np.zeros((b, nblk), np.int32),
+                    np.ones((b,), np.int32),
+                    np.zeros((b,), np.int32),
+                    np.zeros((b,), np.int32), key)
+                self._pools, nxt = out[:-1], out[-1]
+                np.asarray(nxt)
+                self.warmup_runs += 1
         self._warmed = True
 
     # ------------------------------------------------------------------
@@ -363,6 +399,9 @@ class ContinuousDecodeEngine:
                 "max_new": c.max_new,
                 "continuous": True, "stream": True,
                 "prefill_split": self.prefill_split,
+                "kv_dtype": self.kv_dtype,
+                "attend_kernel": self.attend_kernel,
+                "step_buckets": list(self._step_buckets),
                 "slots_live": self._nlive,
                 "ready_rows": len(self._ready),
                 "kv_pool": self.pool.snapshot()}
@@ -380,6 +419,9 @@ class ContinuousDecodeEngine:
         snap["warmup_runs"] = self.warmup_runs
         snap["continuous"] = True
         snap["prefill_split"] = self.prefill_split
+        snap["kv_dtype"] = self.kv_dtype
+        snap["attend_kernel"] = self.attend_kernel
+        snap["step_bucket_dispatches"] = dict(self._bucket_steps)
         snap["slots_live"] = self._nlive
         snap["ready_rows"] = len(self._ready)
         snap["kv_pool"] = self.pool.snapshot()
@@ -584,8 +626,8 @@ class ContinuousDecodeEngine:
                 # the host to stream out — this wait IS the TTFT
                 first = np.asarray(first)
                 from ..serving import scatter_prefill_kv
-                self._pool_k, self._pool_v = scatter_prefill_kv(
-                    self._pool_k, self._pool_v, k, v,
+                self._pools = scatter_prefill_kv(
+                    self._pools, k, v,
                     [row.blocks for row in take], c.kv_block)
         except Exception as e:
             self.stats.on_error(len({r.req for r in take}))
@@ -704,7 +746,7 @@ class ContinuousDecodeEngine:
                 self.pool.free(row.blocks)
                 row.blocks = None
             self._finish_req(row.req, error=error)
-        self._pool_k, self._pool_v = self.callee.new_pool()
+        self._pools = self.callee.new_pool(self.kv_dtype)
 
     def _reap_dead_slots(self) -> None:
         """Release slots whose request was already failed externally
@@ -720,9 +762,14 @@ class ContinuousDecodeEngine:
 
     @hot_path
     def _decode_step(self) -> None:
-        """One token for every live slot: build the step inputs from
-        the slot table, run the step program, fan the sampled tokens
-        out to their requests."""
+        """One decode call for every live slot, dispatched at the
+        smallest exported step bucket holding them: build the step
+        inputs from the slot table (live rows PACKED into the bucket's
+        leading rows — lane identity is host bookkeeping; every
+        per-call array and the block table are rebuilt here anyway),
+        run the rung's step program, fan the sampled tokens out to
+        their requests. Bucket choice is pure host arithmetic on the
+        host-known live count — no device sync."""
         self._reap_dead_slots()
         self._bind_ready()
         live = [(i, s) for i, s in enumerate(self._slots)
@@ -730,32 +777,35 @@ class ContinuousDecodeEngine:
         if not live:
             return   # all slots idle: no dispatch at all
         c = self.callee
-        B, nblk = self.batch, c.blocks_per_seq
-        bt = np.zeros((B, nblk), np.int32)      # 0 = trash page
-        lens = np.ones((B,), np.int32)
-        stepv = np.zeros((B,), np.int32)
-        last = np.zeros((B,), np.int32)
-        for i, row in live:
-            bt[i] = row.blocks
-            lens[i] = row.plen
-            stepv[i] = row.ntok - 1
-            last[i] = row.last
+        nblk = c.blocks_per_seq
+        b = c.pick_step_bucket(len(live), self.kv_dtype)
+        bt = np.zeros((b, nblk), np.int32)      # 0 = trash page
+        lens = np.ones((b,), np.int32)
+        stepv = np.zeros((b,), np.int32)
+        last = np.zeros((b,), np.int32)
+        for j, (i, row) in enumerate(live):
+            bt[j] = row.blocks
+            lens[j] = row.plen
+            stepv[j] = row.ntok - 1
+            last[j] = row.last
         self._nstep += 1
+        self._bucket_steps[b] = self._bucket_steps.get(b, 0) + 1
         T = c.step_tokens
         try:
             if self.step_hook is not None:
                 self.step_hook()
             with _trace.span("serve.decode_step", "serve",
                              {"live": len(live),
-                              "dummy": B - len(live),
+                              "bucket": b,
+                              "dummy": b - len(live),
                               "step_tokens": T}):
-                pk, pv, nxt = c.step(self._pool_k, self._pool_v, bt,
-                                     lens, stepv, last,
-                                     self._fold_key(1 << 20
-                                                    | self._nstep))
+                out = c.step_call(self.kv_dtype, b)(
+                    *self._pools, bt, lens, stepv, last,
+                    self._fold_key(1 << 20 | self._nstep))
+                pools, nxt = out[:-1], out[-1]
                 # the sanctioned materialize: the sampled tokens must
                 # reach the host every step — they are the stream
-                toks = np.asarray(nxt)     # (B, step_tokens)
+                toks = np.asarray(nxt)     # (b, step_tokens)
         except Exception as e:
             reqs = {row.req for _, row in live}
             self.stats.on_error(len(reqs))
@@ -772,21 +822,21 @@ class ContinuousDecodeEngine:
             # lived there: fail everything in flight, rebuild fresh
             self._fail_all_inflight(e)
             return
-        self._pool_k, self._pool_v = pk, pv
+        self._pools = pools
         now = time.monotonic()
         emitted = 0
         toks = toks.tolist()
-        for i, row in live:
+        for j, (i, row) in enumerate(live):
             # a row completing mid-call discards its overshoot tokens
             # (their pool writes die with the row's pages)
             take = min(T, row.req.n_new - row.ntok)
-            self._emit(row, toks[i][:take], now)
+            self._emit(row, toks[j][:take], now)
             emitted += take
             if row.ntok >= row.req.n_new:
                 self._slots[i] = None
                 self._nlive -= 1
                 self._row_done(row, now)
-        self.stats.on_step(emitted, B * T - emitted)
+        self.stats.on_step(emitted, b * T - emitted)
 
     def _loop(self) -> None:
         while True:

@@ -21,7 +21,7 @@ leakage, exactly the bug the pool tests hunt."""
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 from ..analysis import lockcheck as _lockcheck
 
@@ -131,3 +131,28 @@ class BlockPool:
                 "high_water": self.high_water,
                 "allocs": self.allocs,
             }
+
+    def bind_registry(self, registry, labels: Optional[dict] = None):
+        """Register the pool's occupancy gauges on ``registry``:
+        ``cxxnet_kv_pages_in_use`` (live) and ``cxxnet_kv_pages_peak``
+        (the high-water mark since start) — the peak is what sizes a
+        pool: docs/serving.md's guidance ("pages are cheap; a
+        too-small pool silently degrades the scheduler to singleton
+        prefills") is only checkable against a measured peak. Returns
+        the collection hook (pass it to ``registry.remove_hook`` on
+        close, the ServeStats.bind_registry convention)."""
+        labels = dict(labels or {})
+        g_live = registry.gauge(
+            "cxxnet_kv_pages_in_use",
+            "paged KV pool pages currently held by requests",
+            tuple(labels))
+        g_peak = registry.gauge(
+            "cxxnet_kv_pages_peak",
+            "high-water mark of paged KV pool pages held at once",
+            tuple(labels))
+
+        def hook():
+            snap = self.snapshot()
+            g_live.set(snap["in_use"], **labels)
+            g_peak.set(snap["high_water"], **labels)
+        return registry.add_hook(hook)

@@ -261,6 +261,16 @@ def default_prefill_widths(max_prompt_len: int, seq_len: int) -> list:
     return sorted(x for x in widths if x <= P)
 
 
+def attend_kernel_name(paged_attend: str, kv_dtype: str) -> str:
+    """Ledger/metrics label for a decode-step rung's attend kernel:
+    ``gather-xla`` (the r10 materializing gather), ``fused-paged``
+    (ops/paged_attend.py through the block table), ``fused-paged-q8``
+    (same, int8 pages + scale planes)."""
+    if paged_attend == "gather":
+        return "gather-xla"
+    return "fused-paged-q8" if kv_dtype == "int8" else "fused-paged"
+
+
 def export_decode_step(trainer, path: str, max_new: int = 32,
                        temperature: float = 0.0,
                        prompt_len: Optional[int] = None,
@@ -270,6 +280,9 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                        kv_block: int = 128,
                        pool_blocks: Optional[int] = None,
                        step_tokens: int = 4,
+                       kv_dtypes: Optional[Sequence[str]] = None,
+                       step_buckets: Optional[Sequence[int]] = None,
+                       paged_attend: str = "fused",
                        platforms: Optional[Sequence[str]] = None) -> None:
     """Serialize the SPLIT-PHASE decoder for continuous batching:
     instead of ``export_generate``'s one monolithic prefill+decode
@@ -281,16 +294,42 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
       first sampled token. Short prompts run narrow programs; a long
       prompt prefills in its own dispatch and never rides along with
       (or stalls) anyone else's.
-    * ONE decode-step program over a paged KV pool — ``batch`` slots,
-      each slot addressing its cache through a per-slot BLOCK TABLE
-      into a shared pool of ``kv_block``-slot pages (the 128-multiple
+    * DECODE-STEP programs over a paged KV pool — TYPED ARTIFACT
+      RUNGS, one program per (``kv_dtype`` x slot bucket): each slot
+      addresses its cache through a per-slot BLOCK TABLE into a shared
+      pool of ``kv_block``-slot pages (the 128-multiple
       ``cache_slots`` granule from ops/decode_attend.py). Each call
       advances every slot by ``step_tokens`` tokens (multi-step
       scheduling: the per-call host dispatch amortizes over several
       tokens; a slot completing mid-call has its overshoot discarded);
       the serving engine rebinds slots between calls, which is what
       lets requests join and leave per call (Orca-style
-      iteration-level scheduling).
+      iteration-level scheduling), and dispatches each step at the
+      smallest exported bucket holding the live rows, so partial
+      occupancy runs a load-proportional program instead of the full
+      slot count's.
+
+    ``paged_attend`` picks the attend implementation baked into the
+    step programs: ``fused`` (default) attends THROUGH the block table
+    (ops/paged_attend.py — the Pallas paged kernel on TPU, the
+    barrier-fenced merged-dot XLA form elsewhere; measured 1.35x over
+    the gather step at the r12 bench shape); ``gather`` keeps the r10
+    materializing gather as the measured baseline.
+
+    ``kv_dtypes`` lists the cache-dtype rungs serialized into the
+    artifact (default: the trainer's ``decode_kv`` knob, so
+    ``decode_kv = int8`` routes to the int8 rung — the r10 loud
+    rejection is gone now that the fused kernel exists): ``native``
+    stores the compute dtype; ``int8`` stores int8 pages plus
+    per-(page, head, slot) f32 absmax scale planes
+    (``generate._quant8`` — prompt K/V is quantized on the way into
+    the pool by ``scatter_prefill_kv``), halving the KV bytes the
+    ~87%-streaming step moves and roughly doubling the sequences a
+    pool byte budget holds. int8 requires ``paged_attend = "fused"``
+    (the XLA gather attend on an int8 cache is a recorded perf
+    negative). Prefill programs are rung-independent (they emit
+    native K/V; quantization happens at scatter), so rungs share
+    them.
 
     Pool geometry (recorded in the meta): logical per-slot cache =
     ``prompt_slots(prompt_len) + max_new`` attend slots, padded to the
@@ -298,16 +337,16 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
     ``blocks_per_seq = cache_slots / kv_block`` pages;
     ``pool_blocks`` (default: full occupancy + 1) sizes the shared
     pool, with block 0 reserved as the trash page unbound slots write
-    into. ``decode_kv = int8`` is not supported on this path (the
-    paged attend is the XLA slot attend; the int8 win needs the fused
-    kernel — see docs/serving.md); exports with the knob set fail
-    loudly rather than silently serving a different cache dtype.
+    into.
 
-    Greedy outputs are bitwise-identical to the monolithic
-    ``export_generate`` artifact built from the same trainer (the
-    step program slices its gathered pages to exactly the slot
-    layout's attend width) — pinned by tests and by
-    ``tools/decode_quality.py --paged``. Multi-host: collective,
+    Greedy outputs of the NATIVE rung are bitwise-identical to the
+    monolithic ``export_generate`` artifact built from the same
+    trainer (gather slices its pages to exactly the slot layout's
+    attend width; the fused XLA form is bitwise-identical to gather
+    by construction) — pinned by tests and by
+    ``tools/decode_quality.py --paged``; the int8 rung is approximate
+    (~1% relative attend error), gated by the same tool's
+    ``--kv int8`` agreement threshold. Multi-host: collective,
     process 0 writes, like ``export_model``."""
     import jax
     from jax import export as jexport
@@ -319,12 +358,21 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
         raise ValueError(
             "export_decode_step needs the canonical LM graph "
             "(embed -> causal stack(s) -> head): " + why)
-    if getattr(trainer, "decode_kv", "native") == "int8":
+    if paged_attend not in ("fused", "gather"):
+        raise ValueError("paged_attend must be 'fused' or 'gather', "
+                         "got %r" % (paged_attend,))
+    if kv_dtypes is None:
+        kv_dtypes = [getattr(trainer, "decode_kv", "native")]
+    kv_dtypes = list(dict.fromkeys(kv_dtypes))   # ordered, unique
+    for kvd in kv_dtypes:
+        if kvd not in ("native", "int8"):
+            raise ValueError("kv_dtypes entries must be 'native' or "
+                             "'int8', got %r" % (kvd,))
+    if "int8" in kv_dtypes and paged_attend != "fused":
         raise ValueError(
-            "export_decode_step supports decode_kv=native only: the "
-            "paged step program attends through the XLA slot attend, "
-            "where the int8 cache is a recorded perf negative — use "
-            "export_generate (the monolithic decoder) for int8")
+            "the int8 KV rung requires paged_attend='fused': the XLA "
+            "gather attend on an int8 cache is a recorded perf "
+            "negative (docs/performance.md)")
     net = trainer.net
     S = int(net.node_shapes[0][2])
     B = int(batch_size or trainer.batch_size)
@@ -393,6 +441,14 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
         if not rows or rows[0] < 1 or rows[-1] > B:
             raise ValueError("prefill_rows must be in [1, %d], got %s"
                              % (B, rows))
+    if step_buckets is None:
+        buckets = [B]
+    else:
+        buckets = sorted({int(b) for b in step_buckets} | {B})
+        if buckets[0] < 1 or buckets[-1] > B:
+            raise ValueError(
+                "step_buckets must be in [1, %d] (the slot count "
+                "rides along as the top rung), got %s" % (B, buckets))
     nh, d = G.uniform_heads_or_reason(net, plan)
     params = jax.tree.map(
         lambda w: trainer._fetch_global(w) if w is not None else None,
@@ -409,6 +465,9 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
         platforms = [platform]
     SDS = jax.ShapeDtypeStruct
     programs = []
+    rungs = []
+    pool_shape = (pool_blocks, Ltot, nh, kv_block, d)
+    scale_shape = pool_shape[:4]
     # one program serialized and written at a time (see export_model):
     # no whole-artifact blob list resident at once
     with open(path, "wb") as f:
@@ -427,25 +486,56 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                 f.write(blob)
                 programs.append({"kind": "prefill", "rows": r,
                                  "width": w, "bytes": len(blob)})
-        fn = G.build_step(net, plan, float(temperature), B, P, Sl,
-                          kv_block, platform, steps=step_tokens)
+        for kvd in kv_dtypes:
+            if kvd == "int8":
+                pool_args = [SDS(pool_shape, np.int8),
+                             SDS(pool_shape, np.int8),
+                             SDS(scale_shape, np.float32),
+                             SDS(scale_shape, np.float32)]
+            else:
+                pool_args = [SDS(pool_shape, pool_dt),
+                             SDS(pool_shape, pool_dt)]
+            donate = tuple(range(len(pool_args)))
+            for b in buckets:
+                fn = G.build_step(net, plan, float(temperature), b, P,
+                                  Sl, kv_block, platform,
+                                  steps=step_tokens, kv=kvd,
+                                  attend=paged_attend)
 
-        def stp(pk, pv, bt, lens, stepv, last, key, _fn=fn):
-            return _fn(params, pk, pv, bt, lens, stepv, last, key)
+                def stp(*a, _fn=fn):
+                    return _fn(params, *a)
 
-        pool_shape = (pool_blocks, Ltot, nh, kv_block, d)
-        # pool buffers donated: the exported program carries the
-        # input-output aliasing, so each step updates the pool in
-        # place instead of copying it through twice per token
-        blob = jexport.export(
-            jax.jit(stp, donate_argnums=(0, 1)),
-            platforms=list(platforms))(
-                SDS(pool_shape, pool_dt), SDS(pool_shape, pool_dt),
-                SDS((B, nblk), np.int32), SDS((B,), np.int32),
-                SDS((B,), np.int32), SDS((B,), np.int32),
-                SDS((2,), np.uint32)).serialize()
-        f.write(blob)
-        programs.append({"kind": "step", "bytes": len(blob)})
+                # pool buffers (pages AND scale planes) donated: the
+                # exported program carries the input-output aliasing,
+                # so each step updates the pool in place instead of
+                # copying it through twice per token
+                blob = jexport.export(
+                    jax.jit(stp, donate_argnums=donate),
+                    platforms=list(platforms))(
+                        *pool_args,
+                        SDS((b, nblk), np.int32), SDS((b,), np.int32),
+                        SDS((b,), np.int32), SDS((b,), np.int32),
+                        SDS((2,), np.uint32)).serialize()
+                f.write(blob)
+                programs.append({"kind": "step", "kv_dtype": kvd,
+                                 "batch": b, "bytes": len(blob)})
+            isz = 1 if kvd == "int8" else pool_dt.itemsize
+            ssz = 4 if kvd == "int8" else 0
+            rungs.append({
+                "kv_dtype": kvd,
+                "attend_kernel": attend_kernel_name(paged_attend, kvd),
+                "pool_dtype": "int8" if kvd == "int8" else pool_dt.name,
+                "scale_dtype": "float32" if kvd == "int8" else None,
+                # bytes ONE slot's attend streams per decoded token
+                # (K + V pages, plus the scale planes on int8) — the
+                # per-rung traffic the bench ledger attributes
+                "kv_bytes_per_step": 2 * Ltot * nh * Sp * (d * isz
+                                                           + ssz),
+                # bytes one sequence's pages occupy in the pool — the
+                # capacity side of the rung table (docs/serving.md)
+                "kv_bytes_per_seq": 2 * nblk * Ltot * nh * kv_block
+                * (d * isz + ssz),
+            })
     meta = {
         "magic": MAGIC,
         "kind": "generate_step",
@@ -459,7 +549,10 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
         "pool_dtype": pool_dt.name,
         "layers": Ltot, "nhead": nh, "head_dim": d,
         "prefill_rows": rows, "prefill_widths": widths,
-        "decode_layout": "paged", "decode_kv": "native",
+        "decode_layout": "paged", "decode_kv": kv_dtypes[0],
+        "paged_attend": paged_attend,
+        "kv_dtypes": kv_dtypes, "step_buckets": buckets,
+        "rungs": rungs,
         "programs": programs,
         "platforms": list(platforms),
     }
@@ -475,13 +568,14 @@ class ExportedStepDecoder:
     * :meth:`prefill` runs the smallest (rows, width) bucket holding a
       request's prompt rows and returns ``(first_tokens, k, v)`` with
       the prompt K/V for the caller to scatter into the paged pool.
-    * :meth:`step` advances every slot by one token against the pool
-      (async: returns un-materialized device arrays; ``np.asarray``
-      the token vector to block).
+    * :meth:`step_call` hands out the donating step program of a
+      (``kv_dtype``, slot bucket) RUNG; :meth:`step` is the legacy
+      native-max-bucket shorthand (async either way: un-materialized
+      device arrays; ``np.asarray`` the token matrix to block).
     * :meth:`generate` is the sequential reference driver — same
-      contract as ``ExportedDecoder.__call__`` — used by the parity
-      tests and ``tools/decode_quality.py --paged``; serving goes
-      through the engine instead."""
+      contract as ``ExportedDecoder.__call__``, per-rung via ``kv`` —
+      used by the parity tests and ``tools/decode_quality.py
+      --paged``; serving goes through the engine instead."""
 
     def __init__(self, path: str, meta: dict):
         from jax import export as jexport
@@ -495,8 +589,8 @@ class ExportedStepDecoder:
                 "(%d programs, %d bytes on disk)"
                 % (path, len(progs), len(blob)))
         self._pre = {}
-        self._step = None
-        self._step_call = None
+        self._step = {}           # (kv_dtype, bucket) -> exported
+        self._step_calls = {}     # (kv_dtype, bucket) -> donating fn
         lo = 0
         for pr in progs:
             exp = jexport.deserialize(blob[lo:lo + int(pr["bytes"])])
@@ -504,11 +598,15 @@ class ExportedStepDecoder:
             if pr["kind"] == "prefill":
                 self._pre[(int(pr["rows"]), int(pr["width"]))] = exp
             else:
-                self._step = exp
-        if self._step is None or not self._pre:
+                # pre-rung (r10) metas carry a bare {"kind": "step"}:
+                # one native program at the full slot count
+                kvd = pr.get("kv_dtype", "native")
+                b = int(pr.get("batch", meta["batch"]))
+                self._step[(kvd, b)] = exp
+        if not self._step or not self._pre:
             raise ValueError(
                 "%s: generate_step artifact needs at least one "
-                "prefill program and the step program" % path)
+                "prefill program and one step program" % path)
 
     # -- artifact contract -------------------------------------------
     @property
@@ -552,6 +650,50 @@ class ExportedStepDecoder:
         return [self.batch]
 
     @property
+    def kv_dtypes(self) -> list:
+        """Exported cache-dtype rungs, artifact order (native first
+        when both are present — the engine's 'auto' pick)."""
+        kvs = self.meta.get("kv_dtypes")
+        if kvs:
+            return list(kvs)
+        return sorted({kvd for kvd, _ in self._step})
+
+    def step_buckets(self, kv: str = "native") -> list:
+        """Exported slot buckets of the ``kv`` rung family."""
+        out = sorted({b for kvd, b in self._step if kvd == kv})
+        if not out:
+            raise ValueError(
+                "artifact has no %r step rung (exported: %s)"
+                % (kv, self.kv_dtypes))
+        return out
+
+    def pick_step_bucket(self, n: int, kv: str = "native") -> int:
+        """Smallest exported step bucket holding ``n`` live rows."""
+        return _pick_bucket(self.step_buckets(kv), n)
+
+    def rung(self, kv: str = "native") -> dict:
+        """The rung's meta row (attend kernel, pool/scale dtypes,
+        kv_bytes_per_step / kv_bytes_per_seq); synthesized for
+        pre-rung (r10) artifacts."""
+        for r in self.meta.get("rungs") or []:
+            if r.get("kv_dtype") == kv:
+                return dict(r)
+        if kv != "native" or ("native", self.batch) not in self._step:
+            raise ValueError(
+                "artifact has no %r rung (exported: %s)"
+                % (kv, self.kv_dtypes))
+        import jax.numpy as jnp
+        m = self.meta
+        isz = jnp.dtype(m["pool_dtype"]).itemsize
+        L, nh, d = int(m["layers"]), int(m["nhead"]), int(m["head_dim"])
+        return {"kv_dtype": "native", "attend_kernel": "gather-xla",
+                "pool_dtype": m["pool_dtype"], "scale_dtype": None,
+                "kv_bytes_per_step": 2 * L * nh * int(m["pool_slots"])
+                * d * isz,
+                "kv_bytes_per_seq": 2 * L * nh * int(m["pool_slots"])
+                * d * isz}
+
+    @property
     def prefill_rows(self) -> list:
         return sorted({r for r, _ in self._pre})
 
@@ -573,13 +715,26 @@ class ExportedStepDecoder:
         the max bucket when none does (the caller then chunks)."""
         return _pick_bucket(self.prefill_rows, n)
 
-    def new_pool(self):
-        """Fresh zeroed (pool_k, pool_v) device arrays at the exported
-        pool geometry (blocks, layers, nh, kv_block, head_dim)."""
+    def new_pool(self, kv: str = "native"):
+        """Fresh zeroed pool buffers at the exported geometry
+        (blocks, layers, nh, kv_block, head_dim): the ``(pool_k,
+        pool_v)`` pair for the native rung, ``(pool_k, pool_v,
+        scale_k, scale_v)`` — int8 pages plus f32 per-(page, head,
+        slot) scale planes — for the int8 rung. The tuple's arity IS
+        the rung's pool contract: every step/scatter call takes and
+        returns exactly these buffers, donated."""
         import jax.numpy as jnp
         shape = (self.pool_blocks, int(self.meta["layers"]),
                  int(self.meta["nhead"]), self.kv_block,
                  int(self.meta["head_dim"]))
+        if kv == "int8":
+            # scale planes start at 1.0: a zero scale would be safe
+            # (q=0 contributes nothing) but 1.0 keeps every unwritten
+            # slot trivially readable — the slot-layout convention
+            return (jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape, jnp.int8),
+                    jnp.ones(shape[:4], jnp.float32),
+                    jnp.ones(shape[:4], jnp.float32))
         dt = jnp.dtype(self.meta["pool_dtype"])
         return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
@@ -603,49 +758,75 @@ class ExportedStepDecoder:
         first, k, v = self._pre[(r, w)].call(toks, ls, key)
         return first[:n], k[:, :n], v[:, :n]
 
-    def step(self, pool_k, pool_v, bt, lens, stepv, last, key):
-        """One decode call over the paged pool, advancing every slot
-        by ``step_tokens`` tokens — async (no host sync): returns
-        (pool_k', pool_v', next_tokens (batch, step_tokens)) device
-        arrays.
+    def step_call(self, kv: str = "native", bucket: int = None):
+        """The donating step program of the (``kv``, ``bucket``) rung
+        (default: the max bucket): a callable ``(pools..., bt, lens,
+        stepv, last, key) -> (pools'..., next (bucket, step_tokens))``
+        — async (no host sync), pool arity per :meth:`new_pool`.
 
         The pool arguments are DONATED: export serialization drops the
         program's input-output aliasing, so the call goes through an
         outer donating jit that restores it — without this every step
-        round-trips both pool buffers through a copy (measured 10.5 ->
+        round-trips the pool buffers through a copy (measured 10.5 ->
         3.9 ms/step at the bench shape). The caller must drop its old
         pool references and use the returned ones, even on failure
         (the donation-validator seam turns a violation into an
         immediate DonationError naming this site; docs/analysis.md)."""
-        if self._step_call is None:
+        if bucket is None:
+            bucket = self.step_buckets(kv)[-1]
+        key = (kv, int(bucket))
+        fn = self._step_calls.get(key)
+        if fn is None:
             import jax
 
             from .analysis import jitcheck as _jitcheck
+            exp = self._step.get(key)
+            if exp is None:
+                raise ValueError(
+                    "artifact has no (%s, %d) step rung (exported: %s)"
+                    % (kv, bucket, sorted(self._step)))
+            npools = 4 if kv == "int8" else 2
+            donate = tuple(range(npools))
 
-            def exported_decode_step(*a, _call=self._step.call):
+            def exported_decode_step(*a, _call=exp.call):
                 return _call(*a)
 
+            # rung-qualified name: the recompile sentinel's
+            # per-program counts stay attributable per rung
+            exported_decode_step.__name__ = \
+                "exported_decode_step_%s_b%d" % (kv, bucket)
             # always=True: this wrapper is cached for the decoder's
             # lifetime, which may start before jitcheck.enable()
-            self._step_call = _jitcheck.make_donating(
-                jax.jit(exported_decode_step, donate_argnums=(0, 1)),
-                argnums=(0, 1), site="ExportedStepDecoder.step",
+            fn = _jitcheck.make_donating(
+                jax.jit(exported_decode_step, donate_argnums=donate),
+                argnums=donate,
+                site="ExportedStepDecoder.step[%s,b%d]" % (kv, bucket),
                 always=True)
-        return self._step_call(pool_k, pool_v, bt, lens, stepv, last,
-                               key)
+            self._step_calls[key] = fn
+        return fn
+
+    def step(self, pool_k, pool_v, bt, lens, stepv, last, key):
+        """Legacy shorthand for the native max-bucket rung's
+        :meth:`step_call` — same donation contract."""
+        return self.step_call("native")(pool_k, pool_v, bt, lens,
+                                        stepv, last, key)
 
     def generate(self, tokens: np.ndarray, lens: np.ndarray,
                  seed: int = 0,
-                 max_new: Optional[int] = None) -> np.ndarray:
+                 max_new: Optional[int] = None,
+                 kv: str = "native") -> np.ndarray:
         """Sequential reference driver: decode ``tokens (n, S)`` /
         ``lens (n,)`` through prefill + per-token steps with a local
         block table, mirroring what the continuous engine does one
-        request at a time. Same output contract as
+        request at a time. ``kv`` picks the artifact rung (the int8
+        rung quantizes prompt K/V at scatter and new-token K/V in the
+        step, exactly as serving would). Same output contract as
         ``ExportedDecoder.__call__``."""
         import jax
         m = self.meta
         S, B = self.seq_len, self.batch
         nblk = self.blocks_per_seq
+        step_fn = self.step_call(kv)   # validates the rung up front
         toks = np.asarray(tokens, np.int32)
         lens = np.asarray(lens, np.int32)
         if toks.ndim != 2 or toks.shape[1] != S:
@@ -672,7 +853,7 @@ class ExportedStepDecoder:
             t = toks[lo:lo + rows_fit]
             l = lens[lo:lo + rows_fit]
             mrows = t.shape[0]
-            pool_k, pool_v = self.new_pool()
+            pools = self.new_pool(kv)
             bt = np.zeros((B, nblk), np.int32)       # 0 = trash page
             for r in range(mrows):
                 bt[r] = 1 + r * nblk + np.arange(nblk)
@@ -685,8 +866,8 @@ class ExportedStepDecoder:
                                  np.uint32)
                 first, k, v = self.prefill(t[r:r + 1], l[r:r + 1], key)
                 emitted[r, 0] = int(np.asarray(first)[0])
-                pool_k, pool_v = scatter_prefill_kv(
-                    pool_k, pool_v, k, v, [list(bt[r])], self.kv_block)
+                pools = scatter_prefill_kv(
+                    pools, k, v, [list(bt[r])], self.kv_block)
             blens = np.ones((B,), np.int32)
             blens[:mrows] = l
             T = self.step_tokens
@@ -697,8 +878,8 @@ class ExportedStepDecoder:
                 last[:mrows] = emitted[:, i]
                 key = np.asarray(jax.random.fold_in(base, 1 << 20 | i),
                                  np.uint32)
-                pool_k, pool_v, nxt = self.step(
-                    pool_k, pool_v, bt, blens, stepv, last, key)
+                out_t = step_fn(*pools, bt, blens, stepv, last, key)
+                pools, nxt = out_t[:-1], out_t[-1]
                 take = min(T, n_new - 1 - i)   # overshoot discarded
                 emitted[:, i + 1:i + 1 + take] = \
                     np.asarray(nxt)[:mrows, :take]
@@ -711,45 +892,71 @@ class ExportedStepDecoder:
 _SCATTER_CACHE: dict = {}
 
 
-def scatter_prefill_kv(pool_k, pool_v, k, v, block_tables,
-                       kv_block: int):
+def scatter_prefill_kv(pools, k, v, block_tables, kv_block: int):
     """Scatter prefill K/V ``(L, n, nh, W, d)`` into the paged pool at
     each row's block table (logical prompt slot ``j`` maps to page
-    ``bt[j // kv_block]`` offset ``j % kv_block``). One jitted scatter
-    with the pool arrays DONATED, so XLA updates the pool in place
-    (the caller must drop its old references — the returned
-    (pool_k, pool_v) replace them); without donation every prefill
-    would memcpy the whole pool twice."""
+    ``bt[j // kv_block]`` offset ``j % kv_block``). ``pools`` is the
+    rung's buffer tuple from ``ExportedStepDecoder.new_pool``: the
+    ``(pool_k, pool_v)`` pair for the native rung, or ``(pool_k,
+    pool_v, scale_k, scale_v)`` for int8 — in which case the prompt
+    K/V is QUANTIZED on the way in (``generate._quant8`` per (layer,
+    row, head, slot), the same scheme the step program writes new
+    tokens with). One jitted scatter with every pool array DONATED,
+    so XLA updates the pool in place (the caller must drop its old
+    references — the returned tuple replaces them); without donation
+    every prefill would memcpy the whole pool through a copy."""
     import jax
     bt = np.asarray(block_tables, np.int32)          # (n, nb)
     n = bt.shape[0]
     W = int(k.shape[3])
-    key = (W, n, tuple(pool_k.shape), str(pool_k.dtype))
+    quant = len(pools) == 4
+    key = (W, n, quant, tuple(pools[0].shape), str(pools[0].dtype))
     fn = _SCATTER_CACHE.get(key)
     if fn is None:
         from .analysis import jitcheck as _jitcheck
 
-        def _scat(pk, pv, kk, vv, b_idx, off):
-            kt = kk.transpose(1, 3, 0, 2, 4)         # (n, W, L, nh, d)
-            vt = vv.transpose(1, 3, 0, 2, 4)
-            pk = pk.at[b_idx, :, :, off, :].set(kt.astype(pk.dtype))
-            pv = pv.at[b_idx, :, :, off, :].set(vt.astype(pv.dtype))
-            return pk, pv
+        if quant:
+            from .generate import _quant8
+
+            def _scat(pk, pv, ks, vs, kk, vv, b_idx, off):
+                kq, ksn = _quant8(kk)
+                vq, vsn = _quant8(vv)
+                kt = kq.transpose(1, 3, 0, 2, 4)     # (n, W, L, nh, d)
+                vt = vq.transpose(1, 3, 0, 2, 4)
+                kst = ksn.transpose(1, 3, 0, 2)      # (n, W, L, nh)
+                vst = vsn.transpose(1, 3, 0, 2)
+                pk = pk.at[b_idx, :, :, off, :].set(kt)
+                pv = pv.at[b_idx, :, :, off, :].set(vt)
+                ks = ks.at[b_idx, :, :, off].set(kst)
+                vs = vs.at[b_idx, :, :, off].set(vst)
+                return pk, pv, ks, vs
+            donate = (0, 1, 2, 3)
+        else:
+            def _scat(pk, pv, kk, vv, b_idx, off):
+                kt = kk.transpose(1, 3, 0, 2, 4)     # (n, W, L, nh, d)
+                vt = vv.transpose(1, 3, 0, 2, 4)
+                pk = pk.at[b_idx, :, :, off, :].set(
+                    kt.astype(pk.dtype))
+                pv = pv.at[b_idx, :, :, off, :].set(
+                    vt.astype(pv.dtype))
+                return pk, pv
+            donate = (0, 1)
         # per-shape name: the recompile sentinel's per-program counts
         # stay attributable (one compile per (width, rows) is warmup;
         # a second of the SAME name is a real recompile)
-        _scat.__name__ = "scatter_prefill_w%d_n%d" % (W, n)
+        _scat.__name__ = "scatter_prefill%s_w%d_n%d" % (
+            "_q8" if quant else "", W, n)
         # always=True: the module-global cache outlives any one
         # jitcheck.enable() window
         fn = _jitcheck.make_donating(
-            jax.jit(_scat, donate_argnums=(0, 1)),
-            argnums=(0, 1), site="scatter_prefill_kv", always=True)
+            jax.jit(_scat, donate_argnums=donate),
+            argnums=donate, site="scatter_prefill_kv", always=True)
         _SCATTER_CACHE[key] = fn
     cols = np.arange(W)
     b_idx = bt[:, cols // kv_block].astype(np.int32)      # (n, W)
     off = np.ascontiguousarray(np.broadcast_to(
         cols % kv_block, (n, W))).astype(np.int32)
-    return fn(pool_k, pool_v, k, v, b_idx, off)
+    return fn(*pools, k, v, b_idx, off)
 
 
 def _load_exps(path: str, meta: Optional[dict]):

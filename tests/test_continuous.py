@@ -199,13 +199,165 @@ def test_step_export_validations(lm, tmp_path):
     with pytest.raises(ValueError, match="kv_block"):
         serving.export_decode_step(tr, str(tmp_path / "c"), max_new=4,
                                    prompt_len=8, kv_block=100)
+    # int8 routes to the fused rung now (r12); the loud rejection
+    # that remains is int8 x the gather attend — the recorded perf
+    # negative (XLA materializes the dequantized cache)
+    with pytest.raises(ValueError, match="fused"):
+        serving.export_decode_step(tr, str(tmp_path / "d"), max_new=4,
+                                   prompt_len=8, kv_dtypes=["int8"],
+                                   paged_attend="gather")
+    with pytest.raises(ValueError, match="kv_dtypes"):
+        serving.export_decode_step(tr, str(tmp_path / "e"), max_new=4,
+                                   prompt_len=8, kv_dtypes=["fp4"])
+    with pytest.raises(ValueError, match="step_buckets"):
+        serving.export_decode_step(tr, str(tmp_path / "f"), max_new=4,
+                                   prompt_len=8, step_buckets=[0])
+    with pytest.raises(ValueError, match="paged_attend"):
+        serving.export_decode_step(tr, str(tmp_path / "g"), max_new=4,
+                                   prompt_len=8, paged_attend="magic")
+
+
+def test_decode_kv_knob_routes_to_int8_rung(lm, tmp_path):
+    """The r10 'decode_kv=native only' rejection is gone: the trainer
+    knob now routes the export to the int8 rung by default."""
+    tr = lm["tr"]
     tr.set_param("decode_kv", "int8")
     try:
-        with pytest.raises(ValueError, match="native only"):
-            serving.export_decode_step(tr, str(tmp_path / "d"),
-                                       max_new=4, prompt_len=8)
+        p = str(tmp_path / "i8")
+        serving.export_decode_step(tr, p, max_new=4, prompt_len=8,
+                                   platforms=["cpu"])
     finally:
         tr.set_param("decode_kv", "native")
+    dec = serving.load_exported(p)
+    assert dec.kv_dtypes == ["int8"]
+    assert dec.meta["decode_kv"] == "int8"
+    assert dec.rung("int8")["attend_kernel"] == "fused-paged-q8"
+    with pytest.raises(ValueError, match="rung"):
+        dec.step_buckets("native")
+
+
+@pytest.fixture(scope="module")
+def rung_path(lm, tmp_path_factory):
+    """A typed-rung artifact from the same trained weights: both
+    kv_dtype rungs x step buckets [1, 2, 4]."""
+    p = str(tmp_path_factory.mktemp("rungs") / "rungs.export")
+    serving.export_decode_step(lm["tr"], p, max_new=6, temperature=0.0,
+                               prompt_len=8,
+                               kv_dtypes=["native", "int8"],
+                               step_buckets=[1, 2], platforms=["cpu"])
+    return p
+
+
+def test_step_export_rungs_meta(rung_path):
+    dec = serving.load_exported(rung_path)
+    m = dec.meta
+    assert m["paged_attend"] == "fused"
+    assert dec.kv_dtypes == ["native", "int8"]
+    assert dec.step_buckets("native") == [1, 2, 4]
+    assert dec.step_buckets("int8") == [1, 2, 4]
+    assert dec.pick_step_bucket(1) == 1
+    assert dec.pick_step_bucket(3, "int8") == 4
+    rn, r8 = dec.rung("native"), dec.rung("int8")
+    assert rn["attend_kernel"] == "fused-paged"
+    assert r8["attend_kernel"] == "fused-paged-q8"
+    # the capacity claim the docs' rung table makes: int8 pages hold
+    # ~2x the KV state per byte (f32 pool on this rig: d*4 vs d+4)
+    assert rn["kv_bytes_per_seq"] / r8["kv_bytes_per_seq"] >= 1.9
+    assert rn["kv_bytes_per_step"] / r8["kv_bytes_per_step"] >= 1.9
+    # int8 pools: int8 pages + f32 scale planes, ones-initialized
+    pools = dec.new_pool("int8")
+    assert len(pools) == 4
+    assert str(pools[0].dtype) == "int8"
+    assert str(pools[2].dtype) == "float32"
+    assert float(np.asarray(pools[2]).min()) == 1.0
+    # a pre-rung loader contract stays intact on the r10-style export
+    assert serving.load_exported(rung_path).batch == 4
+
+
+def test_step_bucket_rung_dispatch_and_parity(rung_path, lm):
+    """The engine dispatches each decode call at the smallest exported
+    bucket holding the live rows — and the sub-bucket programs emit
+    the SAME tokens the full-width program would (row independence),
+    so outputs stay bitwise against the monolithic reference."""
+    eng = ContinuousDecodeEngine(serving.load_exported(rung_path),
+                                 warmup=False)
+    try:
+        r1 = eng.submit_tokens(lm["toks"][:1], lm["lens"][:1])
+        np.testing.assert_array_equal(r1.result(30), lm["ref"][:1])
+        r4 = eng.submit_tokens(lm["toks"], lm["lens"])
+        np.testing.assert_array_equal(r4.result(30), lm["ref"])
+        m = eng.metrics()
+        assert m["kv_dtype"] == "native"
+        assert m["attend_kernel"] == "fused-paged"
+        bd = m["step_bucket_dispatches"]
+        assert bd.get(1, 0) >= 1, bd     # the single-row request ran
+                                         # the 1-slot rung
+        assert bd.get(4, 0) >= 1, bd     # the 4-row request ran full
+    finally:
+        eng.close()
+
+
+def test_int8_rung_engine_agreement(rung_path, lm):
+    """The int8 rung through the full engine path (quantizing scatter,
+    q8 step programs, scale planes riding the pool): greedy tokens on
+    the well-margined trained net agree with the exact reference at
+    the slot-layout int8 convention (>= 0.98 here; the committed
+    oracle run pins the rung at 1.0 agreement against the slot-layout
+    int8 path — docs/serving.md's rung table)."""
+    eng = ContinuousDecodeEngine(serving.load_exported(rung_path),
+                                 kv_dtype="int8", warmup=True)
+    try:
+        assert eng.kv_dtype == "int8"
+        assert eng.attend_kernel == "fused-paged-q8"
+        out = np.asarray(
+            eng.submit_tokens(lm["toks"], lm["lens"]).result(30))
+        agree = (out == lm["ref"]).mean()
+        assert agree >= 0.98, (agree, out, lm["ref"])
+        # prompts round-trip untouched regardless of quantization
+        for i in range(4):
+            n = int(lm["lens"][i])
+            np.testing.assert_array_equal(out[i, :n],
+                                          lm["toks"][i, :n])
+    finally:
+        eng.close()
+
+
+def test_int8_rung_driver_agreement(rung_path, lm):
+    """Same contract through the sequential reference driver
+    (generate(kv='int8')) — what tools/decode_quality.py --paged
+    --kv int8 measures on the Markov oracle."""
+    dec = serving.load_exported(rung_path)
+    out = dec.generate(lm["toks"], lm["lens"], kv="int8")
+    agree = (np.asarray(out) == lm["ref"]).mean()
+    assert agree >= 0.98, agree
+    # the native rung through the same rung-dispatch plumbing stays
+    # bitwise (the acceptance gate's other half)
+    np.testing.assert_array_equal(
+        dec.generate(lm["toks"], lm["lens"], kv="native"), lm["ref"])
+
+
+def test_engine_rejects_missing_rung(lm):
+    with pytest.raises(ValueError, match="rung"):
+        ContinuousDecodeEngine(serving.load_exported(lm["step_path"]),
+                               kv_dtype="int8", start=False)
+
+
+def test_pool_registry_peak_gauge():
+    """serve/kvpool.BlockPool.bind_registry: the high-water gauge
+    (cxxnet_kv_pages_peak) beside the live gauge — pool sizing
+    guidance is measured against the peak, not the instant."""
+    from cxxnet_tpu.obs.registry import Registry
+    reg = Registry()
+    p = BlockPool(8, 128)
+    hook = p.bind_registry(reg, {"kind": "decode"})
+    held = p.alloc(3)
+    p.free(held[:2])
+    assert reg.get_value("cxxnet_kv_pages_in_use", kind="decode") == 1
+    assert reg.get_value("cxxnet_kv_pages_peak", kind="decode") == 3
+    p.free(held[2:])
+    assert reg.get_value("cxxnet_kv_pages_in_use", kind="decode") == 0
+    assert reg.get_value("cxxnet_kv_pages_peak", kind="decode") == 3
+    reg.remove_hook(hook)
 
 
 def test_paged_reference_driver_bitwise_parity(lm):

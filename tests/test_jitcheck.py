@@ -354,8 +354,14 @@ def step_path(tmp_path_factory):
             .astype(np.float32).reshape(4, 1, 24, 1),
             label=seq[:, 1:].astype(np.float32)))
     p = str(tmp_path_factory.mktemp("jc") / "step.export")
+    # the FULL r12 rung surface (both kv_dtypes x sub-batch step
+    # buckets): the compile-free contract must hold per rung, and the
+    # program space this multiplies out is exactly what the warmup
+    # must cover
     serving.export_decode_step(tr, p, max_new=4, temperature=0.0,
-                               prompt_len=8, platforms=["cpu"])
+                               prompt_len=8,
+                               kv_dtypes=["native", "int8"],
+                               step_buckets=[1, 2], platforms=["cpu"])
     return p
 
 
@@ -392,3 +398,25 @@ def test_continuous_engine_steady_state_compile_free(step_path):
         if eng is not None:
             eng.close()
         jitcheck.disable()
+
+
+def test_decode_rung_gate_all_rungs_compile_free(step_path):
+    """tools/analysis_gate.check_decode_rungs — the CI-facing form of
+    the contract above, per RUNG: every exported kv_dtype rung serves
+    steady-state compile-free behind its own armed sentinel (the
+    --ledger row asserts this across the whole rung space)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    from analysis_gate import check_decode_rungs
+    res = check_decode_rungs(step_path)
+    assert res["ok"], res
+    kvs = {r["kv_dtype"] for r in res["rungs"]}
+    assert kvs == {"native", "int8"}, res
+    for r in res["rungs"]:
+        assert r["steady_state_compiles"] == 0, r
+        assert r["warmup_compiles"] > 0, r     # fresh load per rung:
+        assert r["donating_calls"] > 0, r      # the rung really ran
+        assert r["step_buckets"] == [1, 2, 4], r

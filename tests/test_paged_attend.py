@@ -1,0 +1,242 @@
+"""Fused paged decode-attend (ops/paged_attend.py): block-table edge
+cases, on CPU through the ``pallas_env`` interpret seam.
+
+The kernel family attends THROUGH the block table, so its failure
+modes are paging bugs, not math bugs — these tests pin exactly those:
+
+* non-contiguous page order agrees bitwise with the gather path (the
+  r10 materializing gather attend is the reference semantics);
+* the trash page (pool block 0) contributes zero weight wherever the
+  bias masks it — garbage in trash never leaks into an attend;
+* a partially-filled last page masks correctly (``attend_slots``
+  caps the width at Sl < nblk*bs: the alignment pad and multi-step
+  overshoot headroom never enter the softmax);
+* the q8 variants track the unquantized attend at the slot-layout
+  int8 error bound and validate their scale-plane shapes.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu.generate import _quant8
+from cxxnet_tpu.ops import paged_attend as pa
+from cxxnet_tpu.ops.decode_attend import NEG_INF
+
+B, NH, D, BS, NBLK, NB, L = 4, 2, 32, 128, 2, 11, 3
+SP, SL = NBLK * BS, 224        # Sl < Sp: partially-filled last page
+
+
+def _rig(seed=0, contiguous=False):
+    rs = np.random.RandomState(seed)
+    pk = jnp.asarray(rs.randn(NB, L, NH, BS, D).astype(np.float32))
+    pv = jnp.asarray(rs.randn(NB, L, NH, BS, D).astype(np.float32))
+    q = jnp.asarray(rs.randn(B, NH, D).astype(np.float32))
+    if contiguous:
+        bt = np.arange(1, 1 + B * NBLK, dtype=np.int32)
+        bt = bt.reshape(B, NBLK)
+    else:
+        bt = rs.permutation(np.arange(1, NB))[:B * NBLK] \
+            .reshape(B, NBLK).astype(np.int32)
+    lens = rs.randint(5, 190, size=(B,))
+    pos = np.arange(SP)[None, :]
+    keep = ((pos < lens[:, None])
+            | ((pos >= 192) & (pos <= 192 + rs.randint(0, 30)))) \
+        & (pos < SL)
+    bias = jnp.asarray(np.where(keep, 0.0, NEG_INF).astype(np.float32))
+    return pk, pv, q, jnp.asarray(bt), bias, keep
+
+
+def _gather_ref(q, pool_k, pool_v, bt, keep, li):
+    """The r10 gather path verbatim: gather + transpose + slice to Sl,
+    then the slot attend (generate.build_step's attend='gather')."""
+    k_c = pool_k[bt, li].transpose(0, 2, 1, 3, 4) \
+        .reshape(B, NH, SP, D)[:, :, :SL]
+    v_c = pool_v[bt, li].transpose(0, 2, 1, 3, 4) \
+        .reshape(B, NH, SP, D)[:, :, :SL]
+    s = jnp.einsum("bhd,bhkd->bhk", q, k_c,
+                   preferred_element_type=jnp.float32) * (D ** -0.5)
+    att = jax.nn.softmax(
+        jnp.where(jnp.asarray(keep[:, None, :SL]), s, NEG_INF), -1)
+    return jnp.einsum("bhk,bhkd->bhd", att.astype(jnp.float32), v_c)
+
+
+def test_xla_form_bitwise_matches_gather_path():
+    """The fallback (merged dots behind barriers) is bitwise-identical
+    to the gather attend — the invariant that keeps the fused-paged
+    native rung's greedy outputs bitwise-equal to the monolithic
+    decoder on every platform the suite runs on."""
+    pk, pv, q, bt, bias, keep = _rig()
+
+    def check_layer(li):
+        # layer is a static shape-affecting index: one trace per li,
+        # called exactly once each (first and last pool layer)
+        ref = np.asarray(jax.jit(
+            lambda a, b: _gather_ref(q, a, b, bt, keep, li))(pk, pv))
+        out = np.asarray(jax.jit(
+            lambda a, b: pa.paged_attend(
+                q, a, b, bt, bias, li, attend_slots=SL, impl="xla")
+        )(pk, pv))
+        np.testing.assert_array_equal(out, ref)
+
+    check_layer(0)
+    check_layer(L - 1)
+
+
+def test_pallas_noncontiguous_pages_bitwise_vs_gathered_pool():
+    """Page-order indirection is exact: the kernel on a shuffled block
+    table returns bitwise the same output as the kernel on a pool
+    whose pages were pre-gathered into contiguous order — the only
+    difference between the two runs is the table, so any diff is a
+    paging bug. Against the gather path (a different softmax
+    schedule) the kernel agrees to f32 reduction-order noise with
+    identical argmax."""
+    pk, pv, q, bt, bias, keep = _rig()
+    out = np.asarray(jax.jit(lambda a, b: pa.paged_attend(
+        q, a, b, bt, bias, 1, attend_slots=SL, impl="pallas",
+        interpret=True))(pk, pv))
+    # pre-gather the same pages into contiguous pool order
+    pk2 = np.zeros_like(np.asarray(pk))
+    pv2 = np.zeros_like(np.asarray(pv))
+    bt2 = np.arange(1, 1 + B * NBLK, dtype=np.int32).reshape(B, NBLK)
+    btn = np.asarray(bt)
+    for s in range(B):
+        for j in range(NBLK):
+            pk2[bt2[s, j]] = np.asarray(pk)[btn[s, j]]
+            pv2[bt2[s, j]] = np.asarray(pv)[btn[s, j]]
+    out2 = np.asarray(jax.jit(lambda a, b: pa.paged_attend(
+        q, a, b, jnp.asarray(bt2), bias, 1, attend_slots=SL,
+        impl="pallas", interpret=True))(jnp.asarray(pk2),
+                                        jnp.asarray(pv2)))
+    np.testing.assert_array_equal(out, out2)
+    ref = np.asarray(jax.jit(
+        lambda a, b: _gather_ref(q, a, b, bt, keep, 1))(pk, pv))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(out.argmax(-1), ref.argmax(-1))
+
+
+@pytest.mark.parametrize("impl", ["pallas", "xla"])
+def test_trash_page_contributes_zero_weight(impl):
+    """A block table pointing a masked region at the trash page (pool
+    block 0) must yield an output INDEPENDENT of the trash page's
+    contents: exp(bias + anything finite) underflows to exactly 0.0,
+    so two different garbage fills give bitwise-equal outputs."""
+    pk, pv, q, bt, bias, keep = _rig()
+    # every slot's SECOND page is the trash page, and the bias masks
+    # everything past the first page (short prompts, no decode region)
+    btn = np.asarray(bt).copy()
+    btn[:, 1] = 0
+    pos = np.arange(SP)[None, :]
+    keep2 = pos < 60                      # valid slots all in page 0
+    bias2 = jnp.asarray(np.broadcast_to(
+        np.where(keep2, 0.0, NEG_INF), (B, SP)).astype(np.float32))
+
+    def run(fill):
+        pk2 = np.asarray(pk).copy()
+        pv2 = np.asarray(pv).copy()
+        pk2[0] = fill
+        pv2[0] = -fill
+        return np.asarray(jax.jit(lambda a, b: pa.paged_attend(
+            q, a, b, jnp.asarray(btn), bias2, 0, attend_slots=SL,
+            impl=impl, interpret=True))(jnp.asarray(pk2),
+                                        jnp.asarray(pv2)))
+
+    np.testing.assert_array_equal(run(1e3), run(-7.0))
+
+
+@pytest.mark.parametrize("impl", ["pallas", "xla"])
+def test_partial_last_page_masks_correctly(impl):
+    """attend_slots = Sl < nblk*bs: positions in [Sl, Sp) — alignment
+    pad plus the step program's overshoot headroom — must not enter
+    the attend even when their pool slots hold (garbage) writes."""
+    pk, pv, q, bt, bias, keep = _rig(seed=3)
+    pkn = np.asarray(pk).copy()
+    pvn = np.asarray(pv).copy()
+    # poison every slot's [Sl, Sp) tail through its own block table
+    btn = np.asarray(bt)
+    for s in range(B):
+        pg = btn[s, (SL // BS)]
+        pkn[pg, :, :, SL % BS:, :] = 1e4
+        pvn[pg, :, :, SL % BS:, :] = -1e4
+    out = np.asarray(jax.jit(lambda a, b: pa.paged_attend(
+        q, a, b, bt, bias, 2, attend_slots=SL, impl=impl,
+        interpret=True))(jnp.asarray(pkn), jnp.asarray(pvn)))
+    ref = np.asarray(jax.jit(
+        lambda a, b: _gather_ref(q, a, b, bt, keep, 2))(
+            jnp.asarray(pkn), jnp.asarray(pvn)))
+    if impl == "xla":
+        np.testing.assert_array_equal(out, ref)
+    else:
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+    assert np.isfinite(out).all()
+
+
+def test_q8_tracks_unquantized_at_slot_layout_bound():
+    """The q8 kernels on a quantized pool track the exact attend at
+    the decode_attend_q8 error bound (~1% relative at d=32 absmax),
+    and the pallas/xla forms track each other."""
+    pk, pv, q, bt, bias, keep = _rig(seed=5)
+    kq, ks = _quant8(pk)
+    vq, vs = _quant8(pv)
+    exact = np.asarray(jax.jit(
+        lambda: _gather_ref(q, pk, pv, bt, keep, 1))())
+
+    def run_q8(impl):
+        # impl is a python-level branch: one trace per form, each
+        # called exactly once
+        return np.asarray(jax.jit(
+            lambda: pa.paged_attend_q8(
+                q, kq, vq, ks, vs, bt, bias, 1, attend_slots=SL,
+                impl=impl, interpret=True))())
+
+    outs = {"pallas": run_q8("pallas"), "xla": run_q8("xla")}
+    for impl in ("pallas", "xla"):
+        rel = (np.linalg.norm(outs[impl] - exact)
+               / np.linalg.norm(exact))
+        assert rel < 0.05, (impl, rel)
+    rel = (np.linalg.norm(outs["pallas"] - outs["xla"])
+           / np.linalg.norm(exact))
+    assert rel < 0.02, rel
+
+
+def test_q8_trash_page_zero_weight():
+    """The q8 path's trash-page invariance: scale planes of the trash
+    page are garbage too, and still must not leak."""
+    pk, pv, q, bt, bias, keep = _rig(seed=6)
+    kq, ks = _quant8(pk)
+    vq, vs = _quant8(pv)
+    btn = np.asarray(bt).copy()
+    btn[:, 1] = 0
+    pos = np.arange(SP)[None, :]
+    bias2 = jnp.asarray(np.broadcast_to(
+        np.where(pos < 50, 0.0, NEG_INF), (B, SP)).astype(np.float32))
+
+    def run(fill):
+        kq2 = np.asarray(kq).copy(); kq2[0] = fill
+        ks2 = np.asarray(ks).copy(); ks2[0] = abs(fill) + 1.0
+        return np.asarray(jax.jit(lambda: pa.paged_attend_q8(
+            q, jnp.asarray(kq2), vq, jnp.asarray(ks2), vs,
+            jnp.asarray(btn), bias2, 0, attend_slots=SL,
+            impl="pallas", interpret=True))())
+
+    np.testing.assert_array_equal(run(127), run(-3))
+
+
+def test_validation_surface():
+    pk, pv, q, bt, bias, keep = _rig()
+    with pytest.raises(ValueError, match="impl"):
+        pa.paged_attend(q, pk, pv, bt, bias, 0, impl="cuda")
+    with pytest.raises(ValueError, match="layer"):
+        pa.paged_attend(q, pk, pv, bt, bias, L, impl="xla")
+    with pytest.raises(ValueError, match="bias"):
+        pa.paged_attend(q, pk, pv, bt, bias[:, :SL], 0, impl="xla")
+    with pytest.raises(ValueError, match="attend_slots"):
+        pa.paged_attend(q, pk, pv, bt, bias, 0, attend_slots=SP + 1,
+                        impl="xla")
+    with pytest.raises(ValueError, match="scale planes"):
+        pa.paged_attend_q8(q, pk, pv, jnp.ones((NB, L, NH)),
+                           jnp.ones((NB, L, NH)), bt, bias, 0,
+                           impl="xla")
+    with pytest.raises(ValueError, match="block table"):
+        pa.paged_attend(q, pk, pv, bt[:2], bias, 0, impl="xla")

@@ -9,14 +9,28 @@ Usage:
                                                # scanned, per-rule and
                                                # per-family counts,
                                                # waiver/stale detail
+  python tools/analysis_gate.py --rungs        # + the DYNAMIC decode-
+                                               # rung gate: every
+                                               # kv_dtype rung of a
+                                               # split-phase artifact
+                                               # must run steady-state
+                                               # compile-free behind
+                                               # an armed jitcheck
+                                               # sentinel (warmup must
+                                               # cover every kv_dtype
+                                               # x bucket x rows
+                                               # combo)
   python tools/analysis_gate.py --ledger       # also record the gate
                                                # surface as a
                                                # net=analysis row in
                                                # docs/bench_history
                                                # .json (rule counts,
-                                               # waivers, files) so
-                                               # BENCH history tracks
-                                               # its growth
+                                               # waivers, files, and
+                                               # the rung gate —
+                                               # --ledger implies
+                                               # --rungs) so BENCH
+                                               # history tracks its
+                                               # growth
 
 The baseline lives at ``docs/analysis_waivers.txt``; one waiver per
 line::
@@ -118,6 +132,102 @@ def gate_summary(findings, unwaived, stale, waivers, files):
     }
 
 
+def _build_rung_artifact(td):
+    """A tiny trained LM exported as a FULL typed-rung split-phase
+    artifact (both kv_dtype rungs x sub-batch step buckets) — the
+    largest program surface one export can carry, which is exactly
+    what the rung gate must prove warm-coverable."""
+    import numpy as np
+
+    from cxxnet_tpu import config, models, serving
+    from cxxnet_tpu.io import DataBatch
+    from cxxnet_tpu.trainer import Trainer
+    tr = Trainer()
+    for k, v in config.parse_string(models.tiny_lm(
+            seq_len=24, vocab=16, embed=32, nlayer=1, nhead=2)):
+        tr.set_param(k, v)
+    for k, v in (("batch_size", "4"), ("dev", "cpu:0"), ("eta", "0.3"),
+                 ("seed", "0"), ("metric", "token_error")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    start = rs.randint(0, 16, size=(4, 1))
+    seq = (start + np.arange(25)) % 16
+    tr.update(DataBatch(
+        data=seq[:, :24].astype(np.float32).reshape(4, 1, 24, 1),
+        label=seq[:, 1:].astype(np.float32)))
+    path = os.path.join(td, "rungs.export")
+    serving.export_decode_step(tr, path, max_new=4, temperature=0.0,
+                               prompt_len=8,
+                               kv_dtypes=["native", "int8"],
+                               step_buckets=[1, 2], platforms=["cpu"])
+    return path
+
+
+def check_decode_rungs(step_path=None, traffic_rows=(1, 2)):
+    """Dynamic rung-coverage gate: for EVERY kv_dtype rung a
+    split-phase artifact exports, spin a warmed continuous engine
+    with the jitcheck recompile sentinel armed, replay traffic across
+    live-row counts, and demand ZERO steady-state compiles — the
+    exact bug class the r11 armed bench caught for prefill buckets,
+    multiplied by the r12 rung space (kv_dtype x step bucket x
+    rows-bucket: a combo the engine warmup misses is a guaranteed
+    scheduler-thread compile under load). With no ``step_path`` a
+    tiny two-rung artifact is built in a tempdir. Returns the
+    summary dict the --ledger row records; ``ok`` is the gate bit."""
+    import tempfile
+
+    import numpy as np
+
+    from cxxnet_tpu import serving
+    from cxxnet_tpu.analysis import jitcheck
+    from cxxnet_tpu.serve.continuous import ContinuousDecodeEngine
+
+    with tempfile.TemporaryDirectory() as td:
+        if step_path is None:
+            step_path = _build_rung_artifact(td)
+        with open(step_path + ".meta") as f:
+            meta = json.load(f)
+        rows = []
+        for kv in meta.get("kv_dtypes") or ["native"]:
+            # fresh load per rung: each rung's engine must compile its
+            # whole program surface inside its own warmup window
+            dec = serving.load_exported(step_path)
+            mon = jitcheck.enable()
+            eng = None
+            try:
+                eng = ContinuousDecodeEngine(dec, kv_dtype=kv,
+                                             warmup=True)
+                mon.arm()
+                S = dec.seq_len
+                for n in traffic_rows:
+                    n = max(1, min(int(n), dec.batch))
+                    toks = np.zeros((n, S), np.int32)
+                    toks[:, :2] = 1
+                    lens = np.full((n,), 2, np.int32)
+                    eng.submit_tokens(toks, lens).result(60)
+                steady = int(mon.steady_compiles)
+                rows.append({
+                    "kv_dtype": kv,
+                    "attend_kernel": eng.attend_kernel,
+                    "step_buckets": list(dec.step_buckets(kv)),
+                    "steady_state_compiles": steady,
+                    "warmup_compiles": int(mon.total_compiles) - steady,
+                    "donating_calls": int(mon.donating_calls),
+                    "violations": [repr(v) for v in mon.violations()]
+                    if steady else [],
+                })
+            finally:
+                if eng is not None:
+                    eng.close()
+                jitcheck.disable()
+    return {
+        "artifact_step_buckets": meta.get("step_buckets"),
+        "rungs": rows,
+        "ok": all(r["steady_state_compiles"] == 0 for r in rows),
+    }
+
+
 def record_ledger(summary):
     """Append the gate surface to the bench ledger (net=analysis,
     newest snapshot wins — the same convention as the net=obs rows):
@@ -139,9 +249,18 @@ def main(argv=None):
                          "just failures")
     ap.add_argument("--json", action="store_true",
                     help="print the result as one JSON line")
+    ap.add_argument("--rungs", action="store_true",
+                    help="also run the dynamic decode-rung gate: "
+                         "every exported kv_dtype rung must serve "
+                         "steady-state compile-free (jitcheck armed)")
+    ap.add_argument("--step-artifact", default=None,
+                    help="existing split-phase artifact for --rungs "
+                         "(default: build a tiny two-rung one)")
     ap.add_argument("--ledger", action="store_true",
                     help="record the gate surface as a net=analysis "
-                         "row in docs/bench_history.json")
+                         "row in docs/bench_history.json (implies "
+                         "--rungs: the row asserts zero steady-state "
+                         "compiles across ALL exported rungs)")
     ap.add_argument("--root", default=_ROOT)
     ap.add_argument("--waivers", default=None,
                     help="waiver file (default docs/analysis_waivers"
@@ -153,6 +272,22 @@ def main(argv=None):
     waived_n = len(findings) - len(unwaived)
     summary = gate_summary(findings, unwaived, stale, res.waivers,
                            res.files)
+    rungs_ok = True
+    if args.rungs or args.ledger:
+        rung_res = check_decode_rungs(args.step_artifact)
+        summary["decode_rungs"] = rung_res
+        rungs_ok = rung_res["ok"]
+        if not rungs_ok:
+            print("analysis_gate: DECODE RUNG GATE TRIPPED — "
+                  "steady-state compiles on an exported rung:",
+                  file=sys.stderr)
+            for r in rung_res["rungs"]:
+                if r["steady_state_compiles"]:
+                    print("  rung %s: %d compile(s)\n    %s"
+                          % (r["kv_dtype"],
+                             r["steady_state_compiles"],
+                             "\n    ".join(r["violations"])),
+                          file=sys.stderr)
     if args.ledger:
         record_ledger(summary)
     if args.json:
@@ -171,7 +306,15 @@ def main(argv=None):
         for k in stale:
             print("  STALE waiver (matches nothing, remove it): %s"
                   % k)
-    return 1 if unwaived else 0
+        if "decode_rungs" in summary:
+            print("decode rung gate: %s (%s)"
+                  % ("clean" if rungs_ok else "TRIPPED",
+                     ", ".join("%s=%d steady compiles"
+                               % (r["kv_dtype"],
+                                  r["steady_state_compiles"])
+                               for r in summary["decode_rungs"]
+                               ["rungs"])))
+    return 1 if (unwaived or not rungs_ok) else 0
 
 
 if __name__ == "__main__":

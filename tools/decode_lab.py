@@ -17,7 +17,18 @@ real D2H fence. One trainer per batch size (gpt2-class trainers are
 `decode_layout` knob on the same trainer so params/compile cache are
 shared.
 
+Layout names starting with ``paged`` measure the SERVING path's
+split-phase artifact instead of Trainer.generate — the kernel
+comparison then covers what the continuous engine actually runs
+(docs/serving.md rung table): ``paged-gather`` (the r10 materializing
+gather step), ``paged-fused`` (ops/paged_attend.py through the block
+table), ``paged-fused:int8`` (the quantized rung). These time the
+ExportedStepDecoder reference driver, so the same long-minus-short
+subtraction isolates the steady per-step cost.
+
 Usage: python tools/decode_lab.py [--batches 8,32,64] [--trials 5]
+       python tools/decode_lab.py \
+           --layouts slotk,paged-gather,paged-fused,paged-fused:int8
 """
 
 import argparse
@@ -105,6 +116,40 @@ def resident_fn(tr, toks, lens, max_new):
     return run
 
 
+def paged_runner(tr, lay, toks, lens, mn, cache):
+    """Runner for the paged serving-path variants: export the
+    split-phase artifact for the variant's (attend, kv) rung once per
+    (batch, layout), then time the ExportedStepDecoder reference
+    driver (host-fenced per call, like tr.generate)."""
+    import tempfile
+
+    from cxxnet_tpu import serving
+    dec = cache.get(lay)
+    if dec is None:
+        base, _, kv = lay.partition(":")
+        attend = "gather" if base.endswith("gather") else "fused"
+        # the TemporaryDirectory rides the cache so its finalizer
+        # removes the export (weights-sized per batch x layout) at
+        # process end instead of leaking it into /tmp
+        td = tempfile.TemporaryDirectory(prefix="declab_")
+        path = os.path.join(td.name, "step.export")
+        serving.export_decode_step(
+            tr, path, max_new=MAX_NEW, temperature=0.0,
+            prompt_len=PROMPT, kv_dtypes=[kv or "native"],
+            paged_attend=attend)
+        dec = serving.load_exported(path)
+        cache[lay] = dec
+        cache[lay + ":td"] = td
+    kv = lay.partition(":")[2] or "native"
+    dec.generate(toks, lens, max_new=mn, kv=kv)       # warm/compile
+
+    def run():
+        t0 = time.perf_counter()
+        dec.generate(toks, lens, max_new=mn, kv=kv)
+        return (time.perf_counter() - t0) * 1000.0
+    return run
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--batches", default="8,32,64")
@@ -136,7 +181,14 @@ def main():
         # a ":int8" suffix on a layout name (e.g. "slotk:int8") selects
         # the quantized KV cache for that variant
         runners = {}
+        paged_cache = {}
         for lay in layouts:
+            if lay.startswith("paged"):
+                # serving-path variant: exported split-phase artifact
+                for mn in (MAX_NEW, SHORT_NEW):
+                    runners[(lay, mn)] = paged_runner(
+                        tr, lay, toks, lens, mn, paged_cache)
+                continue
             base, _, kv = lay.partition(":")
             tr.set_param("decode_layout", base)
             tr.set_param("decode_kv", kv or "native")
@@ -154,6 +206,10 @@ def main():
             step_ms = (t_long - t_short) / (MAX_NEW - SHORT_NEW)
             row = {
                 "batch": batch, "layout": lay, "net": args.net,
+                "attend_kernel": (
+                    paged_cache[lay].rung(
+                        lay.partition(":")[2] or "native")
+                    ["attend_kernel"] if lay in paged_cache else None),
                 "prompt": PROMPT,
                 "max_new": MAX_NEW, "nlayer": args.nlayer,
                 "total_ms_best": round(t_long, 2),
@@ -167,6 +223,7 @@ def main():
             rows.append(row)
             print(json.dumps(row), flush=True)
         runners.clear()       # closures hold tr; drop before the del
+        paged_cache.clear()
         del tr
         gc.collect()
     print(json.dumps({"decode_lab": rows}))
