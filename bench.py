@@ -70,12 +70,16 @@ def _measure_h2d_gbps(n_mb: int = 64, trials: int = 3) -> float:
         _H2D_CACHE[n_mb] = (arr, red)
     arr, red = _H2D_CACHE[n_mb]
     best = 0.0
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        d = jax.device_put(arr)
-        float(np.asarray(red(d)))
-        dt = time.perf_counter() - t0
-        best = max(best, arr.nbytes / dt / 1e9)
+    # a measurement probe, not the measured train path: its fetches
+    # are sanctioned under the armed shardcheck sentinel
+    from cxxnet_tpu.analysis import shardcheck
+    with shardcheck.allow("h2d-probe"):
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            d = jax.device_put(arr)
+            float(np.asarray(red(d)))
+            dt = time.perf_counter() - t0
+            best = max(best, arr.nbytes / dt / 1e9)
     return best
 
 
@@ -163,15 +167,20 @@ def _measure_dispatch_floor_ms(iters: int = 12) -> float:
     import jax.numpy as jnp
     import numpy as np
 
-    f = jax.jit(lambda x: x + 1.0)
-    x = jax.device_put(jnp.zeros((8, 128), jnp.float32))
-    y = f(x)
-    float(np.asarray(y[0, 0]))                    # warm
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        y = f(y)
-    float(np.asarray(y[0, 0]))
-    return (time.perf_counter() - t0) / iters * 1000.0
+    # a dispatch-floor probe, not the measured train path: its eager
+    # scalar fetches (y[0, 0]) and zeros fill are sanctioned under
+    # the armed shardcheck sentinel
+    from cxxnet_tpu.analysis import shardcheck
+    with shardcheck.allow("dispatch-floor-probe"):
+        f = jax.jit(lambda x: x + 1.0)
+        x = jax.device_put(jnp.zeros((8, 128), jnp.float32))
+        y = f(x)
+        float(np.asarray(y[0, 0]))                # warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y = f(y)
+        float(np.asarray(y[0, 0]))
+        return (time.perf_counter() - t0) / iters * 1000.0
 
 
 def main() -> None:
@@ -210,6 +219,14 @@ def main() -> None:
         label=rs.randint(0, 1000, size=(BATCH, 1)).astype(np.float32),
         norm=(np.full((3, 1, 1), 120.0, np.float32), 1.0))
         for _ in range(4)]
+
+    # shardcheck sentinel on for the whole train bench (production
+    # posture, docs/analysis.md): armed after the prologue, every
+    # measured window must pay ZERO implicit host transfers and ZERO
+    # implicit reshards — data staging is explicit (stage/_put_fields)
+    # and every step's arguments carry their declared placements
+    from cxxnet_tpu.analysis import shardcheck
+    shard_mon = shardcheck.enable()
 
     def build_trainer():
         return ge._build_trainer(batch_size=BATCH, nclass=1000,
@@ -272,6 +289,7 @@ def main() -> None:
                              "%s\n" % e)
             time.sleep(10.0)
             tr = build_trainer()
+    shard_mon.arm()   # steady state: implicit transfers now disallowed
     # the floor probe runs once per trial, inside the same
     # resident+fused window; the MIN across trials is used for the
     # corrected MFU, so a contended-window probe can only UNDER-correct
@@ -368,6 +386,8 @@ def main() -> None:
     cores = os.cpu_count() or 1
     feed_projection = min(decode_ips * cores, pipeline) \
         if decode_ips else pipeline
+    shardcheck.disable()
+    shard_sentinel = _shard_gate(shard_mon, "train", armed=True)
     best_recorded = _update_history({
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "images_per_sec": round(best, 2),
@@ -427,6 +447,12 @@ def main() -> None:
                             "single-put probe cannot (measured 1.6 "
                             "in a contended window)",
         "dispatch_floor_ms": round(dispatch_floor_ms, 3),
+        "shard_sentinel": shard_sentinel,
+        "shard_note": "shardcheck armed after the prologue: every "
+                      "measured window ran with implicit host "
+                      "transfers disallowed and the step programs' "
+                      "input placements validated (0 required; a "
+                      "violation hard-fails before recording)",
         "best_recorded": best_recorded,
         "best_by_net": _ledger_summary(),
         "best_recorded_note": "best window across ALL recorded runs "
@@ -972,6 +998,24 @@ def _jit_gate(jit_mon, label: str, **extra) -> dict:
     return jit_mon.summary(donation_validator_on=True, **extra)
 
 
+def _shard_gate(shard_mon, label: str, **extra) -> dict:
+    """The sharding twin of :func:`_jit_gate` (docs/analysis.md):
+    armed steady state must pay ZERO implicit host transfers and ZERO
+    implicit reshards — a window that paid either is a regression and
+    must not be recorded. Returns the ``shard_sentinel`` summary dict
+    for the ledger entry."""
+    if shard_mon.steady_transfers_total or shard_mon.steady_reshards_total:
+        sys.stderr.write(
+            "bench %s: SHARD SENTINEL TRIPPED — %d implicit "
+            "transfer(s), %d implicit reshard(s); nothing "
+            "recorded:\n  %s\n"
+            % (label, shard_mon.steady_transfers_total,
+               shard_mon.steady_reshards_total,
+               "\n  ".join(map(repr, shard_mon.violations()))))
+        sys.exit(1)
+    return shard_mon.summary(**extra)
+
+
 def serve_main(args) -> None:
     """The serving fast-path benchmark (``python bench.py serve``).
 
@@ -1007,10 +1051,16 @@ def serve_main(args) -> None:
     # donation validation, docs/analysis.md) — same production-posture
     # argument, and the sentinel is ARMED after warmup: a single
     # steady-state compile in any window fails this bench hard.
-    from cxxnet_tpu.analysis import jitcheck
+    # r13: the shardcheck sentinel rides along — armed at the same
+    # moment, so every measured window also runs with implicit host
+    # transfers disallowed (dispatch stages inputs explicitly via
+    # serving.stage_host) and the exported programs registered for
+    # reshard attribution
+    from cxxnet_tpu.analysis import jitcheck, shardcheck
     rs = np.random.RandomState(0)
     data = rs.randn(SERVE_BATCH, 1, 1, SERVE_DIM).astype(np.float32)
     jit_mon = jitcheck.enable()
+    shard_mon = shardcheck.enable()
     try:
         with _flight_on() as flight, \
                 tempfile.TemporaryDirectory() as td:
@@ -1031,6 +1081,7 @@ def serve_main(args) -> None:
             for m in (fixed, ladder):
                 ServingEngine(m, start=False).warmup()
             jit_mon.arm()      # steady state: no compile from here on
+            shard_mon.arm()    # ... and no implicit transfer/reshard
 
             one = lambda i: 1
             mixed = lambda i: 1 + i % 4
@@ -1109,8 +1160,10 @@ def serve_main(args) -> None:
                 })
     finally:
         jitcheck.disable()
+        shardcheck.disable()
 
     sentinel = _jit_gate(jit_mon, "serve", armed=True)
+    shard_sentinel = _shard_gate(shard_mon, "serve", armed=True)
     entry = {
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "rows_per_sec": round(pipe_rps, 1),
@@ -1122,6 +1175,7 @@ def serve_main(args) -> None:
         "flight_recorder_on": True,
         "flight_events_recorded": flight.recorded,
         "recompile_sentinel": sentinel,
+        "shard_sentinel": shard_sentinel,
         "obs": best_obs,
     }
     best = _update_history(entry, net="serve", metric="rows_per_sec")
@@ -1180,6 +1234,12 @@ def serve_main(args) -> None:
                           "(and the donation validator); a run with "
                           "steady_state_compiles > 0 hard-fails "
                           "before recording anything",
+        "shard_sentinel": shard_sentinel,
+        "shard_note": "shardcheck armed with jitcheck: implicit host "
+                      "transfers disallowed in every measured window "
+                      "(dispatch stages inputs via serving.stage_host)"
+                      "; transfers or reshards > 0 hard-fail before "
+                      "recording anything",
         "offered_load_sweep": sweep,
         "best_recorded": best,
     }))
@@ -2062,9 +2122,15 @@ def scaling_main(args) -> None:
     nclass = 1000 if real else 16
     dtype = "bfloat16" if real else "float32"
     base_rate = None
+    # shardcheck armed per device count (the MULTICHIP train leg): a
+    # sharded mesh step that pays an implicit host transfer or reshard
+    # per iteration is exactly the silent scaling killer this bench
+    # exists to rule out — 0 required, hard-fail otherwise
+    from cxxnet_tpu.analysis import shardcheck
     for n in counts:
         gb = per_dev * n
         dev_str = "%s:%s" % (platform, ",".join(map(str, range(n))))
+        shard_mon = shardcheck.enable()
         tr = ge._build_trainer(batch_size=gb, nclass=nclass,
                                dev=dev_str, dtype=dtype,
                                input_shape=shape, eval_train=0)
@@ -2078,6 +2144,7 @@ def scaling_main(args) -> None:
         for i in range(max(2, args.trials // 2)):
             tr.update(staged[i % 2])
         np.asarray(tr._epoch_dev)
+        shard_mon.arm()
         best = 0.0
         for _ in range(args.trials):
             t0 = time.perf_counter()
@@ -2085,6 +2152,9 @@ def scaling_main(args) -> None:
                 tr.update(staged[i % 2])
             np.asarray(tr._epoch_dev)
             best = max(best, gb * args.iters / (time.perf_counter() - t0))
+        shardcheck.disable()
+        sentinel = _shard_gate(shard_mon, "scaling[%d]" % n,
+                               armed=True)
         if base_rate is None:
             base_rate = best
         params_bytes = sum(a.nbytes for a in jax.tree.leaves(tr.params))
@@ -2100,6 +2170,7 @@ def scaling_main(args) -> None:
             "speedup_baseline_devices": counts[0],
             "grad_allreduce_mbytes_per_step": round(
                 2 * (n - 1) / n * params_bytes / 1e6, 2),
+            "shard_sentinel": sentinel,
         }))
         del tr, staged
 

@@ -27,6 +27,13 @@ tier grows threads:
   naming the donating call site + argnum. Same creation-time seam
   discipline as lockcheck (``make_donating``, ``allow`` warmup
   regions).
+* :mod:`.shardcheck` — the runtime half of the SHARD rules: a
+  transfer sentinel on JAX's ``transfer_guard`` seam (armed steady
+  state disallows implicit host transfers;
+  ``cxxnet_implicit_transfers_total``) and a reshard validator
+  (``make_sharded``) that raises an attributed ``ReshardError`` the
+  moment a mesh program is called with an argument whose sharding
+  would force an implicit reshard. Same seam discipline again.
 * :func:`hot_path` — the marker the SYNC/JIT checkers key on. Zero
   runtime cost: it stamps an attribute and returns the function.
 
@@ -37,7 +44,7 @@ module import time.
 
 from __future__ import annotations
 
-from . import jitcheck, lockcheck  # noqa: F401  (the seam modules import)
+from . import jitcheck, lockcheck, shardcheck  # noqa: F401  (seams)
 
 _HOT_ATTR = "__cxxnet_hot_path__"
 

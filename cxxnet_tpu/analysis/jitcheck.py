@@ -96,6 +96,22 @@ def _iter_leaves(obj, depth: int = 0):
         yield obj
 
 
+def forward_introspection(wrapper, fn):
+    """Keep the jitted introspection surface reachable through a
+    validation wrapper: ``Trainer.step_cost_analysis`` and
+    ``tools/multichip_report`` call ``.lower(...)`` on the wrapped
+    step, and these entry points never execute the program, so
+    routing them straight to ``fn`` skips no validation. ONE list,
+    shared by every seam wrapper (``make_donating``,
+    ``shardcheck.make_sharded``, serving's staging wrapper) so a new
+    introspection attribute cannot drift between them."""
+    for attr in ("lower", "eval_shape", "trace"):
+        bound = getattr(fn, attr, None)
+        if bound is not None:
+            setattr(wrapper, attr, bound)
+    return wrapper
+
+
 class JitCheckError(RuntimeError):
     """Base for violations that cannot safely proceed."""
 
@@ -400,12 +416,4 @@ def make_donating(fn, argnums: Sequence[int], site: Optional[str] = None,
 
     wrapper.__name__ = "donating[%s]" % name
     wrapper.__wrapped__ = fn
-    # the jitted callable's introspection surface must survive the
-    # wrap: Trainer.step_cost_analysis and tools/multichip_report call
-    # self._train_step.lower(...) — these never execute the program,
-    # so routing them straight to fn skips no donation validation
-    for _attr in ("lower", "eval_shape", "trace"):
-        _bound = getattr(fn, _attr, None)
-        if _bound is not None:
-            setattr(wrapper, _attr, _bound)
-    return wrapper
+    return forward_introspection(wrapper, fn)

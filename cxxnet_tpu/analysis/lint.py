@@ -70,6 +70,38 @@ analysis/jitcheck.py)
     nothing rebinds the outputs — the caller is left holding dead
     buffers (the drop-aliasing-on-export bug class).
 
+**SHARD — SPMD sharding hygiene** (the static half of
+analysis/shardcheck.py)
+  The checker models mesh-in-scope like the lock model: a class that
+  assigns ``self.X = make_mesh(...)``/``Mesh(...)`` is mesh-aware, and
+  so is the body of a ``with Mesh(...):`` block; the axis-name
+  vocabulary is the ``parallel.py`` constants (``data``/``model``/
+  ``seq``/``pipe``) plus any axis tuple a ``Mesh(...)`` construction
+  in the same module declares:
+
+  * SHARD001 — a jit/pjit built (stored or returned) under a mesh
+    without explicit ``in_shardings``/``out_shardings``: XLA's
+    propagation then picks the placement, and a propagation change
+    silently reshards — mesh programs must declare both sides.
+    (An immediately-invoked ``jax.jit(f)(x)`` init one-shot is not
+    a cached program and is exempt.)
+  * SHARD002 — a ``PartitionSpec`` naming an axis absent from the
+    module's mesh vocabulary: the spec silently no-ops (jax treats an
+    unknown axis as an error only at use; a typo'd axis in a helper
+    replicates instead of sharding).
+  * SHARD003 — host materialization (``np.asarray``, ``.item()``,
+    ``jax.device_get``, ``.__array__()``) of a MESH-PROGRAM result
+    inside ``@hot_path`` code — the sharded twin of SYNC001: on a
+    sharded output this is a hidden all-gather plus a host copy.
+  * SHARD004 — a ``shard_map``/``pjit``-wrapped function containing a
+    host callback or Python-side branching on a traced parameter:
+    per-shard callbacks serialize the mesh, and ``if traced:`` is a
+    tracer error that only fires at run time.
+  * SHARD005 — ``device_put`` with no sharding/device argument in a
+    mesh-aware module: the array lands wherever the default device
+    points (implicit replication on first use) — the silent-placement
+    foot-gun mesh code must not ship.
+
 **OBS — observability conventions** (obs/registry.py, obs/trace.py)
   * OBS001 — a ``span(...)`` call that is not the context expression
     of a ``with`` (an unmanaged span never records its exit: the
@@ -1210,6 +1242,365 @@ class JitChecker(Checker):
 
 
 # ----------------------------------------------------------------------
+# SHARD
+
+MESH_FACTORY_NAMES = {"Mesh", "make_mesh"}
+# the parallel.py axis vocabulary: the names every mesh this codebase
+# constructs can carry (make_mesh axes). Only LITERAL axis strings are
+# checked — P(DATA_AXIS) through a constant is conservatively skipped,
+# like every dynamically-built name in this file
+MESH_AXIS_VOCAB = {"data", "model", "seq", "pipe"}
+SHARD_CALLBACK_LEAVES = {"pure_callback", "io_callback",
+                         "debug_callback", "callback"}
+
+
+def _has_mesh_factory(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            d = _call_name(sub)
+            if d is not None \
+                    and d.rsplit(".", 1)[-1] in MESH_FACTORY_NAMES:
+                return True
+    return False
+
+
+def _is_sharded_ctor(call: ast.Call) -> bool:
+    """A jit/pjit construction that declares its placements (either
+    side counts: pjit defaults the other to propagation from it)."""
+    return any(kw.arg in ("in_shardings", "out_shardings")
+               for kw in call.keywords)
+
+
+class ShardChecker(Checker):
+    name = "SHARD"
+
+    def __init__(self, extra_hot: Sequence[str] = ()) -> None:
+        self.extra_hot = set(extra_hot)
+
+    # -- module vocabulary --------------------------------------------
+    @staticmethod
+    def _axis_vocab(mod: Module) -> Set[str]:
+        """The axis names in scope for this module: the parallel.py
+        constants plus every literal axis tuple a ``Mesh(...)``
+        construction in the module declares (the second-mesh-in-class
+        near miss: its axes join the vocabulary too)."""
+        vocab = set(MESH_AXIS_VOCAB)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _call_name(node)
+            if d is None or d.rsplit(".", 1)[-1] != "Mesh":
+                continue
+            axes = node.args[1] if len(node.args) >= 2 else None
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    axes = kw.value
+            if axes is not None:
+                for sub in ast.walk(axes):
+                    if isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str):
+                        vocab.add(sub.value)
+        return vocab
+
+    @staticmethod
+    def _class_has_mesh(node: ast.ClassDef) -> bool:
+        """Mesh-in-scope, modeled like the lock model: some method
+        assigns ``self.X = make_mesh(...)`` / ``Mesh(...)``."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and sub.targets \
+                    and _self_attr(sub.targets[0]) is not None \
+                    and _has_mesh_factory(sub.value):
+                return True
+        return False
+
+    @staticmethod
+    def _mesh_prog_names(root, self_attrs: bool) -> Set[str]:
+        """Names (``self.X`` or local/module NAME) assigned from a
+        placement-declaring jit/pjit construction or a
+        ``shardcheck.make_sharded`` wrap — the callables whose results
+        SHARD003 tracks as mesh-program outputs."""
+        out: Set[str] = set()
+        for sub in ast.walk(root):
+            if not (isinstance(sub, ast.Assign) and sub.targets):
+                continue
+            sharded = False
+            for c in ast.walk(sub.value):
+                if not isinstance(c, ast.Call):
+                    continue
+                d = _call_name(c)
+                leaf = d.rsplit(".", 1)[-1] if d else None
+                if leaf == "make_sharded" \
+                        or (_is_jit_ctor(c) and _is_sharded_ctor(c)):
+                    sharded = True
+                    break
+            if not sharded:
+                continue
+            for tgt in _flat_targets(sub.targets):
+                name = _track(tgt)
+                if name is None:
+                    continue
+                if self_attrs == name.startswith("self."):
+                    out.add(name)
+        return out
+
+    # -- drive --------------------------------------------------------
+    def check(self, mod: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        vocab = self._axis_vocab(mod)
+        mesh_aware = _has_mesh_factory(mod.tree)
+        # treat leaf "P" as PartitionSpec only when the module actually
+        # deals in PartitionSpec (the import-alias convention); a
+        # foreign helper named P must not be mistaken for it
+        p_leaves = {"PartitionSpec"}
+        if "PartitionSpec" in mod.source:
+            p_leaves.add("P")
+        # calls that are immediately invoked: jit(f)(x) — the inner
+        # ctor is somebody's .func, not a stored program
+        invoked = {id(c.func) for c in ast.walk(mod.tree)
+                   if isinstance(c, ast.Call)}
+        module_progs = self._mesh_prog_names(mod.tree, self_attrs=False)
+
+        def qual_of(stack):
+            return ".".join(stack) if stack else "<module>"
+
+        # SHARD001: statements under a mesh scope (mesh-holding class
+        # or with-Mesh block)
+        def walk001(node, stack, in_mesh):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk001(child, stack + [child.name],
+                            in_mesh or self._class_has_mesh(child))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    walk001(child, stack + [child.name], in_mesh)
+                elif isinstance(child, (ast.With, ast.AsyncWith)):
+                    wm = in_mesh or any(
+                        _has_mesh_factory(i.context_expr)
+                        for i in child.items)
+                    walk001(child, stack, wm)
+                else:
+                    if in_mesh and isinstance(
+                            child,
+                            (ast.Assign, ast.AnnAssign, ast.Return)):
+                        self._check_bare_jit(mod, qual_of(stack),
+                                             child, invoked, findings)
+                    walk001(child, stack, in_mesh)
+
+        # SHARD002/SHARD005: every call, with its enclosing qualname
+        def walk_calls(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.ClassDef, ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    walk_calls(child, stack + [child.name])
+                    continue
+                if isinstance(child, ast.Call):
+                    self._pspec_call(mod, qual_of(stack), child,
+                                     vocab, p_leaves, findings)
+                    if mesh_aware:
+                        self._device_put_call(mod, qual_of(stack),
+                                              child, findings)
+                walk_calls(child, stack)
+
+        # SHARD003: hot-path functions, with class-scoped mesh programs
+        def walk_hot(node, stack, cls_progs):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk_hot(child, stack + [child.name],
+                             self._mesh_prog_names(child,
+                                                   self_attrs=True))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    qual = ".".join(stack + [child.name])
+                    if SyncChecker._is_hot(child) \
+                            or "%s::%s" % (mod.path, qual) \
+                            in self.extra_hot:
+                        self._check_hot_materialize(
+                            mod, qual, child, module_progs | cls_progs,
+                            findings)
+                    walk_hot(child, stack + [child.name], cls_progs)
+                else:
+                    walk_hot(child, stack, cls_progs)
+
+        walk001(mod.tree, [], False)
+        walk_calls(mod.tree, [])
+        walk_hot(mod.tree, [], set())
+        self._check_shard_map(mod, findings)
+        return findings
+
+    # -- SHARD001 -----------------------------------------------------
+    def _check_bare_jit(self, mod, qual, stmt, invoked, findings):
+        value = getattr(stmt, "value", None)
+        if value is None:
+            return
+        for sub in ast.walk(value):
+            if not (isinstance(sub, ast.Call) and _is_jit_ctor(sub)):
+                continue
+            if id(sub) in invoked:
+                continue    # jit(f)(x): a one-shot, not a program
+            if _is_sharded_ctor(sub):
+                continue
+            findings.append(Finding(
+                "SHARD001", mod.path, sub.lineno, qual,
+                "jit/pjit built under a mesh without in_shardings/"
+                "out_shardings — XLA propagation picks the placement "
+                "and a propagation change silently reshards"))
+
+    # -- SHARD002 -----------------------------------------------------
+    def _pspec_call(self, mod, qual, call, vocab, p_leaves, findings):
+        d = _call_name(call)
+        if d is None or d.rsplit(".", 1)[-1] not in p_leaves:
+            return
+        for arg in call.args:
+            if isinstance(arg, ast.Starred):
+                continue
+            # manual walk so a nested Call's own strings (P(pick("x")))
+            # are not mistaken for axis literals
+            stack = [arg]
+            while stack:
+                node = stack.pop()
+                if isinstance(node, ast.Call):
+                    continue      # strings inside a nested call are
+                                  # someone else's arguments
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str):
+                    if node.value not in vocab:
+                        findings.append(Finding(
+                            "SHARD002", mod.path, node.lineno, qual,
+                            "PartitionSpec axis %r is absent from "
+                            "every mesh this module constructs "
+                            "(vocabulary: %s) — the spec silently "
+                            "misplaces" % (node.value, sorted(vocab))))
+                    continue
+                stack.extend(ast.iter_child_nodes(node))
+
+    # -- SHARD003 -----------------------------------------------------
+    def _check_hot_materialize(self, mod, qual, fn, progs, findings):
+        if not progs:
+            return
+
+        def is_prog_call(node) -> bool:
+            return isinstance(node, ast.Call) \
+                and _track(node.func) in progs
+
+        tainted: Set[str] = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and sub.targets \
+                    and is_prog_call(sub.value):
+                for tgt in _flat_targets(sub.targets):
+                    name = _track(tgt)
+                    if name:
+                        tainted.add(name)
+
+        def reads_result(expr) -> bool:
+            for node in ast.walk(expr):
+                if is_prog_call(node):
+                    return True
+                name = _track(node)
+                if name is not None and name in tainted:
+                    return True
+            return False
+
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = _call_name(sub)
+            leaf = d.rsplit(".", 1)[-1] if d else None
+            hit = None
+            if d in ("np.asarray", "numpy.asarray", "np.array",
+                     "numpy.array", "jax.device_get", "device_get") \
+                    and sub.args and reads_result(sub.args[0]):
+                hit = d + "(...)"
+            elif leaf in ("item", "__array__") and not sub.args \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and reads_result(sub.func.value):
+                hit = ".%s()" % leaf
+            if hit:
+                findings.append(Finding(
+                    "SHARD003", mod.path, sub.lineno, qual,
+                    "%s materializes a mesh-program result in a hot "
+                    "path — on a sharded output this is a hidden "
+                    "all-gather plus a host copy" % hit))
+
+    # -- SHARD004 -----------------------------------------------------
+    def _check_shard_map(self, mod, findings):
+        wrapped: Set[str] = set()
+        lambdas: List[ast.Lambda] = []
+        for sub in ast.walk(mod.tree):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = _call_name(sub)
+            leaf = d.rsplit(".", 1)[-1] if d else None
+            if leaf not in ("shard_map", "pjit") or not sub.args:
+                continue
+            fn_arg = sub.args[0]
+            if isinstance(fn_arg, ast.Name):
+                wrapped.add(fn_arg.id)
+            elif isinstance(fn_arg, ast.Lambda):
+                lambdas.append(fn_arg)
+        if not wrapped and not lambdas:
+            return
+
+        def flag_body(qual, fn, params):
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    d = _call_name(sub)
+                    leaf = d.rsplit(".", 1)[-1] if d else None
+                    if leaf in SHARD_CALLBACK_LEAVES:
+                        findings.append(Finding(
+                            "SHARD004", mod.path, sub.lineno, qual,
+                            "host callback %s(...) inside a shard_map/"
+                            "pjit-wrapped function — every shard "
+                            "round-trips the host per call" % (d,)))
+                if isinstance(sub, (ast.If, ast.While)):
+                    reads = {n for n in (
+                        _track(x) for x in ast.walk(sub.test)
+                        if isinstance(getattr(x, "ctx", None),
+                                      ast.Load)) if n}
+                    hit = sorted(reads & params)
+                    if hit:
+                        findings.append(Finding(
+                            "SHARD004", mod.path, sub.lineno, qual,
+                            "Python branch on traced parameter %s "
+                            "inside a shard_map/pjit-wrapped function "
+                            "— a TracerBoolConversionError at run "
+                            "time; use lax.cond/where"
+                            % ", ".join(map(repr, hit))))
+
+        def visit(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    if child.name in wrapped:
+                        params = {a.arg for a in child.args.args
+                                  if a.arg != "self"}
+                        flag_body(".".join(stack + [child.name]),
+                                  child, params)
+                    visit(child, stack + [child.name])
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, stack + [child.name])
+                else:
+                    visit(child, stack)
+
+        visit(mod.tree, [])
+        for lam in lambdas:
+            params = {a.arg for a in lam.args.args}
+            flag_body("<lambda>", lam, params)
+
+    # -- SHARD005 -----------------------------------------------------
+    def _device_put_call(self, mod, qual, call, findings):
+        d = _call_name(call)
+        if d is None or d.rsplit(".", 1)[-1] != "device_put":
+            return
+        if len(call.args) >= 2 or call.keywords:
+            return        # explicit placement (or device=/src= kw)
+        findings.append(Finding(
+            "SHARD005", mod.path, call.lineno, qual,
+            "device_put without a sharding in a mesh-aware module — "
+            "the array lands on the default device and implicitly "
+            "replicates/reshards on first sharded use"))
+
+
+# ----------------------------------------------------------------------
 # OBS
 
 class ObsChecker(Checker):
@@ -1287,7 +1678,8 @@ def all_checkers(extra_hot: Sequence[str] = (),
                  extra_donating=DEFAULT_EXTRA_DONATING
                  ) -> List[Checker]:
     return [ConcChecker(), SyncChecker(extra_hot),
-            JitChecker(extra_hot, extra_donating), ObsChecker()]
+            JitChecker(extra_hot, extra_donating),
+            ShardChecker(extra_hot), ObsChecker()]
 
 
 def check_source(source: str, path: str = "<snippet>.py",

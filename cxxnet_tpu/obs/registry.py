@@ -555,3 +555,40 @@ def watch_jitcheck(monitor, registry: Optional[Registry] = None
         g_prog.set(len(mon.compiles))
 
     return reg.add_hook(pull)
+
+
+def watch_shardcheck(monitor, registry: Optional[Registry] = None
+                     ) -> Callable[[], None]:
+    """Publish an ``analysis.shardcheck.ShardMonitor``:
+    ``cxxnet_implicit_transfers_total`` (implicit host transfers in
+    armed steady state — must stay zero),
+    ``cxxnet_reshards_total`` (mesh-program calls whose argument
+    placement would force an implicit reshard, armed steady state —
+    must stay zero), and ``cxxnet_shard_programs`` (distinct programs
+    registered through the ``make_sharded`` seam).
+
+    Each scrape reads the ACTIVE monitor when one is enabled (falling
+    back to ``monitor``) — the same per-call resolution
+    ``watch_jitcheck`` uses, so cycling the sentinel does not freeze
+    the exported series on a defunct monitor."""
+    reg = registry or get_registry()
+    c_tr = reg.counter("cxxnet_implicit_transfers_total",
+                       "implicit host transfers observed while the "
+                       "shardcheck sentinel was armed — any nonzero "
+                       "value is a serving/training regression")
+    c_rs = reg.counter("cxxnet_reshards_total",
+                       "mesh-program calls whose argument sharding "
+                       "would force an implicit reshard (armed steady "
+                       "state) — any nonzero value is a regression")
+    g_prog = reg.gauge("cxxnet_shard_programs",
+                       "distinct programs registered through the "
+                       "shardcheck make_sharded seam")
+
+    def pull():
+        from cxxnet_tpu.analysis import shardcheck
+        mon = shardcheck.active() or monitor
+        c_tr.set_total(mon.steady_transfers_total)
+        c_rs.set_total(mon.steady_reshards_total)
+        g_prog.set(len(mon.programs))
+
+    return reg.add_hook(pull)

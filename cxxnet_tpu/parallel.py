@@ -120,19 +120,56 @@ def make_mesh(devices: Sequence[jax.Device],
     return Mesh(devs.reshape(shape), tuple(axes))
 
 
+def mesh_platform(mesh: Mesh) -> str:
+    """The platform string of the devices a mesh spans ('cpu'/'tpu'/
+    ...): the single source for "which backend does this mesh's program
+    target", deduplicating the ``mesh.devices.flat[0].platform`` chains
+    serving.py grew one export path at a time."""
+    return mesh.devices.flat[0].platform
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Batch axis sharded across the data axis of the mesh."""
     return NamedSharding(mesh, P(DATA_AXIS))
+
+
+_SEQ_FALLBACK_WARNED: set = set()
 
 
 def input_sharding(mesh: Mesh, shape: Tuple[int, ...]) -> NamedSharding:
     """Placement for the network's input node: batch over ``data``, and —
     when the mesh has a ``seq`` axis and the node is sequence-shaped
     (b, 1, s, e) with s divisible — the sequence dim over ``seq``, so
-    long-context activations never materialise unsharded."""
-    if SEQ_AXIS in mesh.shape and len(shape) == 4 and shape[1] == 1 \
-            and shape[2] % mesh.shape[SEQ_AXIS] == 0:
-        return NamedSharding(mesh, P(DATA_AXIS, None, SEQ_AXIS, None))
+    long-context activations never materialise unsharded.
+
+    When a ``seq`` axis EXISTS but the sequence length is not divisible
+    by its size, the sequence dim falls back to replication — a real
+    capacity loss on long-context runs that used to happen silently:
+    it is now counted in the registry
+    (``cxxnet_seq_shard_fallback_total``) and warned once per shape."""
+    if SEQ_AXIS in mesh.shape and len(shape) == 4 and shape[1] == 1:
+        if shape[2] % mesh.shape[SEQ_AXIS] == 0:
+            return NamedSharding(mesh,
+                                 P(DATA_AXIS, None, SEQ_AXIS, None))
+        # the silent-replication fallback, made loud exactly once per
+        # shape (the registry counter keeps the running total; the
+        # one-shot warning keeps a long training loop from spamming)
+        from .obs.registry import get_registry
+        get_registry().counter(
+            "cxxnet_seq_shard_fallback_total",
+            "sequence-shaped inputs whose seq dim fell back to "
+            "replication because the length does not divide the seq "
+            "mesh axis").inc()
+        key = (shape[2], int(mesh.shape[SEQ_AXIS]))
+        if key not in _SEQ_FALLBACK_WARNED:
+            _SEQ_FALLBACK_WARNED.add(key)
+            import warnings
+            warnings.warn(
+                "input_sharding: sequence length %d does not divide "
+                "the seq mesh axis (%d) — the sequence dim REPLICATES "
+                "instead of sharding; pad the sequence or resize the "
+                "mesh (counted in cxxnet_seq_shard_fallback_total)"
+                % key, stacklevel=2)
     return batch_sharding(mesh)
 
 

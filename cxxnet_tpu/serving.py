@@ -24,6 +24,45 @@ import numpy as np
 MAGIC = "cxxnet_tpu.export.v1"
 
 
+def stage_host(*arrays):
+    """Explicitly place host arrays on device before dispatching an
+    exported program; device-resident arguments pass through untouched.
+
+    Exported ``.call`` with a raw numpy argument pays an IMPLICIT
+    host->device transfer per dispatch — invisible in the profile,
+    disallowed under the armed shardcheck transfer sentinel
+    (docs/analysis.md). This helper is the one sanctioned staging
+    point the serving dispatch paths share; when artifacts start
+    carrying a mesh + input shardings (the sharded-export ROADMAP
+    item), this is the seam that will place rows directly into their
+    declared shards instead of the default device.
+
+    Seam discipline (the ``make_donating`` pattern): with no
+    shardcheck monitor enabled this is a single global read and the
+    arrays pass through UNTOUCHED — jax's inline numpy conversion at
+    dispatch is ~100us/call cheaper on the CPU backend than an
+    explicit ``device_put``, and with no guard armed the implicit
+    path is sanctioned. Monitored runs (the armed bench legs, the
+    sentinel tests) stage explicitly and so prove the steady state
+    clean."""
+    from .analysis import shardcheck as _shardcheck
+    if _shardcheck.active() is None:
+        return arrays
+    import jax
+    # ONE batched put for every host member (per-array puts each cost
+    # a dispatch round trip — the same lesson as trainer._put_batch);
+    # device-resident members pass through untouched
+    host_idx = [i for i, a in enumerate(arrays)
+                if isinstance(a, np.ndarray)]
+    if not host_idx:
+        return arrays
+    staged = jax.device_put(tuple(arrays[i] for i in host_idx))
+    out = list(arrays)
+    for i, s in zip(host_idx, staged):
+        out[i] = s
+    return tuple(out)
+
+
 def auto_ladder(batch: int) -> list:
     """The default shape-bucket ladder for ``batch``: powers of two
     1, 2, 4, ... capped by ``batch``, with ``batch`` itself as the top
@@ -109,7 +148,8 @@ def export_model(trainer, path: str,
         return values[net.out_node]
 
     if platforms is None:
-        platforms = [trainer.mesh.devices.flat[0].platform]
+        from .parallel import mesh_platform
+        platforms = [mesh_platform(trainer.mesh)]
     # one rung exported, serialized, and written at a time: holding
     # every rung's weights-baked-in blob at once would multiply peak
     # host memory by the ladder length
@@ -199,7 +239,8 @@ def export_generate(trainer, path: str, max_new: int = 32,
     if jax.process_index() != 0:
         return
     trainer._warn_moe_capacity(plan, "export_generate")
-    platform = trainer.mesh.devices.flat[0].platform
+    from .parallel import mesh_platform
+    platform = mesh_platform(trainer.mesh)
     if platforms is None:
         platforms = [platform]
     sizes, resolved = [], []
@@ -460,7 +501,8 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
     Ltot = sum(int(params[si]["wqkv"].shape[0])
                for si in plan["stacks"])
     pool_dt = jnp.dtype(net.compute_dtype)
-    platform = trainer.mesh.devices.flat[0].platform
+    from .parallel import mesh_platform
+    platform = mesh_platform(trainer.mesh)
     if platforms is None:
         platforms = [platform]
     SDS = jax.ShapeDtypeStruct
@@ -724,19 +766,26 @@ class ExportedStepDecoder:
         the rung's pool contract: every step/scatter call takes and
         returns exactly these buffers, donated."""
         import jax.numpy as jnp
+
+        from .analysis import shardcheck as _shardcheck
         shape = (self.pool_blocks, int(self.meta["layers"]),
                  int(self.meta["nhead"]), self.kv_block,
                  int(self.meta["head_dim"]))
-        if kv == "int8":
-            # scale planes start at 1.0: a zero scale would be safe
-            # (q=0 contributes nothing) but 1.0 keeps every unwritten
-            # slot trivially readable — the slot-layout convention
-            return (jnp.zeros(shape, jnp.int8),
-                    jnp.zeros(shape, jnp.int8),
-                    jnp.ones(shape[:4], jnp.float32),
-                    jnp.ones(shape[:4], jnp.float32))
-        dt = jnp.dtype(self.meta["pool_dtype"])
-        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+        # pool allocation is a deliberate device-buffer creation step
+        # (the eager zeros/ones fills upload their scalar constants),
+        # sanctioned under the armed transfer sentinel
+        with _shardcheck.allow("pool-alloc"):
+            if kv == "int8":
+                # scale planes start at 1.0: a zero scale would be
+                # safe (q=0 contributes nothing) but 1.0 keeps every
+                # unwritten slot trivially readable — the slot-layout
+                # convention
+                return (jnp.zeros(shape, jnp.int8),
+                        jnp.zeros(shape, jnp.int8),
+                        jnp.ones(shape[:4], jnp.float32),
+                        jnp.ones(shape[:4], jnp.float32))
+            dt = jnp.dtype(self.meta["pool_dtype"])
+            return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
     def prefill(self, tokens: np.ndarray, lens: np.ndarray, key):
         """Run the smallest (rows, width) prefill bucket holding
@@ -755,7 +804,8 @@ class ExportedStepDecoder:
         toks[:n] = tokens[:, :w]
         ls = np.ones((r,), np.int32)
         ls[:n] = lens
-        first, k, v = self._pre[(r, w)].call(toks, ls, key)
+        first, k, v = self._pre[(r, w)].call(
+            *stage_host(toks, ls, key))
         return first[:n], k[:, :n], v[:, :n]
 
     def step_call(self, kv: str = "native", bucket: int = None):
@@ -780,6 +830,7 @@ class ExportedStepDecoder:
             import jax
 
             from .analysis import jitcheck as _jitcheck
+            from .analysis import shardcheck as _shardcheck
             exp = self._step.get(key)
             if exp is None:
                 raise ValueError(
@@ -795,13 +846,31 @@ class ExportedStepDecoder:
             # per-program counts stay attributable per rung
             exported_decode_step.__name__ = \
                 "exported_decode_step_%s_b%d" % (kv, bucket)
+            site = "ExportedStepDecoder.step[%s,b%d]" % (kv, bucket)
             # always=True: this wrapper is cached for the decoder's
             # lifetime, which may start before jitcheck.enable()
-            fn = _jitcheck.make_donating(
+            inner = _jitcheck.make_donating(
                 jax.jit(exported_decode_step, donate_argnums=donate),
-                argnums=donate,
-                site="ExportedStepDecoder.step[%s,b%d]" % (kv, bucket),
-                always=True)
+                argnums=donate, site=site, always=True)
+            # sharding seam (docs/analysis.md): the meta carries no
+            # in_shardings yet (single-device artifact), so this
+            # registers the program and attributes transfer-guard
+            # trips; a mesh-carrying artifact's shardings validate
+            # here for free the day export writes them
+            inner = _shardcheck.make_sharded(
+                inner, in_shardings=self.meta.get("in_shardings"),
+                site=site, always=True)
+
+            def fn(*a, _inner=inner):
+                # per-call control arrays (block table, lens, step,
+                # last, key) arrive as host numpy: stage them
+                # explicitly so armed steady state pays no implicit
+                # transfer (the pool buffers pass through untouched)
+                return _inner(*stage_host(*a))
+
+            fn.__name__ = "staged[%s]" % site
+            fn.__wrapped__ = inner
+            _jitcheck.forward_introspection(fn, inner)
             self._step_calls[key] = fn
         return fn
 
@@ -846,7 +915,9 @@ class ExportedStepDecoder:
         if not 1 <= n_new <= self.max_new:
             raise ValueError("max_new must be in [1, %d], got %d"
                              % (self.max_new, n_new))
-        base = jax.random.PRNGKey(int(seed))
+        from .analysis import shardcheck as _shardcheck
+        with _shardcheck.allow("prng-seed"):
+            base = jax.random.PRNGKey(int(seed))
         out = np.array(toks, copy=True)
         rows_fit = min(B, (self.pool_blocks - 1) // nblk)
         for lo in range(0, n, rows_fit):
@@ -862,8 +933,9 @@ class ExportedStepDecoder:
             # change values — one row at a time keeps this driver
             # trivially correct for mixed prompt lengths
             for r in range(mrows):
-                key = np.asarray(jax.random.fold_in(base, lo + r),
-                                 np.uint32)
+                with _shardcheck.allow("prng-seed"):
+                    key = np.asarray(jax.random.fold_in(base, lo + r),
+                                     np.uint32)
                 first, k, v = self.prefill(t[r:r + 1], l[r:r + 1], key)
                 emitted[r, 0] = int(np.asarray(first)[0])
                 pools = scatter_prefill_kv(
@@ -876,8 +948,10 @@ class ExportedStepDecoder:
                 stepv = np.full((B,), i, np.int32)
                 last = np.zeros((B,), np.int32)
                 last[:mrows] = emitted[:, i]
-                key = np.asarray(jax.random.fold_in(base, 1 << 20 | i),
-                                 np.uint32)
+                with _shardcheck.allow("prng-seed"):
+                    key = np.asarray(
+                        jax.random.fold_in(base, 1 << 20 | i),
+                        np.uint32)
                 out_t = step_fn(*pools, bt, blens, stepv, last, key)
                 pools, nxt = out_t[:-1], out_t[-1]
                 take = min(T, n_new - 1 - i)   # overshoot discarded
@@ -947,16 +1021,38 @@ def scatter_prefill_kv(pools, k, v, block_tables, kv_block: int):
         _scat.__name__ = "scatter_prefill%s_w%d_n%d" % (
             "_q8" if quant else "", W, n)
         # always=True: the module-global cache outlives any one
-        # jitcheck.enable() window
+        # jitcheck/shardcheck enable() window
+        from .analysis import shardcheck as _shardcheck
         fn = _jitcheck.make_donating(
             jax.jit(_scat, donate_argnums=donate),
             argnums=donate, site="scatter_prefill_kv", always=True)
+        fn = _shardcheck.make_sharded(fn, site="scatter_prefill_kv",
+                                      always=True)
         _SCATTER_CACHE[key] = fn
     cols = np.arange(W)
     b_idx = bt[:, cols // kv_block].astype(np.int32)      # (n, W)
     off = np.ascontiguousarray(np.broadcast_to(
         cols % kv_block, (n, W))).astype(np.int32)
-    return fn(*pools, k, v, b_idx, off)
+    return fn(*pools, k, v, *stage_host(b_idx, off))
+
+
+def _sharded_bucket_call(exps, meta, calls, b: int, site: str):
+    """The bucket program of a loaded artifact behind the shardcheck
+    seam, built lazily and cached in ``calls`` (one wrapper per
+    bucket for the artifact's lifetime, hence ``always=True``):
+    registers the program for transfer/reshard attribution, and a
+    mesh-carrying artifact's ``in_shardings`` meta validates here for
+    free the day sharded export writes it (docs/analysis.md). Shared
+    by ExportedModel and ExportedDecoder so the seam cannot drift
+    between them."""
+    fn = calls.get(b)
+    if fn is None:
+        from .analysis import shardcheck as _shardcheck
+        fn = _shardcheck.make_sharded(
+            exps[b].call, in_shardings=(meta or {}).get("in_shardings"),
+            site=site, always=True)
+        calls[b] = fn
+    return fn
 
 
 def _load_exps(path: str, meta: Optional[dict]):
@@ -1014,6 +1110,7 @@ class ExportedDecoder:
                 self._exps = {int(meta["batch"]):
                               jexport.deserialize(f.read())}
         self.meta = meta
+        self._calls: dict = {}
 
     @property
     def batch(self) -> int:
@@ -1027,17 +1124,23 @@ class ExportedDecoder:
     def buckets(self) -> list:
         return sorted(self._exps)
 
+    def _bucket_call(self, b: int):
+        return _sharded_bucket_call(self._exps, self.meta, self._calls,
+                                    b, "ExportedDecoder.call[b%d]" % b)
+
     def call_exact(self, tokens: np.ndarray, lens: np.ndarray, key):
         """Run the bucket matching ``tokens.shape[0]`` exactly — no
         pad, no trim, and no host sync: returns the device array of
         JAX's async dispatch (``np.asarray`` it to block). The serving
-        engine's pipelined dispatch lives on this."""
+        engine's pipelined dispatch lives on this. Host inputs are
+        staged explicitly (``stage_host``) so armed steady state pays
+        no implicit transfer."""
         b = tokens.shape[0]
         if b not in self._exps:
             raise ValueError(
                 "no exported bucket of %d rows (ladder: %s)"
                 % (b, self.buckets))
-        return self._exps[b].call(tokens, lens, key)
+        return self._bucket_call(b)(*stage_host(tokens, lens, key))
 
     def __call__(self, tokens: np.ndarray, lens: np.ndarray,
                  seed: int = 0) -> np.ndarray:
@@ -1062,7 +1165,20 @@ class ExportedDecoder:
             # would silently corrupt its output
             raise ValueError(
                 "lens must be (%d,) with every prompt >= 1 token" % n)
-        base = jax.random.PRNGKey(seed)
+        from .analysis import shardcheck as _shardcheck
+        with _shardcheck.allow("prng-seed"):
+            # distinct key per chunk past the first: reusing one key
+            # would make rows i and B+i (same slot, same key) sample
+            # identically at temperature>0; chunk 0 keeps the base key
+            # so n <= B calls through the B-bucket match
+            # tr.generate(seed) byte-exact (on a ladder artifact a
+            # short call runs a smaller rung, whose sampled stream
+            # differs at temperature>0 — see the class docstring).
+            # Seed-material upload is sanctioned (allow window)
+            base = jax.random.PRNGKey(seed)
+            keys = [np.asarray(
+                base if lo == 0 else jax.random.fold_in(base, lo // B),
+                np.uint32) for lo in range(0, n, B)]
         outs = []
         for lo in range(0, n, B):
             t, l = toks[lo:lo + B], lens[lo:lo + B]
@@ -1071,17 +1187,8 @@ class ExportedDecoder:
                 pad = b - t.shape[0]
                 t = np.concatenate([t, np.zeros((pad, S), np.int32)])
                 l = np.concatenate([l, np.ones((pad,), np.int32)])
-            # distinct key per chunk past the first: reusing one key
-            # would make rows i and B+i (same slot, same key) sample
-            # identically at temperature>0; chunk 0 keeps the base key
-            # so n <= B calls through the B-bucket match
-            # tr.generate(seed) byte-exact (on a ladder artifact a
-            # short call runs a smaller rung, whose sampled stream
-            # differs at temperature>0 — see the class docstring)
-            key = np.asarray(
-                base if lo == 0 else jax.random.fold_in(base, lo // B),
-                np.uint32)
-            outs.append(np.asarray(self._exps[b].call(t, l, key)))
+            outs.append(np.asarray(self._bucket_call(b)(
+                *stage_host(t, l, keys[lo // B]))))
         out = outs[0] if len(outs) == 1 else np.concatenate(outs)
         return out[:n]
 
@@ -1127,6 +1234,11 @@ class ExportedModel:
             self._exp = exp
         else:
             self._exp = self._exps[max(self._exps)]
+        self._calls: dict = {}
+
+    def _bucket_call(self, b: int):
+        return _sharded_bucket_call(self._exps, self.meta, self._calls,
+                                    b, "ExportedModel.call[b%d]" % b)
 
     @property
     def batch(self) -> Optional[int]:
@@ -1142,22 +1254,27 @@ class ExportedModel:
         """Run the bucket matching ``data.shape[0]`` exactly — no pad,
         no trim, no host sync: returns JAX's async-dispatch device
         array (``np.asarray`` it to block). The serving engine's
-        pipelined dispatch lives on this."""
+        pipelined dispatch lives on this. Host inputs are staged
+        explicitly (``stage_host``) so armed steady state pays no
+        implicit transfer."""
         if not self._exps:    # bare blob: the one program shape-checks
-            return self._exp.call(data)
+            return self._exp.call(*stage_host(data))
         b = data.shape[0]
         if b not in self._exps:
             raise ValueError(
                 "no exported bucket of %d rows (ladder: %s)"
                 % (b, sorted(self._exps)))
-        return self._exps[b].call(data)
+        return self._bucket_call(b)(*stage_host(data))
 
     def __call__(self, data: np.ndarray) -> np.ndarray:
         dt = np.dtype((self.meta or {}).get("input_dtype", "float32"))
         arr = np.asarray(data, dt)
         shape = (self.meta or {}).get("input_shape")
         if shape is None or arr.shape == tuple(shape):
-            return np.asarray(self._exp.call(arr))
+            if self._exps:          # the max bucket, behind the seam
+                return np.asarray(self._bucket_call(max(self._exps))(
+                    *stage_host(arr)))
+            return np.asarray(self._exp.call(*stage_host(arr)))
         B = int(shape[0])
         buckets = sorted(self._exps)
         item = tuple(shape[1:])
@@ -1175,7 +1292,8 @@ class ExportedModel:
             if chunk.shape[0] < b:
                 pad = np.zeros((b - chunk.shape[0],) + item, dt)
                 chunk = np.concatenate([chunk, pad])
-            outs.append(np.asarray(self._exps[b].call(chunk)))
+            outs.append(np.asarray(
+                self._bucket_call(b)(*stage_host(chunk))))
         out = outs[0] if len(outs) == 1 else np.concatenate(outs)
         return out[:n]
 

@@ -599,36 +599,53 @@ class Trainer:
         # (docs/analysis.md): disabled (the default) make_donating
         # returns the jitted callable untouched; under the monitor a
         # donated-then-reused buffer raises an immediate DonationError
-        # naming this site instead of jax's deferred buffer-deleted
+        # naming this site instead of jax's deferred buffer-deleted.
+        # every step ALSO goes through the shardcheck reshard seam
+        # with the same in_shardings handed to jax.jit: armed, a
+        # caller whose argument placement would force an implicit
+        # reshard gets an attributed ReshardError instead of a silent
+        # per-step all-gather
         from .analysis import jitcheck as _jitcheck
-        self._train_step = _jitcheck.make_donating(jax.jit(
-            train_step, donate_argnums=(0, 1, 2, 3, 4) + don_data,
-            in_shardings=(psh, osh, rep, rep, rep, xsh, dsh, dsh),
-            out_shardings=(psh, osh, rep, rep, rep, None)),
-            argnums=(0, 1, 2, 3, 4) + don_data,
-            site="Trainer._train_step")
+        from .analysis import shardcheck as _shardcheck
+        in_train = (psh, osh, rep, rep, rep, xsh, dsh, dsh)
+        self._train_step = _shardcheck.make_sharded(
+            _jitcheck.make_donating(jax.jit(
+                train_step, donate_argnums=(0, 1, 2, 3, 4) + don_data,
+                in_shardings=in_train,
+                out_shardings=(psh, osh, rep, rep, rep, None)),
+                argnums=(0, 1, 2, 3, 4) + don_data,
+                site="Trainer._train_step"),
+            in_shardings=in_train, site="Trainer._train_step")
         # state writes fold back into self.params host-side, so their
         # output shardings must match the params' declared placement
         ssh = {(li, tag): psh[li][tag]
                for li, mod in enumerate(net.modules)
                for tag in getattr(mod, "state_tags", ())
                if psh[li] and tag in psh[li]}
-        self._accum_step = _jitcheck.make_donating(jax.jit(
-            accum_step, donate_argnums=(0, 1, 2) + don_data,
-            in_shardings=(gsh, rep, rep, psh, rep, xsh, dsh, dsh),
-            out_shardings=(gsh, rep, rep, None, ssh)),
-            argnums=(0, 1, 2) + don_data,
-            site="Trainer._accum_step")
-        self._eval_step = _jitcheck.make_donating(jax.jit(
-            eval_step, donate_argnums=(1,),
-            in_shardings=(psh, rep, xsh, dsh, dsh, dsh),
-            out_shardings=rep),
-            argnums=(1,), site="Trainer._eval_step")
-        self._apply_accum = _jitcheck.make_donating(jax.jit(
-            apply_accum, donate_argnums=(0, 1, 2, 3),
-            in_shardings=(psh, osh, gsh, rep),
-            out_shardings=(psh, osh, gsh, rep)),
-            argnums=(0, 1, 2, 3), site="Trainer._apply_accum")
+        in_accum = (gsh, rep, rep, psh, rep, xsh, dsh, dsh)
+        self._accum_step = _shardcheck.make_sharded(
+            _jitcheck.make_donating(jax.jit(
+                accum_step, donate_argnums=(0, 1, 2) + don_data,
+                in_shardings=in_accum,
+                out_shardings=(gsh, rep, rep, None, ssh)),
+                argnums=(0, 1, 2) + don_data,
+                site="Trainer._accum_step"),
+            in_shardings=in_accum, site="Trainer._accum_step")
+        in_eval = (psh, rep, xsh, dsh, dsh, dsh)
+        self._eval_step = _shardcheck.make_sharded(
+            _jitcheck.make_donating(jax.jit(
+                eval_step, donate_argnums=(1,),
+                in_shardings=in_eval, out_shardings=rep),
+                argnums=(1,), site="Trainer._eval_step"),
+            in_shardings=in_eval, site="Trainer._eval_step")
+        in_apply = (psh, osh, gsh, rep)
+        self._apply_accum = _shardcheck.make_sharded(
+            _jitcheck.make_donating(jax.jit(
+                apply_accum, donate_argnums=(0, 1, 2, 3),
+                in_shardings=in_apply,
+                out_shardings=(psh, osh, gsh, rep)),
+                argnums=(0, 1, 2, 3), site="Trainer._apply_accum"),
+            in_shardings=in_apply, site="Trainer._apply_accum")
         self._forward = jax.jit(
             forward_step, in_shardings=(psh, xsh, dsh),
             static_argnums=(3,))
@@ -727,13 +744,16 @@ class Trainer:
             # may legally be dispatched again (bench cycles a fixed
             # staged set); donate_inputs=1 (the single-dispatch
             # device-prefetch feed) hands the group's HBM to XLA
-            self._train_multi = _jitcheck.make_donating(jax.jit(
-                train_multi, donate_argnums=(0, 1, 2, 3, 4) + don_data,
-                in_shardings=(psh, osh, rep, rep, rep, xsh_s, dsh_s,
-                              dsh_s),
-                out_shardings=(psh, osh, rep, rep, rep, None)),
-                argnums=(0, 1, 2, 3, 4) + don_data,
-                site="Trainer._train_multi")
+            in_multi = (psh, osh, rep, rep, rep, xsh_s, dsh_s, dsh_s)
+            self._train_multi = _shardcheck.make_sharded(
+                _jitcheck.make_donating(jax.jit(
+                    train_multi,
+                    donate_argnums=(0, 1, 2, 3, 4) + don_data,
+                    in_shardings=in_multi,
+                    out_shardings=(psh, osh, rep, rep, rep, None)),
+                    argnums=(0, 1, 2, 3, 4) + don_data,
+                    site="Trainer._train_multi"),
+                in_shardings=in_multi, site="Trainer._train_multi")
 
             def eval_multi(params, eaccum, data_s, extras_s, labels_s,
                            mask_s):
@@ -752,11 +772,13 @@ class Trainer:
                                       self.fuse_steps)))
                 return eaccum
 
-            self._eval_multi = _jitcheck.make_donating(jax.jit(
-                eval_multi, donate_argnums=(1,),
-                in_shardings=(psh, rep, xsh_s, dsh_s, dsh_s, dsh_s),
-                out_shardings=rep),
-                argnums=(1,), site="Trainer._eval_multi")
+            in_emulti = (psh, rep, xsh_s, dsh_s, dsh_s, dsh_s)
+            self._eval_multi = _shardcheck.make_sharded(
+                _jitcheck.make_donating(jax.jit(
+                    eval_multi, donate_argnums=(1,),
+                    in_shardings=in_emulti, out_shardings=rep),
+                    argnums=(1,), site="Trainer._eval_multi"),
+                in_shardings=in_emulti, site="Trainer._eval_multi")
 
             def forward_multi(params, data_s, extras_s, node_ids):
                 # the prediction stream fused the same way: one

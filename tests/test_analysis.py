@@ -536,6 +536,184 @@ def test_jit_seam_wrapper_seen_through():
 
 
 # ----------------------------------------------------------------------
+# SHARD: SPMD sharding hygiene
+
+
+def test_shard001_bare_jit_under_mesh_and_annotated_clean():
+    bad = """
+    import jax
+    from cxxnet_tpu import parallel
+    class T:
+        def __init__(self, devs):
+            self.mesh = parallel.make_mesh(devs)
+            self._step = jax.jit(lambda p, x: p + x)
+    """
+    out = findings(bad)
+    assert [f.rule for f in out] == ["SHARD001"]
+    assert out[0].func == "T.__init__"
+    # near miss 1: the same construction fully annotated
+    ok = bad.replace(
+        "jax.jit(lambda p, x: p + x)",
+        "jax.jit(lambda p, x: p + x, in_shardings=(psh, xsh), "
+        "out_shardings=psh)")
+    assert rules(ok) == []
+    # near miss 2: no mesh anywhere in the class — plain jit is legal
+    ok2 = """
+    import jax
+    class T:
+        def __init__(self):
+            self._step = jax.jit(lambda p, x: p + x)
+    """
+    assert rules(ok2) == []
+    # near miss 3: an immediately-invoked init one-shot (the
+    # Trainer.init_model shape) is not a cached program
+    ok3 = bad.replace("self._step = jax.jit(lambda p, x: p + x)",
+                      "params = jax.jit(init)(rng)")
+    assert rules(ok3) == []
+
+
+def test_shard001_with_mesh_block():
+    bad = """
+    import jax
+    from jax.sharding import Mesh
+    def build(devs, fn):
+        with Mesh(devs, ("data",)):
+            g = jax.jit(fn)
+        return g
+    """
+    out = findings(bad)
+    assert [f.rule for f in out] == ["SHARD001"]
+    assert out[0].func == "build"
+    ok = bad.replace("jax.jit(fn)",
+                     "jax.jit(fn, in_shardings=None, "
+                     "out_shardings=None)")
+    assert rules(ok) == []
+
+
+def test_shard002_partitionspec_axis_vocabulary():
+    bad = """
+    from jax.sharding import PartitionSpec as P
+    def spec():
+        return P("batch", None)
+    """
+    out = findings(bad)
+    assert [f.rule for f in out] == ["SHARD002"]
+    assert "'batch'" in out[0].msg
+    # the parallel.py vocabulary (literals and constants) is clean
+    ok = """
+    from jax.sharding import PartitionSpec as P
+    from cxxnet_tpu.parallel import DATA_AXIS, SEQ_AXIS
+    def spec():
+        return P(DATA_AXIS, None, SEQ_AXIS, None), P("model", "pipe")
+    """
+    assert rules(ok) == []
+    # near miss: the axis is declared on a SECOND mesh in the same
+    # class — its axis tuple joins the module vocabulary
+    ok2 = """
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    class T:
+        def __init__(self, devs):
+            self.mesh = Mesh(np.asarray(devs), ("data",))
+            self.grid = Mesh(np.asarray(devs).reshape(2, 2),
+                             ("rows", "cols"))
+        def spec(self):
+            return P("rows", "cols")
+    """
+    assert rules(ok2) == []
+
+
+def test_shard003_hot_materialize_of_mesh_program_result():
+    bad = """
+    import jax, numpy as np
+    from cxxnet_tpu.analysis import hot_path
+    class T:
+        def __init__(self, fn, xsh):
+            self.mesh = jax.sharding.Mesh(jax.devices(), ("data",))
+            self._step = jax.jit(fn, in_shardings=(xsh,),
+                                 out_shardings=xsh)
+        @hot_path
+        def hot(self, x):
+            out = self._step(x)
+            return np.asarray(out)
+    """
+    out = [f for f in findings(bad) if f.rule == "SHARD003"]
+    assert len(out) == 1 and out[0].func == "T.hot"
+    assert "all-gather" in out[0].msg
+    # near miss 1: the result stays on device — async dispatch intact
+    ok = bad.replace("return np.asarray(out)", "return out")
+    assert [f.rule for f in findings(ok)
+            if f.rule.startswith("SHARD")] == []
+    # near miss 2: same materialize in a COLD function is SYNC's
+    # domain at most, not SHARD's
+    ok2 = bad.replace("@hot_path\n        def hot", "def cold",
+                      1).replace("@hot_path", "")
+    assert [f.rule for f in findings(ok2)
+            if f.rule.startswith("SHARD")] == []
+
+
+def test_shard004_shard_map_callback_and_traced_branch():
+    bad = """
+    from jax.experimental.shard_map import shard_map
+    import jax
+    def body(x):
+        if x > 0:
+            x = x + 1
+        jax.debug.callback(print, x)
+        return x
+    def build(mesh, spec):
+        return shard_map(body, mesh=mesh, in_specs=(spec,),
+                         out_specs=spec)
+    """
+    out = [f for f in findings(bad) if f.rule == "SHARD004"]
+    assert len(out) == 2 and all(f.func == "body" for f in out)
+    msgs = " ".join(f.msg for f in out)
+    assert "host callback" in msgs and "traced parameter" in msgs
+    # near miss: collectives + host-side config branching are the
+    # legal shard_map body shape (ops/ring_attention.py)
+    ok = """
+    from jax.experimental.shard_map import shard_map
+    import jax
+    def body(x, causal=False):
+        y = jax.lax.psum(x, "seq")
+        return y
+    def helper(x):
+        if x > 0:          # NOT shard_map-wrapped: plain host code
+            return x
+        return -x
+    def build(mesh, spec):
+        return shard_map(body, mesh=mesh, in_specs=(spec,),
+                         out_specs=spec)
+    """
+    assert rules(ok) == []
+
+
+def test_shard005_device_put_in_mesh_aware_module():
+    bad = """
+    import jax
+    from cxxnet_tpu import parallel
+    def stage(devs, x):
+        mesh = parallel.make_mesh(devs)
+        return jax.device_put(x)
+    """
+    out = findings(bad)
+    assert [f.rule for f in out] == ["SHARD005"]
+    assert out[0].func == "stage"
+    # near miss 1: explicit sharding
+    ok = bad.replace("jax.device_put(x)",
+                     "jax.device_put(x, parallel.batch_sharding(mesh))")
+    assert rules(ok) == []
+    # near miss 2: the same bare put in a module that never
+    # constructs a mesh (the serving/export modules) is legal
+    ok2 = """
+    import jax
+    def stage(x):
+        return jax.device_put(x)
+    """
+    assert rules(ok2) == []
+
+
+# ----------------------------------------------------------------------
 # OBS: span + metric conventions
 
 
@@ -648,6 +826,10 @@ def test_tree_gate_is_clean():
     # the donating/ctor model is wired in, not silently skipping)
     assert any(f.rule.startswith("JIT") for f in findings_all), \
         "no JIT findings at all — did the JIT checker detach?"
+    # the SHARD family sees the tree (the waived trainer fast paths
+    # prove the mesh model is wired in, not silently skipping)
+    assert any(f.rule.startswith("SHARD") for f in findings_all), \
+        "no SHARD findings at all — did the SHARD checker detach?"
     # tests/ is part of the gated surface (r10)
     assert any(f.path.startswith("tests/") for f in findings_all), \
         "tests/ no longer scanned — gate surface shrank"
@@ -664,8 +846,9 @@ def test_gate_json_summary_shape():
     assert s["waived"] == len(findings_all)       # the tree is clean
     assert s["waivers"] == len(waivers)
     assert sum(s["rules"].values()) == s["findings"]
-    assert set(s["families"]) <= {"CONC", "SYNC", "JIT", "OBS",
-                                  "PARSE"}
+    assert set(s["families"]) <= {"CONC", "SYNC", "JIT", "SHARD",
+                                  "OBS", "PARSE"}
+    assert "SHARD" in s["families"]       # the r13 family is counted
     assert sum(s["families"].values()) == s["findings"]
 
 
@@ -680,6 +863,9 @@ def test_ledger_carries_analysis_row():
     assert row["waivers"] >= 1 and not row["stale_waivers"]
     assert sum(row["rules"].values()) == row["findings"]
     assert "JIT" in row["families"]
+    # the committed row carries the SHARD family's counts (r13): the
+    # ledger pins that the gate surface grew with the new checker
+    assert "SHARD" in row["families"]
 
 
 # ----------------------------------------------------------------------

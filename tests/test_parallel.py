@@ -129,6 +129,71 @@ def test_model_parallel_must_divide_devices():
         make_trainer(model_parallel=3)
 
 
+def test_mesh_platform():
+    """parallel.mesh_platform: the single source for a mesh's target
+    backend (dedupes the serving.py platform chains)."""
+    assert parallel.mesh_platform(
+        parallel.make_mesh(jax.devices()[:4])) == "cpu"
+    assert parallel.mesh_platform(
+        parallel.make_mesh(jax.devices()[:8], model_parallel=2)) \
+        == "cpu"
+    # and the trainer's mesh agrees with its configured device
+    tr = make_trainer()
+    assert parallel.mesh_platform(tr.mesh) == "cpu"
+
+
+def test_input_sharding_seq_divisible_shards_sequence():
+    mesh = parallel.make_mesh(jax.devices()[:4], seq_parallel=2)
+    sh = parallel.input_sharding(mesh, (8, 1, 16, 32))
+    assert sh.spec == parallel.P(parallel.DATA_AXIS, None,
+                                 parallel.SEQ_AXIS, None)
+
+
+def test_input_sharding_seq_fallback_counts_and_warns_once():
+    """The indivisible-seq fallback is no longer silent: it counts in
+    the registry (cxxnet_seq_shard_fallback_total) and warns exactly
+    once per (length, axis) shape."""
+    from cxxnet_tpu.obs.registry import get_registry
+    reg = get_registry()
+    mesh = parallel.make_mesh(jax.devices()[:4], seq_parallel=2)
+
+    def count():
+        return reg.get_value("cxxnet_seq_shard_fallback_total") or 0.0
+
+    before = count()
+    with pytest.warns(UserWarning, match="REPLICATES"):
+        sh = parallel.input_sharding(mesh, (8, 1, 17, 32))
+    assert sh.spec == parallel.P(parallel.DATA_AXIS)   # batch-only
+    assert count() == before + 1
+    # second occurrence of the SAME shape: counted again, no new warn
+    import warnings as _warnings
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        sh = parallel.input_sharding(mesh, (8, 1, 17, 32))
+    assert count() == before + 2
+
+
+def test_input_sharding_fallback_only_for_seq_shaped_nodes():
+    """Non-sequence-shaped nodes and seq-free meshes replicate the
+    sequence dim legitimately — no count, no warning."""
+    from cxxnet_tpu.obs.registry import get_registry
+    reg = get_registry()
+
+    def count():
+        return reg.get_value("cxxnet_seq_shard_fallback_total") or 0.0
+
+    before = count()
+    seq_mesh = parallel.make_mesh(jax.devices()[:4], seq_parallel=2)
+    # (b, c>1, h, w): an image node, not a sequence node
+    sh = parallel.input_sharding(seq_mesh, (8, 3, 17, 32))
+    assert sh.spec == parallel.P(parallel.DATA_AXIS)
+    # no seq axis on the mesh at all
+    flat = parallel.make_mesh(jax.devices()[:4])
+    sh = parallel.input_sharding(flat, (8, 1, 17, 32))
+    assert sh.spec == parallel.P(parallel.DATA_AXIS)
+    assert count() == before
+
+
 def test_collective_report_parses_partitioned_hlo():
     """collective_report: per-axis wire bytes from a compiled sharded
     program (the r4 quantitative multichip evidence path)."""

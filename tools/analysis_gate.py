@@ -1,5 +1,9 @@
-"""Run the analysis lint checkers over the tree against the committed
-waiver baseline — the standing CI gate (docs/analysis.md).
+"""Run the analysis lint checkers (CONC/SYNC/JIT/SHARD/OBS) over the
+tree against the committed waiver baseline — the standing CI gate
+(docs/analysis.md). ``--json``/``--ledger`` report per-rule AND
+per-family counts, so the net=analysis ledger row tracks each
+family's surface (the SHARD family landed in r13 alongside the
+runtime shardcheck sentinel).
 
 Usage:
   python tools/analysis_gate.py                # gate: exit 1 if dirty

@@ -29,16 +29,20 @@ force_host_cpu(8)
 import jax  # noqa: E402
 
 from cxxnet_tpu import models, parallel  # noqa: E402
+from cxxnet_tpu.analysis import shardcheck  # noqa: E402
 from cxxnet_tpu.io import DataBatch  # noqa: E402
 from tools.perf_lab import build as _pl_build  # noqa: E402
 
 
 def build(text, batch, **overrides):
     """perf_lab.build (the shared trainer-bootstrap path: defaults,
-    retries) forced onto the virtual CPU mesh at the given dtype."""
+    retries) forced onto the virtual CPU mesh at the given dtype —
+    inside a shardcheck warmup window (trainer init/staging is
+    sanctioned; the ANALYSIS runs armed)."""
     ov = [("dev", "cpu"), ("eval_train", "0")]
     ov += [(k, str(v)) for k, v in overrides.items()]
-    return _pl_build(ov, text, nclass=0, batch=batch)
+    with shardcheck.allow("build"):
+        return _pl_build(ov, text, nclass=0, batch=batch)
 
 
 def analyze(name, tr, batch, image=None, lm=None, note="",
@@ -60,6 +64,8 @@ def analyze(name, tr, batch, image=None, lm=None, note="",
             data=rs.rand(batch, *image).astype(np.float32),
             label=rs.randint(0, 16, (batch, 1)).astype(np.float32))
     tr._maybe_set_norm(b)
+    # runs ARMED: _put_batch places the global batch explicitly under
+    # its declared shardings (an implicit transfer here would raise)
     data, extras, labels = tr._put_batch(b)
     specs = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
@@ -77,6 +83,13 @@ def analyze(name, tr, batch, image=None, lm=None, note="",
 
 
 def main():
+    # the whole report runs under the ARMED shardcheck sentinel
+    # (docs/analysis.md): trainer builds are sanctioned warmup
+    # windows; everything else — batch placement, the step lowering —
+    # must pay zero implicit host transfers and zero reshards, and a
+    # violation fails the tool before it writes anything
+    mon = shardcheck.enable()
+    mon.arm()
     rows = []
     # weak-scaling basis: the REAL single-chip recipes' per-device
     # batch (AlexNet 256/chip, GPT-2-small 32/chip), and the measured
@@ -155,6 +168,17 @@ def main():
              "all-to-all — docs/parallel.md; nlayer=2 of 12"))
     del tr
 
+    shardcheck.disable()
+    sentinel = mon.summary(armed=True)
+    if sentinel["steady_state_transfers"] or \
+            sentinel["steady_state_reshards"]:
+        sys.stderr.write(
+            "multichip_report: SHARD SENTINEL TRIPPED — %d implicit "
+            "transfer(s), %d reshard(s); nothing written:\n  %s\n"
+            % (sentinel["steady_state_transfers"],
+               sentinel["steady_state_reshards"],
+               "\n  ".join(map(repr, mon.violations()))))
+        sys.exit(1)
     out = {
         "generated": "round 5",
         "method": "collectives parsed from the GSPMD-partitioned HLO "
@@ -164,6 +188,8 @@ def main():
                   "compute (model_flops @ measured-class MFU) vs wire "
                   "(bytes @ v5e ICI roofline), no-overlap/full-overlap "
                   "bracket",
+        "shardcheck": dict(sentinel, implicit_transfers=int(
+            sentinel["steady_state_transfers"])),
         "configs": rows,
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
