@@ -316,6 +316,10 @@ class LearnTask:
                             # continuous batching (serve/continuous.py)
                             "serve_stream", "serve_prefill_split",
                             "serve_kv_blocks", "serve_kv_dtype",
+                            # cross-request prefix cache
+                            # (serve/prefixcache.py)
+                            "serve_prefix_cache",
+                            "serve_prefix_capacity_pages",
                             # multi-replica front end (serve/router.py)
                             "serve_replicas", "serve_max_retries",
                             "serve_priority_default", "serve_swap",
@@ -931,7 +935,13 @@ class LearnTask:
         KV state per pool byte, docs/serving.md rung table),
         serve_kv_blocks (default 0 = the whole
         exported pool; fewer pages = admission control without a
-        re-export).
+        re-export), serve_prefix_cache (default 1 = on when the
+        artifact carries tail-prefill programs: cross-request
+        copy-on-write KV page sharing keyed by a token-prefix trie,
+        serve/prefixcache.py — a prompt extending a cached prefix
+        skips straight to incremental tail prefill; 0 = off),
+        serve_prefix_capacity_pages (trie page budget; default 0 =
+        half the usable pool).
 
         serve_replicas = N (default 1) runs the resilient multi-
         replica topology instead: N supervised ServingEngine replicas
@@ -1032,6 +1042,10 @@ class LearnTask:
                     kv_blocks=int(d.get("serve_kv_blocks", "0")),
                     kv_dtype=d.get("serve_kv_dtype",
                                    "auto").strip() or "auto",
+                    prefix_cache="auto" if int(
+                        d.get("serve_prefix_cache", "1")) else False,
+                    prefix_capacity_pages=int(
+                        d.get("serve_prefix_capacity_pages", "0")),
                     slo_ms=slo_ms or None,
                     warmup=bool(int(d.get("serve_warmup", "1"))),
                     registry=get_registry())

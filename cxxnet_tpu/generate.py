@@ -361,6 +361,146 @@ def build_prefill(net, p, temperature: float, B: int, W: int,
     return jax.jit(prefill)
 
 
+def build_tail_prefill(net, p, temperature: float, B: int, W: int,
+                       block: int, ctx_blocks: int,
+                       platform: str = "cpu", kv: str = "native"):
+    """Build the jitted INCREMENTAL (tail) prefill for the prefix
+    cache (serve/prefixcache.py): a request whose prompt extends a
+    cached prefix recomputes only the uncached TAIL, attending over
+    the prefix K/V already sitting in the paged pool:
+
+        (params, pools..., toks (B, W) int32, clens (B,) int32,
+         lens (B,) int32, bt (B, nblk) int32, rng)
+            -> (first (B,) int32, k (Ltot, B, nh, W, d), v (same))
+
+    ``toks`` holds each row's tail tokens (absolute prompt positions
+    ``[clens, lens)``, zero-padded to the ``W`` width bucket);
+    ``clens`` the cached-prefix length (a ``block`` multiple — the
+    trie shares at page granularity); ``bt`` the row's FULL block
+    table, whose first ``ctx_blocks`` pages cover the prompt region.
+    Per layer the prefix K/V is gathered from those pages (the
+    gather-attend indexing from ``build_step``), the tail's fresh K/V
+    joins it at its true positions, and the tail queries attend over
+    the combined ``ctx_blocks * block``-slot context with the exact
+    causal mask (key position <= query position). Pool buffers are
+    READ-ONLY here (not donated) — the caller scatters the returned
+    tail K/V into the row's own pages afterwards
+    (``scatter_prefill_kv(..., starts=clens)``), so shared prefix
+    pages are never written: that is the whole copy-on-write
+    contract.
+
+    BITWISE parity with the cold path (``build_prefill`` at the full
+    prompt's width bucket) holds on the native rung wherever the cold
+    prefill resolves to the exact XLA attend (CPU always; TPU differs
+    in flash's low-order bits exactly as train-vs-serve already
+    does): per-token math (embed, rmsnorm, qkv, wo, MLP, head) is
+    row-count independent, each attend score is the same
+    d-contraction, and the softmax/attend reductions differ from the
+    cold program only by TRAILING exactly-zero entries (exp of the
+    mask's NEG underflows to 0.0) — the same trailing-pad invariance
+    the prefill width buckets already rely on for their bitwise
+    guarantee. The int8 rung attends over DEQUANTIZED prefix pages
+    (int8 pages x f32 scale planes), so its cached-vs-cold parity is
+    approximate at the usual ~1% attend-error bound."""
+    emb = net.modules[p["embed"]]
+    stacks = [net.modules[i] for i in p["stacks"]]
+    dt = net.compute_dtype
+    e = emb.param.num_hidden
+    nh, d = uniform_heads_or_reason(net, p)
+    if kv not in ("native", "int8"):
+        raise ValueError("kv must be 'native' or 'int8', got %r" % kv)
+    Wc = int(ctx_blocks) * int(block)
+    npools = 4 if kv == "int8" else 2
+
+    def tail(params, *args):
+        pools = args[:npools]
+        toks, clens, lens, bt, rng = args[npools:]
+        # tail token j of row b sits at absolute position clens[b] + j
+        pos = clens[:, None] + jnp.arange(W)[None, :]        # (B, W)
+        lp0 = params[p["embed"]]
+        h = jnp.take(lp0["wmat"], toks, axis=0).astype(dt)
+        if emb.learn_pos:
+            S_emb = lp0["pos"].shape[0]
+            h = h + jnp.take(lp0["pos"],
+                             jnp.minimum(pos, S_emb - 1),
+                             axis=0).astype(dt)
+        bidx = jnp.arange(B)
+        bt_ctx = bt[:, :ctx_blocks]
+        pos_k = jnp.arange(Wc)[None, None, :]                # (1,1,Wc)
+        # exact causal mask over ABSOLUTE positions: prefix keys
+        # (< clens) and earlier tail keys are visible, everything
+        # else (pad slots, garbage past the prompt) is NEG-masked —
+        # exp underflows to exactly 0.0, the trailing-pad invariance
+        keep = pos_k <= pos[:, :, None]                      # (B,W,Wc)
+        ks, vs = [], []
+        li = 0
+        for si, st in zip(p["stacks"], stacks):
+            lp = params[si]
+            nlayer = lp["wqkv"].shape[0]
+            for l in range(nlayer):
+                layer_p = {kk: vv[l] for kk, vv in lp.items()}
+                x = _rmsnorm(h, layer_p["norm1"], dt)
+                qkv = jnp.einsum("bse,fe->bsf", x,
+                                 layer_p["wqkv"].astype(dt))
+                qkv4 = qkv.reshape(B, W, 3, nh, d).transpose(
+                    2, 0, 3, 1, 4)
+                q, k_new, v_new = qkv4[0], qkv4[1], qkv4[2]
+                if kv == "int8":
+                    pool_k, pool_v, pool_ks, pool_vs = pools
+                    k_ctx = (pool_k[bt_ctx, li].astype(jnp.float32)
+                             * pool_ks[bt_ctx, li][..., None]
+                             ).astype(dt)
+                    v_ctx = (pool_v[bt_ctx, li].astype(jnp.float32)
+                             * pool_vs[bt_ctx, li][..., None]
+                             ).astype(dt)
+                else:
+                    pool_k, pool_v = pools
+                    k_ctx = pool_k[bt_ctx, li].astype(dt)
+                    v_ctx = pool_v[bt_ctx, li].astype(dt)
+                # (B, cb, nh, block, d) -> (B, nh, Wc, d): the gather
+                # attend's page indexing (build_step), so the prefix
+                # bytes land exactly where the cold prefill wrote them
+                k_ctx = k_ctx.transpose(0, 2, 1, 3, 4).reshape(
+                    B, nh, Wc, d)
+                v_ctx = v_ctx.transpose(0, 2, 1, 3, 4).reshape(
+                    B, nh, Wc, d)
+                # the tail's fresh K/V joins the context at its true
+                # positions (mode="drop": pad rows past the context
+                # width write nowhere)
+                k_all = k_ctx.at[bidx[:, None], :, pos, :].set(
+                    k_new.transpose(0, 2, 1, 3), mode="drop")
+                v_all = v_ctx.at[bidx[:, None], :, pos, :].set(
+                    v_new.transpose(0, 2, 1, 3), mode="drop")
+                scores = jnp.einsum(
+                    "bhqd,bhkd->bhqk", q, k_all,
+                    preferred_element_type=jnp.float32) * (d ** -0.5)
+                att = jax.nn.softmax(
+                    jnp.where(keep[:, None], scores, NEG), -1)
+                out = jnp.einsum("bhqk,bhkd->bhqd",
+                                 att.astype(dt), v_all)
+                out = out.transpose(0, 2, 1, 3).reshape(B, W, e)
+                h = h + jnp.einsum("bse,fe->bsf", out,
+                                   layer_p["wo"].astype(dt))
+                x = _rmsnorm(h, layer_p["norm2"], dt)
+                h = h + _mlp_block(st, layer_p, x, dt)
+                ks.append(k_new)
+                vs.append(v_new)
+                li += 1
+        # the first sampled token reads the logits at the LAST prompt
+        # position, which lives at tail index lens - 1 - clens
+        last = jnp.take_along_axis(
+            h, (lens - 1 - clens)[:, None, None], axis=1)[:, 0]
+        logits = _head_logits(params, p, dt, last)
+        first, _ = _sample_at(logits, rng, temperature)
+        return (first.astype(jnp.int32),
+                jnp.stack(ks), jnp.stack(vs))   # (Ltot, B, nh, W, d)
+
+    # named for the recompile sentinel (see build_prefill)
+    tail.__name__ = "gen_tail_prefill_b%d_w%d%s" % (
+        B, W, "_q8" if kv == "int8" else "")
+    return jax.jit(tail)
+
+
 def build_step(net, p, temperature: float, B: int, P: int, Sl: int,
                block: int, platform: str = "cpu", steps: int = 1,
                kv: str = "native", attend: str = "gather"):

@@ -31,6 +31,13 @@ paged KV pool:
   queue immediately (:class:`StreamRequest`), so time-to-first-token
   is one prefill away regardless of time-to-last-token;
   serve/server.py renders the events as SSE chunks.
+* PREFIX CACHE — a cross-request token-prefix trie
+  (serve/prefixcache.py) shares completed prompts' KV pages
+  copy-on-write: a request whose prompt extends a cached prefix binds
+  the shared pages into its block table at admission and dispatches
+  the artifact's INCREMENTAL tail-prefill program over only the
+  uncached tokens — at heavy template share that is the difference
+  between recomputing every system prompt and paying it once.
 
 Greedy outputs are bitwise-identical to the fixed-shape path from the
 same weights (the step program's attend is shape-identical to the
@@ -117,7 +124,7 @@ class _Row:
     """One admitted prompt row waiting for (or bound to) a slot."""
 
     __slots__ = ("req", "ridx", "toks", "plen", "blocks",
-                 "ntok", "last")
+                 "ntok", "last", "clen", "shared", "nodes")
 
     def __init__(self, req: StreamRequest, ridx: int,
                  toks: np.ndarray, plen: int):
@@ -128,6 +135,9 @@ class _Row:
         self.blocks: Optional[list] = None
         self.ntok = 0               # tokens emitted so far
         self.last = 0               # last emitted token id
+        self.clen = 0               # cached-prefix tokens (kv_block x)
+        self.shared: list = []      # shared prefix pages (refs held)
+        self.nodes: list = []       # pinned trie nodes
 
 
 class ContinuousDecodeEngine:
@@ -153,6 +163,18 @@ class ContinuousDecodeEngine:
                       (kv_bytes_per_seq in the artifact meta), so the
                       same byte budget holds ~2x the KV state —
                       docs/serving.md's rung table
+      prefix_cache    cross-request prefix cache
+                      (serve/prefixcache.py): "auto" (default) = on
+                      when the artifact carries the rung's tail-
+                      prefill programs, True = required (raises
+                      otherwise), False = off. A request whose prompt
+                      extends a cached prefix binds the shared pages
+                      into its block table at admission and runs
+                      incremental prefill on only the uncached tail
+      prefix_capacity_pages
+                      page budget for trie-held (published) pages;
+                      0 = half the usable pool. Pinned pages are
+                      never evicted
       step_hook       callable invoked before every decode step — the
                       fault-injection / test-throttle seam (raising
                       fails the step's requests through the real error
@@ -171,6 +193,7 @@ class ContinuousDecodeEngine:
                  timeout_ms: float = 30000.0,
                  prefill_split: bool = True, kv_blocks: int = 0,
                  kv_dtype: str = "auto",
+                 prefix_cache="auto", prefix_capacity_pages: int = 0,
                  max_wait_ms: float = 0.0, max_batch=None,
                  dispatch_depth: int = 0,
                  stats: Optional[ServeStats] = None, seed: int = 0,
@@ -212,6 +235,36 @@ class ContinuousDecodeEngine:
         self.registry = registry if registry is not None else Registry()
         self.pool = BlockPool(decoder.pool_blocks, decoder.kv_block,
                               limit=int(kv_blocks))
+        # cross-request prefix cache: needs the rung's exported tail-
+        # prefill programs (a hit skips straight to incremental
+        # prefill, so there is nothing to do without them)
+        has_tail = decoder.has_tail_prefill(self.kv_dtype)
+        if prefix_cache is True and not has_tail:
+            raise ValueError(
+                "prefix_cache=True but the artifact carries no %s-"
+                "rung tail-prefill programs — re-export with "
+                "tail_prefill=True (and a prompt region wider than "
+                "one kv_block page)" % self.kv_dtype)
+        self.prefix = None
+        self._tail_ws: list = []
+        if prefix_cache is not False and has_tail:
+            from .prefixcache import PrefixCache
+            self.prefix = PrefixCache(
+                self.pool, decoder.kv_block,
+                capacity_pages=int(prefix_capacity_pages),
+                # at least one sequence must stay allocatable with
+                # the trie full — cache growth must never wedge
+                # admission
+                reserve_pages=decoder.blocks_per_seq)
+            self._tail_ws = decoder.tail_widths(self.kv_dtype)
+        self._ntail = 0
+        # prefill-compute accounting: slot-tokens each prefill program
+        # actually ran (rows bucket x width bucket) — the number the
+        # prefix cache shrinks (a 32-token tail dispatches a 64-wide
+        # program instead of the 192-wide full prefill), reported
+        # beside the dispatch counts so the ledger can attribute
+        # compute, not just events
+        self._pf_slot_tokens = 0
         self._pools = decoder.new_pool(kv_dtype)
         self._slots: List[Optional[_Row]] = [None] * self.batch
         self._nlive = 0
@@ -283,6 +336,10 @@ class ContinuousDecodeEngine:
             # what the docs' pool-sizing guidance is measured against
             self.pool.bind_registry(self.registry, self.obs_labels),
         ]
+        if self.prefix is not None:
+            self._registry_hooks.append(
+                self.prefix.bind_registry(self.registry,
+                                          self.obs_labels))
         self._thread = threading.Thread(
             target=self._loop, name="serve-continuous", daemon=True)
         if start:
@@ -344,6 +401,24 @@ class ContinuousDecodeEngine:
                         self._pools, kn, vn,
                         [[0] * nb for _ in range(n)], c.kv_block)
             nblk = c.blocks_per_seq
+            if self.prefix is not None:
+                # prefix-cache tail prefills: one compile per (rows,
+                # tail width, rung) — a cache hit mid-traffic must
+                # dispatch an already-compiled program. The trim
+                # slices and the offset scatter reuse the shapes the
+                # full-prefill loop above just warmed (the scatter's
+                # start offsets are host-side index arithmetic, not
+                # part of the compile key)
+                for w in c.tail_widths(self.kv_dtype):
+                    for r in c.prefill_rows:
+                        out = c.tail_call(self.kv_dtype, r, w)(
+                            *self._pools,
+                            np.zeros((r, w), np.int32),
+                            np.zeros((r,), np.int32),
+                            np.ones((r,), np.int32),
+                            np.zeros((r, nblk), np.int32), key)
+                        np.asarray(out[0])
+                        self.warmup_runs += 1
             for b in self._step_buckets:
                 out = c.step_call(self.kv_dtype, b)(
                     *self._pools,
@@ -404,6 +479,7 @@ class ContinuousDecodeEngine:
                 "step_buckets": list(self._step_buckets),
                 "slots_live": self._nlive,
                 "ready_rows": len(self._ready),
+                "prefix_cache": self.prefix is not None,
                 "kv_pool": self.pool.snapshot()}
 
     def metrics(self) -> dict:
@@ -425,6 +501,10 @@ class ContinuousDecodeEngine:
         snap["slots_live"] = self._nlive
         snap["ready_rows"] = len(self._ready)
         snap["kv_pool"] = self.pool.snapshot()
+        snap["tail_prefills"] = self._ntail
+        snap["prefill_slot_tokens"] = self._pf_slot_tokens
+        snap["prefix_cache"] = None if self.prefix is None \
+            else self.prefix.snapshot()
         return snap
 
     # ------------------------------------------------------------------
@@ -467,6 +547,25 @@ class ContinuousDecodeEngine:
             return True
         return False
 
+    def _release_row(self, row: _Row) -> None:
+        """Drop every pool reference a row holds — its full block
+        table once allocated (shared prefix pages decref back to the
+        trie, owned pages free), or just its admission-time shared
+        pages before that — and unpin its trie nodes. The one place
+        row-held pages are given back, so no exit path (done, expired,
+        drained, failed, closed) can leak a reference."""
+        if row.blocks is not None:
+            self.pool.release(row.blocks, owner=row.req.id)
+            row.blocks = None
+        elif row.shared:
+            self.pool.release(row.shared, owner=row.req.id)
+        row.shared = []
+        if row.nodes:
+            if self.prefix is not None:
+                self.prefix.unpin(row.nodes)
+            row.nodes = []
+        row.clen = 0
+
     def _sweep_expired_locked(self) -> int:
         now = time.monotonic()
         dead = []
@@ -480,6 +579,7 @@ class ContinuousDecodeEngine:
         self._q.extend(alive)
         failed = set()
         for r in dead:
+            self._release_row(r)
             if r.req not in failed:
                 failed.add(r.req)
                 self.stats.on_timeout()
@@ -507,7 +607,16 @@ class ContinuousDecodeEngine:
             with self._live_lock:
                 self._live.add(req)
             for r, pl in enumerate(lens.tolist()):
-                self._q.append(_Row(req, r, toks[r, :pl].copy(), pl))
+                row = _Row(req, r, toks[r, :pl].copy(), pl)
+                if self.prefix is not None:
+                    # admission-time trie lookup: the deepest cached
+                    # prefix path is pinned for the request lifetime,
+                    # and the row's prefill shrinks to the tail
+                    row.nodes, row.shared = \
+                        self.prefix.match_and_pin(row.toks,
+                                                  owner=req.id)
+                    row.clen = len(row.shared) * self.callee.kv_block
+                self._q.append(row)
             tr = _trace.sink()
             if tr is not None:
                 with tr.span("serve.admit", "serve",
@@ -538,42 +647,75 @@ class ContinuousDecodeEngine:
             base = jax.random.PRNGKey(self._seed)
             return np.asarray(jax.random.fold_in(base, tag), np.uint32)
 
+    def _row_class(self, row: _Row):
+        """Dispatch class of a waiting row — rows only batch within
+        one class (one program per dispatch). Prefix-cache hits run
+        the TAIL program at the tail's width bucket; with the cache
+        on, a COLD row whose whole prompt fits a tail bucket ALSO
+        rides the tail program at ``clen = 0`` (bitwise-equal to the
+        classic prefill — the tail program is a general offset
+        prefill), so cached tails and short cold prompts merge into
+        ONE dispatch class instead of fragmenting the schedule into
+        per-width singletons. Wide cold prompts keep the classic
+        prefill program."""
+        if row.clen:
+            return ("tail", self._pick_tail(row.plen - row.clen))
+        if self._tail_ws and row.plen <= self._tail_ws[-1]:
+            return ("tail", self._pick_tail(row.plen))
+        return ("full", self.callee.pick_width(row.plen))
+
+    def _pick_tail(self, n: int) -> int:
+        for w in self._tail_ws:
+            if w >= n:
+                return w
+        # unreachable for artifacts this exporter wrote (tail widths
+        # cover prompt_len - kv_block); raise attributably rather
+        # than let a bare StopIteration kill the scheduler thread
+        return self.callee.pick_tail_width(n, self.kv_dtype)
+
     @hot_path
     def _prefill_dispatch(self) -> bool:
         """Prefill waiting rows: one prefill program run at the head
-        row's width bucket, prompt K/V scattered into the pool, first
+        row's class (full prompts at their width bucket; prefix-cache
+        hits through the narrower TAIL program, attending over their
+        shared pages), prompt K/V scattered into the pool — tail rows
+        from their start page, never touching shared pages — first
         token emitted (the TTFT moment — it streams NOW, even if every
         decode lane is busy), rows parked on the ready queue until a
         lane frees. Returns whether anything was prefilled."""
         c = self.callee
+        nblk = c.blocks_per_seq
         maxr = c.prefill_rows[-1]
         take: List[_Row] = []
         with self._cond:
             # one pass: drop dead rows, fail expired ones, and collect
-            # candidates of the OLDEST waiter's width class from
-            # anywhere in the queue — widths must not mix in one
-            # dispatch (a long prompt prefills in its own dispatch,
-            # never dragging short ones to the wide program), and
-            # head-run-only gathering would cap batches at the
-            # short/long interleave's run length
+            # candidates of the OLDEST waiter's class from anywhere in
+            # the queue — classes must not mix in one dispatch (a long
+            # prompt prefills in its own dispatch, never dragging
+            # short ones to the wide program; a cached row dispatches
+            # a different program entirely), and head-run-only
+            # gathering would cap batches at the interleave's run
+            # length
             now = time.monotonic()
             kept: List[_Row] = []
             cand: List[_Row] = []
-            head_w = None
+            head_cls = None
             for row in self._q:
                 if row.req.done:           # failed by drain/sweep
+                    self._release_row(row)
                     continue
                 if row.req.deadline is not None \
                         and now > row.req.deadline:
+                    self._release_row(row)
                     self.stats.on_timeout()
                     self._finish_req(row.req, error=RequestExpired(
                         "request expired after %.0f ms before prefill"
                         % (1000.0 * (now - row.req.t_submit))))
                     continue
-                w = c.pick_width(row.plen)
-                if head_w is None:
-                    head_w = w
-                if w == head_w and len(cand) < maxr:
+                cls = self._row_class(row)
+                if head_cls is None:
+                    head_cls = cls
+                if cls == head_cls and len(cand) < maxr:
                     cand.append(row)
                 else:
                     kept.append(row)
@@ -581,6 +723,9 @@ class ContinuousDecodeEngine:
                 self._q.clear()
                 self._q.extend(kept)
                 return False
+            # a cache hit needs only the pages its shared prefix does
+            # not cover — the capacity half of the prefix-cache win
+            need = {id(r): nblk - len(r.shared) for r in cand}
             if self._nlive and self._ready:
                 # batch formation, starvation-keyed: while the ready
                 # queue holds prefilled rows the lanes CANNOT starve,
@@ -594,59 +739,103 @@ class ContinuousDecodeEngine:
                 # moment the ready queue drains, prefill runs with
                 # whatever fits (an idle lane always gets fed)
                 want = min(len(cand), maxr)
-                fit = min(want,
-                          self.pool.free_blocks // c.blocks_per_seq)
-                if fit < want:
+                if self.pool.free_blocks \
+                        < sum(need[id(r)] for r in cand[:want]):
                     self._q.clear()
                     self._q.extend(sorted(
                         cand + kept, key=lambda r: r.req.t_submit))
                     return False
             for row in cand:
-                if not self.pool.can_alloc(c.blocks_per_seq):
-                    kept.append(row)
-                    continue
-                row.blocks = self.pool.alloc(c.blocks_per_seq)
+                if not self.pool.can_alloc(need[id(row)]):
+                    # pool-pressure eviction: ask the trie to give
+                    # back exclusively-held pages before turning a
+                    # row away — a cache allowed to sit on pages
+                    # while admission starves would invert its
+                    # whole purpose
+                    if self.prefix is not None:
+                        self.prefix.reclaim(
+                            need[id(row)] - self.pool.free_blocks)
+                    if not self.pool.can_alloc(need[id(row)]):
+                        kept.append(row)
+                        continue
+                # shared prefix pages head the block table (logical
+                # pages [0, clen/kv_block)), owned pages fill the rest
+                row.blocks = row.shared + self.pool.alloc(
+                    need[id(row)], owner=row.req.id)
+                row.shared = []
                 take.append(row)
             self._q.clear()
             self._q.extend(sorted(kept,
                                   key=lambda r: r.req.t_submit))
         if not take:
             return False
-        w = c.pick_width(max(r.plen for r in take))
+        is_tail = head_cls[0] == "tail"
+        w = head_cls[1]
         n = len(take)
         toks = np.zeros((n, w), np.int32)
         lens = np.zeros((n,), np.int32)
+        clens = np.zeros((n,), np.int32)
         for i, row in enumerate(take):
-            toks[i, :row.plen] = row.toks
+            toks[i, :row.plen - row.clen] = row.toks[row.clen:]
             lens[i] = row.plen
+            clens[i] = row.clen
         self._nprefill += 1
+        self._pf_slot_tokens += c.pick_rows(n) * w
         tr = _trace.sink()
         try:
             with _trace.span("serve.prefill", "serve",
-                             {"rows": n, "width": w}):
+                             {"rows": n, "width": w,
+                              "tail": is_tail}):
                 if tr is not None:
                     for row in take:
                         tr.flow_step("request", row.req.seq, "serve")
-                first, k, v = c.prefill(
-                    toks, lens, self._fold_key(self._nprefill))
-                # the sanctioned materialize: first tokens must reach
-                # the host to stream out — this wait IS the TTFT
-                first = np.asarray(first)
                 from ..serving import scatter_prefill_kv
-                self._pools = scatter_prefill_kv(
-                    self._pools, k, v,
-                    [row.blocks for row in take], c.kv_block)
+                if is_tail:
+                    # incremental prefill: compute K/V for only the
+                    # uncached tails, attending over the shared
+                    # prefix pages (read-only), then scatter the tail
+                    # K/V into each row's OWN pages from its start
+                    # page — the copy-on-write write path
+                    bt = np.array([row.blocks for row in take],
+                                  np.int32)
+                    first, k, v = c.tail_prefill(
+                        self._pools, toks, clens, lens, bt,
+                        self._fold_key(self._nprefill),
+                        kv=self.kv_dtype)
+                    first = np.asarray(first)
+                    self._ntail += 1
+                    self._pools = scatter_prefill_kv(
+                        self._pools, k, v,
+                        [row.blocks for row in take], c.kv_block,
+                        starts=clens, valid=lens - clens)
+                else:
+                    first, k, v = c.prefill(
+                        toks, lens, self._fold_key(self._nprefill))
+                    # the sanctioned materialize: first tokens must
+                    # reach the host to stream out — this wait IS
+                    # the TTFT
+                    first = np.asarray(first)
+                    self._pools = scatter_prefill_kv(
+                        self._pools, k, v,
+                        [row.blocks for row in take], c.kv_block)
         except Exception as e:
             self.stats.on_error(len({r.req for r in take}))
             for row in take:
-                self.pool.free(row.blocks)
-                row.blocks = None
+                self._release_row(row)
                 self._finish_req(row.req, error=e)
             # the scatter donates the pool buffers; after a failure
             # partway through them nothing in the pool can be trusted
             self._fail_all_inflight(e)
             return True
         self.stats.on_prefill(n)
+        if self.prefix is not None:
+            # publish the completed prompts' full pages back: later
+            # requests with the same prefix bind them instead of
+            # recomputing (rows that were themselves hits only add
+            # pages PAST their matched depth)
+            for row in take:
+                self.prefix.publish(row.toks, row.blocks,
+                                    owner=row.req.id)
         now = time.monotonic()
         first = first.tolist()
         for i, row in enumerate(take):
@@ -673,9 +862,7 @@ class ContinuousDecodeEngine:
             while self._ready:
                 cand = self._ready.popleft()
                 if cand.req.done:
-                    if cand.blocks is not None:
-                        self.pool.free(cand.blocks)
-                        cand.blocks = None
+                    self._release_row(cand)
                     continue
                 row = cand
                 break
@@ -703,11 +890,10 @@ class ContinuousDecodeEngine:
                             "tokens": list(toks)})
 
     def _row_done(self, row: _Row, now: float) -> None:
-        """Row finished: free its pages, complete the request when it
-        was the last row out."""
-        if row.blocks is not None:
-            self.pool.free(row.blocks)
-            row.blocks = None
+        """Row finished: release its pages (shared prefix pages decref
+        back to the trie), complete the request when it was the last
+        row out."""
+        self._release_row(row)
         req = row.req
         req.rows_left -= 1
         if req.rows_left > 0:
@@ -737,22 +923,33 @@ class ContinuousDecodeEngine:
         """Pool-integrity reset after a failed donated call: every row
         with K/V in the (now untrustworthy or consumed) pool fails,
         pages return, and the pool is rebuilt from scratch. Queued
-        rows (no pool state yet) are untouched."""
+        rows (no pool state yet) stay queued — but their prefix-cache
+        matches are VOID (the matched pages' content dies with the
+        pool), so their pins and shared references release and they
+        fall back to cold prefill. The trie itself resets the same
+        way: its held references release instead of leaking pages
+        whose K/V no longer exists."""
         for i, row in enumerate(self._slots):
             if row is None:
                 continue
-            if row.blocks is not None:
-                self.pool.free(row.blocks)
-                row.blocks = None
+            self._release_row(row)
             self._slots[i] = None
             self._nlive -= 1
             self._finish_req(row.req, error=error)
         while self._ready:
             row = self._ready.popleft()
-            if row.blocks is not None:
-                self.pool.free(row.blocks)
-                row.blocks = None
+            self._release_row(row)
             self._finish_req(row.req, error=error)
+        if self.prefix is not None:
+            # one _cond hold across the queued-row release AND the
+            # trie reset: an _admit interleaving between them could
+            # pin a node the reset is about to release (admissions
+            # match under _cond, so holding it closes the race; lock
+            # order stays cond -> prefixcache -> kvpool)
+            with self._cond:
+                for row in self._q:
+                    self._release_row(row)
+                self.prefix.reset()
         self._pools = self.callee.new_pool(self.kv_dtype)
 
     def _reap_dead_slots(self) -> None:
@@ -761,9 +958,7 @@ class ContinuousDecodeEngine:
         slot rebinds next prefill."""
         for i, row in enumerate(self._slots):
             if row is not None and row.req.done:
-                if row.blocks is not None:
-                    self.pool.free(row.blocks)
-                    row.blocks = None
+                self._release_row(row)
                 self._slots[i] = None
                 self._nlive -= 1
 
@@ -817,9 +1012,7 @@ class ContinuousDecodeEngine:
             reqs = {row.req for _, row in live}
             self.stats.on_error(len(reqs))
             for i, row in live:
-                if row.blocks is not None:
-                    self.pool.free(row.blocks)
-                    row.blocks = None
+                self._release_row(row)
                 self._slots[i] = None
                 self._nlive -= 1
             for req in reqs:
@@ -881,7 +1074,10 @@ class ContinuousDecodeEngine:
                 self.stats.on_drained()
                 n += 1
         with self._cond:
-            self._q.clear()
+            while self._q:
+                # queued stragglers hold prefix-cache pins/references
+                # from admission — give them back before dropping
+                self._release_row(self._q.popleft())
         if n:
             _trace.instant("serve.drain_stragglers", "serve",
                            {"failed": n})
@@ -896,25 +1092,19 @@ class ContinuousDecodeEngine:
         with self._cond:
             while self._q:
                 row = self._q.popleft()
-                if row.blocks is not None:
-                    self.pool.free(row.blocks)
-                    row.blocks = None
+                self._release_row(row)
                 self._finish_req(row.req,
                                  error=RuntimeError("engine closed"))
         while self._ready:
             row = self._ready.popleft()
-            if row.blocks is not None:
-                self.pool.free(row.blocks)
-                row.blocks = None
+            self._release_row(row)
             self._finish_req(row.req,
                              error=RuntimeError("engine closed"))
         for i, row in enumerate(self._slots):
             # rows a drain failed while they sat in a lane: the
             # scheduler thread is gone, so their pages reap here
             if row is not None:
-                if row.blocks is not None:
-                    self.pool.free(row.blocks)
-                    row.blocks = None
+                self._release_row(row)
                 self._slots[i] = None
                 self._nlive -= 1
                 self._finish_req(row.req,
@@ -923,6 +1113,11 @@ class ContinuousDecodeEngine:
             leftovers = list(self._live)
         for req in leftovers:
             self._finish_req(req, error=RuntimeError("engine closed"))
+        if self.prefix is not None:
+            # every row reference is gone; the trie's own page
+            # references go back too, so a drained engine leaves the
+            # pool provably empty (the leak check the tests pin)
+            self.prefix.reset()
         self.registry.collect()
         for h in self._registry_hooks:
             self.registry.remove_hook(h)

@@ -5,10 +5,21 @@ pool of fixed-size KV pages — ``kv_block`` cache slots each, on the
 128-multiple ``cache_slots`` granule from ops/decode_attend.py. This
 module is the HOST half of the design: which request owns which pages.
 Each decoding request holds ``blocks_per_seq`` pages listed in its
-block table; pages return to the free list the moment the request
-leaves its slot, so the next admission reuses them without touching
-device memory. vLLM's PagedAttention allocator, minus copy-on-write —
-requests never share pages here.
+block table; pages return to the free list the moment the last
+reference drops, so the next admission reuses them without touching
+device memory.
+
+Pages are REFCOUNTED (r14): ``alloc`` hands a page out at refcount 1,
+``share`` adds a reference (the prefix cache pinning a page into a
+second request's block table, or the trie itself holding a published
+page), ``release`` drops one — the page only rejoins the free list at
+zero. That is what makes vLLM-style copy-on-write prefix sharing
+possible on top of this pool (serve/prefixcache.py): shared pages are
+immutable prompt K/V, every writer writes to pages it allocated
+itself. ``free`` is ``release`` under its historical name. Each
+reference carries an optional OWNER label (a request id, a lane, a
+trie node), so a double free names who holds — or last released — the
+page instead of just printing its id.
 
 Block 0 is the reserved TRASH page: slots not bound to a request point
 their whole block table at it, so the step program's writes for dead
@@ -16,12 +27,12 @@ slots land somewhere harmless. ``alloc`` never hands it out.
 
 Thread-safe through the lockcheck seam (the scheduler thread allocates
 while admission/drain paths free). Double frees and leaked pages are
-hard errors — a page in two block tables means cross-request KV
-leakage, exactly the bug the pool tests hunt."""
+hard errors — a page in two block tables WITHOUT a matching reference
+means cross-request KV leakage, exactly the bug the pool tests hunt."""
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from ..analysis import lockcheck as _lockcheck
 
@@ -31,8 +42,8 @@ class PoolExhausted(RuntimeError):
 
 
 class BlockPool:
-    """Free-list allocator over ``num_blocks`` pool pages (page 0
-    reserved as the trash page)."""
+    """Refcounting free-list allocator over ``num_blocks`` pool pages
+    (page 0 reserved as the trash page)."""
 
     def __init__(self, num_blocks: int, block_size: int = 128,
                  limit: int = 0) -> None:
@@ -53,6 +64,9 @@ class BlockPool:
         # LIFO free list: the page a request just released is the
         # hottest candidate for the next admission
         self._free: List[int] = list(range(self.limit - 1, 0, -1))
+        self._ref: Dict[int, int] = {}          # page -> live refs
+        self._owners: Dict[int, List[str]] = {}  # page -> ref labels
+        self._last_free: Dict[int, str] = {}    # page -> last releaser
         self._in_use = 0
         self.high_water = 0
         self.allocs = 0
@@ -67,58 +81,141 @@ class BlockPool:
         with self._lock:
             return self._in_use
 
+    @property
+    def shared_blocks(self) -> int:
+        """Pages currently referenced more than once — the live
+        footprint of copy-on-write sharing."""
+        with self._lock:
+            return sum(1 for r in self._ref.values() if r > 1)
+
     def can_alloc(self, n: int) -> bool:
         with self._lock:
             return len(self._free) >= n
 
-    def alloc(self, n: int) -> List[int]:
-        """Take ``n`` pages; raises :class:`PoolExhausted` (taking
-        none) when fewer are free — partial grants would deadlock two
-        half-admitted requests against each other."""
+    def alloc(self, n: int, owner: Optional[str] = None) -> List[int]:
+        """Take ``n`` pages at refcount 1; raises
+        :class:`PoolExhausted` (taking none) when fewer are free —
+        partial grants would deadlock two half-admitted requests
+        against each other. ``owner`` labels the reference for the
+        double-free/leak diagnostics."""
         n = int(n)
         if n < 1:
             raise ValueError("alloc needs n >= 1")
+        label = owner or "?"
         with self._lock:
             if len(self._free) < n:
                 raise PoolExhausted(
                     "%d pages requested, %d free (pool %d, limit %d)"
                     % (n, len(self._free), self.num_blocks, self.limit))
             out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+                self._owners[b] = [label]
             self._in_use += n
             self.allocs += 1
             self.high_water = max(self.high_water, self._in_use)
             return out
 
-    def free(self, blocks: Sequence[int]) -> None:
-        """Return pages to the free list. Freeing the trash page, an
-        out-of-range id, or a page that is already free raises — any
-        of those means a block table went stale while the step program
-        could still write through it."""
+    def share(self, blocks: Sequence[int],
+              owner: Optional[str] = None) -> None:
+        """Add one reference to each page in ``blocks`` — the page
+        must currently be held (sharing a free page would resurrect
+        stale K/V into a live block table). Sharing never touches
+        device memory: the new holder reads the same immutable pages,
+        and writes anything new to pages it allocates itself (the
+        copy-on-write contract)."""
         blocks = [int(b) for b in blocks]
+        label = owner or "?"
         with self._lock:
-            # seen covers the free list AND earlier entries of this
-            # very call: free([3, 3]) is as much a double free as two
-            # calls are
-            seen = set(self._free)
+            for b in blocks:
+                if not 1 <= b < self.limit:
+                    raise ValueError(
+                        "share of page %d outside the usable pool "
+                        "[1, %d)" % (b, self.limit))
+                if self._ref.get(b, 0) < 1:
+                    raise ValueError(
+                        "share of FREE pool page %d (last released "
+                        "by %s) — a free page's K/V is stale"
+                        % (b, self._last_free.get(b, "<never held>")))
+            for b in blocks:
+                self._ref[b] += 1
+                self._owners[b].append(label)
+
+    def release(self, blocks: Sequence[int],
+                owner: Optional[str] = None) -> None:
+        """Drop one reference per page; a page rejoins the free list
+        when its last reference goes. Releasing the trash page, an
+        out-of-range id, or a page with no live references raises —
+        any of those means a block table went stale while the step
+        program could still write through it. The error names the
+        page's current (or last) holders, so a double free points at
+        the offending lane / trie node, not just a number."""
+        blocks = [int(b) for b in blocks]
+        label = owner or "?"
+        with self._lock:
+            # count refs being dropped per page IN THIS CALL too:
+            # release([3, 3]) against one live ref is as much a double
+            # free as two calls are
+            need: Dict[int, int] = {}
             for b in blocks:
                 if not 1 <= b < self.limit:
                     raise ValueError(
                         "free of page %d outside the usable pool "
                         "[1, %d)" % (b, self.limit))
-                if b in seen:
+                need[b] = need.get(b, 0) + 1
+            for b, cnt in need.items():
+                have = self._ref.get(b, 0)
+                if have < cnt:
+                    if have == 0:
+                        raise ValueError(
+                            "double free of pool page %d (no live "
+                            "references; last released by %s)"
+                            % (b, self._last_free.get(
+                                b, "<never held>")))
                     raise ValueError(
-                        "double free of pool page %d" % b)
-                seen.add(b)
+                        "double free of pool page %d (releasing %d "
+                        "references but only %d held, by %s)"
+                        % (b, cnt, have,
+                           ", ".join(self._owners.get(b, []))))
             for b in blocks:
-                self._free.append(b)
-            self._in_use -= len(blocks)
+                self._ref[b] -= 1
+                owners = self._owners[b]
+                if label in owners:
+                    owners.remove(label)
+                elif owners:
+                    owners.pop()
+                if self._ref[b] == 0:
+                    del self._ref[b]
+                    del self._owners[b]
+                    self._last_free[b] = label
+                    self._free.append(b)
+                    self._in_use -= 1
+
+    def free(self, blocks: Sequence[int],
+             owner: Optional[str] = None) -> None:
+        """Historical name for :meth:`release` (one reference per
+        page) — the double-free/leak checks generalized to the
+        share/release semantics."""
+        self.release(blocks, owner=owner)
+
+    def refcount(self, block: int) -> int:
+        with self._lock:
+            return self._ref.get(int(block), 0)
+
+    def owners(self, block: int) -> List[str]:
+        """Current reference labels of a page (diagnostics)."""
+        with self._lock:
+            return list(self._owners.get(int(block), []))
 
     def assert_empty(self) -> None:
         """Test hook: every page handed out has come back."""
         with self._lock:
             if self._in_use:
+                held = {b: list(o) for b, o in
+                        sorted(self._owners.items())[:8]}
                 raise AssertionError(
-                    "%d pool pages still held (leak)" % self._in_use)
+                    "%d pool pages still held (leak): %s"
+                    % (self._in_use, held))
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -128,19 +225,23 @@ class BlockPool:
                 "limit": self.limit,
                 "in_use": self._in_use,
                 "free": len(self._free),
+                "shared": sum(1 for r in self._ref.values() if r > 1),
                 "high_water": self.high_water,
                 "allocs": self.allocs,
             }
 
     def bind_registry(self, registry, labels: Optional[dict] = None):
         """Register the pool's occupancy gauges on ``registry``:
-        ``cxxnet_kv_pages_in_use`` (live) and ``cxxnet_kv_pages_peak``
-        (the high-water mark since start) — the peak is what sizes a
-        pool: docs/serving.md's guidance ("pages are cheap; a
-        too-small pool silently degrades the scheduler to singleton
-        prefills") is only checkable against a measured peak. Returns
-        the collection hook (pass it to ``registry.remove_hook`` on
-        close, the ServeStats.bind_registry convention)."""
+        ``cxxnet_kv_pages_in_use`` (live), ``cxxnet_kv_pages_peak``
+        (the high-water mark since start) and
+        ``cxxnet_kv_pages_shared`` (pages referenced by more than one
+        holder — the prefix cache's live sharing footprint). The peak
+        is what sizes a pool: docs/serving.md's guidance ("pages are
+        cheap; a too-small pool silently degrades the scheduler to
+        singleton prefills") is only checkable against a measured
+        peak. Returns the collection hook (pass it to
+        ``registry.remove_hook`` on close, the ServeStats
+        .bind_registry convention)."""
         labels = dict(labels or {})
         g_live = registry.gauge(
             "cxxnet_kv_pages_in_use",
@@ -150,9 +251,15 @@ class BlockPool:
             "cxxnet_kv_pages_peak",
             "high-water mark of paged KV pool pages held at once",
             tuple(labels))
+        g_shared = registry.gauge(
+            "cxxnet_kv_pages_shared",
+            "paged KV pool pages held by more than one reference "
+            "(prefix-cache sharing)",
+            tuple(labels))
 
         def hook():
             snap = self.snapshot()
             g_live.set(snap["in_use"], **labels)
             g_peak.set(snap["high_water"], **labels)
+            g_shared.set(snap["shared"], **labels)
         return registry.add_hook(hook)

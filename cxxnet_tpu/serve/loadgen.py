@@ -56,6 +56,24 @@ shapes production traffic actually takes; all deterministic in
                         full exported max_new on requests that asked
                         for a few tokens, an iteration-level
                         scheduler must not (TTFT and goodput tell)
+* ``shared_prefix``   — all-generate streaming traffic where a
+                        ``template_share`` fraction of requests
+                        follow one of ``n_templates`` long prompt
+                        templates (same leading ``template_len``
+                        tokens, per-user suffixes), the rest carry
+                        genuinely unique prompts — the prefix-cache
+                        yardstick (serve/prefixcache.py): with the
+                        cache on, template requests skip straight to
+                        incremental tail prefill; TTFT, the
+                        prefill-dispatch count and the hit rate tell
+
+Entries may carry ``template`` (an integer template id) +
+``template_len``: the target then synthesizes the prompt as that
+template's deterministic leading tokens plus a per-request suffix, so
+every replay of a catalog entry reproduces the same byte-identical
+prefix-sharing structure. Unique entries (``uniq``) mix the request
+index into the LEADING tokens so no two requests ever share a full
+kv_block page by accident.
 
 Generate entries may carry ``prompt_len`` (tokens; clamped to the
 target artifact), ``max_new`` (per-request cap, continuous engines
@@ -82,7 +100,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 from ..obs import trace as _trace
 
 SCENARIOS = ("steady", "bursty", "mixed_priority", "mixed_kinds",
-             "slow_client", "mixed_prompt_len")
+             "slow_client", "mixed_prompt_len", "shared_prefix")
 
 
 # ----------------------------------------------------------------------
@@ -177,14 +195,27 @@ def make_scenario(name: str, duration_s: float = 4.0,
                   burst_duty: float = 0.3,
                   short_prompt_len: int = 4,
                   long_prompt_len: int = 48,
-                  short_max_new: int = 4) -> List[dict]:
+                  short_max_new: int = 4,
+                  n_templates: int = 4,
+                  template_share: float = 0.625,
+                  template_len: int = 144,
+                  suffix_len: int = 16) -> List[dict]:
     """Synthesize one catalog scenario as a trace (see module doc).
     ``rps`` is the MEAN arrival rate; bursty packs the same volume
     into ``burst_duty`` of each ``burst_period_s``;
     ``short_prompt_len`` / ``long_prompt_len`` shape the
     mixed_prompt_len interleave (2 short : 1 long), whose short
     entries also ask for only ``short_max_new`` completion tokens
-    (long entries take the artifact's full max_new)."""
+    (long entries take the artifact's full max_new).
+    ``n_templates`` / ``template_share`` / ``template_len`` /
+    ``suffix_len`` shape shared_prefix: a ``template_share`` fraction
+    of entries extend one of ``n_templates`` shared
+    ``template_len``-token prompt templates with a ``suffix_len``
+    per-user suffix (asking for ``short_max_new`` tokens — the
+    template-heavy chat shape); the rest are unique
+    ``short_prompt_len`` prompts. The mix is deterministic in
+    ``seed``, so a catalog entry replays with byte-identical sharing
+    structure."""
     if name not in SCENARIOS:
         raise ValueError("unknown scenario %r (know %s)"
                          % (name, ", ".join(SCENARIOS)))
@@ -224,6 +255,17 @@ def make_scenario(name: str, duration_s: float = 4.0,
             else:
                 e["prompt_len"] = int(short_prompt_len)
                 e["max_new"] = int(short_max_new)
+        elif name == "shared_prefix":
+            e["kind"] = "generate"
+            e["stream"] = 1
+            e["max_new"] = int(short_max_new)
+            if rnd() < float(template_share):
+                e["template"] = i % int(n_templates)
+                e["template_len"] = int(template_len)
+                e["prompt_len"] = int(template_len) + int(suffix_len)
+            else:
+                e["uniq"] = 1
+                e["prompt_len"] = int(short_prompt_len)
         entries.append(e)
     entries.sort(key=lambda e: e["t"])
     return entries
@@ -249,13 +291,32 @@ class EngineTarget:
         self.data = data
         self.prompt_len = int(prompt_len)
 
-    def _prompts(self, rows: int, i: int, plen: Optional[int] = None):
+    def _prompts(self, rows: int, i: int, entry: dict):
         import numpy as np
         c = self.decode.callee
         toks = np.zeros((rows, c.seq_len), np.int32)
+        plen = entry.get("prompt_len")
         L = min(int(plen or self.prompt_len), c.max_prompt_len)
+        tid = entry.get("template")
         for r in range(rows):
-            toks[r, :L] = [(i + r + j) % 7 + 1 for j in range(L)]
+            if tid is not None:
+                # shared_prefix: the template's leading tokens are a
+                # pure function of its id (byte-identical across
+                # requests and replays), the suffix varies per request
+                TL = min(int(entry.get("template_len", L)), L)
+                toks[r, :TL] = [(int(tid) * 3 + 1 + j * j) % 7 + 1
+                                for j in range(TL)]
+                toks[r, TL:L] = [(i + r + j) % 7 + 1
+                                 for j in range(L - TL)]
+            elif entry.get("uniq"):
+                # genuinely unique prompts: the request index's base-7
+                # digits lead the prompt, so no two requests share a
+                # full kv_block page by accident (the legacy pattern
+                # below cycles every 7 requests — a dishonest "hit")
+                toks[r, :L] = [((i + r) // 7 ** j + j) % 7 + 1
+                               for j in range(L)]
+            else:
+                toks[r, :L] = [(i + r + j) % 7 + 1 for j in range(L)]
         return toks, [L] * rows
 
     def _generate(self, entry: dict, i: int, rows: int, kw: dict):
@@ -265,7 +326,7 @@ class EngineTarget:
         (the fixed-shape decoder) only have an answer at completion,
         so their ttft EQUALS their latency — which is exactly the
         comparison the continuous-batching bench draws."""
-        toks, lens = self._prompts(rows, i, entry.get("prompt_len"))
+        toks, lens = self._prompts(rows, i, entry)
         streamable = getattr(self.decode, "supports_stream", False)
         if entry.get("max_new") is not None and streamable:
             kw["max_new"] = int(entry["max_new"])
@@ -368,8 +429,20 @@ class HTTPTarget:
         rows = int(entry.get("rows", 1))
         if kind == "generate":
             L = int(entry.get("prompt_len") or self.prompt_len)
-            prompts = [[(i + r + j) % 7 + 1 for j in range(L)]
-                       for r in range(rows)]
+            tid = entry.get("template")
+            if tid is not None:
+                TL = min(int(entry.get("template_len", L)), L)
+                tmpl = [(int(tid) * 3 + 1 + j * j) % 7 + 1
+                        for j in range(TL)]
+                prompts = [tmpl + [(i + r + j) % 7 + 1
+                                   for j in range(L - TL)]
+                           for r in range(rows)]
+            elif entry.get("uniq"):
+                prompts = [[((i + r) // 7 ** j + j) % 7 + 1
+                            for j in range(L)] for r in range(rows)]
+            else:
+                prompts = [[(i + r + j) % 7 + 1 for j in range(L)]
+                           for r in range(rows)]
             obj = {"prompts": prompts}
             if entry.get("stream"):
                 obj["stream"] = True
@@ -559,10 +632,17 @@ class LoadGen:
 
 
 def score(results: Sequence[dict], slo_ms: float,
-          duration_s: Optional[float] = None) -> Dict:
+          duration_s: Optional[float] = None,
+          registry=None) -> Dict:
     """Ledger-row fields for one replay: latency percentiles over
     ANSWERED requests, SLO attainment (answered within ``slo_ms``),
-    outcome counts, throughput, and the worst pacer lag."""
+    outcome counts, throughput, and the worst pacer lag.
+
+    ``registry`` (the engine's obs registry) adds the server-side
+    prefill economics the prefix-cache bench reads:
+    ``prefill_dispatches`` (cxxnet_serve_prefills_total) and
+    ``prefix_hit_rate`` (cxxnet_prefix_{hits,misses}_total) — absent
+    when the series are (hit rate: when the cache is off)."""
     lats = sorted(r["latency_ms"] for r in results
                   if r["status"] == "ok")
     counts: Dict[str, int] = {}
@@ -602,6 +682,14 @@ def score(results: Sequence[dict], slo_ms: float,
     if toks:
         extra["tokens_out"] = toks
         extra["tok_per_sec"] = round(toks / duration_s, 1)
+    if registry is not None:
+        pf = registry.get_value("cxxnet_serve_prefills_total")
+        if pf is not None:
+            extra["prefill_dispatches"] = int(pf)
+        hits = registry.get_value("cxxnet_prefix_hits_total")
+        miss = registry.get_value("cxxnet_prefix_misses_total")
+        if hits is not None and miss is not None and hits + miss > 0:
+            extra["prefix_hit_rate"] = round(hits / (hits + miss), 4)
     return dict({
         "requests": len(results),
         "ok": n,

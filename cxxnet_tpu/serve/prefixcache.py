@@ -1,0 +1,344 @@
+"""Cross-request prefix cache: copy-on-write KV page sharing on the
+paged pool.
+
+At millions-of-users scale decode traffic is dominated by SHARED
+prompt prefixes — system prompts, few-shot templates, multi-turn
+history. The paged KV pool (serve/kvpool.py) already makes KV pages
+position-addressable through per-request block tables, so prompt K/V
+computed once can back any later request with the same prefix: this
+module is the host-side index that makes the match — a TOKEN-PREFIX
+TRIE keyed at ``kv_block`` (page) granularity, in the style of vLLM's
+PagedAttention block sharing and SGLang's RadixAttention.
+
+* One trie node = one FULL page of prompt tokens (``kv_block`` ids,
+  keyed by their bytes under the parent's path). The node owns a
+  refcounted pool page holding those tokens' K/V (int8 rungs share
+  the quantized pages AND their scale planes — one page id covers
+  K, V and both planes).
+* ``match_and_pin`` (admission time, @hot_path) walks a prompt's full
+  page-aligned chunks and returns the deepest cached path: the
+  request binds those pages into the head of its block table
+  (``pool.share`` per page) and runs INCREMENTAL prefill on only the
+  uncached tail (``ExportedStepDecoder.tail_prefill``). Matching is
+  capped at ``(plen - 1) // kv_block`` chunks so at least one prompt
+  token always remains to prefill — the first sampled token needs a
+  live forward pass — which also means a prompt that is NOT a
+  kv_block multiple never shares its straddling page.
+* COPY-ON-WRITE: shared pages are immutable prompt K/V. A request
+  extending a cached prefix writes its tail (and all decode tokens)
+  into pages it allocated itself (``scatter_prefill_kv(...,
+  starts=clen)`` starts past the shared pages; decode writes land at
+  slots >= P, whose pages are never shareable since a publishable
+  chunk must sit wholly inside the prompt) — so no device copy is
+  ever needed, and a "write" to shared content simply isn't
+  expressible.
+* ``publish`` runs after a successful prefill: each full page of the
+  prompt not yet in the trie transfers into it (the trie takes its
+  own ``pool.share`` reference on the request's page; the request
+  keeps decoding through it and releases its own reference at the
+  end, exactly like any other page).
+* EVICTION is LRU-by-leaf under a page-capacity bound, scored by
+  bytes_held x recompute_cost: every leaf holds one page (bytes
+  equal), and recomputing chunk ``d`` means prefilling
+  ``(d + 1) * kv_block`` tokens, so at equal recency the SHALLOWEST
+  (cheapest-to-recompute) leaf goes first. Pinned pages (live
+  requests hold the node) are never evicted; interior nodes are
+  never leaves, so a path stays intact while anything below it
+  lives. When every candidate is pinned the insert is skipped — the
+  pool must never be starved for live decode by cache growth.
+
+Thread-safe through the lockcheck seam; lock order is
+``serve.prefixcache.lock`` -> ``serve.kvpool.lock`` (the cache calls
+the pool, never the reverse). ``reset`` releases every trie-held
+reference — the engine's pool-integrity reset after a failed donated
+call routes through it so trie refs are released, not leaked."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..analysis import hot_path
+from ..analysis import lockcheck as _lockcheck
+
+
+class _Node:
+    __slots__ = ("key", "parent", "children", "page", "pins",
+                 "last_use", "depth", "src")
+
+    def __init__(self, key: bytes, parent, page: int, depth: int,
+                 tick: int, src: str):
+        self.key = key
+        self.parent = parent
+        self.children: Dict[bytes, "_Node"] = {}
+        self.page = int(page)
+        self.pins = 0
+        self.last_use = tick
+        self.depth = int(depth)
+        self.src = src          # publisher, for leak/double-free text
+
+    def label(self) -> str:
+        return "prefix-trie[d%d<-%s]" % (self.depth, self.src)
+
+
+class PrefixCache:
+    """Token-prefix trie over refcounted pool pages (module doc).
+
+    ``capacity_pages`` bounds trie-HELD pages (default: half the
+    pool's usable pages — the cache must leave room for live decode);
+    ``kv_block`` is the page granule (the artifact's);
+    ``reserve_pages`` (the engine passes ``blocks_per_seq``) clamps
+    any user-set capacity so at least one sequence's worth of pages
+    stays allocatable even with the trie full of exclusively-held
+    pages — without the clamp a capacity near the pool size could
+    wedge admission permanently (trie pages are only reclaimed by
+    eviction, and nothing evicts while nothing can prefill)."""
+
+    def __init__(self, pool, kv_block: int,
+                 capacity_pages: int = 0,
+                 reserve_pages: int = 0) -> None:
+        self.pool = pool
+        self.kv_block = int(kv_block)
+        if self.kv_block < 1:
+            raise ValueError("kv_block must be >= 1")
+        usable = pool.limit - 1
+        cap = int(capacity_pages) or max(usable // 2, 1)
+        self.capacity_pages = max(
+            min(cap, usable - int(reserve_pages)), 1)
+        self._lock = _lockcheck.make_lock("serve.prefixcache.lock")
+        self._root: Dict[bytes, _Node] = {}
+        self._tick = 0               # logical LRU clock (deterministic)
+        self.pages_held = 0
+        self.hits = 0                # requests that matched >= 1 page
+        self.misses = 0
+        self.evictions = 0
+        self.inserts = 0
+        self.pages_reused = 0        # shared page bindings handed out
+
+    # ------------------------------------------------------------------
+    def _chunks(self, tokens: np.ndarray, n: int):
+        kvb = self.kv_block
+        t = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        for d in range(n):
+            yield t[d * kvb:(d + 1) * kvb].tobytes()
+
+    @hot_path
+    def match_and_pin(self, tokens, owner: Optional[str] = None
+                      ) -> Tuple[List[_Node], List[int]]:
+        """Admission-time lookup: walk ``tokens``' full page chunks
+        down the trie and PIN the deepest cached path for the
+        request's lifetime (``unpin`` releases it). Returns
+        ``(nodes, pages)``; the pages carry one ``pool.share``
+        reference each for this request — its block table owns them
+        like any other page and releases them at row end. Capped at
+        ``(len - 1) // kv_block`` chunks so the tail keeps >= 1 token
+        (and a straddling partial page is never shared). ``tokens``
+        is host-side numpy (the engine's admitted prompt row) — the
+        lookup never touches device state."""
+        depth_max = max((len(tokens) - 1) // self.kv_block, 0)
+        out: List[_Node] = []
+        with self._lock:
+            self._tick += 1
+            children = self._root
+            for key in self._chunks(tokens, depth_max):
+                node = children.get(key)
+                if node is None:
+                    break
+                node.pins += 1
+                node.last_use = self._tick
+                out.append(node)
+                children = node.children
+            pages = [n.page for n in out]
+            if pages:
+                self.hits += 1
+                self.pages_reused += len(pages)
+                # the request's own reference on each shared page:
+                # lock order prefixcache -> kvpool, held here so a
+                # concurrent evict cannot free the page between the
+                # match and the share
+                self.pool.share(pages, owner=owner or "prefix-hit")
+            else:
+                self.misses += 1
+        return out, pages
+
+    def unpin(self, nodes: List[_Node]) -> None:
+        """Drop a request's eviction pins (its POOL references on the
+        shared pages are released separately, with the rest of its
+        block table)."""
+        if not nodes:
+            return
+        with self._lock:
+            for n in nodes:
+                if n.pins <= 0:
+                    raise AssertionError(
+                        "unpin of unpinned trie node at depth %d"
+                        % n.depth)
+                n.pins -= 1
+
+    # ------------------------------------------------------------------
+    def publish(self, tokens, blocks, owner: Optional[str] = None
+                ) -> int:
+        """After a successful (full or tail) prefill: walk the
+        prompt's full page chunks, inserting any not yet cached with
+        the request's own page at that position (``blocks[d]`` — the
+        trie takes its own pool reference; the request keeps its own
+        and releases it at row end). Full chunks only
+        (``(d + 1) * kv_block <= len(tokens)``): the straddling page
+        carries garbage past the prompt and — with prompt lengths
+        bounded by the prompt region P — decode writes can never land
+        in a published page. Returns how many pages were inserted;
+        inserts stop (skipped, not queued) when capacity is reached
+        and nothing evictable remains."""
+        tokens = np.asarray(tokens, np.int32)
+        nd = int(tokens.shape[0]) // self.kv_block
+        inserted = 0
+        with self._lock:
+            self._tick += 1
+            children = self._root
+            parent = None
+            path: List[_Node] = []
+            for d, key in enumerate(self._chunks(tokens, nd)):
+                node = children.get(key)
+                if node is None:
+                    while self.pages_held >= self.capacity_pages:
+                        if not self._evict_one_locked(protect=path):
+                            return inserted
+                    node = _Node(key, parent, blocks[d], d, self._tick,
+                                 owner or "?")
+                    # the trie's own reference: the page now outlives
+                    # the request that computed it — labeled with the
+                    # publisher, so leak/double-free diagnostics name
+                    # which request populated the page
+                    self.pool.share([node.page], owner=node.label())
+                    children[key] = node
+                    self.pages_held += 1
+                    self.inserts += 1
+                    inserted += 1
+                else:
+                    node.last_use = self._tick
+                path.append(node)
+                parent = node
+                children = node.children
+        return inserted
+
+    def reclaim(self, n_pages: int) -> int:
+        """POOL-pressure eviction: give back up to ``n_pages``
+        trie-held pages so live decode can allocate — the second
+        eviction trigger beside publish-time capacity overflow
+        (without it, a trie full of exclusively-held pages could
+        wedge admission: nothing evicts while nothing can prefill).
+        Only pages the trie holds EXCLUSIVELY free real pool space
+        (a page some request still shares survives in the pool
+        either way, so evicting it buys nothing); pinned leaves are
+        refused as always. Returns how many pages actually rejoined
+        the free list."""
+        freed = 0
+        with self._lock:
+            while freed < int(n_pages):
+                before = self.pool.free_blocks
+                if not self._evict_one_locked(exclusive_only=True):
+                    break
+                freed += self.pool.free_blocks - before
+        return freed
+
+    def _evict_one_locked(self, protect=(),
+                          exclusive_only: bool = False) -> bool:
+        """Evict the least valuable unpinned LEAF: LRU primary, then
+        bytes_held x recompute_cost — at one page per leaf the bytes
+        are equal and recompute cost grows with depth, so ties evict
+        the SHALLOWEST (cheapest to recompute) first. Returns False
+        when nothing is evictable (every leaf pinned/protected).
+        The full-trie scan is O(pages) per eviction — fine at the
+        page counts a pool holds (tens to a few hundred); an LRU
+        list of leaves is the upgrade if tries ever grow past
+        that."""
+        protect = set(id(n) for n in protect)
+        best = None
+        stack = list(self._root.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+                continue
+            if n.pins > 0 or id(n) in protect:
+                continue
+            if exclusive_only and self.pool.refcount(n.page) != 1:
+                continue
+            score = (n.last_use, n.depth)
+            if best is None or score < (best.last_use, best.depth):
+                best = n
+        if best is None:
+            return False
+        siblings = best.parent.children if best.parent is not None \
+            else self._root
+        del siblings[best.key]
+        self.pool.release([best.page], owner=best.label())
+        self.pages_held -= 1
+        self.evictions += 1
+        return True
+
+    # ------------------------------------------------------------------
+    def reset(self) -> int:
+        """Release EVERY trie-held pool reference and clear the trie —
+        the pool-integrity path: after a failed donated call the pool
+        buffers are rebuilt from scratch, so every cached page's
+        content is gone and holding its reference would leak the page
+        forever. Callers must unpin live requests first (their own
+        pool references are released with their block tables); a
+        still-pinned node here is an engine bug and raises."""
+        with self._lock:
+            released = 0
+            stack = list(self._root.values())
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                if n.pins > 0:
+                    raise AssertionError(
+                        "prefix-cache reset with %d live pins at "
+                        "depth %d — release the rows first" %
+                        (n.pins, n.depth))
+                self.pool.release([n.page], owner=n.label())
+                released += 1
+            self._root = {}
+            self.pages_held = 0
+            return released
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "pages_held": self.pages_held,
+                "capacity_pages": self.capacity_pages,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / total) if total else 0.0,
+                "pages_reused": self.pages_reused,
+                "evictions": self.evictions,
+                "inserts": self.inserts,
+            }
+
+    def bind_registry(self, registry, labels: Optional[dict] = None):
+        """Publish the cache counters into an obs registry at scrape
+        time: ``cxxnet_prefix_hits_total`` / ``_misses_total`` /
+        ``_evictions_total`` / ``_inserts_total`` and the
+        ``cxxnet_prefix_pages_held`` gauge (the pool's own
+        ``cxxnet_kv_pages_shared`` gauge shows the live sharing
+        footprint). Returns the hook for ``remove_hook``."""
+        labels = dict(labels or {})
+        names = tuple(labels)
+        cs = {f: registry.counter(
+            "cxxnet_prefix_%s_total" % f,
+            "prefix-cache %s since engine start" % f, names)
+            for f in ("hits", "misses", "evictions", "inserts")}
+        g_pages = registry.gauge(
+            "cxxnet_prefix_pages_held",
+            "KV pool pages currently owned by the prefix trie", names)
+
+        def hook():
+            snap = self.snapshot()
+            for f, c in cs.items():
+                c.set_total(snap[f], **labels)
+            g_pages.set(snap["pages_held"], **labels)
+        return registry.add_hook(hook)

@@ -324,6 +324,7 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                        kv_dtypes: Optional[Sequence[str]] = None,
                        step_buckets: Optional[Sequence[int]] = None,
                        paged_attend: str = "fused",
+                       tail_prefill: bool = True,
                        platforms: Optional[Sequence[str]] = None) -> None:
     """Serialize the SPLIT-PHASE decoder for continuous batching:
     instead of ``export_generate``'s one monolithic prefill+decode
@@ -379,6 +380,19 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
     ``pool_blocks`` (default: full occupancy + 1) sizes the shared
     pool, with block 0 reserved as the trash page unbound slots write
     into.
+
+    ``tail_prefill`` (default True) additionally serializes the
+    INCREMENTAL prefill programs the cross-request prefix cache
+    (serve/prefixcache.py) dispatches: one per (``kv_dtype`` x rows x
+    tail-width bucket), each computing K/V for only the UNCACHED tail
+    of a prompt while attending over the prefix pages already in the
+    pool (``generate.build_tail_prefill``; pool buffers are read-only
+    inputs, never donated — shared pages are copy-on-write). Only
+    tail widths a cached prompt can actually need are exported
+    (max tail = prompt_len - kv_block), and the whole family is
+    skipped when P <= kv_block (no full page ever fits inside the
+    prompt region, so nothing is shareable) — ``meta["ctx_blocks"]``
+    and the ``tail_prefill`` program entries record what shipped.
 
     Greedy outputs of the NATIVE rung are bitwise-identical to the
     monolithic ``export_generate`` artifact built from the same
@@ -510,6 +524,17 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
     rungs = []
     pool_shape = (pool_blocks, Ltot, nh, kv_block, d)
     scale_shape = pool_shape[:4]
+    # tail-prefill family (prefix cache): context = the prompt-region
+    # pages; only tail widths a cached prompt can need (the cache
+    # shares whole kv_block pages, so the max tail is
+    # prompt_len - kv_block) — and nothing at all when no full page
+    # fits inside the prompt region
+    ctx_blocks = -(-P // kv_block)
+    tail_widths = []
+    if tail_prefill and P > kv_block:
+        max_tail = max(prompt_len - kv_block, 1)
+        cover = next((w for w in widths if w >= max_tail), widths[-1])
+        tail_widths = [w for w in widths if w <= cover]
     # one program serialized and written at a time (see export_model):
     # no whole-artifact blob list resident at once
     with open(path, "wb") as f:
@@ -561,6 +586,30 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                 f.write(blob)
                 programs.append({"kind": "step", "kv_dtype": kvd,
                                  "batch": b, "bytes": len(blob)})
+            for w in tail_widths:
+                for r in rows:
+                    fn = G.build_tail_prefill(
+                        net, plan, float(temperature), r, w, kv_block,
+                        ctx_blocks, platform, kv=kvd)
+
+                    def tpre(*a, _fn=fn):
+                        return _fn(params, *a)
+
+                    # pool buffers are READ-ONLY inputs (no donation):
+                    # a tail prefill must never write a shared page —
+                    # the engine scatters the returned tail K/V into
+                    # the row's OWN pages afterwards
+                    blob = jexport.export(
+                        jax.jit(tpre), platforms=list(platforms))(
+                            *pool_args,
+                            SDS((r, w), np.int32), SDS((r,), np.int32),
+                            SDS((r,), np.int32),
+                            SDS((r, nblk), np.int32),
+                            SDS((2,), np.uint32)).serialize()
+                    f.write(blob)
+                    programs.append({"kind": "tail_prefill",
+                                     "kv_dtype": kvd, "rows": r,
+                                     "width": w, "bytes": len(blob)})
             isz = 1 if kvd == "int8" else pool_dt.itemsize
             ssz = 4 if kvd == "int8" else 0
             rungs.append({
@@ -593,6 +642,8 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
         "prefill_rows": rows, "prefill_widths": widths,
         "decode_layout": "paged", "decode_kv": kv_dtypes[0],
         "paged_attend": paged_attend,
+        "ctx_blocks": ctx_blocks,
+        "tail_prefill_widths": tail_widths,
         "kv_dtypes": kv_dtypes, "step_buckets": buckets,
         "rungs": rungs,
         "programs": programs,
@@ -633,12 +684,17 @@ class ExportedStepDecoder:
         self._pre = {}
         self._step = {}           # (kv_dtype, bucket) -> exported
         self._step_calls = {}     # (kv_dtype, bucket) -> donating fn
+        self._tail = {}           # (kv_dtype, rows, width) -> exported
+        self._tail_calls = {}     # same key -> staged wrapper
         lo = 0
         for pr in progs:
             exp = jexport.deserialize(blob[lo:lo + int(pr["bytes"])])
             lo += int(pr["bytes"])
             if pr["kind"] == "prefill":
                 self._pre[(int(pr["rows"]), int(pr["width"]))] = exp
+            elif pr["kind"] == "tail_prefill":
+                self._tail[(pr.get("kv_dtype", "native"),
+                            int(pr["rows"]), int(pr["width"]))] = exp
             else:
                 # pre-rung (r10) metas carry a bare {"kind": "step"}:
                 # one native program at the full slot count
@@ -756,6 +812,104 @@ class ExportedStepDecoder:
         """Smallest exported prefill row bucket holding n rows whole;
         the max bucket when none does (the caller then chunks)."""
         return _pick_bucket(self.prefill_rows, n)
+
+    # -- incremental (tail) prefill: the prefix-cache programs --------
+    @property
+    def ctx_blocks(self) -> int:
+        """Prompt-region pages a tail prefill gathers as its attend
+        context (``ceil(P / kv_block)``; meta-recorded)."""
+        m = self.meta
+        return int(m.get("ctx_blocks",
+                         -(-int(m["prompt_slots"]) // self.kv_block)))
+
+    def has_tail_prefill(self, kv: str = "native") -> bool:
+        """Whether the artifact carries the ``kv`` rung's incremental
+        prefill family — the prefix cache's hard prerequisite (pre-r14
+        artifacts, and exports whose prompt region holds no full page,
+        have none: the engine then serves with the cache off)."""
+        return any(kvd == kv for kvd, _, _ in self._tail)
+
+    def tail_widths(self, kv: str = "native") -> list:
+        """Exported tail-width buckets of the ``kv`` rung family."""
+        return sorted({w for kvd, _, w in self._tail if kvd == kv})
+
+    def pick_tail_width(self, tail_len: int, kv: str = "native") -> int:
+        """Smallest exported tail-width bucket holding ``tail_len``
+        uncached tokens."""
+        for w in self.tail_widths(kv):
+            if w >= tail_len:
+                return w
+        raise ValueError(
+            "tail of %d tokens exceeds the widest exported "
+            "tail-prefill bucket (%s rung: %s)"
+            % (tail_len, kv, self.tail_widths(kv)))
+
+    def tail_call(self, kv: str, rows: int, width: int):
+        """The (``kv``, ``rows``, ``width``) tail-prefill program:
+        ``(pools..., toks (rows, width), clens (rows,), lens (rows,),
+        bt (rows, nblk), key) -> (first (rows,), k (L, rows, nh,
+        width, d), v)``. Pool buffers pass through READ-ONLY (no
+        donation — shared prefix pages are copy-on-write, the caller
+        scatters the tail K/V into the row's own pages); the per-call
+        host arrays are staged through ``stage_host`` so the armed
+        transfer sentinel sees a clean steady state."""
+        key = (kv, int(rows), int(width))
+        fn = self._tail_calls.get(key)
+        if fn is None:
+            from .analysis import shardcheck as _shardcheck
+            exp = self._tail.get(key)
+            if exp is None:
+                raise ValueError(
+                    "artifact has no (%s, rows=%d, width=%d) tail-"
+                    "prefill program (exported: %s)"
+                    % (kv, rows, width, sorted(self._tail)))
+            site = "ExportedStepDecoder.tail[%s,r%d,w%d]" \
+                % (kv, rows, width)
+            inner = _shardcheck.make_sharded(
+                exp.call, in_shardings=self.meta.get("in_shardings"),
+                site=site, always=True)
+
+            def fn(*a, _inner=inner):
+                return _inner(*stage_host(*a))
+
+            fn.__name__ = "staged[%s]" % site
+            fn.__wrapped__ = inner
+            self._tail_calls[key] = fn
+        return fn
+
+    def tail_prefill(self, pools, tokens, clens, lens, bt, key,
+                     kv: str = "native"):
+        """Run the smallest (rows, tail-width) bucket holding the
+        uncached tails: ``tokens (n, >= max tail)`` carries each row's
+        TAIL tokens left-aligned, ``clens`` the cached prefix lengths
+        (kv_block multiples), ``lens`` the absolute prompt lengths,
+        ``bt (n, blocks_per_seq)`` the full per-row block tables
+        (shared prefix pages first). Pads rows with 1-token dummies on
+        trash tables, trims the outputs back to ``n``. Returns
+        ``(first (n,), k (L, n, nh, w, d), v)`` — the caller scatters
+        k/v into the rows' OWN pages from ``starts=clens``."""
+        n = int(tokens.shape[0])
+        clens = np.asarray(clens, np.int32)
+        lens = np.asarray(lens, np.int32)
+        tl = int((lens - clens).max(initial=1))
+        w = self.pick_tail_width(tl, kv)
+        r = self.pick_rows(n)
+        if r < n:
+            raise ValueError(
+                "tail prefill of %d rows exceeds the largest exported "
+                "prefill bucket %d — chunk the request" % (n, r))
+        toks = np.zeros((r, w), np.int32)
+        toks[:n, :min(w, tokens.shape[1])] = \
+            np.asarray(tokens, np.int32)[:, :w]
+        cl = np.zeros((r,), np.int32)
+        cl[:n] = clens
+        ls = np.ones((r,), np.int32)
+        ls[:n] = lens
+        btm = np.zeros((r, self.blocks_per_seq), np.int32)
+        btm[:n] = np.asarray(bt, np.int32)
+        first, k, v = self.tail_call(kv, r, w)(
+            *pools, toks, cl, ls, btm, key)
+        return first[:n], k[:, :n], v[:, :n]
 
     def new_pool(self, kv: str = "native"):
         """Fresh zeroed pool buffers at the exported geometry
@@ -966,7 +1120,8 @@ class ExportedStepDecoder:
 _SCATTER_CACHE: dict = {}
 
 
-def scatter_prefill_kv(pools, k, v, block_tables, kv_block: int):
+def scatter_prefill_kv(pools, k, v, block_tables, kv_block: int,
+                       starts=None, valid=None):
     """Scatter prefill K/V ``(L, n, nh, W, d)`` into the paged pool at
     each row's block table (logical prompt slot ``j`` maps to page
     ``bt[j // kv_block]`` offset ``j % kv_block``). ``pools`` is the
@@ -978,7 +1133,18 @@ def scatter_prefill_kv(pools, k, v, block_tables, kv_block: int):
     tokens with). One jitted scatter with every pool array DONATED,
     so XLA updates the pool in place (the caller must drop its old
     references — the returned tuple replaces them); without donation
-    every prefill would memcpy the whole pool through a copy."""
+    every prefill would memcpy the whole pool through a copy.
+
+    ``starts`` (per-row, kv_block multiples) makes the scatter
+    OFFSET-CAPABLE — the prefix-cache tail prefill writes its K/V
+    from logical slot ``starts[r]`` (i.e. from a start PAGE) instead
+    of slot 0, so shared prefix pages below it are never touched
+    (copy-on-write). ``valid`` (per-row tail lengths) routes the pad
+    columns past each row's real tail to the trash page: an offset
+    write's padding would otherwise land past the row's region. Both
+    are HOST-side index arithmetic — the jitted program (and its
+    compile cache key) is unchanged, which also keeps the recompile
+    sentinel's warmup coverage intact."""
     import jax
     bt = np.asarray(block_tables, np.int32)          # (n, nb)
     n = bt.shape[0]
@@ -1030,9 +1196,23 @@ def scatter_prefill_kv(pools, k, v, block_tables, kv_block: int):
                                       always=True)
         _SCATTER_CACHE[key] = fn
     cols = np.arange(W)
-    b_idx = bt[:, cols // kv_block].astype(np.int32)      # (n, W)
-    off = np.ascontiguousarray(np.broadcast_to(
-        cols % kv_block, (n, W))).astype(np.int32)
+    if starts is None:
+        b_idx = bt[:, cols // kv_block].astype(np.int32)  # (n, W)
+        off = np.ascontiguousarray(np.broadcast_to(
+            cols % kv_block, (n, W))).astype(np.int32)
+    else:
+        logical = np.asarray(starts, np.int64)[:, None] \
+            + cols[None, :]                               # (n, W)
+        page = np.minimum(logical // kv_block, bt.shape[1] - 1)
+        b_idx = np.take_along_axis(bt, page, axis=1).astype(np.int32)
+        off = np.ascontiguousarray(logical % kv_block).astype(np.int32)
+        if valid is not None:
+            # pad columns past the row's real tail write to the trash
+            # page (0): an offset scatter's padding would otherwise
+            # land past the row's own region
+            keep = cols[None, :] < np.asarray(valid,
+                                              np.int64)[:, None]
+            b_idx = np.where(keep, b_idx, 0).astype(np.int32)
     return fn(*pools, k, v, *stage_host(b_idx, off))
 
 

@@ -118,8 +118,8 @@ def test_access_log_from_live_server_replays(tmp_path):
 
 def test_catalog_names_and_determinism():
     assert set(("bursty", "mixed_priority", "mixed_kinds",
-                "slow_client", "steady",
-                "mixed_prompt_len")) == set(SCENARIOS)
+                "slow_client", "steady", "mixed_prompt_len",
+                "shared_prefix")) == set(SCENARIOS)
     for name in SCENARIOS:
         a = make_scenario(name, duration_s=2.0, rps=50, seed=11)
         b = make_scenario(name, duration_s=2.0, rps=50, seed=11)

@@ -40,14 +40,35 @@ each, and scores the requested KV rung (``--kv``):
   agreement AGAINST THAT, plus matched chain validity vs exact, the
   end-task cross-check.
 
+``--prefix`` mode — the cross-request prefix cache
+(serve/prefixcache.py) must not change what a user reads: the same
+oracle prompts (all extending ONE shared template prefix, suffixes
+diverging per row — the worst case for the cache's copy-on-write
+bookkeeping) decode through a COLD continuous engine (prefix cache
+off) and a WARMED one (cache on, template pages published by a first
+pass, the scored pass all hits), and the outputs are compared:
+
+* ``--kv native``: greedy agreement must be 1.0 BIT-FOR-BIT — the
+  incremental tail prefill attends over the pooled prefix pages with
+  exactly the cold program's math (docs/serving.md);
+* ``--kv int8``: the tail attends over DEQUANTIZED int8 pages +
+  scale planes, so cached-vs-cold is approximate at the rung's usual
+  ~1% attend-error bound — gated at >= 0.99 agreement with matched
+  chain validity.
+
+The run also asserts the cache actually engaged (hit rate > 0, tail
+prefills dispatched) — a silently-cold "parity" pass proves nothing.
+
 ``--net tiny`` swaps the gpt2-small recipe for a small LM at the same
-oracle (seq 128, prompt 64, max_new 64 — still 128-granule aligned)
-so the parity gate runs in minutes on a CPU rig.
+oracle (seq 128, prompt 64, max_new 64 — still 128-granule aligned;
+``--prefix`` raises it to seq 256 / prompt 160 so the prompt region
+holds a full shareable page) so the gates run in minutes on a CPU rig.
 
 One JSON line per run; paste-ready for docs/performance.md.
 
 Usage: python tools/decode_quality.py [--rounds 4] [--batch 32]
        python tools/decode_quality.py --paged [--net tiny]
+       python tools/decode_quality.py --prefix [--net tiny]
 """
 import argparse
 import json
@@ -78,12 +99,18 @@ def main():
                          "split-phase one instead of int8 vs exact — "
                          "greedy outputs must match bitwise on the "
                          "native rung")
+    ap.add_argument("--prefix", action="store_true",
+                    help="compare the continuous engine's decode of "
+                         "shared-template prompts with the prefix "
+                         "cache COLD vs WARMED instead — greedy "
+                         "outputs must match bitwise on the native "
+                         "rung")
     ap.add_argument("--kv", choices=("native", "int8"),
                     default="native",
-                    help="--paged mode: which exported KV rung to "
-                         "score (int8 = quantized pool pages + scale "
-                         "planes; agreement-threshold gate instead "
-                         "of bitwise)")
+                    help="--paged/--prefix mode: which exported KV "
+                         "rung to score (int8 = quantized pool pages "
+                         "+ scale planes; agreement-threshold gate "
+                         "instead of bitwise)")
     ap.add_argument("--net", choices=("gpt2", "tiny"), default="gpt2",
                     help="tiny: a small LM at a 128-granule-aligned "
                          "oracle shape (CPU-rig friendly)")
@@ -92,6 +119,11 @@ def main():
     global SEQ, VOCAB, PROMPT, MAX_NEW
     if args.net == "tiny":
         SEQ, VOCAB, PROMPT, MAX_NEW = 128, 256, 64, 64
+        if args.prefix:
+            # the prefix cache shares whole 128-slot pages, so the
+            # prompt region must hold at least one (prompt 160 ->
+            # P = 192)
+            SEQ, PROMPT, MAX_NEW = 256, 160, 64
 
     import perf_lab
 
@@ -146,6 +178,92 @@ def main():
         nxt = o[:, PROMPT:PROMPT + MAX_NEW]
         ok = (succ[prev] == nxt[..., None]).any(-1)
         return float(ok.mean())
+
+    if args.prefix:
+        import tempfile
+
+        from cxxnet_tpu import serving
+        from cxxnet_tpu.obs.registry import Registry
+        from cxxnet_tpu.serve.continuous import ContinuousDecodeEngine
+
+        # every prompt extends ONE shared template (drawn from the
+        # chain), suffixes diverging per row AFTER the last full
+        # page — so the cache shares the template pages and every
+        # row still ends in distinct context
+        TL = PROMPT - 8
+        xp = gen(1, 999)
+        template = xp[0, :TL].copy()
+        toks = np.zeros((args.batch, SEQ), np.int32)
+        g = np.random.RandomState(7)
+        for r in range(args.batch):
+            toks[r, :TL] = template
+            cur = template[-1]
+            for j in range(TL, PROMPT):
+                cur = succ[cur, g.randint(0, 4)]
+                toks[r, j] = cur
+        lens = np.full(args.batch, PROMPT, np.int32)
+
+        td = tempfile.mkdtemp(prefix="decq_")
+        step_p = os.path.join(td, "step.export")
+        serving.export_decode_step(tr, step_p, max_new=MAX_NEW,
+                                   temperature=0.0, prompt_len=PROMPT,
+                                   kv_dtypes=[args.kv])
+
+        def drive(prefix_on, passes):
+            reg = Registry()
+            eng = ContinuousDecodeEngine(
+                serving.load_exported(step_p), warmup=True,
+                kv_dtype=args.kv, registry=reg,
+                prefix_cache=True if prefix_on else False)
+            try:
+                out = None
+                for _ in range(passes):
+                    outs = []
+                    for r in range(args.batch):
+                        req = eng.submit_tokens(toks[r:r + 1],
+                                                [PROMPT])
+                        outs.append(np.asarray(req.result(300.0)))
+                    out = np.concatenate(outs, 0)
+                m = eng.metrics()
+            finally:
+                eng.close()
+            eng.pool.assert_empty()        # zero-leak gate
+            return out, m
+
+        cold, m_cold = drive(False, 1)
+        # pass 1 warms the trie (row 0 publishes, later rows already
+        # hit); pass 2 is the scored all-hit pass
+        cached, m_hot = drive(True, 2)
+        pc = m_hot["prefix_cache"]
+        if pc["hits"] == 0 or m_hot["tail_prefills"] == 0:
+            raise SystemExit("prefix cache never engaged: %r" % pc)
+        agreement = float(
+            (cold[:, gen_slice] == cached[:, gen_slice]).mean())
+        row = {
+            "experiment": "decode_quality_prefix_parity",
+            "net": args.net, "rounds_trained": args.rounds,
+            "batch": args.batch, "prompt": PROMPT, "max_new": MAX_NEW,
+            "template_len": TL, "kv_dtype": args.kv,
+            "greedy_agreement_cached_vs_cold": round(agreement, 5),
+            "bitwise_identical": bool(np.array_equal(cold, cached)),
+            "chain_validity_cold": round(validity(cold), 5),
+            "chain_validity_cached": round(validity(cached), 5),
+            "prefix_hit_rate": round(pc["hit_rate"], 4),
+            "prefix_pages_held": pc["pages_held"],
+            "tail_prefills": m_hot["tail_prefills"],
+            "pool_page_leaks": 0,      # assert_empty passed above
+            "train_wall_s": round(time.time() - t0, 1),
+        }
+        print(json.dumps(row), flush=True)
+        gate = 1.0 if args.kv == "native" else 0.99
+        if agreement < gate:
+            raise SystemExit(
+                "cached-vs-cold agreement %.5f below the %s gate %g"
+                % (agreement, args.kv, gate))
+        if args.kv == "native" and not row["bitwise_identical"]:
+            raise SystemExit("native rung cached decode is not "
+                             "bitwise-identical to cold")
+        return
 
     if args.paged:
         import tempfile

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Prefix-cache smoke: the cross-request copy-on-write KV sharing
+loop proven end to end, watchdogged for CI.
+
+One command exercises the whole prefix-cache lifecycle
+(docs/serving.md) with BOTH runtime sentinels armed — the jitcheck
+recompile detector and the shardcheck transfer guard — so a cache hit
+mid-traffic dispatching an unwarmed tail program, or a trie lookup
+paying a hidden host transfer, fails loudly:
+
+1. train a tiny LM whose prompt region holds a full shareable
+   kv_block page, export the split-phase decoder WITH its tail-
+   prefill family, and start a continuous engine (warmup covers every
+   tail program before the sentinels arm);
+2. WARM the cache: template-sharing prompts decode, the template's
+   page is published, and a second wave must HIT (binding shared
+   pages + incremental tail prefill);
+3. KILL-AND-READMIT: a step-hook fault fails the in-flight window —
+   the pool-integrity reset must release the trie's held references
+   (not leak them) and void queued matches — then the SAME prompts
+   readmit cold, re-warm the trie, and hit again;
+4. assert: all readmitted traffic answered, final hit rate > 0, ZERO
+   pool-page leaks at drain (the refcount ledger balances through the
+   fault), 0 steady-state recompiles and 0 implicit transfers /
+   reshards with both sentinels armed.
+
+``run()`` is the in-process entry point the tier-1 test uses
+(tests/test_prefixcache.py, the scenario_smoke pattern); ``main()``
+adds the watchdog for standalone/CI use.
+
+Usage: JAX_PLATFORMS=cpu python tools/prefix_smoke.py [--timeout 300]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SEQ, PROMPT, MAX_NEW, VOCAB = 200, 160, 6, 16
+
+
+def _watchdog(seconds: int):
+    def fire():
+        import faulthandler
+        sys.stderr.write("prefix_smoke: DEADLOCK — no completion "
+                         "within %ds; thread dump follows\n" % seconds)
+        faulthandler.dump_traceback()
+        os._exit(2)
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _artifact(td):
+    import numpy as np
+
+    from cxxnet_tpu import config, models, serving
+    from cxxnet_tpu.io import DataBatch
+    from cxxnet_tpu.trainer import Trainer
+
+    tr = Trainer()
+    for k, v in config.parse_string(models.tiny_lm(
+            seq_len=SEQ, vocab=VOCAB, embed=32, nlayer=1, nhead=2)):
+        tr.set_param(k, v)
+    for k, v in (("batch_size", "2"), ("dev", "cpu:0"), ("eta", "0.3"),
+                 ("seed", "0"), ("metric", "token_error")):
+        tr.set_param(k, v)
+    tr.init_model()
+    rs = np.random.RandomState(0)
+    for _ in range(3):
+        start = rs.randint(0, VOCAB, size=(2, 1))
+        seq = (start + np.arange(SEQ + 1)) % VOCAB
+        tr.update(DataBatch(
+            data=seq[:, :SEQ].astype(np.float32).reshape(2, 1, SEQ, 1),
+            label=seq[:, 1:].astype(np.float32)))
+    path = os.path.join(td, "prefix_smoke.export")
+    serving.export_decode_step(tr, path, max_new=MAX_NEW,
+                               temperature=0.0, prompt_len=PROMPT,
+                               prefill_rows=[1, 2],
+                               platforms=["cpu"])
+    return path
+
+
+def run() -> int:
+    import numpy as np
+
+    from cxxnet_tpu import serving
+    from cxxnet_tpu.analysis import jitcheck, shardcheck
+    from cxxnet_tpu.obs.registry import Registry
+    from cxxnet_tpu.serve.continuous import ContinuousDecodeEngine
+
+    rc = 0
+    checks = []
+
+    def check(name, ok, detail=""):
+        checks.append((name, bool(ok), detail))
+        return bool(ok)
+
+    tmpl = ((np.arange(144) * 5 + 3) % VOCAB).astype(np.int32)
+
+    def prompts(n, seed):
+        g = np.random.RandomState(seed)
+        toks = np.zeros((n, SEQ), np.int32)
+        lens = np.zeros((n,), np.int32)
+        for r in range(n):
+            plen = 150 + r
+            toks[r, :144] = tmpl
+            toks[r, 144:plen] = g.randint(0, VOCAB, plen - 144)
+            lens[r] = plen
+        return toks, lens
+
+    def wave(eng, toks, lens, expect_error=False):
+        reqs = [eng.submit_tokens(toks[r:r + 1], [int(lens[r])])
+                for r in range(toks.shape[0])]
+        ok = errs = 0
+        for req in reqs:
+            try:
+                req.result(60.0)
+                ok += 1
+            except Exception:
+                errs += 1
+        return ok, errs
+
+    with tempfile.TemporaryDirectory() as td:
+        path = _artifact(td)
+        # sentinel discipline (the scenario_smoke pattern): jitcheck
+        # enables FIRST — its enable can itself fail — and every later
+        # global flip happens inside the try so the finally unwinds
+        # them all even on a setup failure
+        jit_mon = jitcheck.enable()
+        eng = None
+        shard_mon = None
+        fault = {"arm": False, "fired": False}
+
+        def step_hook():
+            if fault["arm"]:
+                fault["arm"] = False
+                fault["fired"] = True
+                raise RuntimeError("injected step fault (smoke)")
+
+        try:
+            shard_mon = shardcheck.enable()
+            reg = Registry()
+            eng = ContinuousDecodeEngine(
+                serving.load_exported(path), warmup=True,
+                registry=reg, step_hook=step_hook,
+                prefix_cache=True)
+            # warmup covered every prefill/tail/step program: armed
+            # steady state must compile and transfer NOTHING
+            jit_mon.arm()
+            shard_mon.arm()
+
+            t1, l1 = prompts(2, 1)
+            ok1, e1 = wave(eng, t1, l1)          # warms the trie
+            ok2, e2 = wave(eng, t1, l1)          # must hit
+            pc = eng.metrics()["prefix_cache"]
+            check("warm_traffic_answered",
+                  ok1 + ok2 == 4 and e1 + e2 == 0,
+                  "ok %d/%d err %d" % (ok1 + ok2, 4, e1 + e2))
+            check("cache_warmed_and_hit",
+                  pc["hits"] >= 2 and pc["pages_held"] >= 1, pc)
+
+            # kill: fault the NEXT decode step mid-window — the pool-
+            # integrity reset must release trie-held refs, not leak
+            fault["arm"] = True
+            t2, l2 = prompts(2, 2)
+            okf, ef = wave(eng, t2, l2)
+            check("fault_fired_and_failed_inflight",
+                  fault["fired"] and ef >= 1,
+                  "fired=%s ok=%d err=%d" % (fault["fired"], okf, ef))
+            check("reset_released_trie_refs",
+                  eng.metrics()["prefix_cache"]["pages_held"] == 0
+                  and eng.pool.in_use == 0,
+                  eng.pool.snapshot())
+
+            # readmit: the same prompts run cold, re-warm, hit again
+            ok3, e3 = wave(eng, t1, l1)
+            ok4, e4 = wave(eng, t1, l1)
+            pc = eng.metrics()["prefix_cache"]
+            check("readmitted_traffic_answered",
+                  ok3 + ok4 == 4 and e3 + e4 == 0,
+                  "ok %d err %d" % (ok3 + ok4, e3 + e4))
+            check("hit_rate_after_readmit",
+                  pc["hit_rate"] > 0 and pc["hits"] >= 3, pc)
+
+            eng.drain(timeout=5.0)
+            check("recompile_clean", jit_mon.steady_compiles == 0,
+                  [repr(v) for v in jit_mon.violations()[:3]])
+            check("recompile_instrumented", jit_mon.total_compiles > 0,
+                  jit_mon.total_compiles)
+            check("transfer_clean",
+                  shard_mon.steady_transfers_total == 0
+                  and shard_mon.steady_reshards_total == 0,
+                  {"transfers": dict(shard_mon.steady_transfers),
+                   "reshards": dict(shard_mon.steady_reshards)})
+        finally:
+            if eng is not None:
+                eng.close()
+            if shard_mon is not None:
+                shardcheck.disable()
+            jitcheck.disable()
+        try:
+            eng.pool.assert_empty()
+            check("zero_pool_page_leaks_at_drain", True)
+        except AssertionError as e:
+            check("zero_pool_page_leaks_at_drain", False, str(e))
+
+    for name, ok, detail in checks:
+        print("prefix_smoke[%s]: %s %s"
+              % ("ok" if ok else "FAIL", name,
+                 detail if not ok else ""))
+        if not ok:
+            rc = 1
+    if rc == 0:
+        print("prefix_smoke ok")
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--timeout", type=int, default=300,
+                    help="watchdog: hard-exit 2 after this many "
+                         "seconds")
+    args = ap.parse_args()
+    _watchdog(args.timeout)
+    return run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
