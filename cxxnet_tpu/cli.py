@@ -25,6 +25,43 @@ from .trainer import GroupStager, StagedBatch, Trainer
 ConfigEntry = Tuple[str, str]
 
 
+def parse_mesh_spec(val: str) -> Tuple[int, int]:
+    """``export_mesh`` / ``serve_mesh`` syntax: ``D`` (data-parallel
+    ways) or ``DxM`` / ``D,M`` (data x model) -> (data, model)."""
+    s = val.strip().lower().replace("x", ",")
+    parts = [int(p) for p in s.split(",") if p.strip()]
+    if not parts or len(parts) > 2 or any(p < 1 for p in parts):
+        raise ValueError(
+            "mesh spec must be D or DxM (data[,model] ways, each "
+            ">= 1), got %r" % val)
+    return parts[0], parts[1] if len(parts) > 1 else 1
+
+
+def check_serve_mesh(mesh_s: str, mesh_meta, src: str) -> None:
+    """``serve_mesh``: the operator's topology intent, checked against
+    what the artifact actually carries (``mesh_meta`` = the meta's
+    mesh stanza or None) — deploying a single-device artifact where a
+    4-way mesh was expected (or vice versa) fails HERE with both
+    named, not as mysterious capacity/latency at traffic time. Both
+    serve topologies (single engine AND the replica router) run
+    through this."""
+    if not mesh_s or mesh_s == "0":
+        return
+    want_dp, want_mp = parse_mesh_spec(mesh_s)
+    have = dict(zip(mesh_meta["axes"], mesh_meta["shape"])) \
+        if mesh_meta else {}
+    have_dp = int(have.get("data", 1))
+    have_mp = int(have.get("model", 1))
+    if (want_dp, want_mp) != (have_dp, have_mp):
+        raise RuntimeError(
+            "serve_mesh=%s expects a %dx%d (data x model) mesh "
+            "artifact, but %s carries %s — re-export with "
+            "export_mesh=%s or fix serve_mesh"
+            % (mesh_s, want_dp, want_mp, src,
+               "mesh %s" % (mesh_meta,) if mesh_meta
+               else "no mesh (single-device)", mesh_s))
+
+
 class LearnTask:
     def __init__(self) -> None:
         self.cfg: List[ConfigEntry] = []
@@ -307,8 +344,12 @@ class LearnTask:
                                    # typed rungs (docs/serving.md)
                                    "export_kv_dtype",
                                    "export_paged_attend",
-                                   "export_step_buckets"]),
+                                   "export_step_buckets",
+                                   # mesh-carrying artifacts
+                                   # (sharded serving)
+                                   "export_mesh"]),
         "serve": frozenset(["export_in", "serve_host", "serve_port",
+                            "serve_mesh",
                             "serve_max_wait_ms", "serve_max_batch",
                             "serve_queue_limit", "serve_timeout_ms",
                             "serve_dispatch_depth", "serve_warmup",
@@ -849,13 +890,31 @@ class LearnTask:
         trainer's decode_kv) picks the cache-dtype rungs,
         export_step_buckets (comma list) adds sub-batch decode-step
         rungs, export_paged_attend (fused|gather, default fused)
-        picks the attend kernel (docs/serving.md rung table)."""
+        picks the attend kernel (docs/serving.md rung table).
+        export_mesh = D | DxM emits a MESH-CARRYING artifact for any
+        of the three export kinds: programs compiled under pjit with
+        explicit shardings over a data(xmodel) mesh on the local
+        devices, the mesh + per-arg PartitionSpecs recorded in the
+        meta, batch ladders rounded up to data-axis multiples
+        (docs/serving.md "sharded serving")."""
         from . import serving
         d = dict(self.cfg)
         out = d.get("export_out", "model.export")
         plats = d.get("export_platform", "")
         platforms = [p.strip() for p in plats.split(",") if p.strip()] \
             or None
+        # export_mesh = D | DxM: emit a MESH-CARRYING artifact — every
+        # program compiled under pjit with explicit shardings over a
+        # data(xmodel) mesh on the local devices, mesh + PartitionSpecs
+        # recorded in the meta (docs/serving.md "sharded serving")
+        mesh = None
+        mesh_s = d.get("export_mesh", "").strip()
+        if mesh_s and mesh_s != "0":
+            dpw, mpw = parse_mesh_spec(mesh_s)
+            if dpw * mpw > 1:
+                mesh = serving.make_serving_mesh(
+                    dpw, mpw,
+                    platform=platforms[0] if platforms else None)
         bs = int(d.get("export_batch", "0")) or None
         ladder_s = d.get("export_batch_ladder", "").strip()
         if ladder_s == "auto":
@@ -889,8 +948,9 @@ class LearnTask:
                               if x.strip()] or None,
                 paged_attend=d.get("export_paged_attend",
                                    "fused").strip() or "fused",
-                platforms=platforms)
-            print("exported split-phase decoder to %s (+.meta)" % out)
+                platforms=platforms, mesh=mesh)
+            print("exported split-phase decoder to %s (+.meta)%s"
+                  % (out, " [mesh %s]" % mesh_s if mesh else ""))
             return
         if int(dec or "0"):
             serving.export_generate(
@@ -899,12 +959,15 @@ class LearnTask:
                 temperature=float(d.get("temperature", "0")),
                 prompt_len=int(d.get("export_prompt_len", "0")) or None,
                 batch_size=bs, batch_ladder=ladder,
-                platforms=platforms)
-            print("exported decoder to %s (+.meta)" % out)
+                platforms=platforms, mesh=mesh)
+            print("exported decoder to %s (+.meta)%s"
+                  % (out, " [mesh %s]" % mesh_s if mesh else ""))
             return
         serving.export_model(self.trainer, out, batch_size=bs,
-                             batch_ladder=ladder, platforms=platforms)
-        print("exported model to %s (+.meta)" % out)
+                             batch_ladder=ladder, platforms=platforms,
+                             mesh=mesh)
+        print("exported model to %s (+.meta)%s"
+              % (out, " [mesh %s]" % mesh_s if mesh else ""))
 
     def task_serve(self) -> None:
         """task=serve: dynamic-batching HTTP inference server
@@ -923,6 +986,19 @@ class LearnTask:
         first-call compile), serve_access_log (default 0: one
         structured JSON line per request on stderr — method, path,
         status, request_id, wall ms; docs/observability.md).
+
+        MESH-CARRYING artifacts (export_mesh=D[xM] at export time;
+        docs/serving.md "sharded serving") serve through the same
+        engines: the artifact's recorded mesh is realized on the
+        local devices at load (a topology that cannot carry it fails
+        with the expected vs available counts named), every dispatch
+        stages its batch directly into the declared shards, and on a
+        split-phase decoder the paged KV pool allocates per mesh
+        slice. serve_mesh = D | DxM asserts the operator's intended
+        topology against what the artifact carries (default 0 =
+        accept the artifact as-is); serve_replicas > 1 rejects mesh
+        artifacts (the mesh IS the scale-out — N replicas would
+        contend for the same devices).
 
         A generate_step artifact (export_decode=step) serves through
         the CONTINUOUS-BATCHING engine instead (serve/continuous.py):
@@ -998,16 +1074,34 @@ class LearnTask:
             from .serve.router import Router
             path = d["export_in"]
             meta_path = path + ".meta"
+            _meta = {}
             if os.path.exists(meta_path):
                 import json as _json
                 with open(meta_path) as f:
-                    if _json.load(f).get("kind") == "generate_step":
-                        raise RuntimeError(
-                            "serve_replicas > 1 does not support "
-                            "generate_step artifacts: the continuous-"
-                            "batching engine is single-replica (set "
-                            "serve_replicas=1, or export a monolithic "
-                            "decoder for the router topology)")
+                    _meta = _json.load(f)
+                if _meta.get("kind") == "generate_step":
+                    raise RuntimeError(
+                        "serve_replicas > 1 does not support "
+                        "generate_step artifacts: the continuous-"
+                        "batching engine is single-replica (set "
+                        "serve_replicas=1, or export a monolithic "
+                        "decoder for the router topology)")
+                if _meta.get("mesh"):
+                    raise RuntimeError(
+                        "serve_replicas > 1 does not support "
+                        "mesh-carrying artifacts: every replica "
+                        "would contend for the same %s mesh devices "
+                        "— the mesh itself is the scale-out (one "
+                        "engine serves every shard); set "
+                        "serve_replicas=1, or export without "
+                        "export_mesh for the router topology"
+                        % (_meta["mesh"].get("shape"),))
+            # the operator's serve_mesh assertion applies to the
+            # router topology too (a mesh artifact was rejected just
+            # above, so this catches the other direction: expecting a
+            # mesh from an artifact that carries none)
+            check_serve_mesh(d.get("serve_mesh", "").strip(),
+                             _meta.get("mesh"), path)
             rs = ReplicaSet(
                 lambda: serving.load_exported(path), n=n_rep,
                 engine_kw=engine_kw, registry=get_registry(),
@@ -1028,6 +1122,10 @@ class LearnTask:
                 raise RuntimeError(
                     "task=serve needs export_in=<artifact> or "
                     "model_in=<ckpt>")
+            check_serve_mesh(
+                d.get("serve_mesh", "").strip(),
+                (getattr(callee, "meta", None) or {}).get("mesh"),
+                d.get("export_in", "the live model"))
             if isinstance(callee, serving.ExportedStepDecoder):
                 # a split-phase artifact serves through the
                 # continuous-batching engine: paged KV pool, prefill/

@@ -134,6 +134,7 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 
 _SEQ_FALLBACK_WARNED: set = set()
+_BATCH_FALLBACK_WARNED: set = set()
 
 
 def input_sharding(mesh: Mesh, shape: Tuple[int, ...]) -> NamedSharding:
@@ -146,7 +147,41 @@ def input_sharding(mesh: Mesh, shape: Tuple[int, ...]) -> NamedSharding:
     by its size, the sequence dim falls back to replication — a real
     capacity loss on long-context runs that used to happen silently:
     it is now counted in the registry
-    (``cxxnet_seq_shard_fallback_total``) and warned once per shape."""
+    (``cxxnet_seq_shard_fallback_total``) and warned once per shape.
+    A BATCH not divisible by the ``data`` axis falls back the same way
+    (full replication, ``cxxnet_batch_shard_fallback_total``, one
+    warning per shape) — every shard would otherwise need an unequal
+    slice.  Serving never hits this fallback by construction: a
+    mesh-carrying export rounds its batch ladder up to data-axis
+    multiples (serving.export_model / export_decode_step), so the
+    counter staying at zero is part of the sharded-serving contract
+    (docs/serving.md)."""
+    ndata = int(mesh.shape.get(DATA_AXIS, 1))
+    if ndata > 1 and shape and shape[0] % ndata != 0:
+        from .obs.registry import get_registry
+        get_registry().counter(
+            "cxxnet_batch_shard_fallback_total",
+            "inputs whose batch dim fell back to replication because "
+            "the batch does not divide the data mesh axis").inc()
+        key = (shape[0], ndata)
+        if key not in _BATCH_FALLBACK_WARNED:
+            _BATCH_FALLBACK_WARNED.add(key)
+            import warnings
+            warnings.warn(
+                "input_sharding: batch %d does not divide the data "
+                "mesh axis (%d) — the batch dim REPLICATES instead of "
+                "sharding; round the batch (or ladder bucket) up to a "
+                "data-axis multiple (counted in "
+                "cxxnet_batch_shard_fallback_total)" % key,
+                stacklevel=2)
+        # only the BATCH dim falls back: a still-divisible sequence
+        # dim keeps its seq-axis placement, so long-context
+        # activations don't lose their sharding to a batch hiccup
+        if SEQ_AXIS in mesh.shape and len(shape) == 4 \
+                and shape[1] == 1 \
+                and shape[2] % mesh.shape[SEQ_AXIS] == 0:
+            return NamedSharding(mesh, P(None, None, SEQ_AXIS, None))
+        return replicated(mesh)
     if SEQ_AXIS in mesh.shape and len(shape) == 4 and shape[1] == 1:
         if shape[2] % mesh.shape[SEQ_AXIS] == 0:
             return NamedSharding(mesh,

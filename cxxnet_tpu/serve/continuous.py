@@ -124,7 +124,7 @@ class _Row:
     """One admitted prompt row waiting for (or bound to) a slot."""
 
     __slots__ = ("req", "ridx", "toks", "plen", "blocks",
-                 "ntok", "last", "clen", "shared", "nodes")
+                 "ntok", "last", "clen", "shared", "nodes", "shard")
 
     def __init__(self, req: StreamRequest, ridx: int,
                  toks: np.ndarray, plen: int):
@@ -138,6 +138,7 @@ class _Row:
         self.clen = 0               # cached-prefix tokens (kv_block x)
         self.shared: list = []      # shared prefix pages (refs held)
         self.nodes: list = []       # pinned trie nodes
+        self.shard = 0              # mesh slice owning its pages/lane
 
 
 class ContinuousDecodeEngine:
@@ -233,12 +234,38 @@ class ContinuousDecodeEngine:
         self.step_hook = step_hook
         self.obs_labels = dict(obs_labels or {})
         self.registry = registry if registry is not None else Registry()
+        # mesh-carrying artifact (docs/serving.md "sharded serving"):
+        # slots and the pool's page space both split across the dp
+        # shards — lane i belongs to shard i // (B/dp), and a row's
+        # pages come from its shard's pool slice, so the step
+        # program's page gather never leaves the shard
+        self.mesh = getattr(decoder, "mesh", None)
+        self.dp = int(getattr(decoder, "dp", 1) or 1)
+        if self.batch % self.dp:
+            raise ValueError(
+                "artifact slot count %d does not divide its %d-way "
+                "data axis" % (self.batch, self.dp))
+        self.lanes_per_shard = self.batch // self.dp
         self.pool = BlockPool(decoder.pool_blocks, decoder.kv_block,
-                              limit=int(kv_blocks))
+                              limit=int(kv_blocks), shards=self.dp)
         # cross-request prefix cache: needs the rung's exported tail-
         # prefill programs (a hit skips straight to incremental
         # prefill, so there is nothing to do without them)
         has_tail = decoder.has_tail_prefill(self.kv_dtype)
+        if self.dp > 1:
+            # shared trie pages live in ONE shard's slice and would
+            # pin every later hit to the publishing shard — the
+            # cross-shard prefix cache is future work, so a mesh
+            # engine serves with the cache off (and says so loudly
+            # when the operator demanded it on)
+            if prefix_cache is True:
+                raise ValueError(
+                    "prefix_cache=True is not supported on a "
+                    "mesh-carrying (dp=%d) artifact: shared prefix "
+                    "pages would pin requests to the publishing "
+                    "shard — serve with prefix_cache=auto/False "
+                    "(docs/serving.md)" % self.dp)
+            prefix_cache = False
         if prefix_cache is True and not has_tail:
             raise ValueError(
                 "prefix_cache=True but the artifact carries no %s-"
@@ -266,6 +293,7 @@ class ContinuousDecodeEngine:
         # compute, not just events
         self._pf_slot_tokens = 0
         self._pools = decoder.new_pool(kv_dtype)
+        self._trash_tpl: dict = {}   # bucket -> trash block table
         self._slots: List[Optional[_Row]] = [None] * self.batch
         self._nlive = 0
         self._bucket_steps = {b: 0 for b in self._step_buckets}
@@ -276,11 +304,12 @@ class ContinuousDecodeEngine:
         self._warmup_on_start = bool(warmup)
         self._warmed = False
         self.warmup_runs = 0
-        if self.pool.limit - 1 < decoder.blocks_per_seq:
+        if self.pool.usable_per_shard < decoder.blocks_per_seq:
             raise ValueError(
-                "kv_blocks=%d leaves %d usable pages; one sequence "
-                "needs %d" % (kv_blocks, self.pool.limit - 1,
-                              decoder.blocks_per_seq))
+                "kv_blocks=%d leaves %d usable pages per shard; one "
+                "sequence needs %d"
+                % (kv_blocks, self.pool.usable_per_shard,
+                   decoder.blocks_per_seq))
         from collections import deque
         self._q = deque()        # rows waiting for PREFILL
         # rows already prefilled (pages + first token emitted) parked
@@ -369,11 +398,20 @@ class ContinuousDecodeEngine:
         space by kv_dtype x step bucket — a missed combo here is a
         guaranteed scheduler-thread compile under load (the gate in
         tools/analysis_gate.py --rungs replays exactly this
-        contract)."""
+        contract).
+
+        Also a sanctioned ``shardcheck.allow`` window: warmup's eager
+        trim slices and dummy control arrays pay deliberate host
+        uploads, and an engine may warm (hot-swap spare, fresh bench
+        window) while the transfer guard is already armed — the
+        thread-local allowance is exactly the lifecycle the sentinel
+        defines for warmup (docs/analysis.md)."""
         from ..analysis import jitcheck as _jitcheck
+        from ..analysis import shardcheck as _shardcheck
         from ..serving import scatter_prefill_kv
         c = self.callee
-        with _jitcheck.allow("serve.continuous.warmup"):
+        with _jitcheck.allow("serve.continuous.warmup"), \
+                _shardcheck.allow("serve.continuous.warmup"):
             key = self._fold_key(0)
             maxr = c.prefill_rows[-1]
             for w in c.prefill_widths:
@@ -382,7 +420,9 @@ class ContinuousDecodeEngine:
                 for r in c.prefill_rows:
                     toks = np.zeros((r, w), np.int32)
                     lens = np.ones((r,), np.int32)
-                    outs[r] = c._pre[(r, w)].call(toks, lens, key)
+                    # through the staged seam (pre_call): a mesh
+                    # artifact's programs cannot consume host numpy
+                    outs[r] = c.pre_call(r, w)(toks, lens, key)
                     np.asarray(outs[r][0])
                     self.warmup_runs += 1
                 for n in range(1, maxr + 1):
@@ -422,7 +462,7 @@ class ContinuousDecodeEngine:
             for b in self._step_buckets:
                 out = c.step_call(self.kv_dtype, b)(
                     *self._pools,
-                    np.zeros((b, nblk), np.int32),
+                    self._trash_bt(b),
                     np.ones((b,), np.int32),
                     np.zeros((b,), np.int32),
                     np.zeros((b,), np.int32), key)
@@ -480,6 +520,7 @@ class ContinuousDecodeEngine:
                 "slots_live": self._nlive,
                 "ready_rows": len(self._ready),
                 "prefix_cache": self.prefix is not None,
+                "mesh": c.meta.get("mesh"),
                 "kv_pool": self.pool.snapshot()}
 
     def metrics(self) -> dict:
@@ -497,6 +538,7 @@ class ContinuousDecodeEngine:
         snap["prefill_split"] = self.prefill_split
         snap["kv_dtype"] = self.kv_dtype
         snap["attend_kernel"] = self.attend_kernel
+        snap["mesh"] = self.callee.meta.get("mesh")
         snap["step_bucket_dispatches"] = dict(self._bucket_steps)
         snap["slots_live"] = self._nlive
         snap["ready_rows"] = len(self._ready)
@@ -628,6 +670,28 @@ class ContinuousDecodeEngine:
     def _free_slot_ids(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s is None]
 
+    def _trash_bt(self, b: int) -> np.ndarray:
+        """A (b, blocks_per_seq) block table of trash pages — each
+        dispatch row pointing at ITS shard slice's trash page, so a
+        dead lane's writes stay inside the shard that owns the lane
+        (page 0 everywhere on a single-device pool). One template per
+        bucket, built once and copied per dispatch: this runs on the
+        scheduler thread inside every decode step."""
+        tpl = self._trash_tpl.get(b)
+        if tpl is None:
+            nblk = self.callee.blocks_per_seq
+            if self.dp > 1:
+                trash = np.repeat(
+                    np.asarray([self.pool.trash_page(s)
+                                for s in range(self.dp)], np.int32),
+                    b // self.dp)
+                tpl = np.broadcast_to(trash[:, None],
+                                      (b, nblk)).copy()
+            else:
+                tpl = np.zeros((b, nblk), np.int32)
+            self._trash_tpl[b] = tpl
+        return tpl.copy()
+
     def _fold_key(self, tag: int):
         # greedy artifact: the key is dead weight — the cached return
         # skips the per-step fold_in dispatch AND the allow-window
@@ -746,7 +810,12 @@ class ContinuousDecodeEngine:
                         cand + kept, key=lambda r: r.req.t_submit))
                     return False
             for row in cand:
-                if not self.pool.can_alloc(need[id(row)]):
+                # row -> shard placement: the slice with the most
+                # free pages (pool.pick_shard) — pages are 4x lanes
+                # per slice, so page balance tracks lane balance; a
+                # single-device pool always picks shard 0
+                shard = self.pool.pick_shard(need[id(row)])
+                if shard is None:
                     # pool-pressure eviction: ask the trie to give
                     # back exclusively-held pages before turning a
                     # row away — a cache allowed to sit on pages
@@ -755,13 +824,15 @@ class ContinuousDecodeEngine:
                     if self.prefix is not None:
                         self.prefix.reclaim(
                             need[id(row)] - self.pool.free_blocks)
-                    if not self.pool.can_alloc(need[id(row)]):
+                        shard = self.pool.pick_shard(need[id(row)])
+                    if shard is None:
                         kept.append(row)
                         continue
                 # shared prefix pages head the block table (logical
                 # pages [0, clen/kv_block)), owned pages fill the rest
+                row.shard = shard
                 row.blocks = row.shared + self.pool.alloc(
-                    need[id(row)], owner=row.req.id)
+                    need[id(row)], owner=row.req.id, shard=shard)
                 row.shared = []
                 take.append(row)
             self._q.clear()
@@ -854,20 +925,32 @@ class ContinuousDecodeEngine:
     def _bind_ready(self) -> None:
         """Move prefilled rows from the ready queue into free decode
         lanes (requests failed while parked just give their pages
-        back)."""
+        back). On a mesh, a lane only takes rows of ITS shard — the
+        row's pages live in that shard's pool slice, and binding it
+        anywhere else would make every step's page gather
+        cross-shard."""
         for i, s in enumerate(self._slots):
             if s is not None:
                 continue
+            shard = i // self.lanes_per_shard
             row = None
+            skipped: List[_Row] = []
             while self._ready:
                 cand = self._ready.popleft()
                 if cand.req.done:
                     self._release_row(cand)
                     continue
+                if self.dp > 1 and cand.shard != shard:
+                    skipped.append(cand)
+                    continue
                 row = cand
                 break
+            for cnd in reversed(skipped):
+                self._ready.appendleft(cnd)
             if row is None:
-                return
+                if self.dp == 1:
+                    return
+                continue
             self._slots[i] = row
             self._nlive += 1
 
@@ -980,12 +1063,32 @@ class ContinuousDecodeEngine:
             return   # all slots idle: no dispatch at all
         c = self.callee
         nblk = c.blocks_per_seq
-        b = c.pick_step_bucket(len(live), self.kv_dtype)
-        bt = np.zeros((b, nblk), np.int32)      # 0 = trash page
+        if self.dp == 1:
+            b = c.pick_step_bucket(len(live), self.kv_dtype)
+            placed = [(j, i, row)
+                      for j, (i, row) in enumerate(live)]
+        else:
+            # per-shard packing: dispatch rows [s*(b/dp), ...) belong
+            # to mesh shard s, so each live row must land in its own
+            # shard's chunk (its pages live in that slice) — the
+            # bucket is the smallest whose PER-SHARD capacity holds
+            # the busiest shard; dummies point at their shard's trash
+            by_shard: List[list] = [[] for _ in range(self.dp)]
+            for i, row in live:
+                by_shard[i // self.lanes_per_shard].append((i, row))
+            per_need = max(len(g) for g in by_shard)
+            b = next((bb for bb in self._step_buckets
+                      if bb // self.dp >= per_need),
+                     self._step_buckets[-1])
+            placed = []
+            for s, g in enumerate(by_shard):
+                for jloc, (i, row) in enumerate(g):
+                    placed.append((s * (b // self.dp) + jloc, i, row))
+        bt = self._trash_bt(b)
         lens = np.ones((b,), np.int32)
         stepv = np.zeros((b,), np.int32)
         last = np.zeros((b,), np.int32)
-        for j, (i, row) in enumerate(live):
+        for j, i, row in placed:
             bt[j] = row.blocks
             lens[j] = row.plen
             stepv[j] = row.ntok - 1
@@ -1026,7 +1129,7 @@ class ContinuousDecodeEngine:
         now = time.monotonic()
         emitted = 0
         toks = toks.tolist()
-        for j, (i, row) in enumerate(live):
+        for j, i, row in placed:
             # a row completing mid-call discards its overshoot tokens
             # (their pool writes die with the row's pages)
             take = min(T, row.req.n_new - row.ntok)

@@ -255,6 +255,7 @@ class _ForwardCallee:
         self.batch = self.buckets[-1]
         self.item_shape = tuple(int(d) for d in meta["input_shape"][1:])
         self.dtype = np.dtype(meta.get("input_dtype", "float32"))
+        self.mesh_info = meta.get("mesh")
         self._model = model
         self._exact = getattr(model, "call_exact", None)
 
@@ -319,6 +320,7 @@ class _DecodeCallee:
         self.seq_len = int(m["seq_len"])
         self.max_prompt_len = int(m["max_prompt_len"])
         self.max_new = int(m["max_new"])
+        self.mesh_info = m.get("mesh")
         self._dec = dec
         self._exact = getattr(dec, "call_exact", None)
 
@@ -533,10 +535,16 @@ class ServingEngine:
         ``jitcheck.allow`` window: with the recompile sentinel armed
         (bench/chaos posture), compiles HERE are sanctioned warmup —
         a replica hot-swapped mid-run warms its programs without
-        tripping the steady-state contract (docs/analysis.md)."""
+        tripping the steady-state contract (docs/analysis.md). Also a
+        sanctioned ``shardcheck.allow`` window for the same
+        lifecycle reason: warming while the transfer guard is armed
+        (hot-swap spare, fresh bench window) is deliberate host
+        traffic on this thread only."""
         from ..analysis import jitcheck as _jitcheck
+        from ..analysis import shardcheck as _shardcheck
         c = self.callee
-        with _jitcheck.allow("serve.engine.warmup"):
+        with _jitcheck.allow("serve.engine.warmup"), \
+                _shardcheck.allow("serve.engine.warmup"):
             for b in self.buckets:
                 if self.kind == "forward":
                     buf = self._get_buf(b)
@@ -594,6 +602,11 @@ class ServingEngine:
                 "buckets": list(self.buckets),
                 "dispatch_depth": self.dispatch_depth,
                 "queue_depth": self.queue_depth}
+        mesh = getattr(self.callee, "mesh_info", None)
+        if mesh:
+            # a mesh-carrying artifact: the dispatch runs one sharded
+            # program over every mesh device (docs/serving.md)
+            info["mesh"] = mesh
         if self.kind == "decode":
             info["seq_len"] = self.callee.seq_len
             info["max_prompt_len"] = self.callee.max_prompt_len
@@ -614,6 +627,9 @@ class ServingEngine:
         snap["queue_limit"] = self.queue_limit
         snap["dispatch_depth"] = self.dispatch_depth
         snap["warmup_runs"] = self.warmup_runs
+        mesh = getattr(self.callee, "mesh_info", None)
+        if mesh:
+            snap["mesh"] = mesh
         return snap
 
     # ------------------------------------------------------------------

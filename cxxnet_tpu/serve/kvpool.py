@@ -25,6 +25,18 @@ Block 0 is the reserved TRASH page: slots not bound to a request point
 their whole block table at it, so the step program's writes for dead
 slots land somewhere harmless. ``alloc`` never hands it out.
 
+SHARDED pools (r15, sharded serving): a mesh-carrying split-phase
+artifact shards the device pool's block dim over the mesh's ``data``
+axis, so the page space is cut into ``shards`` contiguous SLICES of
+``num_blocks / shards`` pages — each mesh slice owns one. The host
+mirror here: per-slice free lists, a per-slice trash page (the first
+page of each slice, ``trash_page(shard)``), and per-slice ``limit``
+accounting; ``alloc(..., shard=s)`` hands out pages of slice ``s``
+only, so a row's block table never leaves the shard its dispatch
+lane lives on and the step program's page gather stays shard-local.
+``shards=1`` (the default) is exactly the historical single-slice
+pool, trash page 0 included.
+
 Thread-safe through the lockcheck seam (the scheduler thread allocates
 while admission/drain paths free). Double frees and leaked pages are
 hard errors — a page in two block tables WITHOUT a matching reference
@@ -43,27 +55,50 @@ class PoolExhausted(RuntimeError):
 
 class BlockPool:
     """Refcounting free-list allocator over ``num_blocks`` pool pages
-    (page 0 reserved as the trash page)."""
+    cut into ``shards`` contiguous slices (the first page of each
+    slice reserved as that slice's trash page; page 0 for the
+    default single-slice pool)."""
 
     def __init__(self, num_blocks: int, block_size: int = 128,
-                 limit: int = 0) -> None:
+                 limit: int = 0, shards: int = 1) -> None:
         num_blocks = int(num_blocks)
-        if num_blocks < 2:
+        shards = int(shards)
+        if shards < 1:
+            raise ValueError("shards must be >= 1, got %d" % shards)
+        if num_blocks % shards:
             raise ValueError(
-                "BlockPool needs >= 2 blocks (trash page + one real), "
-                "got %d" % num_blocks)
+                "num_blocks (%d) must divide across %d shard "
+                "slice(s): the device pool's block dim is sharded "
+                "evenly over the mesh's data axis" % (num_blocks,
+                                                      shards))
+        bps = num_blocks // shards
+        if bps < 2:
+            raise ValueError(
+                "BlockPool needs >= 2 blocks per slice (trash page + "
+                "one real), got %d over %d shard(s)"
+                % (num_blocks, shards))
         self.num_blocks = num_blocks
         self.block_size = int(block_size)
+        self.shards = shards
+        self.blocks_per_shard = bps
         # runtime clamp: serve_kv_blocks can keep fewer pages live
         # than the exported pool carries (admission control without a
-        # re-export); 0 = use the whole pool
-        self.limit = min(int(limit) or num_blocks, num_blocks)
-        if self.limit < 2:
-            raise ValueError("block limit must leave >= 1 usable page")
+        # re-export); 0 = use the whole pool. Applied PER SLICE: each
+        # shard keeps limit/shards of its pages usable
+        total = min(int(limit) or num_blocks, num_blocks)
+        per = total // shards
+        if per < 2:
+            raise ValueError(
+                "block limit must leave >= 1 usable page per shard "
+                "slice (limit %d over %d shard(s))" % (total, shards))
+        self._per_limit = per
+        self.limit = per * shards
         self._lock = _lockcheck.make_lock("serve.kvpool.lock")
-        # LIFO free list: the page a request just released is the
-        # hottest candidate for the next admission
-        self._free: List[int] = list(range(self.limit - 1, 0, -1))
+        # per-slice LIFO free lists: the page a request just released
+        # is the hottest candidate for the next admission on its shard
+        self._free: List[List[int]] = [
+            list(range(s * bps + per - 1, s * bps, -1))
+            for s in range(shards)]
         self._ref: Dict[int, int] = {}          # page -> live refs
         self._owners: Dict[int, List[str]] = {}  # page -> ref labels
         self._last_free: Dict[int, str] = {}    # page -> last releaser
@@ -71,10 +106,34 @@ class BlockPool:
         self.high_water = 0
         self.allocs = 0
 
+    def trash_page(self, shard: int = 0) -> int:
+        """The reserved trash page of a shard slice (page 0 on the
+        single-slice pool): dead dispatch lanes of that shard point
+        their whole block table here."""
+        return int(shard) * self.blocks_per_shard
+
+    def shard_of(self, page: int) -> int:
+        """Which shard slice a page id lives in."""
+        return int(page) // self.blocks_per_shard
+
+    def _valid(self, b: int) -> bool:
+        return 0 <= b < self.num_blocks \
+            and 1 <= (b % self.blocks_per_shard) < self._per_limit
+
+    @property
+    def usable_per_shard(self) -> int:
+        """Allocatable pages per shard slice (the slice minus its
+        trash page, under the per-slice limit clamp)."""
+        return self._per_limit - 1
+
     @property
     def free_blocks(self) -> int:
         with self._lock:
-            return len(self._free)
+            return sum(len(f) for f in self._free)
+
+    def free_blocks_in(self, shard: int) -> int:
+        with self._lock:
+            return len(self._free[int(shard)])
 
     @property
     def in_use(self) -> int:
@@ -88,26 +147,51 @@ class BlockPool:
         with self._lock:
             return sum(1 for r in self._ref.values() if r > 1)
 
-    def can_alloc(self, n: int) -> bool:
+    def can_alloc(self, n: int, shard: Optional[int] = None) -> bool:
+        """Whether ``n`` pages are allocatable from ``shard``'s slice
+        (from SOME single slice when shard is None — an allocation
+        never spans slices: a row's block table must stay inside the
+        shard its dispatch lane lives on)."""
         with self._lock:
-            return len(self._free) >= n
+            if shard is not None:
+                return len(self._free[int(shard)]) >= n
+            return any(len(f) >= n for f in self._free)
 
-    def alloc(self, n: int, owner: Optional[str] = None) -> List[int]:
-        """Take ``n`` pages at refcount 1; raises
-        :class:`PoolExhausted` (taking none) when fewer are free —
-        partial grants would deadlock two half-admitted requests
-        against each other. ``owner`` labels the reference for the
-        double-free/leak diagnostics."""
+    def pick_shard(self, n: int) -> Optional[int]:
+        """The slice with the most free pages that can grant ``n`` —
+        the engine's balanced row->shard placement — or None when no
+        slice can."""
+        with self._lock:
+            best, best_free = None, n - 1
+            for s, f in enumerate(self._free):
+                if len(f) > best_free:
+                    best, best_free = s, len(f)
+            return best
+
+    def alloc(self, n: int, owner: Optional[str] = None,
+              shard: int = 0) -> List[int]:
+        """Take ``n`` pages of ``shard``'s slice at refcount 1;
+        raises :class:`PoolExhausted` (taking none) when fewer are
+        free there — partial grants would deadlock two half-admitted
+        requests against each other. ``owner`` labels the reference
+        for the double-free/leak diagnostics."""
         n = int(n)
         if n < 1:
             raise ValueError("alloc needs n >= 1")
+        shard = int(shard)
+        if not 0 <= shard < self.shards:
+            raise ValueError("shard %d outside [0, %d)"
+                             % (shard, self.shards))
         label = owner or "?"
         with self._lock:
-            if len(self._free) < n:
+            free = self._free[shard]
+            if len(free) < n:
                 raise PoolExhausted(
-                    "%d pages requested, %d free (pool %d, limit %d)"
-                    % (n, len(self._free), self.num_blocks, self.limit))
-            out = [self._free.pop() for _ in range(n)]
+                    "%d pages requested, %d free in shard %d "
+                    "(pool %d over %d shard(s), limit %d)"
+                    % (n, len(free), shard, self.num_blocks,
+                       self.shards, self.limit))
+            out = [free.pop() for _ in range(n)]
             for b in out:
                 self._ref[b] = 1
                 self._owners[b] = [label]
@@ -128,10 +212,13 @@ class BlockPool:
         label = owner or "?"
         with self._lock:
             for b in blocks:
-                if not 1 <= b < self.limit:
+                if not self._valid(b):
                     raise ValueError(
                         "share of page %d outside the usable pool "
-                        "[1, %d)" % (b, self.limit))
+                        "(%d pages over %d shard slice(s), per-slice "
+                        "limit %d, trash pages reserved)"
+                        % (b, self.num_blocks, self.shards,
+                           self._per_limit))
                 if self._ref.get(b, 0) < 1:
                     raise ValueError(
                         "share of FREE pool page %d (last released "
@@ -158,10 +245,13 @@ class BlockPool:
             # free as two calls are
             need: Dict[int, int] = {}
             for b in blocks:
-                if not 1 <= b < self.limit:
+                if not self._valid(b):
                     raise ValueError(
                         "free of page %d outside the usable pool "
-                        "[1, %d)" % (b, self.limit))
+                        "(%d pages over %d shard slice(s), per-slice "
+                        "limit %d, trash pages reserved)"
+                        % (b, self.num_blocks, self.shards,
+                           self._per_limit))
                 need[b] = need.get(b, 0) + 1
             for b, cnt in need.items():
                 have = self._ref.get(b, 0)
@@ -188,7 +278,7 @@ class BlockPool:
                     del self._ref[b]
                     del self._owners[b]
                     self._last_free[b] = label
-                    self._free.append(b)
+                    self._free[b // self.blocks_per_shard].append(b)
                     self._in_use -= 1
 
     def free(self, blocks: Sequence[int],
@@ -223,8 +313,10 @@ class BlockPool:
                 "blocks": self.num_blocks,
                 "block_size": self.block_size,
                 "limit": self.limit,
+                "shards": self.shards,
                 "in_use": self._in_use,
-                "free": len(self._free),
+                "free": sum(len(f) for f in self._free),
+                "free_per_shard": [len(f) for f in self._free],
                 "shared": sum(1 for r in self._ref.values() if r > 1),
                 "high_water": self.high_water,
                 "allocs": self.allocs,

@@ -24,7 +24,15 @@ import numpy as np
 MAGIC = "cxxnet_tpu.export.v1"
 
 
-def stage_host(*arrays):
+class MeshMismatchError(ValueError):
+    """A mesh-carrying artifact cannot be realized on the local
+    topology (wrong device count / axis shape): raised at LOAD time
+    with the expected vs available topology named, instead of
+    surfacing as an inscrutable XLA device-count failure at the first
+    dispatch."""
+
+
+def stage_host(*arrays, shardings=None):
     """Explicitly place host arrays on device before dispatching an
     exported program; device-resident arguments pass through untouched.
 
@@ -32,19 +40,41 @@ def stage_host(*arrays):
     host->device transfer per dispatch — invisible in the profile,
     disallowed under the armed shardcheck transfer sentinel
     (docs/analysis.md). This helper is the one sanctioned staging
-    point the serving dispatch paths share; when artifacts start
-    carrying a mesh + input shardings (the sharded-export ROADMAP
-    item), this is the seam that will place rows directly into their
-    declared shards instead of the default device.
+    point the serving dispatch paths share.
 
-    Seam discipline (the ``make_donating`` pattern): with no
-    shardcheck monitor enabled this is a single global read and the
-    arrays pass through UNTOUCHED — jax's inline numpy conversion at
-    dispatch is ~100us/call cheaper on the CPU backend than an
-    explicit ``device_put``, and with no guard armed the implicit
-    path is sanctioned. Monitored runs (the armed bench legs, the
-    sentinel tests) stage explicitly and so prove the steady state
-    clean."""
+    ``shardings`` (a per-argument sequence of ``NamedSharding``, from
+    a mesh-carrying artifact's meta) makes staging MANDATORY and
+    sharded: each host member is placed directly into its declared
+    shards — an ``nr_devices > 1`` exported program cannot consume a
+    host array at all, and staging anywhere else would pay an
+    immediate reshard at dispatch. Entries may be None (argument
+    already device-resident or deliberately left to jax).
+
+    Seam discipline for the single-device path (the ``make_donating``
+    pattern): with no shardcheck monitor enabled this is a single
+    global read and the arrays pass through UNTOUCHED — jax's inline
+    numpy conversion at dispatch is ~100us/call cheaper on the CPU
+    backend than an explicit ``device_put``, and with no guard armed
+    the implicit path is sanctioned. Monitored runs (the armed bench
+    legs, the sentinel tests) stage explicitly and so prove the
+    steady state clean."""
+    if shardings is not None:
+        import jax
+        host_idx = [i for i, a in enumerate(arrays)
+                    if isinstance(a, np.ndarray)
+                    and i < len(shardings)
+                    and shardings[i] is not None]
+        if not host_idx:
+            return arrays
+        # ONE batched put for every host member, each into its
+        # declared shards (per-array puts each cost a dispatch round
+        # trip — the same lesson as trainer._put_batch)
+        staged = jax.device_put([arrays[i] for i in host_idx],
+                                [shardings[i] for i in host_idx])
+        out = list(arrays)
+        for i, s in zip(host_idx, staged):
+            out[i] = s
+        return tuple(out)
     from .analysis import shardcheck as _shardcheck
     if _shardcheck.active() is None:
         return arrays
@@ -61,6 +91,137 @@ def stage_host(*arrays):
     for i, s in zip(host_idx, staged):
         out[i] = s
     return tuple(out)
+
+
+# ----------------------------------------------------------------------
+# mesh-carrying artifacts: the mesh (axis names + shape + platform) and
+# every program's per-argument PartitionSpecs are serialized into the
+# .meta sidecar, validated at load against the local topology, and
+# materialized into the NamedShardings the dispatch path stages with
+# (docs/serving.md "sharded serving")
+
+def _spec_to_json(spec) -> list:
+    """PartitionSpec -> JSON: one entry per dim (axis name, list of
+    axis names, or null for replicated)."""
+    out = []
+    for e in tuple(spec):
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            out.append([str(a) for a in e])
+        else:
+            out.append(str(e))
+    return out
+
+
+def _spec_from_json(j):
+    from jax.sharding import PartitionSpec
+    return PartitionSpec(*[tuple(e) if isinstance(e, list) else e
+                           for e in (j or [])])
+
+
+def mesh_meta(mesh) -> dict:
+    """The meta stanza a mesh-carrying artifact records: axis names +
+    sizes in mesh order, device count, and the platform the programs
+    were lowered for."""
+    from .parallel import mesh_platform
+    shape = [int(mesh.shape[a]) for a in mesh.axis_names]
+    return {"axes": list(mesh.axis_names), "shape": shape,
+            "devices": int(np.prod(shape)),
+            "platform": mesh_platform(mesh)}
+
+
+def mesh_data_parallel(mmeta) -> int:
+    """The data-axis size of a meta mesh stanza (1 when absent)."""
+    if not mmeta:
+        return 1
+    from .parallel import DATA_AXIS
+    sizes = dict(zip(mmeta["axes"], mmeta["shape"]))
+    return int(sizes.get(DATA_AXIS, 1))
+
+
+def make_serving_mesh(data_parallel: int = 1, model_parallel: int = 1,
+                      platform: Optional[str] = None):
+    """Build an export/serving mesh over the first
+    ``data_parallel * model_parallel`` local devices (the CLI's
+    ``export_mesh`` knob and the bench legs go through here)."""
+    import jax
+
+    from . import parallel
+    n = int(data_parallel) * int(model_parallel)
+    if n < 1:
+        raise ValueError("mesh needs at least one device")
+    try:
+        devs = jax.devices(platform) if platform else jax.devices()
+    except RuntimeError:
+        devs = jax.devices()
+    if len(devs) < n:
+        raise MeshMismatchError(
+            "a %dx%d (data x model) mesh needs %d device(s); this "
+            "process has %d %s device(s)"
+            % (data_parallel, model_parallel, n, len(devs),
+               devs[0].platform if devs else "?"))
+    return parallel.make_mesh(devs[:n], model_parallel=model_parallel)
+
+
+def resolve_mesh(mmeta):
+    """Realize an artifact's recorded mesh on the LOCAL topology via
+    ``parallel.make_mesh``, or raise :class:`MeshMismatchError` naming
+    the expected vs available topology. Called at artifact LOAD — a
+    topology that cannot carry the mesh must fail attributably before
+    the first dispatch, not as an XLA device-count error inside it."""
+    import jax
+
+    from . import parallel
+    axes = [str(a) for a in mmeta["axes"]]
+    shape = [int(x) for x in mmeta["shape"]]
+    need = int(np.prod(shape))
+    platform = mmeta.get("platform")
+    try:
+        devs = jax.devices(platform) if platform else jax.devices()
+    except RuntimeError:
+        devs = jax.devices()
+    if len(devs) < need:
+        raise MeshMismatchError(
+            "artifact carries a mesh %s over %d %s device(s); this "
+            "process has %d %s device(s) — serve it on a topology "
+            "that can realize the mesh, or re-export for this one "
+            "(export_mesh=..., docs/serving.md)"
+            % (dict(zip(axes, shape)), need, platform or "?",
+               len(devs), devs[0].platform if devs else "?"))
+    sizes = dict(zip(axes, shape))
+    mesh = parallel.make_mesh(
+        devs[:need],
+        model_parallel=sizes.get(parallel.MODEL_AXIS, 1),
+        seq_parallel=sizes.get(parallel.SEQ_AXIS, 1),
+        pipeline_parallel=sizes.get(parallel.PIPE_AXIS, 1))
+    got_axes = list(mesh.axis_names)
+    got_shape = [int(mesh.shape[a]) for a in got_axes]
+    if got_axes != axes or got_shape != shape:
+        raise MeshMismatchError(
+            "artifact mesh axes %s shape %s cannot be reconstructed "
+            "by parallel.make_mesh on this topology (got axes %s "
+            "shape %s)" % (axes, shape, got_axes, got_shape))
+    return mesh
+
+
+def _shardings(mesh, spec_jsons):
+    """Materialize a meta's per-arg PartitionSpec list into the
+    NamedShardings the staging/validation seams consume."""
+    from jax.sharding import NamedSharding
+    return tuple(None if j is None
+                 else NamedSharding(mesh, _spec_from_json(j))
+                 for j in spec_jsons)
+
+
+def _shard_ladder(ladder: Sequence[int], dp: int) -> list:
+    """Round every batch bucket UP to the next data-axis multiple
+    (sorted, deduped): a mesh-carrying artifact's buckets must split
+    evenly across the dp shards — an indivisible bucket would fall
+    back to full replication (``parallel.input_sharding``'s counted
+    fallback), which serving must never hit by construction."""
+    dp = int(dp)
+    return sorted({-(-int(b) // dp) * dp for b in ladder})
 
 
 def auto_ladder(batch: int) -> list:
@@ -96,7 +257,8 @@ def _norm_ladder(batch_ladder, batch_size) -> list:
 def export_model(trainer, path: str,
                  batch_size: Optional[int] = None,
                  batch_ladder: Optional[Sequence[int]] = None,
-                 platforms: Optional[Sequence[str]] = None) -> None:
+                 platforms: Optional[Sequence[str]] = None,
+                 mesh=None) -> None:
     """Serialize ``trainer``'s forward pass (weights baked in) to
     ``path`` (+ ``path.meta`` json with the io contract).
 
@@ -116,6 +278,19 @@ def export_model(trainer, path: str,
     max — load-proportional compute (docs/serving.md). The meta's
     ``input_shape`` carries the max bucket, so single-shape readers
     keep working against the top rung.
+
+    ``mesh`` exports a MESH-CARRYING artifact (docs/serving.md
+    "sharded serving"): every bucket program is compiled under pjit
+    with explicit ``in_shardings``/``out_shardings`` (batch over the
+    ``data`` axis via ``parallel.input_sharding``), the mesh (axis
+    names + shape + platform) and the per-arg PartitionSpecs are
+    serialized into the meta, and the batch ladder is rounded UP to
+    data-axis multiples so no bucket ever hits the replication
+    fallback. At load the mesh is validated against the local
+    topology (``resolve_mesh``); a data-parallel mesh then serves N×
+    traffic from one engine. Weights are baked in as constants
+    (replicated); tensor-parallel placement of internals follows
+    GSPMD propagation from the declared boundary shardings.
 
     Multi-host: collective (all processes must call together to gather
     cross-process-sharded weights); only process 0 writes the files."""
@@ -139,6 +314,9 @@ def export_model(trainer, path: str,
         ladder = _norm_ladder(batch_ladder, batch_size)
     else:
         ladder = [int(batch_size or trainer.batch_size)]
+    if mesh is not None:
+        from .parallel import DATA_AXIS
+        ladder = _shard_ladder(ladder, mesh.shape.get(DATA_AXIS, 1))
     bs = ladder[-1]
     item = tuple(net.node_shapes[0][1:])
     in_dtype = np.uint8 if net.input_norm is not None else np.float32
@@ -147,17 +325,29 @@ def export_model(trainer, path: str,
         values, _ = net.apply(params, data, train=False)
         return values[net.out_node]
 
+    from .parallel import mesh_platform
     if platforms is None:
-        from .parallel import mesh_platform
-        platforms = [mesh_platform(trainer.mesh)]
+        platforms = [mesh_platform(mesh if mesh is not None
+                                   else trainer.mesh)]
     # one rung exported, serialized, and written at a time: holding
     # every rung's weights-baked-in blob at once would multiply peak
     # host memory by the ladder length
     sizes = []
+    in_specs = out_specs = None
     with open(path, "wb") as f:
         for b in ladder:
+            if mesh is not None:
+                from .parallel import batch_sharding, input_sharding
+                in_sh = input_sharding(mesh, (b,) + item)
+                out_sh = batch_sharding(mesh)
+                in_specs = [_spec_to_json(in_sh.spec)]
+                out_specs = [_spec_to_json(out_sh.spec)]
+                jf = jax.jit(forward, in_shardings=(in_sh,),
+                             out_shardings=out_sh)
+            else:
+                jf = jax.jit(forward)
             blob = jexport.export(
-                jax.jit(forward), platforms=list(platforms))(
+                jf, platforms=list(platforms))(
                     jax.ShapeDtypeStruct((b,) + item,
                                          in_dtype)).serialize()
             f.write(blob)
@@ -170,6 +360,10 @@ def export_model(trainer, path: str,
         "output_shape": [bs] + list(out_shape[1:]),
         "platforms": list(platforms),
     }
+    if mesh is not None:
+        meta["mesh"] = mesh_meta(mesh)
+        meta["in_shardings"] = in_specs
+        meta["out_shardings"] = out_specs
     if len(ladder) > 1:
         meta["batch_ladder"] = ladder
         meta["ladder_blob_bytes"] = sizes
@@ -182,7 +376,8 @@ def export_generate(trainer, path: str, max_new: int = 32,
                     prompt_len: Optional[int] = None,
                     batch_size: Optional[int] = None,
                     batch_ladder: Optional[Sequence[int]] = None,
-                    platforms: Optional[Sequence[str]] = None) -> None:
+                    platforms: Optional[Sequence[str]] = None,
+                    mesh=None) -> None:
     """Serialize the KV-cache DECODER (weights baked in) to ``path``.
 
     The exported function maps ``(tokens (B, S) int32, lens (B,)
@@ -198,8 +393,12 @@ def export_generate(trainer, path: str, max_new: int = 32,
     ladder of decoders into one artifact (see ``export_model``) —
     every rung shares S/prompt_slots/max_new/temperature, only the
     slot count B varies, and layout/kv re-resolve per rung (kernel
-    feasibility can depend on B). Multi-host: collective, process 0
-    writes, like ``export_model``."""
+    feasibility can depend on B). ``mesh`` exports a MESH-CARRYING
+    decoder (see ``export_model``): slots shard over the ``data``
+    axis (toks/lens in, token matrix out; the PRNG key replicates),
+    the ladder rounds up to data-axis multiples, and the mesh + specs
+    land in the meta. Multi-host: collective, process 0 writes, like
+    ``export_model``."""
     import jax
     from jax import export as jexport
 
@@ -219,6 +418,9 @@ def export_generate(trainer, path: str, max_new: int = 32,
         ladder = _norm_ladder(batch_ladder, batch_size)
     else:
         ladder = [int(batch_size or trainer.batch_size)]
+    if mesh is not None:
+        from .parallel import DATA_AXIS
+        ladder = _shard_ladder(ladder, mesh.shape.get(DATA_AXIS, 1))
     B = ladder[-1]
     max_new = int(max_new)
     if max_new < 1:
@@ -240,9 +442,19 @@ def export_generate(trainer, path: str, max_new: int = 32,
         return
     trainer._warn_moe_capacity(plan, "export_generate")
     from .parallel import mesh_platform
-    platform = mesh_platform(trainer.mesh)
+    platform = mesh_platform(mesh if mesh is not None
+                             else trainer.mesh)
     if platforms is None:
         platforms = [platform]
+    in_specs = out_specs = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from .parallel import DATA_AXIS
+        data_sh = NamedSharding(mesh, _spec_from_json([DATA_AXIS]))
+        repl_sh = NamedSharding(mesh, _spec_from_json([]))
+        gen_in = (data_sh, data_sh, repl_sh)
+        in_specs = [_spec_to_json(s.spec) for s in gen_in]
+        out_specs = [_spec_to_json(data_sh.spec)]
     sizes, resolved = [], []
     with open(path, "wb") as f:
         for b in ladder:
@@ -256,10 +468,15 @@ def export_generate(trainer, path: str, max_new: int = 32,
             def decode(toks, lens, key, _fn=fn):
                 return _fn(params, toks, lens, key)
 
+            if mesh is not None:
+                jf = jax.jit(decode, in_shardings=gen_in,
+                             out_shardings=data_sh)
+            else:
+                jf = jax.jit(decode)
             # write rung by rung (see export_model): no whole-ladder
             # blob list resident at once
             blob = jexport.export(
-                jax.jit(decode), platforms=list(platforms))(
+                jf, platforms=list(platforms))(
                     jax.ShapeDtypeStruct((b, S), np.int32),
                     jax.ShapeDtypeStruct((b,), np.int32),
                     jax.ShapeDtypeStruct((2,), np.uint32)).serialize()
@@ -277,6 +494,10 @@ def export_generate(trainer, path: str, max_new: int = 32,
         "decode_layout": resolved[-1][0], "decode_kv": resolved[-1][1],
         "platforms": list(platforms),
     }
+    if mesh is not None:
+        meta["mesh"] = mesh_meta(mesh)
+        meta["in_shardings"] = in_specs
+        meta["out_shardings"] = out_specs
     if len(ladder) > 1:
         meta["batch_ladder"] = ladder
         meta["ladder_blob_bytes"] = sizes
@@ -325,7 +546,8 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                        step_buckets: Optional[Sequence[int]] = None,
                        paged_attend: str = "fused",
                        tail_prefill: bool = True,
-                       platforms: Optional[Sequence[str]] = None) -> None:
+                       platforms: Optional[Sequence[str]] = None,
+                       mesh=None) -> None:
     """Serialize the SPLIT-PHASE decoder for continuous batching:
     instead of ``export_generate``'s one monolithic prefill+decode
     loop, the artifact carries
@@ -394,6 +616,19 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
     prompt region, so nothing is shareable) — ``meta["ctx_blocks"]``
     and the ``tail_prefill`` program entries record what shipped.
 
+    ``mesh`` exports a MESH-CARRYING split-phase decoder
+    (docs/serving.md "sharded serving") — the typed-rung space grows
+    one axis: kv_dtype x step bucket x MESH. Slots, step buckets,
+    and prefill rows shard over the ``data`` axis (all rounded up to
+    data-axis multiples), and the POOL's block dim shards over it
+    too: the page space is cut into per-shard slices, each with its
+    own trash page and free list (``pool_blocks_per_shard`` in the
+    meta; serve/kvpool.py allocates per slice), so a row's block
+    table stays inside the slice its dispatch shard owns and the
+    step's page gather never leaves the shard. The mesh + per-arg
+    PartitionSpecs serialize into the meta and are validated at load
+    (``resolve_mesh``).
+
     Greedy outputs of the NATIVE rung are bitwise-identical to the
     monolithic ``export_generate`` artifact built from the same
     trainer (gather slices its pages to exactly the slot layout's
@@ -401,8 +636,12 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
     by construction) — pinned by tests and by
     ``tools/decode_quality.py --paged``; the int8 rung is approximate
     (~1% relative attend error), gated by the same tool's
-    ``--kv int8`` agreement threshold. Multi-host: collective,
-    process 0 writes, like ``export_model``."""
+    ``--kv int8`` agreement threshold. A dp-MESH artifact's greedy
+    outputs are bitwise-identical to a single-device artifact's at
+    the matching PER-SHARD bucket shape (each shard runs exactly the
+    per-shard program; pinned by tests/test_sharded_serving.py).
+    Multi-host: collective, process 0 writes, like
+    ``export_model``."""
     import jax
     from jax import export as jexport
 
@@ -433,6 +672,16 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
     B = int(batch_size or trainer.batch_size)
     if B < 1:
         raise ValueError("batch_size must be >= 1")
+    # mesh-carrying export: slots, step buckets, and prefill rows all
+    # shard over the data axis, so each must split evenly across the
+    # dp shards (buckets round UP — the ladder must never hit the
+    # input_sharding replication fallback); the pool's page space is
+    # cut into per-shard slices below
+    dp = 1
+    if mesh is not None:
+        from .parallel import DATA_AXIS
+        dp = int(mesh.shape.get(DATA_AXIS, 1))
+        B = -(-B // dp) * dp
     max_new = int(max_new)
     if max_new < 1:
         raise ValueError("max_new must be >= 1, got %d" % max_new)
@@ -471,13 +720,24 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
         # and the ready backlog must be deep enough that holding a
         # prefill for a full rows bucket never starves a lane. Pages
         # are cheap; a too-small pool silently degrades the scheduler
-        # to singleton prefills
-        pool_blocks = 1 + 4 * B * nblk
+        # to singleton prefills. On a mesh the geometry is computed
+        # PER SLICE — each of the dp shards carries its own trash
+        # page plus 4x its B/dp lanes' pages — then multiplied back
+        # out to the global block dim the program shards
+        pool_blocks = dp * (1 + 4 * (B // dp) * nblk)
     pool_blocks = int(pool_blocks)
-    if pool_blocks < 1 + nblk:
+    if pool_blocks % dp:
+        raise ValueError(
+            "pool_blocks (%d) must divide across the %d-way data "
+            "axis: the pool's block dim is sharded over it, and each "
+            "mesh slice carries its own trash page + free list"
+            % (pool_blocks, dp))
+    if pool_blocks // dp < 1 + nblk:
         raise ValueError(
             "pool_blocks must hold at least the trash page plus one "
-            "sequence (%d blocks), got %d" % (1 + nblk, pool_blocks))
+            "sequence (%d blocks) per mesh slice, got %d%s"
+            % (1 + nblk, pool_blocks,
+               " over %d slices" % dp if dp > 1 else ""))
     if prefill_widths is None:
         widths = default_prefill_widths(prompt_len, S)
     else:
@@ -490,12 +750,17 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                 "the widest prefill bucket (%d) must cover the prompt "
                 "region P=%d" % (widths[-1], P))
     if prefill_rows is None:
-        rows = auto_ladder(min(B, 4))
+        # mesh default: the usual 1..4-rows ladder per SHARD, scaled
+        # by dp so every bucket splits evenly
+        rows = auto_ladder(min(B, 4)) if dp == 1 \
+            else [dp * r for r in auto_ladder(max(1, min(B // dp, 4)))]
     else:
         rows = sorted({int(r) for r in prefill_rows})
         if not rows or rows[0] < 1 or rows[-1] > B:
             raise ValueError("prefill_rows must be in [1, %d], got %s"
                              % (B, rows))
+        if dp > 1:
+            rows = [r for r in _shard_ladder(rows, dp) if r <= B]
     if step_buckets is None:
         buckets = [B]
     else:
@@ -504,6 +769,8 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
             raise ValueError(
                 "step_buckets must be in [1, %d] (the slot count "
                 "rides along as the top rung), got %s" % (B, buckets))
+        if dp > 1:
+            buckets = [b for b in _shard_ladder(buckets, dp) if b <= B]
     nh, d = G.uniform_heads_or_reason(net, plan)
     params = jax.tree.map(
         lambda w: trainer._fetch_global(w) if w is not None else None,
@@ -516,7 +783,8 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                for si in plan["stacks"])
     pool_dt = jnp.dtype(net.compute_dtype)
     from .parallel import mesh_platform
-    platform = mesh_platform(trainer.mesh)
+    platform = mesh_platform(mesh if mesh is not None
+                             else trainer.mesh)
     if platforms is None:
         platforms = [platform]
     SDS = jax.ShapeDtypeStruct
@@ -524,6 +792,27 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
     rungs = []
     pool_shape = (pool_blocks, Ltot, nh, kv_block, d)
     scale_shape = pool_shape[:4]
+    # mesh shardings (per program kind): rows/slots/tables over the
+    # data axis, the pool's BLOCK dim over the data axis (each mesh
+    # slice owns its own page slice — the per-shard pool), prefill
+    # K/V outputs over their rows dim, the PRNG key replicated
+    mesh_sh = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from .parallel import DATA_AXIS
+        data_sh = NamedSharding(mesh, _spec_from_json([DATA_AXIS]))
+        repl_sh = NamedSharding(mesh, _spec_from_json([]))
+        rows2_sh = NamedSharding(mesh,
+                                 _spec_from_json([None, DATA_AXIS]))
+        pre_in = (data_sh, data_sh, repl_sh)
+        pre_out = (data_sh, rows2_sh, rows2_sh)
+        mesh_sh = {
+            "pool": _spec_to_json(data_sh.spec),
+            "prefill_in": [_spec_to_json(s.spec) for s in pre_in],
+            "prefill_out": [_spec_to_json(s.spec) for s in pre_out],
+            "step_in": {}, "step_out": {},
+            "tail_in": {}, "tail_out": {},
+        }
     # tail-prefill family (prefix cache): context = the prompt-region
     # pages; only tail widths a cached prompt can need (the cache
     # shares whole kv_block pages, so the max tail is
@@ -546,8 +835,11 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                 def pre(toks, lens, key, _fn=fn):
                     return _fn(params, toks, lens, key)
 
+                jpre = jax.jit(pre, in_shardings=pre_in,
+                               out_shardings=pre_out) \
+                    if mesh is not None else jax.jit(pre)
                 blob = jexport.export(
-                    jax.jit(pre), platforms=list(platforms))(
+                    jpre, platforms=list(platforms))(
                         SDS((r, w), np.int32), SDS((r,), np.int32),
                         SDS((2,), np.uint32)).serialize()
                 f.write(blob)
@@ -563,6 +855,21 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                 pool_args = [SDS(pool_shape, pool_dt),
                              SDS(pool_shape, pool_dt)]
             donate = tuple(range(len(pool_args)))
+            if mesh is not None:
+                step_in = tuple([data_sh] * len(pool_args)) \
+                    + (data_sh, data_sh, data_sh, data_sh, repl_sh)
+                step_out = tuple([data_sh] * len(pool_args)) \
+                    + (data_sh,)
+                tail_in = tuple([data_sh] * len(pool_args)) \
+                    + (data_sh, data_sh, data_sh, data_sh, repl_sh)
+                mesh_sh["step_in"][kvd] = [
+                    _spec_to_json(s.spec) for s in step_in]
+                mesh_sh["step_out"][kvd] = [
+                    _spec_to_json(s.spec) for s in step_out]
+                mesh_sh["tail_in"][kvd] = [
+                    _spec_to_json(s.spec) for s in tail_in]
+                mesh_sh["tail_out"][kvd] = [
+                    _spec_to_json(s.spec) for s in pre_out]
             for b in buckets:
                 fn = G.build_step(net, plan, float(temperature), b, P,
                                   Sl, kv_block, platform,
@@ -576,8 +883,14 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                 # exported program carries the input-output aliasing,
                 # so each step updates the pool in place instead of
                 # copying it through twice per token
+                if mesh is not None:
+                    jstp = jax.jit(stp, donate_argnums=donate,
+                                   in_shardings=step_in,
+                                   out_shardings=step_out)
+                else:
+                    jstp = jax.jit(stp, donate_argnums=donate)
                 blob = jexport.export(
-                    jax.jit(stp, donate_argnums=donate),
+                    jstp,
                     platforms=list(platforms))(
                         *pool_args,
                         SDS((b, nblk), np.int32), SDS((b,), np.int32),
@@ -599,8 +912,11 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                     # a tail prefill must never write a shared page —
                     # the engine scatters the returned tail K/V into
                     # the row's OWN pages afterwards
+                    jtp = jax.jit(tpre, in_shardings=tail_in,
+                                  out_shardings=pre_out) \
+                        if mesh is not None else jax.jit(tpre)
                     blob = jexport.export(
-                        jax.jit(tpre), platforms=list(platforms))(
+                        jtp, platforms=list(platforms))(
                             *pool_args,
                             SDS((r, w), np.int32), SDS((r,), np.int32),
                             SDS((r,), np.int32),
@@ -649,6 +965,10 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
         "programs": programs,
         "platforms": list(platforms),
     }
+    if mesh is not None:
+        meta["mesh"] = mesh_meta(mesh)
+        meta["mesh_shardings"] = mesh_sh
+        meta["pool_blocks_per_shard"] = pool_blocks // dp
     with open(path + ".meta", "w") as f:
         json.dump(meta, f)
 
@@ -673,6 +993,28 @@ class ExportedStepDecoder:
     def __init__(self, path: str, meta: dict):
         from jax import export as jexport
         self.meta = meta
+        # mesh-carrying artifact: realize the recorded mesh on the
+        # local topology NOW (resolve_mesh raises the attributed
+        # MeshMismatchError when it cannot) and materialize the
+        # per-program NamedShardings every dispatch stages with
+        self.mesh = None
+        self.dp = 1
+        self._msh = {}
+        mm = meta.get("mesh")
+        if mm:
+            self.mesh = resolve_mesh(mm)
+            self.dp = mesh_data_parallel(mm)
+            ms = meta.get("mesh_shardings") or {}
+            if ms:
+                self._msh = {
+                    "pool": _shardings(self.mesh, [ms["pool"]])[0],
+                    "prefill_in": _shardings(self.mesh,
+                                             ms["prefill_in"]),
+                    "step_in": {k: _shardings(self.mesh, v)
+                                for k, v in ms["step_in"].items()},
+                    "tail_in": {k: _shardings(self.mesh, v)
+                                for k, v in ms["tail_in"].items()},
+                }
         progs = meta.get("programs") or []
         with open(path, "rb") as f:
             blob = f.read()
@@ -682,6 +1024,7 @@ class ExportedStepDecoder:
                 "(%d programs, %d bytes on disk)"
                 % (path, len(progs), len(blob)))
         self._pre = {}
+        self._pre_calls = {}      # (rows, width) -> staged wrapper
         self._step = {}           # (kv_dtype, bucket) -> exported
         self._step_calls = {}     # (kv_dtype, bucket) -> donating fn
         self._tail = {}           # (kv_dtype, rows, width) -> exported
@@ -742,6 +1085,14 @@ class ExportedStepDecoder:
     @property
     def pool_blocks(self) -> int:
         return int(self.meta["pool_blocks"])
+
+    @property
+    def pool_blocks_per_shard(self) -> int:
+        """Pages one mesh slice owns (the whole pool on a
+        single-device artifact): the per-shard page geometry the
+        host allocator (serve/kvpool.BlockPool(shards=dp)) mirrors."""
+        return int(self.meta.get("pool_blocks_per_shard",
+                                 self.pool_blocks // self.dp))
 
     @property
     def buckets(self) -> list:
@@ -865,12 +1216,12 @@ class ExportedStepDecoder:
                     % (kv, rows, width, sorted(self._tail)))
             site = "ExportedStepDecoder.tail[%s,r%d,w%d]" \
                 % (kv, rows, width)
+            in_sh = (self._msh.get("tail_in") or {}).get(kv)
             inner = _shardcheck.make_sharded(
-                exp.call, in_shardings=self.meta.get("in_shardings"),
-                site=site, always=True)
+                exp.call, in_shardings=in_sh, site=site, always=True)
 
-            def fn(*a, _inner=inner):
-                return _inner(*stage_host(*a))
+            def fn(*a, _inner=inner, _sh=in_sh):
+                return _inner(*stage_host(*a, shardings=_sh))
 
             fn.__name__ = "staged[%s]" % site
             fn.__wrapped__ = inner
@@ -934,12 +1285,52 @@ class ExportedStepDecoder:
                 # safe (q=0 contributes nothing) but 1.0 keeps every
                 # unwritten slot trivially readable — the slot-layout
                 # convention
-                return (jnp.zeros(shape, jnp.int8),
+                bufs = (jnp.zeros(shape, jnp.int8),
                         jnp.zeros(shape, jnp.int8),
                         jnp.ones(shape[:4], jnp.float32),
                         jnp.ones(shape[:4], jnp.float32))
-            dt = jnp.dtype(self.meta["pool_dtype"])
-            return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+            else:
+                dt = jnp.dtype(self.meta["pool_dtype"])
+                bufs = (jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+            if self.mesh is not None:
+                # mesh pool: the block dim splits across the data
+                # axis — each mesh slice owns its page slice, the
+                # geometry the host allocator mirrors per shard
+                import jax
+                bufs = tuple(jax.device_put(a, self._msh["pool"])
+                             for a in bufs)
+            return bufs
+
+    def pre_call(self, rows: int, width: int):
+        """The (``rows``, ``width``) prefill program behind the
+        shardcheck seam with its staging baked in: host arrays are
+        placed explicitly (into their declared shards on a
+        mesh-carrying artifact — an ``nr_devices > 1`` program cannot
+        consume host numpy at all), and the program registers for
+        transfer/reshard attribution. Cached per bucket for the
+        artifact's lifetime (``always=True``)."""
+        key = (int(rows), int(width))
+        fn = self._pre_calls.get(key)
+        if fn is None:
+            from .analysis import shardcheck as _shardcheck
+            exp = self._pre.get(key)
+            if exp is None:
+                raise ValueError(
+                    "artifact has no (rows=%d, width=%d) prefill "
+                    "program (exported: %s)"
+                    % (rows, width, sorted(self._pre)))
+            site = "ExportedStepDecoder.prefill[r%d,w%d]" % key
+            in_sh = self._msh.get("prefill_in")
+            inner = _shardcheck.make_sharded(
+                exp.call, in_shardings=in_sh, site=site, always=True)
+
+            def fn(*a, _inner=inner, _sh=in_sh):
+                return _inner(*stage_host(*a, shardings=_sh))
+
+            fn.__name__ = "staged[%s]" % site
+            fn.__wrapped__ = inner
+            self._pre_calls[key] = fn
+        return fn
 
     def prefill(self, tokens: np.ndarray, lens: np.ndarray, key):
         """Run the smallest (rows, width) prefill bucket holding
@@ -958,8 +1349,7 @@ class ExportedStepDecoder:
         toks[:n] = tokens[:, :w]
         ls = np.ones((r,), np.int32)
         ls[:n] = lens
-        first, k, v = self._pre[(r, w)].call(
-            *stage_host(toks, ls, key))
+        first, k, v = self.pre_call(r, w)(toks, ls, key)
         return first[:n], k[:, :n], v[:, :n]
 
     def step_call(self, kv: str = "native", bucket: int = None):
@@ -1003,24 +1393,29 @@ class ExportedStepDecoder:
             site = "ExportedStepDecoder.step[%s,b%d]" % (kv, bucket)
             # always=True: this wrapper is cached for the decoder's
             # lifetime, which may start before jitcheck.enable()
+            # the outer jit re-adds only DONATION (export drops the
+            # aliasing); its placements follow the committed sharded
+            # inputs, which staging below guarantees match the
+            # exported program's own declared shardings
             inner = _jitcheck.make_donating(
                 jax.jit(exported_decode_step, donate_argnums=donate),
                 argnums=donate, site=site, always=True)
-            # sharding seam (docs/analysis.md): the meta carries no
-            # in_shardings yet (single-device artifact), so this
-            # registers the program and attributes transfer-guard
-            # trips; a mesh-carrying artifact's shardings validate
-            # here for free the day export writes them
+            # sharding seam (docs/analysis.md): a mesh-carrying
+            # artifact's materialized in_shardings validate every
+            # call here (a mismatch is an attributed ReshardError
+            # when armed); a single-device artifact just registers
+            # the program for transfer-guard attribution
+            in_sh = (self._msh.get("step_in") or {}).get(kv)
             inner = _shardcheck.make_sharded(
-                inner, in_shardings=self.meta.get("in_shardings"),
-                site=site, always=True)
+                inner, in_shardings=in_sh, site=site, always=True)
 
-            def fn(*a, _inner=inner):
+            def fn(*a, _inner=inner, _sh=in_sh):
                 # per-call control arrays (block table, lens, step,
                 # last, key) arrive as host numpy: stage them
-                # explicitly so armed steady state pays no implicit
-                # transfer (the pool buffers pass through untouched)
-                return _inner(*stage_host(*a))
+                # explicitly — into their declared shards on a mesh —
+                # so armed steady state pays no implicit transfer
+                # (the pool buffers pass through untouched)
+                return _inner(*stage_host(*a, shardings=_sh))
 
             fn.__name__ = "staged[%s]" % site
             fn.__wrapped__ = inner
@@ -1073,15 +1468,30 @@ class ExportedStepDecoder:
         with _shardcheck.allow("prng-seed"):
             base = jax.random.PRNGKey(int(seed))
         out = np.array(toks, copy=True)
-        rows_fit = min(B, (self.pool_blocks - 1) // nblk)
+        # per-shard geometry: each mesh slice owns B/dp lanes and its
+        # own page slice (with its own trash page at the slice base);
+        # chunk rows round-robin across shards so no slice overflows.
+        # dp == 1 degenerates to the classic single-pool layout
+        dp = self.dp
+        Ls = B // dp
+        bps = self.pool_blocks_per_shard
+        rf = min(Ls, (bps - 1) // nblk)
+        rows_fit = dp * rf
         for lo in range(0, n, rows_fit):
             t = toks[lo:lo + rows_fit]
             l = lens[lo:lo + rows_fit]
             mrows = t.shape[0]
             pools = self.new_pool(kv)
-            bt = np.zeros((B, nblk), np.int32)       # 0 = trash page
+            # slot of chunk row r: shard r%dp, lane r//dp — every
+            # row's pages come from its own shard's slice
+            slot = [(r % dp) * Ls + r // dp for r in range(mrows)]
+            bt = np.zeros((B, nblk), np.int32)
+            for j in range(B):
+                bt[j] = (j // Ls) * bps      # the slot's shard trash
             for r in range(mrows):
-                bt[r] = 1 + r * nblk + np.arange(nblk)
+                sj, lane = r % dp, r // dp
+                bt[slot[r]] = sj * bps + 1 + lane * nblk \
+                    + np.arange(nblk)
             emitted = np.zeros((mrows, n_new), np.int32)
             # per-row prefill: row-independent, so grouping does not
             # change values — one row at a time keeps this driver
@@ -1093,15 +1503,17 @@ class ExportedStepDecoder:
                 first, k, v = self.prefill(t[r:r + 1], l[r:r + 1], key)
                 emitted[r, 0] = int(np.asarray(first)[0])
                 pools = scatter_prefill_kv(
-                    pools, k, v, [list(bt[r])], self.kv_block)
+                    pools, k, v, [list(bt[slot[r]])], self.kv_block)
             blens = np.ones((B,), np.int32)
-            blens[:mrows] = l
+            for r in range(mrows):
+                blens[slot[r]] = l[r]
             T = self.step_tokens
             i = 0
             while i < n_new - 1:
                 stepv = np.full((B,), i, np.int32)
                 last = np.zeros((B,), np.int32)
-                last[:mrows] = emitted[:, i]
+                for r in range(mrows):
+                    last[slot[r]] = emitted[r, i]
                 with _shardcheck.allow("prng-seed"):
                     key = np.asarray(
                         jax.random.fold_in(base, 1 << 20 | i),
@@ -1109,8 +1521,10 @@ class ExportedStepDecoder:
                 out_t = step_fn(*pools, bt, blens, stepv, last, key)
                 pools, nxt = out_t[:-1], out_t[-1]
                 take = min(T, n_new - 1 - i)   # overshoot discarded
-                emitted[:, i + 1:i + 1 + take] = \
-                    np.asarray(nxt)[:mrows, :take]
+                nxt = np.asarray(nxt)
+                for r in range(mrows):
+                    emitted[r, i + 1:i + 1 + take] = \
+                        nxt[slot[r], :take]
                 i += take
             for r in range(mrows):
                 out[lo + r, l[r]:l[r] + n_new] = emitted[r]
@@ -1150,7 +1564,21 @@ def scatter_prefill_kv(pools, k, v, block_tables, kv_block: int,
     n = bt.shape[0]
     W = int(k.shape[3])
     quant = len(pools) == 4
-    key = (W, n, quant, tuple(pools[0].shape), str(pools[0].dtype))
+    # mesh pools: the block dim is sharded over the data axis — the
+    # jit below follows the committed input shardings (no declaration
+    # needed), the host index arrays stage replicated, and the cache
+    # key carries the DATA-axis size (the pool's actual shard count,
+    # not the mesh's total device count) so the program name stays
+    # attributable per topology
+    pool_mesh = getattr(getattr(pools[0], "sharding", None),
+                        "mesh", None)
+    if pool_mesh is not None:
+        from .parallel import DATA_AXIS
+        nshards = int(dict(pool_mesh.shape).get(DATA_AXIS, 1))
+    else:
+        nshards = 1
+    key = (W, n, quant, tuple(pools[0].shape), str(pools[0].dtype),
+           nshards)
     fn = _SCATTER_CACHE.get(key)
     if fn is None:
         from .analysis import jitcheck as _jitcheck
@@ -1184,8 +1612,9 @@ def scatter_prefill_kv(pools, k, v, block_tables, kv_block: int,
         # per-shape name: the recompile sentinel's per-program counts
         # stay attributable (one compile per (width, rows) is warmup;
         # a second of the SAME name is a real recompile)
-        _scat.__name__ = "scatter_prefill%s_w%d_n%d" % (
-            "_q8" if quant else "", W, n)
+        _scat.__name__ = "scatter_prefill%s_w%d_n%d%s" % (
+            "_q8" if quant else "", W, n,
+            "_dp%d" % nshards if nshards > 1 else "")
         # always=True: the module-global cache outlives any one
         # jitcheck/shardcheck enable() window
         from .analysis import shardcheck as _shardcheck
@@ -1213,23 +1642,28 @@ def scatter_prefill_kv(pools, k, v, block_tables, kv_block: int,
             keep = cols[None, :] < np.asarray(valid,
                                               np.int64)[:, None]
             b_idx = np.where(keep, b_idx, 0).astype(np.int32)
+    if pool_mesh is not None:
+        from jax.sharding import NamedSharding
+        repl = NamedSharding(pool_mesh, _spec_from_json([]))
+        return fn(*pools, k, v,
+                  *stage_host(b_idx, off, shardings=(repl, repl)))
     return fn(*pools, k, v, *stage_host(b_idx, off))
 
 
-def _sharded_bucket_call(exps, meta, calls, b: int, site: str):
+def _sharded_bucket_call(exps, in_shardings, calls, b: int, site: str):
     """The bucket program of a loaded artifact behind the shardcheck
     seam, built lazily and cached in ``calls`` (one wrapper per
     bucket for the artifact's lifetime, hence ``always=True``):
     registers the program for transfer/reshard attribution, and a
-    mesh-carrying artifact's ``in_shardings`` meta validates here for
-    free the day sharded export writes it (docs/analysis.md). Shared
-    by ExportedModel and ExportedDecoder so the seam cannot drift
-    between them."""
+    mesh-carrying artifact's MATERIALIZED ``in_shardings`` validate
+    every call (an arriving mismatch is an attributed ReshardError
+    when armed — docs/analysis.md). Shared by ExportedModel and
+    ExportedDecoder so the seam cannot drift between them."""
     fn = calls.get(b)
     if fn is None:
         from .analysis import shardcheck as _shardcheck
         fn = _shardcheck.make_sharded(
-            exps[b].call, in_shardings=(meta or {}).get("in_shardings"),
+            exps[b].call, in_shardings=in_shardings,
             site=site, always=True)
         calls[b] = fn
     return fn
@@ -1291,6 +1725,15 @@ class ExportedDecoder:
                               jexport.deserialize(f.read())}
         self.meta = meta
         self._calls: dict = {}
+        # mesh-carrying artifact: realize the mesh locally (raises
+        # MeshMismatchError at load when the topology cannot) and
+        # materialize the per-arg shardings staging places into
+        self.mesh = None
+        self._in_sh = None
+        mm = (meta or {}).get("mesh")
+        if mm:
+            self.mesh = resolve_mesh(mm)
+            self._in_sh = _shardings(self.mesh, meta["in_shardings"])
 
     @property
     def batch(self) -> int:
@@ -1305,8 +1748,14 @@ class ExportedDecoder:
         return sorted(self._exps)
 
     def _bucket_call(self, b: int):
-        return _sharded_bucket_call(self._exps, self.meta, self._calls,
-                                    b, "ExportedDecoder.call[b%d]" % b)
+        # mesh-qualified site: the sentinel's per-program counts keep
+        # a dp artifact's programs distinct from the single-device
+        # baseline's when both serve in one process (the bench A/B)
+        site = "ExportedDecoder.call[b%d]%s" % (
+            b, "@dp%d" % mesh_data_parallel(self.meta.get("mesh"))
+            if self.mesh is not None else "")
+        return _sharded_bucket_call(self._exps, self._in_sh,
+                                    self._calls, b, site)
 
     def call_exact(self, tokens: np.ndarray, lens: np.ndarray, key):
         """Run the bucket matching ``tokens.shape[0]`` exactly — no
@@ -1314,13 +1763,15 @@ class ExportedDecoder:
         JAX's async dispatch (``np.asarray`` it to block). The serving
         engine's pipelined dispatch lives on this. Host inputs are
         staged explicitly (``stage_host``) so armed steady state pays
-        no implicit transfer."""
+        no implicit transfer — on a mesh artifact, directly into the
+        declared shards."""
         b = tokens.shape[0]
         if b not in self._exps:
             raise ValueError(
                 "no exported bucket of %d rows (ladder: %s)"
                 % (b, self.buckets))
-        return self._bucket_call(b)(*stage_host(tokens, lens, key))
+        return self._bucket_call(b)(
+            *stage_host(tokens, lens, key, shardings=self._in_sh))
 
     def __call__(self, tokens: np.ndarray, lens: np.ndarray,
                  seed: int = 0) -> np.ndarray:
@@ -1368,7 +1819,8 @@ class ExportedDecoder:
                 t = np.concatenate([t, np.zeros((pad, S), np.int32)])
                 l = np.concatenate([l, np.ones((pad,), np.int32)])
             outs.append(np.asarray(self._bucket_call(b)(
-                *stage_host(t, l, keys[lo // B]))))
+                *stage_host(t, l, keys[lo // B],
+                            shardings=self._in_sh))))
         out = outs[0] if len(outs) == 1 else np.concatenate(outs)
         return out[:n]
 
@@ -1415,10 +1867,23 @@ class ExportedModel:
         else:
             self._exp = self._exps[max(self._exps)]
         self._calls: dict = {}
+        # mesh-carrying artifact (see ExportedDecoder): topology
+        # validated at load, shardings materialized for staging
+        self.mesh = None
+        self._in_sh = None
+        mm = (self.meta or {}).get("mesh")
+        if mm:
+            self.mesh = resolve_mesh(mm)
+            self._in_sh = _shardings(self.mesh,
+                                     self.meta["in_shardings"])
 
     def _bucket_call(self, b: int):
-        return _sharded_bucket_call(self._exps, self.meta, self._calls,
-                                    b, "ExportedModel.call[b%d]" % b)
+        # mesh-qualified site (see ExportedDecoder._bucket_call)
+        site = "ExportedModel.call[b%d]%s" % (
+            b, "@dp%d" % mesh_data_parallel(self.meta.get("mesh"))
+            if self.mesh is not None else "")
+        return _sharded_bucket_call(self._exps, self._in_sh,
+                                    self._calls, b, site)
 
     @property
     def batch(self) -> Optional[int]:
@@ -1444,7 +1909,8 @@ class ExportedModel:
             raise ValueError(
                 "no exported bucket of %d rows (ladder: %s)"
                 % (b, sorted(self._exps)))
-        return self._bucket_call(b)(*stage_host(data))
+        return self._bucket_call(b)(
+            *stage_host(data, shardings=self._in_sh))
 
     def __call__(self, data: np.ndarray) -> np.ndarray:
         dt = np.dtype((self.meta or {}).get("input_dtype", "float32"))
@@ -1453,7 +1919,7 @@ class ExportedModel:
         if shape is None or arr.shape == tuple(shape):
             if self._exps:          # the max bucket, behind the seam
                 return np.asarray(self._bucket_call(max(self._exps))(
-                    *stage_host(arr)))
+                    *stage_host(arr, shardings=self._in_sh)))
             return np.asarray(self._exp.call(*stage_host(arr)))
         B = int(shape[0])
         buckets = sorted(self._exps)
@@ -1472,8 +1938,8 @@ class ExportedModel:
             if chunk.shape[0] < b:
                 pad = np.zeros((b - chunk.shape[0],) + item, dt)
                 chunk = np.concatenate([chunk, pad])
-            outs.append(np.asarray(
-                self._bucket_call(b)(*stage_host(chunk))))
+            outs.append(np.asarray(self._bucket_call(b)(
+                *stage_host(chunk, shardings=self._in_sh))))
         out = outs[0] if len(outs) == 1 else np.concatenate(outs)
         return out[:n]
 

@@ -24,17 +24,31 @@ Usage:
                                                # cover every kv_dtype
                                                # x bucket x rows
                                                # combo)
+  python tools/analysis_gate.py --sharded      # + the DYNAMIC sharded-
+                                               # serving gate: a dp4
+                                               # mesh-carrying export
+                                               # served through a
+                                               # warmed engine with
+                                               # both sentinels armed
+                                               # (0 compiles, 0
+                                               # implicit transfers,
+                                               # 0 reshards; sharded
+                                               # program count
+                                               # recorded)
   python tools/analysis_gate.py --ledger       # also record the gate
                                                # surface as a
                                                # net=analysis row in
                                                # docs/bench_history
                                                # .json (rule counts,
-                                               # waivers, files, and
-                                               # the rung gate —
+                                               # waivers, files, the
+                                               # rung gate AND the
+                                               # sharded-serving gate
+                                               # with its sharded
+                                               # program count —
                                                # --ledger implies
-                                               # --rungs) so BENCH
-                                               # history tracks its
-                                               # growth
+                                               # --rungs + --sharded)
+                                               # so BENCH history
+                                               # tracks its growth
 
 The baseline lives at ``docs/analysis_waivers.txt``; one waiver per
 line::
@@ -232,6 +246,97 @@ def check_decode_rungs(step_path=None, traffic_rows=(1, 2)):
     }
 
 
+def check_sharded_serving(devices: int = 4):
+    """Dynamic sharded-serving gate (r15, docs/serving.md): export a
+    tiny forward on a ``devices``-way data mesh, serve it through a
+    warmed ServingEngine with BOTH sentinels armed, and demand zero
+    steady-state compiles, zero implicit host transfers, and zero
+    implicit reshards — plus the SHARDED PROGRAM COUNT the --ledger
+    row carries, so BENCH history tracks the mesh-carrying program
+    surface alongside the rule families. Needs >= ``devices`` local
+    devices (the tier-1 suite and this tool's CLI both run under
+    ``force_host_cpu(8)``)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from cxxnet_tpu import config as cfg_mod
+    from cxxnet_tpu import serving
+    from cxxnet_tpu.analysis import jitcheck, shardcheck
+    from cxxnet_tpu.serve import ServingEngine
+    from cxxnet_tpu.trainer import Trainer
+
+    if len(jax.devices()) < devices:
+        return {"ok": False, "devices": devices,
+                "skipped": "needs %d local devices, have %d"
+                % (devices, len(jax.devices()))}
+    text = """
+netconfig=start
+layer[+1:fl1] = flatten:fl1
+layer[+1:fc1] = fullc:fc1
+  nhidden = 64
+  init_sigma = 0.05
+layer[+1:r1] = relu:r1
+layer[r1->fc2] = fullc:fc2
+  nhidden = 16
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,32
+batch_size = 8
+eta = 0.01
+"""
+    tr = Trainer()
+    for k, v in cfg_mod.parse_string(text):
+        tr.set_param(k, v)
+    tr.set_param("dev", "cpu")
+    tr.set_param("eval_train", "0")
+    tr.init_model()
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "dp.export")
+        serving.export_model(tr, path, batch_ladder=[1, 2, 4, 8],
+                             platforms=["cpu"],
+                             mesh=serving.make_serving_mesh(devices))
+        del tr
+        model = serving.load_exported(path)
+        jm = jitcheck.enable()
+        sm = shardcheck.enable()
+        eng = None
+        try:
+            eng = ServingEngine(model, warmup=True)
+            jm.arm()
+            sm.arm()
+            rs = np.random.RandomState(0)
+            data = rs.randn(8, 1, 1, 32).astype(np.float32)
+            for n in (1, 3, 8):
+                eng.submit(data[:n]).result(60)
+            steady = int(jm.steady_compiles)
+            row = {
+                "devices": devices,
+                "mesh": model.meta.get("mesh"),
+                "buckets": model.buckets,
+                "sharded_programs": len(sm.programs),
+                "sharded_program_sites": sorted(sm.programs),
+                "sharded_calls": sum(sm.programs.values()),
+                "implicit_transfers": sm.steady_transfers_total,
+                "reshards": sm.steady_reshards_total,
+                "steady_state_compiles": steady,
+            }
+            row["ok"] = (steady == 0
+                         and row["implicit_transfers"] == 0
+                         and row["reshards"] == 0)
+            if not row["ok"]:
+                row["violations"] = [repr(v) for v in sm.violations()] \
+                    + [repr(v) for v in jm.violations()]
+            return row
+        finally:
+            if eng is not None:
+                eng.close()
+            jitcheck.disable()
+            shardcheck.disable()
+
+
 def record_ledger(summary):
     """Append the gate surface to the bench ledger (net=analysis,
     newest snapshot wins — the same convention as the net=obs rows):
@@ -257,6 +362,11 @@ def main(argv=None):
                     help="also run the dynamic decode-rung gate: "
                          "every exported kv_dtype rung must serve "
                          "steady-state compile-free (jitcheck armed)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="also run the dynamic sharded-serving gate: "
+                         "a dp4 mesh-carrying export served armed "
+                         "(0 compiles / transfers / reshards; the "
+                         "sharded program count lands in --ledger)")
     ap.add_argument("--step-artifact", default=None,
                     help="existing split-phase artifact for --rungs "
                          "(default: build a tiny two-rung one)")
@@ -270,6 +380,13 @@ def main(argv=None):
                     help="waiver file (default docs/analysis_waivers"
                          ".txt under --root)")
     args = ap.parse_args(argv)
+
+    if args.rungs or args.ledger or args.sharded:
+        # the dynamic gates initialize jax; the sharded one needs a
+        # multi-device topology — force the 8-way virtual CPU mesh
+        # BEFORE any backend comes up (tolerated no-op afterwards)
+        from cxxnet_tpu.parallel import force_host_cpu
+        force_host_cpu(8)
 
     res = run_gate(args.root, args.waivers)
     findings, unwaived, stale = res.findings, res.unwaived, res.stale
@@ -292,6 +409,16 @@ def main(argv=None):
                              r["steady_state_compiles"],
                              "\n    ".join(r["violations"])),
                           file=sys.stderr)
+    sharded_ok = True
+    if args.sharded or args.ledger:
+        shard_res = check_sharded_serving()
+        summary["sharded_serving"] = shard_res
+        sharded_ok = shard_res["ok"]
+        if not sharded_ok:
+            print("analysis_gate: SHARDED-SERVING GATE TRIPPED — %s"
+                  % (shard_res.get("skipped")
+                     or "; ".join(shard_res.get("violations", []))),
+                  file=sys.stderr)
     if args.ledger:
         record_ledger(summary)
     if args.json:
@@ -318,7 +445,17 @@ def main(argv=None):
                                   r["steady_state_compiles"])
                                for r in summary["decode_rungs"]
                                ["rungs"])))
-    return 1 if (unwaived or not rungs_ok) else 0
+        if "sharded_serving" in summary:
+            ss = summary["sharded_serving"]
+            print("sharded-serving gate: %s (%d sharded program(s), "
+                  "%d call(s), %d implicit transfer(s), %d "
+                  "reshard(s))"
+                  % ("clean" if sharded_ok else "TRIPPED",
+                     ss.get("sharded_programs", 0),
+                     ss.get("sharded_calls", 0),
+                     ss.get("implicit_transfers", -1),
+                     ss.get("reshards", -1)))
+    return 1 if (unwaived or not rungs_ok or not sharded_ok) else 0
 
 
 if __name__ == "__main__":

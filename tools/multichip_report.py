@@ -82,6 +82,78 @@ def analyze(name, tr, batch, image=None, lm=None, note="",
     return row
 
 
+SERVE_MLP = """
+netconfig=start
+layer[+1:fl1] = flatten:fl1
+layer[+1:fc1] = fullc:fc1
+  nhidden = 256
+  init_sigma = 0.05
+layer[+1:r1] = relu:r1
+layer[r1->fc2] = fullc:fc2
+  nhidden = 16
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,64
+batch_size = 32
+eta = 0.01
+"""
+
+
+def serving_leg(mon):
+    """The SHARDED-SERVING leg (r15, docs/serving.md): export a small
+    forward as a dp8 mesh-carrying artifact, serve real dispatches
+    through a warmed ServingEngine under the ALREADY-ARMED transfer
+    sentinel, and record the shardcheck surface — the hard contract
+    is ``implicit_transfers == 0`` (every dispatch stages its rows
+    into the artifact's declared shards via serving.stage_host); a
+    violation fails the whole tool through the existing gate."""
+    import tempfile
+
+    import jax.numpy  # noqa: F401  (backend up before the engine)
+
+    from cxxnet_tpu import serving as srv
+    from cxxnet_tpu.analysis import jitcheck
+    from cxxnet_tpu.serve import ServingEngine
+
+    tr = build(SERVE_MLP, 32)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "dp8.export")
+        with shardcheck.allow("serving-export"):
+            srv.export_model(tr, path, batch_ladder=[8, 16, 32],
+                             platforms=["cpu"],
+                             mesh=srv.make_serving_mesh(8))
+        del tr
+        model = srv.load_exported(path)
+        before_calls = sum(mon.programs.values())
+        jm = jitcheck.enable()
+        eng = None
+        try:
+            eng = ServingEngine(model, warmup=True)
+            jm.arm()
+            rs = np.random.RandomState(0)
+            data = rs.randn(32, 1, 1, 64).astype(np.float32)
+            for n in (1, 6, 8, 20, 32):
+                eng.submit(data[:n]).result(60)
+            steady = int(jm.steady_compiles)
+        finally:
+            if eng is not None:
+                eng.close()
+            jitcheck.disable()
+    sites = sorted(k for k in mon.programs if "ExportedModel" in k)
+    return {
+        "config": "serving_dp8_mlp",
+        "mesh": model.meta.get("mesh"),
+        "buckets": model.buckets,
+        "sharded_programs": len(sites),
+        "sharded_program_sites": sites,
+        "sharded_calls": sum(mon.programs.values()) - before_calls,
+        "steady_state_compiles": steady,
+        "implicit_transfers": int(mon.steady_transfers_total),
+        "reshards": int(mon.steady_reshards_total),
+    }
+
+
 def main():
     # the whole report runs under the ARMED shardcheck sentinel
     # (docs/analysis.md): trainer builds are sanctioned warmup
@@ -168,6 +240,13 @@ def main():
              "all-to-all — docs/parallel.md; nlayer=2 of 12"))
     del tr
 
+    # 6) SERVING leg (r15, sharded serving): a dp8 mesh-carrying
+    # export served through ServingEngine entirely ARMED — the leg
+    # the ROADMAP's "zero steady-state host transfers" contract is
+    # checked on: implicit_transfers must read 0 or the tool fails
+    serving_row = serving_leg(mon)
+    print(json.dumps(serving_row))
+
     shardcheck.disable()
     sentinel = mon.summary(armed=True)
     if sentinel["steady_state_transfers"] or \
@@ -178,6 +257,12 @@ def main():
             % (sentinel["steady_state_transfers"],
                sentinel["steady_state_reshards"],
                "\n  ".join(map(repr, mon.violations()))))
+        sys.exit(1)
+    if serving_row["steady_state_compiles"]:
+        sys.stderr.write(
+            "multichip_report: serving leg compiled in steady state "
+            "(%d compile(s)); nothing written\n"
+            % serving_row["steady_state_compiles"])
         sys.exit(1)
     out = {
         "generated": "round 5",
@@ -190,6 +275,7 @@ def main():
                   "bracket",
         "shardcheck": dict(sentinel, implicit_transfers=int(
             sentinel["steady_state_transfers"])),
+        "serving": serving_row,
         "configs": rows,
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
