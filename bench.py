@@ -916,6 +916,39 @@ def _flight_on(max_events=65536):
         obs_trace.set_flight(None)
 
 
+@contextlib.contextmanager
+def _attrib_on(capacity=65536):
+    """Install the goodput attribution ledger (obs/attrib.py) for a
+    bench window and GUARANTEE it uninstalls — same contract as
+    :func:`_flight_on`. The serving benches run BOTH sinks armed: the
+    headline p50/throughput must include the per-dispatch accounting
+    cost, production posture."""
+    from cxxnet_tpu.obs import attrib
+    led = attrib.enable(capacity)
+    try:
+        yield led
+    finally:
+        attrib.disable()
+
+
+def _attrib_stanza(led, top=4):
+    """The bench-ledger attribution stanza: lifetime taxonomy +
+    per-phase breakdown + the worst waste sources. Fractions are
+    stored UNROUNDED so goodput_frac + the four waste fractions sum
+    to 1.0 within float error — the invariant tests and
+    tools/goodput_report.py --assert-taxonomy pin."""
+    s = led.summary(top=top)
+    return {
+        "events": s["events"],
+        "slot_tokens": s["slot_tokens"],
+        "goodput_tokens": s["goodput_tokens"],
+        "goodput_frac": s["goodput_frac"],
+        "waste_frac": s["waste_frac"],
+        "per_phase": s["per_phase"],
+        "top_waste": s["top_waste"],
+    }
+
+
 # serve bench: shapes chosen so a full-batch forward costs visibly
 # more than a 1-row one (the quantity the bucket ladder recovers) while
 # still compiling in seconds on CPU
@@ -1072,7 +1105,7 @@ def serve_main(args) -> None:
     jit_mon = jitcheck.enable()
     shard_mon = shardcheck.enable()
     try:
-        with _flight_on() as flight, \
+        with _flight_on() as flight, _attrib_on() as attrib_led, \
                 tempfile.TemporaryDirectory() as td:
             tr = _serve_trainer(platform)
             fixed_path = os.path.join(td, "fixed.export")
@@ -1186,6 +1219,7 @@ def serve_main(args) -> None:
         "flight_events_recorded": flight.recorded,
         "recompile_sentinel": sentinel,
         "shard_sentinel": shard_sentinel,
+        "attrib": _attrib_stanza(attrib_led),
         "obs": best_obs,
     }
     best = _update_history(entry, net="serve", metric="rows_per_sec")
@@ -1228,6 +1262,12 @@ def serve_main(args) -> None:
                        "recorder (obs/flight.py) installed — the "
                        "production posture; p50/throughput include "
                        "its ring-append cost",
+        "attrib_goodput_frac": round(
+            entry["attrib"]["goodput_frac"], 4),
+        "attrib_note": "goodput attribution ledger (obs/attrib.py) "
+                       "armed for every window too; full waste "
+                       "taxonomy in the bench ledger entry "
+                       "(tools/goodput_report.py renders it)",
         "latency_trials": lat_trials,
         "throughput_trials": thr_trials,
         "bucket_dispatches_best_window": (best_m or {}).get(
@@ -1911,7 +1951,8 @@ def decode_main(args) -> None:
     jit_mon = jitcheck.enable()
     shard_mon = shardcheck.enable()
     try:
-        with tempfile.TemporaryDirectory() as td:
+        with _attrib_on() as attrib_led, \
+                tempfile.TemporaryDirectory() as td:
             tr = _decode_lm_trainer(platform)
             mono_path = os.path.join(td, "dec_mono.export")
             gather_path = os.path.join(td, "dec_gather.export")
@@ -2168,6 +2209,7 @@ def decode_main(args) -> None:
         "prefix": prefix_stanza,
         "recompile_sentinel": sentinel,
         "shard_sentinel": shard_sentinel,
+        "attrib": _attrib_stanza(attrib_led),
         "windows": windows,
         "frontier": frontier,
     }
@@ -2199,6 +2241,8 @@ def decode_main(args) -> None:
         "attend_kernels": entry["attend_kernels"],
         "kv_bytes_per_step": entry["kv_bytes_per_step"],
         "int8_pool": int8_pool,
+        "attrib_goodput_frac": round(
+            entry["attrib"]["goodput_frac"], 4),
         "prefix": {k: prefix_stanza[k] for k in
                    ("hit_rate", "full_prefill_dispatch_ratio",
                     "prefill_compute_ratio",
@@ -2359,7 +2403,7 @@ def shard_main(args) -> None:
     jit_mon = jitcheck.enable()
     shard_mon = shardcheck.enable()
     try:
-        with _flight_on() as flight, \
+        with _flight_on() as flight, _attrib_on() as attrib_led, \
                 tempfile.TemporaryDirectory() as td:
             tr = _shard_conv_trainer(platform)
             single_path = os.path.join(td, "single.export")
@@ -2439,6 +2483,7 @@ def shard_main(args) -> None:
         "flight_events_recorded": flight.recorded,
         "recompile_sentinel": sentinel,
         "shard_sentinel": shard_sentinel,
+        "attrib": _attrib_stanza(attrib_led),
     }
     best_rec = _update_history(entry, net="shard",
                                metric="dp4_speedup")

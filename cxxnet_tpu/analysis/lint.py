@@ -110,6 +110,17 @@ analysis/shardcheck.py)
   * OBS003 — a literal counter name not ending in ``_total``.
   * OBS004 — more than %(max)d labels on one metric (label cardinality
     is a product, not a sum; keep series enumerable).
+  * OBS005 — a literal ``cxxnet_attrib_*`` metric name outside the
+    closed series set obs/attrib.py declares: the attribution
+    taxonomy is a partition (fractions sum to 1.0), so a stray series
+    under the prefix means some tool invented a category the ledger
+    does not account for.
+  * OBS006 — dict/str work on an ``obs/`` hot path: a ``@hot_path``
+    function in an ``obs/`` module builds a dict/f-string/%%-format/
+    ``.format`` or appends a non-tuple — accounting on the dispatch
+    path must append ONE plain tuple; rendering (labels, dicts)
+    belongs at scrape time. Scoped to obs/ because serving hot paths
+    legitimately pass dict literals as trace-span args.
 
 Checkers only see what is statically there: dynamically-built metric
 names are skipped, locks on foreign objects are invisible, and the
@@ -1608,6 +1619,20 @@ class ObsChecker(Checker):
 
     METRIC_METHODS = {"counter", "gauge", "histogram"}
 
+    # the closed cxxnet_attrib_* series set (obs/attrib.py
+    # bind_registry): the taxonomy is a partition, so a series under
+    # the prefix that is not one of these is a category the ledger
+    # does not account for (OBS005)
+    ATTRIB_SERIES = {
+        "cxxnet_attrib_events_total",
+        "cxxnet_attrib_slot_tokens_total",
+        "cxxnet_attrib_goodput_tokens_total",
+        "cxxnet_attrib_waste_tokens_total",
+        "cxxnet_attrib_kv_pages_total",
+        "cxxnet_attrib_goodput_frac",
+        "cxxnet_attrib_waste_frac",
+    }
+
     def check(self, mod: Module) -> List[Finding]:
         if mod.path.endswith("obs/trace.py"):
             return []   # the tracer's own definitions
@@ -1618,10 +1643,19 @@ class ObsChecker(Checker):
                 for item in node.items:
                     managed.add(id(item.context_expr))
 
+        obs_mod = "obs/" in mod.path
+
         def visit(node, stack):
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, (ast.ClassDef, ast.FunctionDef,
                                       ast.AsyncFunctionDef)):
+                    if obs_mod and isinstance(
+                            child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) \
+                            and SyncChecker._is_hot(child):
+                        self._check_obs_hot(
+                            mod, ".".join(stack + [child.name]),
+                            child, findings)
                     visit(child, stack + [child.name])
                     continue
                 self._check_node(mod, child, stack, managed, findings)
@@ -1629,6 +1663,38 @@ class ObsChecker(Checker):
 
         visit(mod.tree, [])
         return findings
+
+    # -- OBS006 -------------------------------------------------------
+    def _check_obs_hot(self, mod, qual, fn, findings) -> None:
+        """Accounting on the dispatch path appends ONE plain tuple:
+        no dict building, no string rendering, no non-tuple appends.
+        Scoped to ``obs/`` modules' ``@hot_path`` functions — serving
+        hot paths pass dict literals as trace-span args by design."""
+        def flag(node, what):
+            findings.append(Finding(
+                "OBS006", mod.path, node.lineno, qual,
+                "%s inside @hot_path obs accounting — the dispatch "
+                "path appends one plain tuple; rendering belongs at "
+                "scrape time" % what))
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.Dict, ast.DictComp)):
+                flag(sub, "dict built")
+            elif isinstance(sub, ast.JoinedStr):
+                flag(sub, "f-string rendered")
+            elif isinstance(sub, ast.BinOp) \
+                    and isinstance(sub.op, ast.Mod) \
+                    and isinstance(sub.left, ast.Constant) \
+                    and isinstance(sub.left.value, str):
+                flag(sub, "%-format rendered")
+            elif isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute):
+                if sub.func.attr == "format" \
+                        and isinstance(sub.func.value, ast.Constant) \
+                        and isinstance(sub.func.value.value, str):
+                    flag(sub, ".format rendered")
+                elif sub.func.attr == "append" and sub.args \
+                        and not isinstance(sub.args[0], ast.Tuple):
+                    flag(sub, "non-tuple append")
 
     def _check_node(self, mod, node, stack, managed, findings) -> None:
         qual = ".".join(stack) if stack else "<module>"
@@ -1658,6 +1724,14 @@ class ObsChecker(Checker):
                     findings.append(Finding(
                         "OBS003", mod.path, node.lineno, qual,
                         "counter %r must end in _total" % name))
+                elif name.startswith("cxxnet_attrib_") \
+                        and name not in self.ATTRIB_SERIES:
+                    findings.append(Finding(
+                        "OBS005", mod.path, node.lineno, qual,
+                        "metric %r outside the closed cxxnet_attrib_* "
+                        "series set — the waste taxonomy is a "
+                        "partition; add the series to obs/attrib.py "
+                        "(and this set) or rename it" % name))
             labels = None
             if len(node.args) >= 3:
                 labels = node.args[2]

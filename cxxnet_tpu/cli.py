@@ -103,6 +103,7 @@ class LearnTask:
         self.telemetry_port: Optional[int] = None
         self._telemetry = None
         self._flight = None          # task=serve's flight recorder
+        self._attrib = None          # task=serve's attribution ledger
         self._slo = None             # task=serve's SLO engine
         self._obs_hooks: List = []   # global-registry hooks this run
                                      # registered; removed at run end
@@ -235,6 +236,10 @@ class LearnTask:
             if self._flight is not None:
                 obs_trace.set_flight(None)
                 self._flight = None
+            if self._attrib is not None:
+                from .obs import attrib as _attrib
+                _attrib.disable()
+                self._attrib = None
             if self._telemetry is not None:
                 try:
                     self._telemetry.shutdown()
@@ -367,7 +372,9 @@ class LearnTask:
                             # SLO engine + flight recorder (obs/slo.py,
                             # obs/flight.py, docs/observability.md)
                             "slo_p99_ms", "slo_target", "slo_windows",
-                            "flight_events", "flight_dump_dir"]),
+                            "flight_events", "flight_dump_dir",
+                            # goodput attribution ledger (obs/attrib.py)
+                            "attrib_events"]),
     }
 
     def _iter_section_keys(self) -> set:
@@ -1032,7 +1039,10 @@ class LearnTask:
         Observability knobs (docs/observability.md): flight_events
         (default 65536; 0 disables) keeps an always-on bounded ring of
         trace events (obs/flight.py) that SLO incidents dump
-        retroactively; slo_p99_ms = T (0 = off) runs the burn-rate SLO
+        retroactively; attrib_events (default 8192; 0 disables) arms
+        the goodput attribution ledger (obs/attrib.py) — GET
+        /debug/attrib and the cxxnet_attrib_* series report the
+        waste taxonomy; slo_p99_ms = T (0 = off) runs the burn-rate SLO
         engine (obs/slo.py) over the request-latency histogram —
         slo_target (default 0.99) the good fraction, slo_windows
         (default "60,5" seconds) the multi-window rule, incident dumps
@@ -1064,6 +1074,13 @@ class LearnTask:
             from .obs.flight import FlightRecorder
             flight = self._flight = obs_trace.set_flight(
                 FlightRecorder(flight_events))
+        # always-on goodput attribution ledger: same contract as the
+        # flight recorder (bench's armed serve p50 band is the cost
+        # proof); GET /debug/attrib and cxxnet_attrib_* report it
+        attrib_events = int(d.get("attrib_events", "8192"))
+        if attrib_events > 0:
+            from .obs import attrib as _attrib
+            self._attrib = _attrib.enable(capacity=attrib_events)
         if n_rep > 1:
             if "export_in" not in d:
                 raise RuntimeError(

@@ -35,6 +35,11 @@ shared backbone:
   by multi-window burn rate over the registry, emitting
   ``cxxnet_slo_*`` series and incident records that quote histogram
   exemplar request ids and trigger flight dumps.
+* :mod:`.attrib` — the goodput attribution ledger: per-dispatch
+  slot-token accounting across every serving dispatch site,
+  aggregated into a goodput / pad_fill / dummy_lane / overshoot /
+  retry_duplicate waste taxonomy (``cxxnet_attrib_*`` series,
+  ``/debug/attrib``, ``tools/goodput_report.py``).
 
 See docs/observability.md for the full contract (metric naming, trace
 format, request-id semantics).
@@ -46,13 +51,13 @@ from .registry import (Counter, Gauge, Histogram, Registry,
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "get_registry",
            "watch_quantile", "watch_stallclock", "watch_steptimer",
-           "trace", "telemetry", "flight", "slo"]
+           "trace", "telemetry", "flight", "slo", "attrib"]
 
 
 def __getattr__(name):
-    # trace/telemetry/flight/slo load lazily (telemetry pulls in
-    # http.server; slo pulls in the lockcheck seam)
-    if name in ("trace", "telemetry", "flight", "slo"):
+    # trace/telemetry/flight/slo/attrib load lazily (telemetry pulls
+    # in http.server; slo and attrib pull in the lockcheck seam)
+    if name in ("trace", "telemetry", "flight", "slo", "attrib"):
         import importlib
         return importlib.import_module("." + name, __name__)
     raise AttributeError(name)

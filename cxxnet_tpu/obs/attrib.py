@@ -1,0 +1,289 @@
+"""Goodput attribution ledger: per-dispatch device-time accounting.
+
+Every serving dispatch — a prefill, a tail prefill, a continuous
+decode step, a fixed-shape forward/decode batch, a router retry —
+burns a known number of SLOT-TOKENS: bucket rows x tokens-per-slot of
+the program that actually ran, a host-side integer the scheduler
+already holds. This module splits that number into a waste taxonomy
+the autoscaling tier (ROADMAP) can steer on:
+
+* ``goodput``         — slot-tokens that were requested output or real
+                        prompt tokens (work a caller asked for)
+* ``pad_fill``        — bucket padding around live work: empty prefill
+                        rows, intra-row width padding, forward-bucket
+                        rows past the live count
+* ``dummy_lane``      — decode lanes carrying no request for a whole
+                        step (continuous dummies, fixed-decode empty
+                        slots burning ``max_new`` steps each)
+* ``overshoot``       — decode tokens computed past a request's
+                        ``max_new`` and discarded (a row finishing
+                        mid-step throws away the tail of its chunk)
+* ``retry_duplicate`` — work re-done because the router failed an
+                        attempt over to another replica (row-unit
+                        approximation: the router never sees buckets)
+
+Each :meth:`AttribLedger.record` call is one fixed-shape event:
+``(seq, t, phase, rung, shard, bucket_rows, live_rows, width,
+slot_tokens, goodput, pad_fill, dummy_lane, overshoot,
+retry_duplicate, kv_pages)`` appended to a flight-recorder-style ring
+(obs/flight.py is the template), plus per-phase running totals so
+lifetime fractions survive ring eviction. The dispatch-path contract
+mirrors the flight recorder's: ONE tuple build, NO dict building, NO
+string formatting — program labels are rendered at scrape time from
+the event's integers, never on the scheduler thread (the OBS lint
+family enforces this over ``obs/`` hot paths). Every event satisfies
+``slot_tokens == goodput + pad_fill + dummy_lane + overshoot +
+retry_duplicate``, so the aggregated taxonomy sums to 1.0 exactly —
+the invariant the bench stanza test pins.
+
+Module seam (the obs/trace.py pattern): ``enable()`` installs a
+process-global ledger, ``active()`` is the one-global-read the
+dispatch sites branch on (engines pay a single ``is None`` test per
+dispatch when attribution is off), ``summary()`` aggregates on
+demand. ``bind_registry`` follows registry.watch_jitcheck: the hook
+reads the ACTIVE ledger at scrape time, so a ledger enabled after the
+engine was built still exports — ``cxxnet_attrib_*`` series, the
+``/debug/attrib`` endpoint (serve/server.py + obs/telemetry.py) and
+``tools/goodput_report.py`` all render the same :meth:`summary`.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..analysis import hot_path
+from ..analysis import lockcheck as _lockcheck
+
+# phase vocabulary (record() accepts others; these pre-size totals)
+PHASES = ("prefill", "tail_prefill", "decode", "forward",
+          "decode_fixed", "retry")
+WASTE_KINDS = ("pad_fill", "dummy_lane", "overshoot", "retry_duplicate")
+
+# totals columns per phase:
+#   [events, slot_tokens, goodput, pad_fill, dummy_lane, overshoot,
+#    retry_duplicate, kv_pages]
+_NCOL = 8
+
+
+class AttribLedger:
+    """Bounded ring of dispatch-attribution events + per-phase
+    lifetime totals. Thread-safe through one lockcheck-seam lock (the
+    scheduler thread, the completion thread, and router handler
+    threads all record here); ``summary()`` holds the same lock only
+    long enough to copy, so a scrape never stalls a dispatch for the
+    aggregation work."""
+
+    def __init__(self, capacity: int = 8192) -> None:
+        if int(capacity) < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = _lockcheck.make_lock("obs.attrib.lock")
+        self._totals: Dict[str, List[int]] = {
+            p: [0] * _NCOL for p in PHASES}
+        self.recorded = 0          # events ever recorded (evicted incl.)
+
+    # -- the dispatch path ---------------------------------------------
+    @hot_path
+    def record(self, phase: str, rung: str, shard: int,
+               bucket_rows: int, live_rows: int, width: int,
+               slot_tokens: int, goodput: int, pad_fill: int,
+               dummy_lane: int, overshoot: int, retry_duplicate: int,
+               kv_pages: int) -> None:
+        with self._lock:
+            t = self._totals.get(phase)
+            if t is None:
+                t = self._totals.setdefault(phase, [0] * _NCOL)
+            t[0] += 1
+            t[1] += slot_tokens
+            t[2] += goodput
+            t[3] += pad_fill
+            t[4] += dummy_lane
+            t[5] += overshoot
+            t[6] += retry_duplicate
+            t[7] += kv_pages
+            self.recorded += 1
+            self._ring.append((self.recorded, time.monotonic(), phase,
+                               rung, shard, bucket_rows, live_rows,
+                               width, slot_tokens, goodput, pad_fill,
+                               dummy_lane, overshoot, retry_duplicate,
+                               kv_pages))
+
+    # -- aggregation (scrape time, never the dispatch path) ------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def events(self) -> List[tuple]:
+        """Ring snapshot, oldest first (append order)."""
+        with self._lock:
+            return list(self._ring)
+
+    def summary(self, top: int = 8) -> dict:
+        """The waste taxonomy: lifetime per-phase totals + fractions,
+        and the ring window's per-program breakdown ranked by wasted
+        slot-tokens (``top`` worst programs — a program is one
+        (phase, rung, bucket, width, shard) shape, the unit a
+        controller can actually add or remove capacity for)."""
+        with self._lock:
+            totals = {p: list(t) for p, t in self._totals.items()
+                      if t[0]}
+            window = list(self._ring)
+            recorded = self.recorded
+        agg = [0] * _NCOL
+        per_phase = {}
+        for p in sorted(totals):
+            t = totals[p]
+            for i in range(_NCOL):
+                agg[i] += t[i]
+            per_phase[p] = {
+                "events": t[0],
+                "slot_tokens": t[1],
+                "goodput_tokens": t[2],
+                "pad_fill_tokens": t[3],
+                "dummy_lane_tokens": t[4],
+                "overshoot_tokens": t[5],
+                "retry_duplicate_tokens": t[6],
+                "kv_pages_touched": t[7],
+                "goodput_frac": t[2] / t[1] if t[1] else 0.0,
+            }
+        slot = agg[1]
+
+        def frac(x: int) -> float:
+            return x / slot if slot else 0.0
+
+        # window view: group by program shape, rank by waste
+        prog: Dict[tuple, List[int]] = {}
+        for ev in window:
+            key = (ev[2], ev[3], ev[5], ev[7], ev[4])
+            g = prog.get(key)
+            if g is None:
+                g = prog.setdefault(key, [0, 0, 0])
+            g[0] += 1                       # events
+            g[1] += ev[8]                   # slot_tokens
+            g[2] += ev[8] - ev[9]           # wasted slot-tokens
+        programs = [{
+            "program": "%s/%s b%d w%d" % key[:4]
+                       + (" shard%d" % key[4] if key[4] >= 0 else ""),
+            "phase": key[0],
+            "events": g[0],
+            "slot_tokens": g[1],
+            "waste_tokens": g[2],
+            "waste_frac": g[2] / g[1] if g[1] else 0.0,
+        } for key, g in prog.items()]
+        programs.sort(key=lambda d: (-d["waste_tokens"], d["program"]))
+        return {
+            "events": agg[0],
+            "recorded": recorded,
+            "window_events": len(window),
+            "capacity": self.capacity,
+            "slot_tokens": slot,
+            "goodput_tokens": agg[2],
+            "goodput_frac": frac(agg[2]),
+            "waste_frac": {
+                "pad_fill": frac(agg[3]),
+                "dummy_lane": frac(agg[4]),
+                "overshoot": frac(agg[5]),
+                "retry_duplicate": frac(agg[6]),
+            },
+            "kv_pages_touched": agg[7],
+            "per_phase": per_phase,
+            "top_waste": programs[:max(int(top), 0)],
+        }
+
+
+# ----------------------------------------------------------------------
+# module seam: one global ledger, one read + one branch per dispatch
+
+_active: Optional[AttribLedger] = None
+
+
+def enable(capacity: int = 8192) -> AttribLedger:
+    """Install (and return) a fresh process-global ledger. Dispatch
+    sites pick it up on their next event — no engine restart."""
+    global _active
+    _active = AttribLedger(capacity)
+    return _active
+
+
+def disable() -> None:
+    """Drop the global ledger: dispatch sites go back to the single
+    ``is None`` branch, exactly the off cost."""
+    global _active
+    _active = None
+
+
+def active() -> Optional[AttribLedger]:
+    return _active
+
+
+def summary(top: int = 8) -> Optional[dict]:
+    """The active ledger's summary, or None when attribution is off
+    (what ``/debug/attrib`` renders)."""
+    a = _active
+    return None if a is None else a.summary(top=top)
+
+
+# ----------------------------------------------------------------------
+# registry export
+
+def bind_registry(registry, labels: Optional[dict] = None):
+    """Register a scrape-time hook exporting the ACTIVE ledger (the
+    registry.watch_jitcheck convention: the hook re-reads ``active()``
+    per scrape, so enable/disable after binding just works) as the
+    ``cxxnet_attrib_*`` family. Returns the hook for
+    ``registry.remove_hook`` (the engine-close convention)."""
+    labels = dict(labels or {})
+    names = tuple(labels)
+    c_events = registry.counter(
+        "cxxnet_attrib_events_total",
+        "attribution events recorded per dispatch phase",
+        names + ("phase",))
+    c_slot = registry.counter(
+        "cxxnet_attrib_slot_tokens_total",
+        "slot-tokens dispatched per phase (bucket rows x width)",
+        names + ("phase",))
+    c_good = registry.counter(
+        "cxxnet_attrib_goodput_tokens_total",
+        "slot-tokens that were requested work, per phase",
+        names + ("phase",))
+    c_waste = registry.counter(
+        "cxxnet_attrib_waste_tokens_total",
+        "wasted slot-tokens per phase and waste kind",
+        names + ("phase", "kind"))
+    c_pages = registry.counter(
+        "cxxnet_attrib_kv_pages_total",
+        "kv pool pages touched by dispatches, per phase",
+        names + ("phase",))
+    g_good = registry.gauge(
+        "cxxnet_attrib_goodput_frac",
+        "goodput fraction of all slot-tokens dispatched", names)
+    g_waste = registry.gauge(
+        "cxxnet_attrib_waste_frac",
+        "waste fraction of all slot-tokens, per kind",
+        names + ("kind",))
+
+    _kind_col = {"pad_fill": "pad_fill_tokens",
+                 "dummy_lane": "dummy_lane_tokens",
+                 "overshoot": "overshoot_tokens",
+                 "retry_duplicate": "retry_duplicate_tokens"}
+
+    def pull():
+        a = _active
+        if a is None:
+            return
+        s = a.summary(top=0)
+        for p, t in s["per_phase"].items():
+            c_events.set_total(t["events"], phase=p, **labels)
+            c_slot.set_total(t["slot_tokens"], phase=p, **labels)
+            c_good.set_total(t["goodput_tokens"], phase=p, **labels)
+            c_pages.set_total(t["kv_pages_touched"], phase=p, **labels)
+            for kind, col in _kind_col.items():
+                c_waste.set_total(t[col], phase=p, kind=kind, **labels)
+        g_good.set(s["goodput_frac"], **labels)
+        for kind, v in s["waste_frac"].items():
+            g_waste.set(v, kind=kind, **labels)
+
+    return registry.add_hook(pull)

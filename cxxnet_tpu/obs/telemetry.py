@@ -15,6 +15,9 @@ Endpoints:
   GET /slo                   objectives / burn rates / incidents from
                              the attached obs/slo.py engine (404 when
                              none is configured)
+  GET /debug/attrib          goodput attribution summary from the
+                             obs/attrib.py ledger ({"enabled": false}
+                             when the ledger is off)
 
 Stdlib-only (ThreadingHTTPServer) like serve/server.py; one daemon
 thread, silent request logging. Device memory also publishes as the
@@ -101,6 +104,15 @@ class _TelemetryHandler(BaseHTTPRequestHandler):
                 self._send(200,
                            json.dumps(slo.status()).encode("utf-8"),
                            "application/json")
+            return
+        if parts.path == "/debug/attrib":
+            from . import attrib as _attrib
+            s = _attrib.summary()
+            body = {"enabled": s is not None}
+            if s is not None:
+                body.update(s)
+            self._send(200, json.dumps(body).encode("utf-8"),
+                       "application/json")
             return
         if parts.path != "/metrics":
             self._send(404, b'{"error": "no such path"}',

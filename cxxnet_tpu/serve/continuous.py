@@ -63,6 +63,7 @@ import numpy as np
 
 from ..analysis import hot_path
 from ..analysis import lockcheck as _lockcheck
+from ..obs import attrib as _attrib
 from ..obs import trace as _trace
 from ..obs.registry import Registry
 from .engine import (DrainError, QueueFullError, Request, RequestExpired,
@@ -83,7 +84,7 @@ class StreamRequest(Request):
     the completed (rows, seq_len) token matrix."""
 
     __slots__ = ("stream", "n_new", "row_tokens", "_events",
-                 "rows_left", "t_first")
+                 "rows_left", "t_first", "t_prefill_start", "t_bound")
 
     def __init__(self, rows: int, payload, timeout_s, n_new: int,
                  stream: bool):
@@ -93,6 +94,8 @@ class StreamRequest(Request):
         self.row_tokens: List[list] = [[] for _ in range(rows)]
         self.rows_left = rows
         self.t_first: Optional[float] = None
+        self.t_prefill_start: Optional[float] = None
+        self.t_bound: Optional[float] = None
         self._events: _qmod.Queue = _qmod.Queue()
 
     def push_event(self, ev: dict) -> None:
@@ -117,6 +120,29 @@ class StreamRequest(Request):
         t["ttft_ms"] = (None if self.t_first is None else
                         round(1000.0 * (self.t_first - self.t_submit),
                               3))
+        # non-overlapping phase breakdown (queue -> prefill ->
+        # ready-wait -> decode -> stream): the per-request view of the
+        # attribution ledger's phases (docs/observability.md). Rows
+        # that finish at prefill (n_new exhausted by the first token)
+        # never bind a lane — their ready-wait and decode are a true
+        # 0.0, not unknown. "stream" is tokens-ready to response
+        # assembly: timing() is called while the answer/done event is
+        # being built, so it measures the flush the caller still waits
+        # through.
+        def ms(a, b):
+            return None if a is None or b is None \
+                else round(1000.0 * (b - a), 3)
+        done = self.t_done
+        bound = self.t_bound if self.t_bound is not None \
+            else (done if done is not None else None)
+        t["phases"] = {
+            "queue_ms": ms(self.t_submit, self.t_prefill_start),
+            "prefill_ms": ms(self.t_prefill_start, self.t_first),
+            "ready_wait_ms": ms(self.t_first, bound),
+            "decode_ms": ms(bound, done),
+            "stream_ms": (None if done is None else
+                          round(1000.0 * (time.monotonic() - done), 3)),
+        }
         return t
 
 
@@ -364,6 +390,13 @@ class ContinuousDecodeEngine:
             # pool-sizing gauges (live + high-water peak): the peak is
             # what the docs' pool-sizing guidance is measured against
             self.pool.bind_registry(self.registry, self.obs_labels),
+            # goodput attribution export: the hook reads the ACTIVE
+            # ledger per scrape, so enabling attribution after the
+            # engine started still publishes cxxnet_attrib_* here.
+            # Unlabeled deliberately — the ledger is process-global,
+            # and stamping per-engine labels would replicate the same
+            # global numbers under every replica
+            _attrib.bind_registry(self.registry),
         ]
         if self.prefix is not None:
             self._registry_hooks.append(
@@ -852,6 +885,10 @@ class ContinuousDecodeEngine:
             clens[i] = row.clen
         self._nprefill += 1
         self._pf_slot_tokens += c.pick_rows(n) * w
+        t_pf0 = time.monotonic()
+        for row in take:
+            if row.req.t_prefill_start is None:
+                row.req.t_prefill_start = t_pf0
         tr = _trace.sink()
         try:
             with _trace.span("serve.prefill", "serve",
@@ -899,6 +936,27 @@ class ContinuousDecodeEngine:
             self._fail_all_inflight(e)
             return True
         self.stats.on_prefill(n)
+        a = _attrib.active()
+        if a is not None:
+            # one event per prefill program run: bucket_rows x width
+            # slot-tokens split into real prompt tokens (goodput) and
+            # bucket padding (empty rows + intra-row width padding).
+            # Tail rows' goodput is only the uncached tail — the
+            # shared-prefix tokens were someone else's goodput already.
+            rows_b = c.pick_rows(n)
+            live_tok = 0
+            pages = 0
+            shard = take[0].shard
+            for row in take:
+                live_tok += row.plen - row.clen
+                pages += nblk - row.clen // c.kv_block
+                if row.shard != shard:
+                    shard = -1
+            st = rows_b * w
+            a.record("tail_prefill" if is_tail else "prefill",
+                     self.kv_dtype, shard if self.dp > 1 else 0,
+                     rows_b, n, w, st, live_tok, st - live_tok,
+                     0, 0, 0, pages)
         if self.prefix is not None:
             # publish the completed prompts' full pages back: later
             # requests with the same prefix bind them instead of
@@ -951,6 +1009,8 @@ class ContinuousDecodeEngine:
                 if self.dp == 1:
                     return
                 continue
+            if row.req.t_bound is None:
+                row.req.t_bound = time.monotonic()
             self._slots[i] = row
             self._nlive += 1
 
@@ -1128,11 +1188,21 @@ class ContinuousDecodeEngine:
         self._pools = pools
         now = time.monotonic()
         emitted = 0
+        a = _attrib.active()
+        over_s = [0] * self.dp if a is not None else None
+        live_s = [0] * self.dp if a is not None else None
+        pages_s = [0] * self.dp if a is not None else None
+        lps = b // self.dp
         toks = toks.tolist()
         for j, i, row in placed:
             # a row completing mid-call discards its overshoot tokens
             # (their pool writes die with the row's pages)
             take = min(T, row.req.n_new - row.ntok)
+            if a is not None:
+                s = j // lps
+                over_s[s] += T - take
+                live_s[s] += 1
+                pages_s[s] += nblk
             self._emit(row, toks[j][:take], now)
             emitted += take
             if row.ntok >= row.req.n_new:
@@ -1140,6 +1210,18 @@ class ContinuousDecodeEngine:
                 self._nlive -= 1
                 self._row_done(row, now)
         self.stats.on_step(emitted, b * T - emitted)
+        if a is not None:
+            # one event per mesh shard (per rung x bucket x shard):
+            # each shard's lanes_per_shard x step_tokens slot-tokens
+            # split into emitted tokens (goodput), dummy lanes, and
+            # mid-step overshoot discarded past n_new
+            for s in range(self.dp):
+                st = lps * T
+                dummy = (lps - live_s[s]) * T
+                good = st - dummy - over_s[s]
+                a.record("decode", self.kv_dtype, s, lps, live_s[s],
+                         T, st, good, 0, dummy, over_s[s], 0,
+                         pages_s[s])
 
     def _loop(self) -> None:
         while True:

@@ -70,6 +70,7 @@ import numpy as np
 
 from ..analysis import hot_path
 from ..analysis import lockcheck as _lockcheck
+from ..obs import attrib as _attrib
 from ..obs import trace as _trace
 from ..obs.registry import Registry
 from .stats import ServeStats
@@ -484,6 +485,13 @@ class ServingEngine:
                                      labels=self.obs_labels),
             self.registry.add_hook(
                 lambda: g_q.set(self.queue_depth, **self.obs_labels)),
+            # goodput attribution export (obs/attrib.py): the hook
+            # reads the ACTIVE ledger per scrape, so attribution
+            # enabled after engine start still publishes here.
+            # Unlabeled deliberately — the ledger is process-global,
+            # and per-engine labels would replicate the same global
+            # numbers under every replica
+            _attrib.bind_registry(self.registry),
         ]
         self._seed = int(seed)
         self._ndispatch = 0
@@ -893,6 +901,7 @@ class ServingEngine:
                 self._put_buf(pend.bucket, pend.buf)
         self.stats.on_dispatch(len(pend.live),
                                min(pend.rows, pend.bucket), pend.bucket)
+        a = _attrib.active()
         if self.callee.kind == "decode":
             # wasted decode work made visible: every dispatched slot
             # runs the full exported decode loop whether a request
@@ -903,6 +912,19 @@ class ServingEngine:
             rows = min(pend.rows, pend.bucket)
             per = self.callee.max_new
             self.stats.on_step(rows * per, (pend.bucket - rows) * per)
+            if a is not None:
+                # monolithic decode: every bucket slot burns max_new
+                # slot-steps; empty slots are whole dummy lanes
+                a.record("decode_fixed", "fixed", 0, pend.bucket,
+                         rows, per, pend.bucket * per, rows * per,
+                         0, (pend.bucket - rows) * per, 0, 0, 0)
+        elif a is not None:
+            # forward batch: width 1 (one slot-token per row); rows
+            # padding the bucket past the live count are pad_fill
+            rows = min(pend.rows, pend.bucket)
+            a.record("forward", "fixed", 0, pend.bucket, rows, 1,
+                     pend.bucket, rows, pend.bucket - rows, 0, 0, 0,
+                     0)
         done = time.monotonic()
         lo = 0
         for r in pend.live:

@@ -105,6 +105,12 @@ class BlockPool:
         self._in_use = 0
         self.high_water = 0
         self.allocs = 0
+        # per-slice occupancy mirrors (r17): a balanced pool-global
+        # number can hide one slice pinned at its limit while the
+        # others idle — exactly the skew the sharded scheduler's
+        # pick_shard placement is supposed to prevent
+        self._in_use_shard = [0] * shards
+        self._peak_shard = [0] * shards
 
     def trash_page(self, shard: int = 0) -> int:
         """The reserved trash page of a shard slice (page 0 on the
@@ -198,6 +204,10 @@ class BlockPool:
             self._in_use += n
             self.allocs += 1
             self.high_water = max(self.high_water, self._in_use)
+            held = self._in_use_shard[shard] + n
+            self._in_use_shard[shard] = held
+            if held > self._peak_shard[shard]:
+                self._peak_shard[shard] = held
             return out
 
     def share(self, blocks: Sequence[int],
@@ -278,8 +288,10 @@ class BlockPool:
                     del self._ref[b]
                     del self._owners[b]
                     self._last_free[b] = label
-                    self._free[b // self.blocks_per_shard].append(b)
+                    s = b // self.blocks_per_shard
+                    self._free[s].append(b)
                     self._in_use -= 1
+                    self._in_use_shard[s] -= 1
 
     def free(self, blocks: Sequence[int],
              owner: Optional[str] = None) -> None:
@@ -317,10 +329,22 @@ class BlockPool:
                 "in_use": self._in_use,
                 "free": sum(len(f) for f in self._free),
                 "free_per_shard": [len(f) for f in self._free],
+                "in_use_per_shard": list(self._in_use_shard),
+                "peak_per_shard": list(self._peak_shard),
+                "shared_per_shard": self._shared_per_shard(),
                 "shared": sum(1 for r in self._ref.values() if r > 1),
                 "high_water": self.high_water,
                 "allocs": self.allocs,
             }
+
+    def _shared_per_shard(self) -> List[int]:
+        # caller holds self._lock
+        out = [0] * self.shards
+        bps = self.blocks_per_shard
+        for b, r in self._ref.items():
+            if r > 1:
+                out[b // bps] += 1
+        return out
 
     def bind_registry(self, registry, labels: Optional[dict] = None):
         """Register the pool's occupancy gauges on ``registry``:
@@ -331,8 +355,14 @@ class BlockPool:
         is what sizes a pool: docs/serving.md's guidance ("pages are
         cheap; a too-small pool silently degrades the scheduler to
         singleton prefills") is only checkable against a measured
-        peak. Returns the collection hook (pass it to
-        ``registry.remove_hook`` on close, the ServeStats
+        peak. Sharded pools additionally publish the same occupancy
+        numbers PER SLICE as ``cxxnet_kv_shard_pages_free`` /
+        ``_in_use`` / ``_peak`` / ``_shared`` under a ``shard`` label
+        (new names, not a label on the pool-global gauges: the
+        registry's get-or-create pins labelnames at first creation,
+        so re-declaring the global series with an extra label would
+        collide with any earlier binder). Returns the collection hook
+        (pass it to ``registry.remove_hook`` on close, the ServeStats
         .bind_registry convention)."""
         labels = dict(labels or {})
         g_live = registry.gauge(
@@ -349,9 +379,30 @@ class BlockPool:
             "(prefix-cache sharing)",
             tuple(labels))
 
+        shard_names = tuple(labels) + ("shard",)
+        gs_free = registry.gauge(
+            "cxxnet_kv_shard_pages_free",
+            "free paged KV pool pages per shard slice", shard_names)
+        gs_live = registry.gauge(
+            "cxxnet_kv_shard_pages_in_use",
+            "paged KV pool pages held per shard slice", shard_names)
+        gs_peak = registry.gauge(
+            "cxxnet_kv_shard_pages_peak",
+            "high-water mark of pages held per shard slice",
+            shard_names)
+        gs_shared = registry.gauge(
+            "cxxnet_kv_shard_pages_shared",
+            "multi-reference pages per shard slice", shard_names)
+
         def hook():
             snap = self.snapshot()
             g_live.set(snap["in_use"], **labels)
             g_peak.set(snap["high_water"], **labels)
             g_shared.set(snap["shared"], **labels)
+            for s in range(self.shards):
+                sl = dict(labels, shard=str(s))
+                gs_free.set(snap["free_per_shard"][s], **sl)
+                gs_live.set(snap["in_use_per_shard"][s], **sl)
+                gs_peak.set(snap["peak_per_shard"][s], **sl)
+                gs_shared.set(snap["shared_per_shard"][s], **sl)
         return registry.add_hook(hook)

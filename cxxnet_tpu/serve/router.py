@@ -42,6 +42,7 @@ from typing import Dict, Optional
 from ..analysis import hot_path
 from ..analysis import lockcheck as _lockcheck
 from ..metrics import StreamingQuantile
+from ..obs import attrib as _attrib
 from ..obs import trace as _trace
 from ..obs.registry import Registry
 from .engine import (DrainError, QueueFullError, RequestExpired,
@@ -105,13 +106,20 @@ class RouterRequest:
     __slots__ = ("router", "method", "args", "priority", "deadline",
                  "timeout_s", "seq", "id", "t_submit", "attempts",
                  "replica", "version", "_inner", "_state", "_outcome",
-                 "_lock")
+                 "_lock", "rows")
 
     def __init__(self, router: "Router", method: str, args: tuple,
                  priority: int, timeout_s: Optional[float]):
         self.router = router
         self.method = method
         self.args = args
+        # row count for retry attribution (obs/attrib.py): the router
+        # never sees the bucket an attempt dispatched at, so duplicate
+        # work is accounted in request-row units
+        try:
+            self.rows = int(len(args[0])) if args else 1
+        except TypeError:
+            self.rows = 1
         self.priority = priority
         self.timeout_s = timeout_s
         self.t_submit = time.monotonic()
@@ -566,6 +574,13 @@ class Router:
     def _retry_mark(self, tr, req: RouterRequest, rep, err,
                     failures: int) -> None:
         self._count("retries")
+        a = _attrib.active()
+        if a is not None:
+            # the failed attempt's work is being re-done elsewhere:
+            # all of it is retry_duplicate waste (row units — the
+            # router never learns the bucket the replica ran)
+            a.record("retry", "router", -1, req.rows, req.rows, 1,
+                     req.rows, 0, 0, 0, 0, req.rows, 0)
         if tr is not None:
             with tr.span("router.retry", "router",
                          {"request_id": req.id, "from": rep.name,

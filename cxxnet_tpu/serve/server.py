@@ -29,6 +29,12 @@ Endpoints:
                    Prometheus text exposition instead
   GET  /slo        current SLO objectives, burn rates, incident list
                    (obs/slo.py; 404 unless slo_p99_ms configured)
+  GET  /debug/attrib
+                   goodput attribution ledger summary (obs/attrib.py):
+                   per-phase slot-token totals, goodput / pad_fill /
+                   dummy_lane / overshoot / retry_duplicate fractions,
+                   top waste programs; {"enabled": false} when no
+                   ledger is armed
 
 Per-request observability (docs/observability.md): every admitted
 request carries an engine-assigned ``request_id``, echoed in the JSON
@@ -268,6 +274,19 @@ class ServeHandler(BaseHTTPRequestHandler):
             else:
                 self._send(400, {"error":
                                  "format must be json or prom"})
+        elif parts.path == "/debug/attrib":
+            # the goodput attribution ledger's waste taxonomy
+            # (obs/attrib.py; docs/observability.md): per-phase
+            # slot-token totals, goodput/waste fractions, and the
+            # window's worst programs. 200 + enabled:false when no
+            # ledger is armed — a scraper distinguishes "off" from
+            # "no traffic" without a status-code special case
+            from ..obs import attrib as _attrib
+            s = _attrib.summary()
+            body = {"enabled": s is not None}
+            if s is not None:
+                body.update(s)
+            self._send(200, body)
         else:
             self._send(404, {"error": "no such path %s" % parts.path})
 

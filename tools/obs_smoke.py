@@ -14,6 +14,14 @@ requests; every response must carry a request_id + timing breakdown,
 the access log must record every hit, and /metrics?format=prom must
 answer with the Prometheus content type.
 
+Leg 3 (attribution): the goodput attribution ledger (obs/attrib.py)
+runs armed across BOTH legs in the same process; after the serve leg
+the summary must carry events, a goodput_frac > 0, and a waste
+taxonomy that sums to 1.0, and the serve server's /debug/attrib
+endpoint must render the same summary. ``--attrib-out FILE`` writes
+the summary JSON (the committed docs artifact renders through
+tools/goodput_report.py --json).
+
 Then the trace is written and tools/trace_report.py must find >= 3
 non-empty thread lanes (decode worker, dev-prefetch producer, serve
 dispatch/completion, main loop) and >= 1 matched flow (a serving
@@ -21,7 +29,8 @@ request linked admission -> completion across threads). A watchdog
 hard-exits non-zero if anything wedges — CI-safe like feed_smoke.
 
 Usage: JAX_PLATFORMS=cpu python tools/obs_smoke.py \
-           [--timeout 300] [--trace-out obs_trace.json]
+           [--timeout 300] [--trace-out obs_trace.json] \
+           [--attrib-out goodput.json]
 """
 
 import argparse
@@ -179,6 +188,11 @@ def _serve_leg(tr):
         with ThreadPoolExecutor(4) as ex:
             ids = list(ex.map(fire, range(12)))
         assert len(set(ids)) == 12, "request ids not unique"
+        st, ct, body = _get(url + "/debug/attrib")
+        assert st == 200, st
+        dbg = json.loads(body)
+        assert dbg["enabled"] and dbg["events"] > 0, dbg
+        assert dbg["goodput_frac"] > 0, dbg
         st, ct, body = _get(url + "/metrics?format=prom")
         assert st == 200 and ct.startswith("text/plain; version=0.0.4")
         assert "cxxnet_serve_requests_total 12" in body.decode()
@@ -202,20 +216,44 @@ def main() -> int:
                     help="watchdog: hard-exit 2 after this many seconds")
     ap.add_argument("--trace-out", default="",
                     help="keep the trace file here (default: temp dir)")
+    ap.add_argument("--attrib-out", default="",
+                    help="write the attribution summary JSON here "
+                         "(tools/goodput_report.py --json renders it)")
     args = ap.parse_args()
     _watchdog(args.timeout)
     t0 = time.time()
 
-    from cxxnet_tpu.obs import trace as obs_trace
+    from cxxnet_tpu.obs import attrib, trace as obs_trace
     from tools.trace_report import load_events, report, _human
 
     with tempfile.TemporaryDirectory() as td:
         trace_path = args.trace_out or os.path.join(td, "obs_trace.json")
         obs_trace.start(trace_path)
+        attrib.enable()
         tr = _tiny_trainer()
         _train_leg(td, tr)
         _serve_leg(tr)
         obs_trace.stop()
+
+        # ---- attribution leg: both legs ran with the ledger armed;
+        # the serving dispatches must have produced a goodput number
+        # and an exactly-partitioned taxonomy
+        s = attrib.summary()
+        attrib.disable()
+        assert s is not None and s["events"] > 0, s
+        assert s["goodput_frac"] > 0, s
+        tax = s["goodput_frac"] + sum(s["waste_frac"].values())
+        assert abs(tax - 1.0) < 1e-9, \
+            "waste taxonomy sums to %r, not 1.0" % tax
+        print("attrib leg: %d events, %d slot-tokens, goodput %.1f%% "
+              "(pad_fill %.1f%%)"
+              % (s["events"], s["slot_tokens"],
+                 100 * s["goodput_frac"],
+                 100 * s["waste_frac"]["pad_fill"]))
+        if args.attrib_out:
+            with open(args.attrib_out, "w") as f:
+                json.dump(s, f, indent=1, sort_keys=True)
+            print("attribution summary kept at %s" % args.attrib_out)
 
         rep = report(load_events(trace_path))   # json.loads-able or dies
         print(_human(rep))

@@ -22,6 +22,15 @@ Usage:
 matching finish. tools/obs_smoke.py runs both assertions over its
 end-to-end artifact.
 
+``--phases`` rolls the span names up into serving phases (wait /
+prefill / decode / dispatch / admission / other, first marker wins)
+and prints each phase's total busy time as a fraction of the trace
+wall span — the trace-side view of the same question
+tools/goodput_report.py answers from the attribution ledger: where
+did the wall clock go. Nested spans double-count here exactly as in
+the lane table, so fractions are an upper bound per phase, not a
+partition.
+
 ``--check-spans`` is the runtime complement of the static OBS lint
 (analysis/lint.py OBS001): spans recorded by one thread must nest
 like a call stack — a span partially overlapping another on its own
@@ -40,6 +49,48 @@ import sys
 import time
 
 STALL_MARKERS = ("wait", "stall", "backpressure", ".get")
+
+# --phases rollup: first matching marker family names the phase.
+# "wait" is checked first — a span like decode.pool.wait is time
+# BLOCKED, not decode compute, whatever lane it sits on
+PHASE_MARKERS = (
+    ("wait", STALL_MARKERS),
+    ("prefill", ("prefill",)),
+    ("decode", ("decode", "sample", "step")),
+    ("dispatch", ("dispatch", "forward", "device")),
+    ("admission", ("admission", "admit", "submit")),
+)
+
+
+def span_phase(name):
+    """The phase bucket a span name rolls up into ("other" when no
+    marker family matches)."""
+    low = name.lower()
+    for phase, markers in PHASE_MARKERS:
+        if any(m in low for m in markers):
+            return phase
+    return "other"
+
+
+def phase_report(span_rows, wall_ms):
+    """Aggregate per-span rows (from :func:`report`) by phase; each
+    row carries the phase's busy total and its fraction of the trace
+    wall span."""
+    agg = {}
+    for s in span_rows:
+        p = span_phase(s["name"])
+        row = agg.setdefault(p, {"phase": p, "spans": 0, "count": 0,
+                                 "total_ms": 0.0})
+        row["spans"] += 1
+        row["count"] += s["count"]
+        row["total_ms"] += s["total_ms"]
+    out = []
+    for row in sorted(agg.values(), key=lambda r: -r["total_ms"]):
+        row["total_ms"] = round(row["total_ms"], 3)
+        row["wall_frac"] = round(row["total_ms"] / wall_ms, 4) \
+            if wall_ms > 0 else 0.0
+        out.append(row)
+    return out
 
 
 def load_events(path):
@@ -263,6 +314,10 @@ def main():
                          "exemplar request id; exit 2 on any failure")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as one JSON line")
+    ap.add_argument("--phases", action="store_true",
+                    help="roll span names up into serving phases "
+                         "(wait/prefill/decode/dispatch/admission) "
+                         "with per-phase wall-time fractions")
     ap.add_argument("--min-lanes", type=int, default=0,
                     help="exit 2 unless >= N lanes carry spans")
     ap.add_argument("--require-flow", action="store_true",
@@ -289,10 +344,19 @@ def main():
         return 0 if ok else 2
     events = load_events(args.trace)
     rep = report(events)
+    if args.phases:
+        rep["phases"] = phase_report(rep["spans"], rep["wall_ms"])
     if args.check_spans:
         chk = check_spans(events)
         rep["span_check"] = chk
     print(json.dumps(rep) if args.json else _human(rep))
+    if args.phases and not args.json:
+        print("phases (busy ms / fraction of wall):")
+        for p in rep["phases"]:
+            print("  %-12s %9.2f ms  %5.1f%%  (%d span names, "
+                  "%d events)"
+                  % (p["phase"], p["total_ms"],
+                     100.0 * p["wall_frac"], p["spans"], p["count"]))
     if args.check_spans:
         chk = rep["span_check"]
         if not args.json:
