@@ -931,6 +931,24 @@ def _attrib_on(capacity=65536):
         attrib.disable()
 
 
+@contextlib.contextmanager
+def _profile_on(capacity=65536):
+    """Install the program profiler (obs/profile.py) for a bench
+    window and GUARANTEE it uninstalls — same contract as
+    :func:`_attrib_on`; the serving benches run all three sinks armed
+    (flight + attrib + profile), production posture. Calibrates the
+    MFU peak EAGERLY: the measurement jit-compiles one matmul, so it
+    must land here — before the caller arms the jitcheck sentinel —
+    not inside a scrape during a measured window."""
+    from cxxnet_tpu.obs import profile
+    prof = profile.enable(capacity)
+    profile.calibrated_peak()
+    try:
+        yield prof
+    finally:
+        profile.disable()
+
+
 def _attrib_stanza(led, top=4):
     """The bench-ledger attribution stanza: lifetime taxonomy +
     per-phase breakdown + the worst waste sources. Fractions are
@@ -947,6 +965,41 @@ def _attrib_stanza(led, top=4):
         "per_phase": s["per_phase"],
         "top_waste": s["top_waste"],
     }
+
+
+def _profile_stanza(prof, top=12):
+    """The bench-ledger profile stanza (obs/profile.py summary, bench
+    subset): per-phase totals + the per-program table with wall-ms
+    medians, flops and MFU — the rows tools/perf_report.py's
+    regression gate compares run over run."""
+    s = prof.summary(top=top)
+    return {
+        "events": s["events"],
+        "wall_ms": round(s["wall_ms"], 3),
+        "flops": s["flops"],
+        "uncosted_events": s["uncosted_events"],
+        "peak_flops": s["peak_flops"],
+        "mfu": s["mfu"],
+        "per_phase": s["per_phase"],
+        "programs": s["programs"],
+        "uncosted": s["uncosted"],
+    }
+
+
+def _regression_gate(net):
+    """Run tools/perf_report.py --assert-no-regression against the
+    ledger entry just recorded — the self-gating contract: a bench
+    run that regressed past the noise-aware thresholds exits 2 AFTER
+    recording (the evidence lands in the ledger either way)."""
+    import subprocess
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "perf_report.py"),
+         "--assert-no-regression", "--net", net],
+        capture_output=True, text=True)
+    return {"ok": r.returncode == 0, "exit_code": r.returncode,
+            "detail": (r.stdout + r.stderr).strip()}
 
 
 # serve bench: shapes chosen so a full-batch forward costs visibly
@@ -1106,6 +1159,7 @@ def serve_main(args) -> None:
     shard_mon = shardcheck.enable()
     try:
         with _flight_on() as flight, _attrib_on() as attrib_led, \
+                _profile_on() as prof_led, \
                 tempfile.TemporaryDirectory() as td:
             tr = _serve_trainer(platform)
             fixed_path = os.path.join(td, "fixed.export")
@@ -1220,9 +1274,11 @@ def serve_main(args) -> None:
         "recompile_sentinel": sentinel,
         "shard_sentinel": shard_sentinel,
         "attrib": _attrib_stanza(attrib_led),
+        "profile": _profile_stanza(prof_led),
         "obs": best_obs,
     }
     best = _update_history(entry, net="serve", metric="rows_per_sec")
+    gate = _regression_gate("serve")
     if best_obs:
         # metric="timestamp": newest snapshot wins (see feed_main)
         _update_history(dict(best_obs, source="serve",
@@ -1290,9 +1346,17 @@ def serve_main(args) -> None:
                       "(dispatch stages inputs via serving.stage_host)"
                       "; transfers or reshards > 0 hard-fail before "
                       "recording anything",
+        "profile_mfu": entry["profile"]["mfu"],
+        "profile_note": "program profiler (obs/profile.py) armed for "
+                        "every window — per-program device-time + "
+                        "cost-model MFU in the bench ledger entry "
+                        "(tools/perf_report.py renders + gates it)",
+        "regression_gate": gate,
         "offered_load_sweep": sweep,
         "best_recorded": best,
     }))
+    if not gate["ok"]:
+        raise SystemExit(2)
 
 
 # chaos scenario bench: a smaller MLP than the serve bench (each of
@@ -1951,7 +2015,7 @@ def decode_main(args) -> None:
     jit_mon = jitcheck.enable()
     shard_mon = shardcheck.enable()
     try:
-        with _attrib_on() as attrib_led, \
+        with _attrib_on() as attrib_led, _profile_on() as prof_led, \
                 tempfile.TemporaryDirectory() as td:
             tr = _decode_lm_trainer(platform)
             mono_path = os.path.join(td, "dec_mono.export")
@@ -2210,11 +2274,13 @@ def decode_main(args) -> None:
         "recompile_sentinel": sentinel,
         "shard_sentinel": shard_sentinel,
         "attrib": _attrib_stanza(attrib_led),
+        "profile": _profile_stanza(prof_led),
         "windows": windows,
         "frontier": frontier,
     }
     best_rec = _update_history(entry, net="decode_serve",
                                metric="tok_per_sec")
+    gate = _regression_gate("decode_serve")
     print(json.dumps({
         "metric": "decode_serve_tok_per_sec",
         "value": entry["tok_per_sec"],
@@ -2265,9 +2331,13 @@ def decode_main(args) -> None:
                       "host transfers disallowed and its programs "
                       "registered for reshard attribution; transfers "
                       "or reshards > 0 hard-fail before recording",
+        "profile_mfu": entry["profile"]["mfu"],
+        "regression_gate": gate,
         "frontier": frontier,
         "best_recorded": best_rec,
     }))
+    if not gate["ok"]:
+        raise SystemExit(2)
 
 
 # sharded-serving bench (mode=shard): a small CONVNET rather than the
@@ -2404,6 +2474,7 @@ def shard_main(args) -> None:
     shard_mon = shardcheck.enable()
     try:
         with _flight_on() as flight, _attrib_on() as attrib_led, \
+                _profile_on() as prof_led, \
                 tempfile.TemporaryDirectory() as td:
             tr = _shard_conv_trainer(platform)
             single_path = os.path.join(td, "single.export")
@@ -2484,9 +2555,11 @@ def shard_main(args) -> None:
         "recompile_sentinel": sentinel,
         "shard_sentinel": shard_sentinel,
         "attrib": _attrib_stanza(attrib_led),
+        "profile": _profile_stanza(prof_led),
     }
     best_rec = _update_history(entry, net="shard",
                                metric="dp4_speedup")
+    gate = _regression_gate("shard")
     print(json.dumps({
         "metric": "shard_dp4_goodput_speedup",
         "value": dp4,
@@ -2514,8 +2587,12 @@ def shard_main(args) -> None:
                          "(dispatches stage into the artifacts' "
                          "declared shards); any violation hard-fails "
                          "before recording",
+        "profile_mfu": entry["profile"]["mfu"],
+        "regression_gate": gate,
         "best_recorded": best_rec,
     }))
+    if not gate["ok"]:
+        raise SystemExit(2)
 
 
 def scaling_main(args) -> None:

@@ -1633,6 +1633,19 @@ class ObsChecker(Checker):
         "cxxnet_attrib_waste_frac",
     }
 
+    # the closed cxxnet_profile_* series set (obs/profile.py
+    # bind_registry): same partition discipline as the attrib family —
+    # an unlisted series under the prefix is accounting the profiler
+    # does not define (OBS007)
+    PROFILE_SERIES = {
+        "cxxnet_profile_events_total",
+        "cxxnet_profile_wall_ms_total",
+        "cxxnet_profile_flops_total",
+        "cxxnet_profile_uncosted_events_total",
+        "cxxnet_profile_mfu",
+        "cxxnet_profile_peak_flops",
+    }
+
     def check(self, mod: Module) -> List[Finding]:
         if mod.path.endswith("obs/trace.py"):
             return []   # the tracer's own definitions
@@ -1731,6 +1744,14 @@ class ObsChecker(Checker):
                         "metric %r outside the closed cxxnet_attrib_* "
                         "series set — the waste taxonomy is a "
                         "partition; add the series to obs/attrib.py "
+                        "(and this set) or rename it" % name))
+                elif name.startswith("cxxnet_profile_") \
+                        and name not in self.PROFILE_SERIES:
+                    findings.append(Finding(
+                        "OBS007", mod.path, node.lineno, qual,
+                        "metric %r outside the closed cxxnet_profile_* "
+                        "series set — the profiler's accounting is a "
+                        "partition; add the series to obs/profile.py "
                         "(and this set) or rename it" % name))
             labels = None
             if len(node.args) >= 3:

@@ -318,6 +318,99 @@ def uniform_heads_or_reason(net, p):
     return nh, e // nh
 
 
+def program_cost(net, p, kind: str, rows: int = 0, width: int = 0,
+                 bucket: int = 0, step_tokens: int = 1,
+                 attend_slots: int = 0, ctx_width: int = 0,
+                 max_new: int = 0, prompt_slots: int = 0,
+                 kv_bytes: float = 0.0) -> dict:
+    """Analytic ``{"flops", "bytes"}`` of ONE invocation of an
+    exported serving program — the serving half of the train-side
+    ``Network.analytic_model_flops`` (same MFU basis: matmul-dominant
+    terms, causal attention at the useful half, elementwise ignored;
+    layer formulas mirror ``TransformerStackLayer.analytic_flops`` and
+    ``ops/flash_attention.analytic_flops``). obs/profile.py joins
+    these numbers against measured dispatch wall.
+
+    Kinds (the ``export_decode_step`` / ``export_generate`` program
+    vocabulary):
+
+    * ``prefill``       (rows, width) causal pass + head at one
+                        position per row
+    * ``tail_prefill``  (rows, width) tail attending ``ctx_width``
+                        cached context slots on top of its own causal
+                        triangle
+    * ``step``          (bucket, step_tokens) decode step, every
+                        query attending ``attend_slots`` cache slots
+    * ``decode_fixed``  the monolithic generate program: a
+                        ``prompt_slots``-wide prefill plus ``max_new``
+                        steps over a growing cache (average width
+                        charged — the honest mean, not the max)
+
+    ``bytes`` is a STREAMING LOWER BOUND: every weight read once per
+    pass (``step_tokens`` passes for the step loop, ``1 + max_new``
+    for the monolithic decoder) plus the native-dtype K/V the program
+    writes; ``kv_bytes`` adds the rung-dependent cache traffic the
+    caller computes from the artifact's rung table (pool dtype and
+    scale planes are the exporter's knowledge, not the graph's)."""
+    emb = net.modules[p["embed"]]
+    e = emb.param.num_hidden
+    V = emb.vocab_size
+    stacks = [(net.modules[i].nlayer,
+               net.modules[i].nhidden_mlp or 4 * e)
+              for i in p["stacks"]]
+    Ltot = sum(nl for nl, _ in stacks)
+    sz = jnp.dtype(net.compute_dtype).itemsize
+    # per-token per-layer matmul flops: qkv (2*e*3e) + wo (2*e*e)
+    # projections plus the 2-matmul MLP (2*e*m each way)
+    proj_tok = sum(nl * (8.0 * e * e + 4.0 * e * m)
+                   for nl, m in stacks)
+    # weights one pass streams: wqkv + wo + w1 + w2 + norms, + head
+    w_bytes = sz * (sum(nl * (4.0 * e * e + 2.0 * e * m + 2.0 * e)
+                        for nl, m in stacks) + float(V) * e)
+    head_row = 2.0 * e * V              # logits at ONE position
+    if kind == "prefill":
+        toks = float(rows) * width
+        flops = proj_tok * toks \
+            + sum(nl * 2.0 * rows * width * width * e
+                  for nl, _ in stacks) \
+            + head_row * rows
+        nbytes = w_bytes + 2.0 * Ltot * toks * e * sz + kv_bytes
+    elif kind == "tail_prefill":
+        toks = float(rows) * width
+        flops = proj_tok * toks \
+            + sum(nl * (2.0 * rows * width * width * e
+                        + 4.0 * rows * width * ctx_width * e)
+                  for nl, _ in stacks) \
+            + head_row * rows
+        nbytes = w_bytes + 2.0 * Ltot * toks * e * sz + kv_bytes
+    elif kind == "step":
+        toks = float(bucket) * step_tokens
+        flops = proj_tok * toks \
+            + sum(nl * 4.0 * toks * attend_slots * e
+                  for nl, _ in stacks) \
+            + head_row * toks
+        nbytes = w_bytes * step_tokens + kv_bytes
+    elif kind == "decode_fixed":
+        B, P = float(bucket), float(prompt_slots)
+        pre = proj_tok * B * P \
+            + sum(nl * 2.0 * B * P * P * e for nl, _ in stacks) \
+            + head_row * B
+        # step i attends P + i + 1 slots; the sum over max_new steps
+        # is max_new * (P + (max_new + 1)/2) — charge the exact mean
+        avg_sl = P + (max_new + 1) / 2.0
+        steps = proj_tok * B * max_new \
+            + sum(nl * 4.0 * B * max_new * avg_sl * e
+                  for nl, _ in stacks) \
+            + head_row * B * max_new
+        flops = pre + steps
+        nbytes = w_bytes * (1.0 + max_new) \
+            + 2.0 * Ltot * B * P * e * sz \
+            + 2.0 * Ltot * B * avg_sl * e * sz * max_new + kv_bytes
+    else:
+        raise ValueError("unknown program kind %r" % (kind,))
+    return {"flops": flops, "bytes": nbytes}
+
+
 def build_prefill(net, p, temperature: float, B: int, W: int,
                   platform: str = "cpu"):
     """Build the jitted PREFILL half of the split decode:

@@ -18,6 +18,10 @@ Endpoints:
   GET /debug/attrib          goodput attribution summary from the
                              obs/attrib.py ledger ({"enabled": false}
                              when the ledger is off)
+  GET /debug/profile         program-profiler summary from the
+                             obs/profile.py ledger — per-program wall
+                             medians, MFU, uncosted list — same
+                             {"enabled": false} contract
 
 Stdlib-only (ThreadingHTTPServer) like serve/server.py; one daemon
 thread, silent request logging. Device memory also publishes as the
@@ -108,6 +112,15 @@ class _TelemetryHandler(BaseHTTPRequestHandler):
         if parts.path == "/debug/attrib":
             from . import attrib as _attrib
             s = _attrib.summary()
+            body = {"enabled": s is not None}
+            if s is not None:
+                body.update(s)
+            self._send(200, json.dumps(body).encode("utf-8"),
+                       "application/json")
+            return
+        if parts.path == "/debug/profile":
+            from . import profile as _profile
+            s = _profile.summary()
             body = {"enabled": s is not None}
             if s is not None:
                 body.update(s)

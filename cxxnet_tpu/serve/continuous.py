@@ -64,6 +64,7 @@ import numpy as np
 from ..analysis import hot_path
 from ..analysis import lockcheck as _lockcheck
 from ..obs import attrib as _attrib
+from ..obs import profile as _profile
 from ..obs import trace as _trace
 from ..obs.registry import Registry
 from .engine import (DrainError, QueueFullError, Request, RequestExpired,
@@ -135,14 +136,17 @@ class StreamRequest(Request):
         done = self.t_done
         bound = self.t_bound if self.t_bound is not None \
             else (done if done is not None else None)
-        t["phases"] = {
-            "queue_ms": ms(self.t_submit, self.t_prefill_start),
-            "prefill_ms": ms(self.t_prefill_start, self.t_first),
-            "ready_wait_ms": ms(self.t_first, bound),
-            "decode_ms": ms(bound, done),
-            "stream_ms": (None if done is None else
-                          round(1000.0 * (time.monotonic() - done), 3)),
-        }
+        # keys derive from the SHARED phase vocabulary
+        # (obs/profile.py REQUEST_PHASES): trace_report --phases and
+        # the profiler's request-phase joins need no mapping table
+        vals = (ms(self.t_submit, self.t_prefill_start),
+                ms(self.t_prefill_start, self.t_first),
+                ms(self.t_first, bound),
+                ms(bound, done),
+                (None if done is None else
+                 round(1000.0 * (time.monotonic() - done), 3)))
+        t["phases"] = {"%s_ms" % p: v
+                       for p, v in zip(_profile.REQUEST_PHASES, vals)}
         return t
 
 
@@ -397,7 +401,18 @@ class ContinuousDecodeEngine:
             # and stamping per-engine labels would replicate the same
             # global numbers under every replica
             _attrib.bind_registry(self.registry),
+            # program-profiler export (obs/profile.py): same contract
+            _profile.bind_registry(self.registry),
         ]
+        # join the artifact's exported program shapes against the
+        # analytic cost model (per-shard step costs when dp > 1):
+        # registered into the module-level table so a profiler
+        # enabled after engine start still costs them
+        try:
+            _profile.register_costs(
+                decoder.profile_costs(dp=self.dp))
+        except Exception:
+            pass
         if self.prefix is not None:
             self._registry_hooks.append(
                 self.prefix.bind_registry(self.registry,
@@ -957,6 +972,21 @@ class ContinuousDecodeEngine:
                      self.kv_dtype, shard if self.dp > 1 else 0,
                      rows_b, n, w, st, live_tok, st - live_tok,
                      0, 0, 0, pages)
+        pr = _profile.active()
+        if pr is not None:
+            # continuous-site profile event: prefill dispatch ->
+            # scattered K/V wall of the (rows, width) program. The
+            # shard column mirrors the attrib convention (-1 when not
+            # sharded or when the batch spans shards)
+            shard = take[0].shard
+            for row in take:
+                if row.shard != shard:
+                    shard = -1
+            pr.record("continuous",
+                      "tail_prefill" if is_tail else "prefill",
+                      self.kv_dtype, c.pick_rows(n), w,
+                      shard if self.dp > 1 else -1,
+                      (time.monotonic() - t_pf0) * 1000.0)
         if self.prefix is not None:
             # publish the completed prompts' full pages back: later
             # requests with the same prefix bind them instead of
@@ -1156,6 +1186,7 @@ class ContinuousDecodeEngine:
         self._nstep += 1
         self._bucket_steps[b] = self._bucket_steps.get(b, 0) + 1
         T = c.step_tokens
+        t_dec0 = time.monotonic()
         try:
             if self.step_hook is not None:
                 self.step_hook()
@@ -1222,6 +1253,16 @@ class ContinuousDecodeEngine:
                 a.record("decode", self.kv_dtype, s, lps, live_s[s],
                          T, st, good, 0, dummy, over_s[s], 0,
                          pages_s[s])
+        pr = _profile.active()
+        if pr is not None:
+            # continuous-site profile event: step submit -> sampled
+            # tokens materialized. One event per mesh shard (the cost
+            # table registers per-shard step costs, flops/dp), same
+            # wall for each — the shards run one SPMD program
+            wall = (now - t_dec0) * 1000.0
+            for s in range(self.dp):
+                pr.record("continuous", "decode", self.kv_dtype,
+                          lps, T, s if self.dp > 1 else -1, wall)
 
     def _loop(self) -> None:
         while True:

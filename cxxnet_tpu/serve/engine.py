@@ -71,6 +71,7 @@ import numpy as np
 from ..analysis import hot_path
 from ..analysis import lockcheck as _lockcheck
 from ..obs import attrib as _attrib
+from ..obs import profile as _profile
 from ..obs import trace as _trace
 from ..obs.registry import Registry
 from .stats import ServeStats
@@ -365,14 +366,18 @@ class _Pending:
     the completion thread: the un-materialized device output, the
     requests it answers, and the input buffer to recycle."""
 
-    __slots__ = ("out", "live", "rows", "bucket", "buf")
+    __slots__ = ("out", "live", "rows", "bucket", "buf", "t0")
 
-    def __init__(self, out, live, rows, bucket, buf):
+    def __init__(self, out, live, rows, bucket, buf, t0=0.0):
         self.out = out
         self.live = live
         self.rows = rows
         self.bucket = bucket
         self.buf = buf
+        # submit stamp for the program profiler: wall from dispatch
+        # submit to output materialization (includes inflight-queue
+        # wait under pipelining — an upper bound on device time)
+        self.t0 = t0
 
 
 # ----------------------------------------------------------------------
@@ -492,7 +497,20 @@ class ServingEngine:
             # and per-engine labels would replicate the same global
             # numbers under every replica
             _attrib.bind_registry(self.registry),
+            # program-profiler export (obs/profile.py): same contract
+            _profile.bind_registry(self.registry),
         ]
+        # join this callee's exported program shapes against the
+        # analytic cost model: registered into the module-level table
+        # so a profiler enabled after engine start still costs them
+        # (a live-Trainer callee has no export meta — its events land
+        # in the profiler's explicit uncosted list)
+        pc = getattr(callee, "profile_costs", None)
+        if pc is not None:
+            try:
+                _profile.register_costs(pc())
+            except Exception:
+                pass
         self._seed = int(seed)
         self._ndispatch = 0
         self._warmup_on_start = bool(warmup)
@@ -818,6 +836,7 @@ class ServingEngine:
         if rows > self.batch:
             # one oversize request (coalescing is capped at max_batch
             # <= batch): the callee chunks it itself, synchronously
+            t_sub = time.monotonic()
             try:
                 if self.fault_hook is not None:
                     self.fault_hook()
@@ -843,10 +862,12 @@ class ServingEngine:
             t_infer = time.monotonic()
             for r in live:
                 r.t_infer = t_infer
-            pend = _Pending(out, live, rows, self.batch, None)
+            pend = _Pending(out, live, rows, self.batch, None,
+                            t0=t_sub)
         else:
             bucket = self._pick_bucket(rows)
             buf = self._get_buf(bucket)
+            t_sub = time.monotonic()
             try:
                 if self.fault_hook is not None:
                     self.fault_hook()
@@ -869,7 +890,7 @@ class ServingEngine:
             t_infer = time.monotonic()
             for r in live:
                 r.t_infer = t_infer
-            pend = _Pending(out, live, rows, bucket, buf)
+            pend = _Pending(out, live, rows, bucket, buf, t0=t_sub)
         if self._inflight is not None:
             # hand the pending device result to the completion thread;
             # blocks once dispatch_depth batches are in flight — the
@@ -926,6 +947,17 @@ class ServingEngine:
                      pend.bucket, rows, pend.bucket - rows, 0, 0, 0,
                      0)
         done = time.monotonic()
+        pr = _profile.active()
+        if pr is not None:
+            # engine-site profile event: dispatch submit -> output
+            # materialized (under pipelining this includes inflight-
+            # queue wait — an upper bound on per-program device time)
+            phase = ("decode_fixed" if self.callee.kind == "decode"
+                     else "forward")
+            width = (self.callee.max_new
+                     if self.callee.kind == "decode" else 1)
+            pr.record("engine", phase, "fixed", pend.bucket, width,
+                      -1, (done - pend.t0) * 1000.0)
         lo = 0
         for r in pend.live:
             r.t_done = done

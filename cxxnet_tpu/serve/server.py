@@ -287,6 +287,18 @@ class ServeHandler(BaseHTTPRequestHandler):
             if s is not None:
                 body.update(s)
             self._send(200, body)
+        elif parts.path == "/debug/profile":
+            # the program profiler's per-program device-time x cost-
+            # model view (obs/profile.py; docs/observability.md):
+            # per-phase totals, per-program wall medians + MFU, the
+            # bottom-N MFU shapes, and the explicit uncosted list.
+            # Same enabled:false contract as /debug/attrib
+            from ..obs import profile as _profile
+            s = _profile.summary()
+            body = {"enabled": s is not None}
+            if s is not None:
+                body.update(s)
+            self._send(200, body)
         else:
             self._send(404, {"error": "no such path %s" % parts.path})
 

@@ -17,9 +17,12 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Optional, Sequence
 
 import numpy as np
+
+from .obs import profile as _profile
 
 MAGIC = "cxxnet_tpu.export.v1"
 
@@ -254,6 +257,92 @@ def _norm_ladder(batch_ladder, batch_size) -> list:
     return ladder
 
 
+def _xla_cost(jf, *args) -> Optional[dict]:
+    """XLA's own cost estimate of one program: ``lower().
+    cost_analysis()`` -> {"flops", "bytes"} or None. Recorded into
+    artifact meta at export time as the CROSS-CHECK beside the
+    analytic numbers, never as the MFU basis — XLA undercounts two
+    shapes this tree verifiably hits (a ``lax.scan`` body counts once
+    regardless of trip count, a Pallas kernel counts zero; see
+    Trainer.step_cost_analysis) and some backends only report at the
+    executable level, where compiling every exported program twice is
+    not worth a cross-check. Pure best-effort: any failure is None."""
+    try:
+        ca = dict(jf.lower(*args).cost_analysis() or {})
+    except Exception:
+        return None
+    out = {}
+    if ca.get("flops") is not None:
+        out["flops"] = float(ca["flops"])
+    if ca.get("bytes accessed") is not None:
+        out["bytes"] = float(ca["bytes accessed"])
+    return out or None
+
+
+def _params_bytes(params) -> float:
+    """Total serialized-weight bytes of a params pytree — the
+    weight-streaming term of the cost model's bytes lower bound."""
+    import jax
+    tot = 0
+    for leaf in jax.tree.leaves(params):
+        if hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            tot += int(leaf.size) * np.dtype(leaf.dtype).itemsize
+    return float(tot)
+
+
+def profile_cost_table(meta: Optional[dict], dp: int = 1) -> dict:
+    """obs/profile.py cost entries for a loaded artifact's meta:
+    ``(site, phase, rung, bucket, width) -> (flops, bytes)``, keyed
+    exactly the way the serving engines record profile events
+    (docs/observability.md). Artifacts exported before the cost model
+    carry no cost fields and yield an empty table — their events
+    surface in the profiler's explicit ``uncosted`` list.
+
+    ``dp`` is the engine's data-parallel degree: the continuous
+    engine records ONE decode event per mesh shard (bucket = lanes
+    per shard), so step costs register per-shard, divided by dp."""
+    meta = meta or {}
+    dp = max(int(dp), 1)
+    table: dict = {}
+    kind = meta.get("kind")
+    if kind == "generate_step":
+        T = int(meta.get("step_tokens", 1))
+        kvds = meta.get("kv_dtypes") or ["native"]
+        for pr in meta.get("programs") or []:
+            f = pr.get("flops")
+            if f is None:
+                continue
+            by = pr.get("bytes_streamed")
+            if pr["kind"] == "prefill":
+                # prefill programs are rung-agnostic (shared across
+                # kv rungs) but the engine records them under the
+                # rung it serves — register every rung's key
+                for kvd in kvds:
+                    table[("continuous", "prefill", kvd,
+                           int(pr["rows"]), int(pr["width"]))] = (f, by)
+            elif pr["kind"] == "tail_prefill":
+                table[("continuous", "tail_prefill",
+                       str(pr["kv_dtype"]), int(pr["rows"]),
+                       int(pr["width"]))] = (f, by)
+            elif pr["kind"] == "step":
+                lps = int(pr["batch"]) // dp
+                table[("continuous", "decode", str(pr["kv_dtype"]),
+                       lps, T)] = (f / dp,
+                                   None if by is None else by / dp)
+    elif kind == "generate":
+        per = int(meta.get("max_new", 1))
+        for pr in meta.get("program_costs") or []:
+            table[("engine", "decode_fixed", "fixed",
+                   int(pr["bucket"]), per)] = (pr["flops"],
+                                               pr.get("bytes_streamed"))
+    else:
+        for pr in meta.get("program_costs") or []:
+            table[("engine", "forward", "fixed",
+                   int(pr["bucket"]), 1)] = (pr["flops"],
+                                             pr.get("bytes_streamed"))
+    return table
+
+
 def export_model(trainer, path: str,
                  batch_size: Optional[int] = None,
                  batch_ladder: Optional[Sequence[int]] = None,
@@ -334,6 +423,15 @@ def export_model(trainer, path: str,
     # host memory by the ladder length
     sizes = []
     in_specs = out_specs = None
+    # serving cost model (obs/profile.py): analytic forward flops per
+    # bucket — the train-side MFU basis (Network.analytic_model_flops)
+    # scaled to the bucket's batch — plus the weight-stream bytes
+    # lower bound, with XLA's own estimate as the recorded cross-check
+    cfg_b = int(net.node_shapes[0][0]) or 1
+    fwd_flops = net.analytic_model_flops(train=False)["fwd"]
+    w_bytes = _params_bytes(params)
+    item_bytes = float(np.prod(item)) * np.dtype(in_dtype).itemsize
+    prog_costs = []
     with open(path, "wb") as f:
         for b in ladder:
             if mesh is not None:
@@ -346,12 +444,19 @@ def export_model(trainer, path: str,
                              out_shardings=out_sh)
             else:
                 jf = jax.jit(forward)
+            sds = jax.ShapeDtypeStruct((b,) + item, in_dtype)
             blob = jexport.export(
-                jf, platforms=list(platforms))(
-                    jax.ShapeDtypeStruct((b,) + item,
-                                         in_dtype)).serialize()
+                jf, platforms=list(platforms))(sds).serialize()
             f.write(blob)
             sizes.append(len(blob))
+            cost = {"kind": "forward", "bucket": b,
+                    "flops": fwd_flops * b / cfg_b,
+                    "bytes_streamed": w_bytes + b * item_bytes}
+            xc = _xla_cost(jf, sds)
+            if xc:
+                cost["xla_flops"] = xc.get("flops")
+                cost["xla_bytes"] = xc.get("bytes")
+            prog_costs.append(cost)
     out_shape = tuple(net.node_shapes[net.out_node])
     meta = {
         "magic": MAGIC,
@@ -359,6 +464,7 @@ def export_model(trainer, path: str,
         "input_dtype": np.dtype(in_dtype).name,
         "output_shape": [bs] + list(out_shape[1:]),
         "platforms": list(platforms),
+        "program_costs": prog_costs,
     }
     if mesh is not None:
         meta["mesh"] = mesh_meta(mesh)
@@ -455,7 +561,7 @@ def export_generate(trainer, path: str, max_new: int = 32,
         gen_in = (data_sh, data_sh, repl_sh)
         in_specs = [_spec_to_json(s.spec) for s in gen_in]
         out_specs = [_spec_to_json(data_sh.spec)]
-    sizes, resolved = [], []
+    sizes, resolved, prog_costs = [], [], []
     with open(path, "wb") as f:
         for b in ladder:
             # layout/kv re-resolve per rung: kernel feasibility (slotk
@@ -473,15 +579,28 @@ def export_generate(trainer, path: str, max_new: int = 32,
                              out_shardings=data_sh)
             else:
                 jf = jax.jit(decode)
+            sds = (jax.ShapeDtypeStruct((b, S), np.int32),
+                   jax.ShapeDtypeStruct((b,), np.int32),
+                   jax.ShapeDtypeStruct((2,), np.uint32))
             # write rung by rung (see export_model): no whole-ladder
             # blob list resident at once
             blob = jexport.export(
-                jf, platforms=list(platforms))(
-                    jax.ShapeDtypeStruct((b, S), np.int32),
-                    jax.ShapeDtypeStruct((b,), np.int32),
-                    jax.ShapeDtypeStruct((2,), np.uint32)).serialize()
+                jf, platforms=list(platforms))(*sds).serialize()
             f.write(blob)
             sizes.append(len(blob))
+            # serving cost model (obs/profile.py): analytic flops of
+            # one whole prefill + max_new-step decode at this rung,
+            # XLA's estimate as the recorded cross-check
+            cost = dict(G.program_cost(net, plan, "decode_fixed",
+                                       bucket=b, max_new=max_new,
+                                       prompt_slots=P),
+                        kind="decode_fixed", bucket=b)
+            xc = _xla_cost(jf, *sds)
+            if xc:
+                cost["xla_flops"] = xc.get("flops")
+                cost["xla_bytes"] = xc.get("bytes")
+            cost["bytes_streamed"] = cost.pop("bytes")
+            prog_costs.append(cost)
     meta = {
         "magic": MAGIC,
         "kind": "generate",
@@ -493,6 +612,7 @@ def export_generate(trainer, path: str, max_new: int = 32,
         # depends on B) and are listed per rung below
         "decode_layout": resolved[-1][0], "decode_kv": resolved[-1][1],
         "platforms": list(platforms),
+        "program_costs": prog_costs,
     }
     if mesh is not None:
         meta["mesh"] = mesh_meta(mesh)
@@ -838,13 +958,23 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                 jpre = jax.jit(pre, in_shardings=pre_in,
                                out_shardings=pre_out) \
                     if mesh is not None else jax.jit(pre)
+                pre_sds = (SDS((r, w), np.int32), SDS((r,), np.int32),
+                           SDS((2,), np.uint32))
                 blob = jexport.export(
                     jpre, platforms=list(platforms))(
-                        SDS((r, w), np.int32), SDS((r,), np.int32),
-                        SDS((2,), np.uint32)).serialize()
+                        *pre_sds).serialize()
                 f.write(blob)
-                programs.append({"kind": "prefill", "rows": r,
-                                 "width": w, "bytes": len(blob)})
+                pc = G.program_cost(net, plan, "prefill", rows=r,
+                                    width=w)
+                entry = {"kind": "prefill", "rows": r,
+                         "width": w, "bytes": len(blob),
+                         "flops": pc["flops"],
+                         "bytes_streamed": pc["bytes"]}
+                xc = _xla_cost(jpre, *pre_sds)
+                if xc:
+                    entry["xla_flops"] = xc.get("flops")
+                    entry["xla_bytes"] = xc.get("bytes")
+                programs.append(entry)
         for kvd in kv_dtypes:
             if kvd == "int8":
                 pool_args = [SDS(pool_shape, np.int8),
@@ -854,6 +984,12 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
             else:
                 pool_args = [SDS(pool_shape, pool_dt),
                              SDS(pool_shape, pool_dt)]
+            # per-slot cache-stream bytes of this rung (K + V pages
+            # plus the int8 scale planes) — the kv term of the cost
+            # model's bytes lower bound AND the rung table below
+            isz = 1 if kvd == "int8" else pool_dt.itemsize
+            ssz = 4 if kvd == "int8" else 0
+            slot_kv = 2.0 * Ltot * nh * Sp * (d * isz + ssz)
             donate = tuple(range(len(pool_args)))
             if mesh is not None:
                 step_in = tuple([data_sh] * len(pool_args)) \
@@ -889,16 +1025,27 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                                    out_shardings=step_out)
                 else:
                     jstp = jax.jit(stp, donate_argnums=donate)
+                stp_sds = tuple(pool_args) + (
+                    SDS((b, nblk), np.int32), SDS((b,), np.int32),
+                    SDS((b,), np.int32), SDS((b,), np.int32),
+                    SDS((2,), np.uint32))
                 blob = jexport.export(
                     jstp,
-                    platforms=list(platforms))(
-                        *pool_args,
-                        SDS((b, nblk), np.int32), SDS((b,), np.int32),
-                        SDS((b,), np.int32), SDS((b,), np.int32),
-                        SDS((2,), np.uint32)).serialize()
+                    platforms=list(platforms))(*stp_sds).serialize()
                 f.write(blob)
-                programs.append({"kind": "step", "kv_dtype": kvd,
-                                 "batch": b, "bytes": len(blob)})
+                pc = G.program_cost(
+                    net, plan, "step", bucket=b,
+                    step_tokens=step_tokens, attend_slots=Sl,
+                    kv_bytes=b * step_tokens * slot_kv)
+                entry = {"kind": "step", "kv_dtype": kvd,
+                         "batch": b, "bytes": len(blob),
+                         "flops": pc["flops"],
+                         "bytes_streamed": pc["bytes"]}
+                xc = _xla_cost(jstp, *stp_sds)
+                if xc:
+                    entry["xla_flops"] = xc.get("flops")
+                    entry["xla_bytes"] = xc.get("bytes")
+                programs.append(entry)
             for w in tail_widths:
                 for r in rows:
                     fn = G.build_tail_prefill(
@@ -915,19 +1062,31 @@ def export_decode_step(trainer, path: str, max_new: int = 32,
                     jtp = jax.jit(tpre, in_shardings=tail_in,
                                   out_shardings=pre_out) \
                         if mesh is not None else jax.jit(tpre)
+                    tp_sds = tuple(pool_args) + (
+                        SDS((r, w), np.int32), SDS((r,), np.int32),
+                        SDS((r,), np.int32),
+                        SDS((r, nblk), np.int32),
+                        SDS((2,), np.uint32))
                     blob = jexport.export(
                         jtp, platforms=list(platforms))(
-                            *pool_args,
-                            SDS((r, w), np.int32), SDS((r,), np.int32),
-                            SDS((r,), np.int32),
-                            SDS((r, nblk), np.int32),
-                            SDS((2,), np.uint32)).serialize()
+                            *tp_sds).serialize()
                     f.write(blob)
-                    programs.append({"kind": "tail_prefill",
-                                     "kv_dtype": kvd, "rows": r,
-                                     "width": w, "bytes": len(blob)})
-            isz = 1 if kvd == "int8" else pool_dt.itemsize
-            ssz = 4 if kvd == "int8" else 0
+                    Wc = ctx_blocks * kv_block
+                    pc = G.program_cost(
+                        net, plan, "tail_prefill", rows=r, width=w,
+                        ctx_width=Wc,
+                        kv_bytes=r * 2.0 * Ltot * nh * Wc
+                        * (d * isz + ssz))
+                    entry = {"kind": "tail_prefill",
+                             "kv_dtype": kvd, "rows": r,
+                             "width": w, "bytes": len(blob),
+                             "flops": pc["flops"],
+                             "bytes_streamed": pc["bytes"]}
+                    xc = _xla_cost(jtp, *tp_sds)
+                    if xc:
+                        entry["xla_flops"] = xc.get("flops")
+                        entry["xla_bytes"] = xc.get("bytes")
+                    programs.append(entry)
             rungs.append({
                 "kv_dtype": kvd,
                 "attend_kernel": attend_kernel_name(paged_attend, kvd),
@@ -1120,6 +1279,13 @@ class ExportedStepDecoder:
         """Smallest exported step bucket holding ``n`` live rows."""
         return _pick_bucket(self.step_buckets(kv), n)
 
+    def profile_costs(self, dp: int = 1) -> dict:
+        """Per-program analytic cost table for the program profiler
+        (``obs/profile.py``), keyed by the (site, phase, rung, bucket,
+        width) shapes the continuous engine records. ``dp`` divides
+        the step flops across mesh shards (per-shard events)."""
+        return profile_cost_table(self.meta, dp=dp)
+
     def rung(self, kv: str = "native") -> dict:
         """The rung's meta row (attend kernel, pool/scale dtypes,
         kv_bytes_per_step / kv_bytes_per_seq); synthesized for
@@ -1220,8 +1386,19 @@ class ExportedStepDecoder:
             inner = _shardcheck.make_sharded(
                 exp.call, in_shardings=in_sh, site=site, always=True)
 
-            def fn(*a, _inner=inner, _sh=in_sh):
-                return _inner(*stage_host(*a, shardings=_sh))
+            def fn(*a, _inner=inner, _sh=in_sh, _kv=kv,
+                   _r=int(rows), _w=int(width)):
+                pr = _profile.active()
+                if pr is None:
+                    return _inner(*stage_host(*a, shardings=_sh))
+                # decoder-site profile event: submit-side wall of the
+                # program call (async dispatch — NOT device time;
+                # obs/profile.py module docstring)
+                t0 = time.monotonic()
+                out = _inner(*stage_host(*a, shardings=_sh))
+                pr.record("decoder", "tail_prefill", _kv, _r, _w, -1,
+                          (time.monotonic() - t0) * 1000.0)
+                return out
 
             fn.__name__ = "staged[%s]" % site
             fn.__wrapped__ = inner
@@ -1324,8 +1501,19 @@ class ExportedStepDecoder:
             inner = _shardcheck.make_sharded(
                 exp.call, in_shardings=in_sh, site=site, always=True)
 
-            def fn(*a, _inner=inner, _sh=in_sh):
-                return _inner(*stage_host(*a, shardings=_sh))
+            def fn(*a, _inner=inner, _sh=in_sh,
+                   _r=int(rows), _w=int(width)):
+                pr = _profile.active()
+                if pr is None:
+                    return _inner(*stage_host(*a, shardings=_sh))
+                # decoder-site profile event (submit-side wall; the
+                # "any" rung: prefill programs are shared across kv
+                # rungs, so no single rung label applies)
+                t0 = time.monotonic()
+                out = _inner(*stage_host(*a, shardings=_sh))
+                pr.record("decoder", "prefill", "any", _r, _w, -1,
+                          (time.monotonic() - t0) * 1000.0)
+                return out
 
             fn.__name__ = "staged[%s]" % site
             fn.__wrapped__ = inner
@@ -1409,13 +1597,27 @@ class ExportedStepDecoder:
             inner = _shardcheck.make_sharded(
                 inner, in_shardings=in_sh, site=site, always=True)
 
-            def fn(*a, _inner=inner, _sh=in_sh):
+            stepw = int(self.meta.get("step_tokens", 1))
+
+            def fn(*a, _inner=inner, _sh=in_sh, _kv=kv,
+                   _b=int(bucket), _t=stepw):
                 # per-call control arrays (block table, lens, step,
                 # last, key) arrive as host numpy: stage them
                 # explicitly — into their declared shards on a mesh —
                 # so armed steady state pays no implicit transfer
                 # (the pool buffers pass through untouched)
-                return _inner(*stage_host(*a, shardings=_sh))
+                pr = _profile.active()
+                if pr is None:
+                    return _inner(*stage_host(*a, shardings=_sh))
+                # decoder-site profile event: submit-side wall only —
+                # the step program is async (no host sync), so this
+                # is dispatch cost, not device time; uncosted by
+                # design (obs/profile.py docstring)
+                t0 = time.monotonic()
+                out = _inner(*stage_host(*a, shardings=_sh))
+                pr.record("decoder", "decode", _kv, _b, _t, -1,
+                          (time.monotonic() - t0) * 1000.0)
+                return out
 
             fn.__name__ = "staged[%s]" % site
             fn.__wrapped__ = inner
@@ -1747,6 +1949,11 @@ class ExportedDecoder:
     def buckets(self) -> list:
         return sorted(self._exps)
 
+    def profile_costs(self) -> dict:
+        """Per-program analytic cost table for the program profiler
+        (``obs/profile.py``): decode_fixed per exported bucket."""
+        return profile_cost_table(self.meta)
+
     def _bucket_call(self, b: int):
         # mesh-qualified site: the sentinel's per-program counts keep
         # a dp artifact's programs distinct from the single-device
@@ -1894,6 +2101,11 @@ class ExportedModel:
     def buckets(self) -> Optional[list]:
         """Sorted exported batch sizes; None for a meta-less blob."""
         return sorted(self._exps) if self._exps else None
+
+    def profile_costs(self) -> dict:
+        """Per-program analytic cost table for the program profiler
+        (``obs/profile.py``): forward per exported bucket."""
+        return profile_cost_table(self.meta)
 
     def call_exact(self, data: np.ndarray):
         """Run the bucket matching ``data.shape[0]`` exactly — no pad,

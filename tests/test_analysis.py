@@ -754,6 +754,29 @@ def test_obs_label_cardinality():
     assert rules(ok) == []
 
 
+def test_obs007_closed_profile_series():
+    # trigger: a series under the cxxnet_profile_ prefix that
+    # obs/profile.py's bind_registry does not define
+    bad = """
+    def f(reg):
+        reg.counter("cxxnet_profile_bogus_total", "x")
+    """
+    assert rules(bad) == ["OBS007"]
+    # near misses: every declared family member, and a non-profile
+    # prefix, stay clean (OBS005's closed-set discipline, mirrored)
+    ok = """
+    def f(reg):
+        reg.counter("cxxnet_profile_events_total", "x")
+        reg.counter("cxxnet_profile_wall_ms_total", "x")
+        reg.counter("cxxnet_profile_flops_total", "x")
+        reg.counter("cxxnet_profile_uncosted_events_total", "x")
+        reg.gauge("cxxnet_profile_mfu", "x")
+        reg.gauge("cxxnet_profile_peak_flops", "x")
+        reg.counter("cxxnet_profiler_adjacent_total", "x")
+    """
+    assert rules(ok) == []
+
+
 # ----------------------------------------------------------------------
 # gate + waivers
 

@@ -456,8 +456,9 @@ def _fake_history(tmp_path, goodput=0.8):
 
 def test_goodput_report_reads_newest_attrib_run(tmp_path):
     path = _fake_history(tmp_path)
-    s, src = load_history(path)
+    s, src, prof = load_history(path)
     assert s["goodput_frac"] == 0.8 and "net=serve" in src
+    assert prof is None  # fixture run carries no profile stanza
     assert abs(taxonomy_sum(s) - 1.0) < 1e-9
 
 

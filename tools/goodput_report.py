@@ -66,7 +66,10 @@ def load_json(path):
 
 
 def load_history(path):
-    """Newest run in the bench ledger carrying an ``attrib`` stanza."""
+    """Newest run in the bench ledger carrying an ``attrib`` stanza.
+    When the same run also carries a ``profile`` stanza
+    (obs/profile.py), it rides along so the report can join the two
+    ledgers (the device_time column)."""
     with open(path) as f:
         doc = json.load(f)
     runs = doc.get("runs", []) if isinstance(doc, dict) else doc
@@ -74,7 +77,9 @@ def load_history(path):
         if isinstance(run, dict) and isinstance(run.get("attrib"), dict):
             src = "%s (net=%s, %s)" % (path, run.get("net"),
                                        run.get("timestamp", "?")[:19])
-            return run["attrib"], src
+            prof = run.get("profile")
+            return run["attrib"], src, \
+                prof if isinstance(prof, dict) else None
     raise SystemExit("goodput_report: no run in %s carries an attrib "
                      "stanza — run `python bench.py serve` first" % path)
 
@@ -84,7 +89,7 @@ def taxonomy_sum(s):
         s.get("waste_frac", {}).get(k, 0.0) for k in WASTE_KINDS)
 
 
-def human(s, source):
+def human(s, source, profile=None):
     out = ["goodput attribution — %s" % source]
     slot = s.get("slot_tokens", 0)
     out.append("  %d events, %d slot-tokens dispatched"
@@ -96,16 +101,35 @@ def human(s, source):
     for kind in WASTE_KINDS:
         out.append("  %-16s %6.2f%%" % (kind, 100.0 * wf.get(kind, 0.0)))
     pp = s.get("per_phase", {})
+    # device_time join (obs/profile.py): when a profile stanza from
+    # the same bench run is present, each phase's attributed goodput
+    # tokens meet its profiled wall-ms — tokens/s and ms/token per
+    # phase, the two ledgers rendered as one table
+    prof_pp = (profile or {}).get("per_phase", {})
     if pp:
         out.append("per phase:")
-        out.append("  %-14s %8s %14s %14s %9s" %
-                   ("phase", "events", "slot_tokens", "goodput", "frac"))
+        hdr = "  %-14s %8s %14s %14s %9s" % \
+              ("phase", "events", "slot_tokens", "goodput", "frac")
+        if prof_pp:
+            hdr += " %12s %10s %10s" % ("device_time", "tok/s",
+                                        "ms/tok")
+        out.append(hdr)
         for p in sorted(pp):
             t = pp[p]
-            out.append("  %-14s %8d %14d %14d %8.2f%%"
-                       % (p, t.get("events", 0), t.get("slot_tokens", 0),
-                          t.get("goodput_tokens", 0),
-                          100.0 * t.get("goodput_frac", 0.0)))
+            line = "  %-14s %8d %14d %14d %8.2f%%" \
+                % (p, t.get("events", 0), t.get("slot_tokens", 0),
+                   t.get("goodput_tokens", 0),
+                   100.0 * t.get("goodput_frac", 0.0))
+            if prof_pp:
+                w = prof_pp.get(p, {}).get("wall_ms")
+                good = t.get("goodput_tokens", 0)
+                if w:
+                    line += " %10.1fms %10.1f %10.4f" \
+                        % (w, good / (w * 1e-3),
+                           w / good if good else float("inf"))
+                else:
+                    line += " %12s %10s %10s" % ("-", "-", "-")
+            out.append(line)
     top = s.get("top_waste", [])
     if top:
         out.append("top waste sources (ring window, by wasted tokens):")
@@ -138,13 +162,15 @@ def main():
                     help="exit 2 unless goodput + waste fractions sum "
                          "to 1.0")
     args = ap.parse_args()
+    profile = None
     if args.url:
         s, source = load_url(args.url)
     elif args.json_path:
         s, source = load_json(args.json_path)
     else:
-        s, source = load_history(args.history)
-    print(json.dumps(s) if args.json_out else human(s, source))
+        s, source, profile = load_history(args.history)
+    print(json.dumps(s) if args.json_out
+          else human(s, source, profile=profile))
     rc = 0
     if args.assert_taxonomy:
         total = taxonomy_sum(s)

@@ -22,6 +22,18 @@ endpoint must render the same summary. ``--attrib-out FILE`` writes
 the summary JSON (the committed docs artifact renders through
 tools/goodput_report.py --json).
 
+Leg 4 (profile): the program profiler (obs/profile.py) runs armed
+beside the attribution ledger across the same legs, with the device
+peak calibrated up front. The live-trainer engine's events are
+UNCOSTED (no export meta — they must appear in the explicit uncosted
+list); an export_model sub-leg then serves the exported artifact so
+COSTED events exist, and the summary must show events > 0, every
+program either costed or listed uncosted, MFU in (0, 1] on every
+costed row, and the serve server's /debug/profile endpoint must
+render the same summary. ``--profile-out FILE`` writes the summary
+JSON (committed as docs/profile_smoke.json;
+tools/perf_report.py --json renders it).
+
 Then the trace is written and tools/trace_report.py must find >= 3
 non-empty thread lanes (decode worker, dev-prefetch producer, serve
 dispatch/completion, main loop) and >= 1 matched flow (a serving
@@ -193,6 +205,10 @@ def _serve_leg(tr):
         dbg = json.loads(body)
         assert dbg["enabled"] and dbg["events"] > 0, dbg
         assert dbg["goodput_frac"] > 0, dbg
+        st, ct, body = _get(url + "/debug/profile")
+        assert st == 200, st
+        dbg = json.loads(body)
+        assert dbg["enabled"] and dbg["events"] > 0, dbg
         st, ct, body = _get(url + "/metrics?format=prom")
         assert st == 200 and ct.startswith("text/plain; version=0.0.4")
         assert "cxxnet_serve_requests_total 12" in body.decode()
@@ -210,6 +226,61 @@ def _serve_leg(tr):
           "%d access-log records" % len(access))
 
 
+def _profile_leg(tr, td):
+    """Serve an EXPORTED artifact so costed profile events exist: the
+    export records analytic flops per bucket, the engine registers the
+    cost table at init, and every engine-site event joins it."""
+    import numpy as np
+    from cxxnet_tpu import serving
+    from cxxnet_tpu.serve import ServingEngine
+
+    path = os.path.join(td, "smoke.export")
+    serving.export_model(tr, path, platforms=["cpu"])
+    model = serving.load_exported(path)
+    assert model.meta.get("program_costs"), \
+        "export_model recorded no program_costs meta"
+    eng = ServingEngine(model, max_wait_ms=0, queue_limit=64,
+                        warmup=True)
+    rs = np.random.RandomState(1)
+    data = rs.randn(2, 3, 32, 32).astype(np.float32)
+    try:
+        for _ in range(8):
+            eng.submit(data).result(timeout=60)
+    finally:
+        eng.close()
+    print("profile leg: 8 exported-model dispatches (costed)")
+
+
+def _check_profile(s, profile_out=""):
+    """The profile-leg assertions: events flowed, every program is
+    costed or explicitly uncosted, costed MFU is sane, and the costed
+    set is non-empty (the export sub-leg worked)."""
+    assert s is not None and s["events"] > 0, s
+    uncosted = set(s["uncosted"])
+    ncosted = 0
+    for d in s["programs"]:
+        if d["costed"]:
+            ncosted += 1
+            assert d["program"] not in uncosted, d
+            mfu = d["mfu"]
+            if mfu is not None:
+                assert 0.0 < mfu <= 1.0, \
+                    "MFU %r outside (0, 1] for %s" % (mfu, d["program"])
+        else:
+            assert d["program"] in uncosted, \
+                "%s neither costed nor listed uncosted" % d["program"]
+    assert ncosted > 0, \
+        "no costed program events — the export sub-leg recorded none"
+    print("profile leg: %d events over %d programs (%d costed, %d "
+          "uncosted), peak %s FLOP/s"
+          % (s["events"], len(s["programs"]), ncosted, len(uncosted),
+             "%.3g" % s["peak_flops"] if s["peak_flops"] else "?"))
+    if profile_out:
+        with open(profile_out, "w") as f:
+            json.dump(s, f, indent=1, sort_keys=True)
+        print("profile summary kept at %s" % profile_out)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--timeout", type=int, default=300,
@@ -219,20 +290,30 @@ def main() -> int:
     ap.add_argument("--attrib-out", default="",
                     help="write the attribution summary JSON here "
                          "(tools/goodput_report.py --json renders it)")
+    ap.add_argument("--profile-out", default="",
+                    help="write the profiler summary JSON here "
+                         "(tools/perf_report.py --json renders it; "
+                         "committed as docs/profile_smoke.json)")
     args = ap.parse_args()
     _watchdog(args.timeout)
     t0 = time.time()
 
-    from cxxnet_tpu.obs import attrib, trace as obs_trace
+    from cxxnet_tpu.obs import attrib, profile, trace as obs_trace
     from tools.trace_report import load_events, report, _human
 
     with tempfile.TemporaryDirectory() as td:
         trace_path = args.trace_out or os.path.join(td, "obs_trace.json")
         obs_trace.start(trace_path)
         attrib.enable()
+        profile.enable()
+        # calibrate the MFU denominator up front — the measurement
+        # jit-compiles one matmul, which must not land inside an armed
+        # jitcheck window (none here, but the bench discipline holds)
+        profile.calibrated_peak()
         tr = _tiny_trainer()
         _train_leg(td, tr)
         _serve_leg(tr)
+        _profile_leg(tr, td)
         obs_trace.stop()
 
         # ---- attribution leg: both legs ran with the ledger armed;
@@ -254,6 +335,13 @@ def main() -> int:
             with open(args.attrib_out, "w") as f:
                 json.dump(s, f, indent=1, sort_keys=True)
             print("attribution summary kept at %s" % args.attrib_out)
+
+        # ---- profile leg: the profiler ran armed across the same
+        # legs; engine events over the live trainer are uncosted, the
+        # exported sub-leg's are costed with MFU in (0, 1]
+        ps = profile.summary(top=64)
+        profile.disable()
+        _check_profile(ps, args.profile_out)
 
         rep = report(load_events(trace_path))   # json.loads-able or dies
         print(_human(rep))

@@ -48,6 +48,22 @@ import os
 import sys
 import time
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the per-REQUEST phase vocabulary shared with obs/profile.py,
+# serve/continuous.py StreamRequest.timing() ("<phase>_ms" keys) and
+# /debug/attrib's per-phase tables: one set of names, so this report's
+# rollup joins those views without a mapping table. Re-exported from
+# the canonical constant when the package is importable (the literal
+# fallback keeps this tool stdlib-runnable; a tier-1 test pins the two
+# tuples equal so they cannot drift)
+try:
+    sys.path.insert(0, REPO)
+    from cxxnet_tpu.obs.profile import REQUEST_PHASES
+except Exception:
+    REQUEST_PHASES = ("queue", "prefill", "ready_wait", "decode",
+                      "stream")
+
 STALL_MARKERS = ("wait", "stall", "backpressure", ".get")
 
 # --phases rollup: first matching marker family names the phase.
@@ -357,6 +373,9 @@ def main():
                   "%d events)"
                   % (p["phase"], p["total_ms"],
                      100.0 * p["wall_frac"], p["spans"], p["count"]))
+        print("  (per-request timing() and /debug/attrib phase keys "
+              "share the %s vocabulary — join directly)"
+              % "/".join(REQUEST_PHASES))
     if args.check_spans:
         chk = rep["span_check"]
         if not args.json:
